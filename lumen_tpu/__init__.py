@@ -1,0 +1,28 @@
+"""lumen-tpu: a TPU-native ML inference framework.
+
+A from-scratch rebuild of the capabilities of EdwinZhanCN/Lumen (a local-first
+photo-indexing inference microservice suite: CLIP embedding / zero-shot
+classification, face detection + recognition, OCR, and VLM captioning behind a
+shared gRPC streaming protocol) — re-designed for TPU hardware:
+
+- Compute is Flax modules compiled by XLA (bf16 matmuls on the MXU), not ONNX
+  graph sessions (reference execution layer:
+  ``packages/lumen-clip/src/lumen_clip/backends/onnxrt_backend.py``).
+- Throughput comes from a micro-batching runtime with static shape buckets
+  (the reference serves one payload per request).
+- Scale-out uses ``jax.sharding.Mesh`` + XLA collectives over ICI/DCN rather
+  than per-process model replicas.
+
+Subpackages
+-----------
+core      config / resources / result schemas (reference: lumen-resources)
+runtime   mesh + dtype policy + batching queue + weight loading
+ops       jnp/Pallas kernels: attention, NMS, CTC, image ops
+parallel  sharding rules, ring attention, multi-host init
+models    flax model families: clip, face, ocr, vlm
+serving   gRPC wire protocol, task registry, hub router, servers
+app       control plane (REST + WS log streaming)
+utils     logging etc.
+"""
+
+__version__ = "0.1.0"

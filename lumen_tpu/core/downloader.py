@@ -1,0 +1,199 @@
+"""Config-driven model-artifact downloader with integrity validation.
+
+Covers the responsibilities of the reference's
+``lumen_resources/downloader.py:61-513``:
+
+- iterate every enabled service x model in a :class:`LumenConfig`,
+- build runtime/precision-aware ``allow_patterns`` so only the needed
+  artifacts are fetched,
+- fetch declared zero-shot dataset files (labels JSON + ``.npy`` label
+  embeddings) in a second phase,
+- validate the downloaded tree against the repo's ``model_info.json``
+  (including rknn-style per-device file dicts),
+- roll the model directory back on failure so a later retry starts clean.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import os
+import shutil
+from dataclasses import dataclass, field
+
+from .config import LumenConfig, ModelConfig
+from .exceptions import DownloadError, ResourceError
+from .model_info import ModelInfo, load_model_info
+from .platform import Platform
+
+logger = logging.getLogger(__name__)
+
+# Patterns always fetched: manifest, tokenizer + model configs.
+_COMMON_PATTERNS = [
+    "model_info.json",
+    "*config*.json",
+    "tokenizer*",
+    "*.txt",
+    "*.yaml",
+]
+
+
+@dataclass
+class DownloadResult:
+    service: str
+    alias: str
+    model: str
+    ok: bool
+    path: str | None = None
+    error: str | None = None
+
+
+@dataclass
+class DownloadReport:
+    results: list[DownloadResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+    def failures(self) -> list[DownloadResult]:
+        return [r for r in self.results if not r.ok]
+
+
+def allow_patterns_for(model_cfg: ModelConfig) -> list[str]:
+    """Runtime/precision-aware filter for a snapshot download.
+
+    Mirrors the selection semantics of the reference
+    (``downloader.py:179-251``): onnx fetches ``*.{precision}.onnx`` (or all
+    ``*.onnx`` when unspecified), torch fetches safetensors/bin checkpoints,
+    rknn fetches the per-device subtree. The native ``jax`` runtime fetches
+    safetensors (+ orbax checkpoint dirs).
+    """
+    patterns = list(_COMMON_PATTERNS)
+    rt = model_cfg.runtime
+    if rt == "jax":
+        patterns += ["*.safetensors", "*.safetensors.index.json", "orbax/*", "jax/*", "*.bin", "*.pt"]
+    elif rt == "torch":
+        patterns += ["*.safetensors", "*.bin", "*.pt"]
+    elif rt == "onnx":
+        if model_cfg.precision:
+            patterns += [f"onnx/*.{model_cfg.precision}.onnx", f"*.{model_cfg.precision}.onnx"]
+        patterns += ["onnx/*.onnx", "*.onnx"] if not model_cfg.precision else []
+    elif rt == "rknn":
+        patterns += [f"rknn/{model_cfg.rknn_device}/*"]
+    return patterns
+
+
+class Downloader:
+    def __init__(self, config: LumenConfig):
+        self.config = config
+        self.platform = Platform(config.metadata.region, config.metadata.cache_dir)
+
+    # -- public API -------------------------------------------------------
+
+    def download_all(self) -> DownloadReport:
+        """Download every model of every enabled service; never raises —
+        failures are reported per model (callers decide whether to abort,
+        as the reference hub does at ``src/lumen/server.py:168-175``)."""
+        report = DownloadReport()
+        for svc_name, svc in self.config.enabled_services().items():
+            for alias, model_cfg in svc.models.items():
+                report.results.append(self._download_one(svc_name, alias, model_cfg))
+        return report
+
+    # -- internals --------------------------------------------------------
+
+    def _download_one(self, svc: str, alias: str, model_cfg: ModelConfig) -> DownloadResult:
+        res = DownloadResult(service=svc, alias=alias, model=model_cfg.model, ok=False)
+        # Remember whether this model pre-existed: rollback must never
+        # destroy a cached copy we did not just (re)download.
+        was_cached = self.platform.is_cached(model_cfg.model)
+        try:
+            path = self.platform.download(
+                model_cfg.model, allow_patterns=allow_patterns_for(model_cfg)
+            )
+            info = load_model_info(path)
+            self._download_datasets(path, info, model_cfg)
+            self.validate_files(path, info, model_cfg)
+            res.ok, res.path = True, path
+        except ResourceError as e:
+            logger.error("download failed for %s/%s: %s", svc, alias, e)
+            if not was_cached:
+                self.cleanup_model(model_cfg.model)
+            res.error = str(e)
+        return res
+
+    def _download_datasets(self, path: str, info: ModelInfo, model_cfg: ModelConfig) -> None:
+        """Phase two: fetch dataset files named in model_info (relative
+        paths), only for the dataset the config selects."""
+        if not model_cfg.dataset or not info.datasets:
+            return
+        ds = info.datasets.get(model_cfg.dataset)
+        if ds is None:
+            raise DownloadError(
+                f"dataset {model_cfg.dataset!r} not declared by model {info.name!r}",
+                repo_id=model_cfg.model,
+            )
+        missing = [p for p in (ds.labels, ds.embeddings) if not os.path.exists(os.path.join(path, p))]
+        if missing:
+            # update=True: the model dir already exists from phase one, so a
+            # plain download() would be a cache-hit no-op.
+            self.platform.download(model_cfg.model, allow_patterns=missing, update=True)
+
+    def _resolve_runtime_entry(self, info: ModelInfo, model_cfg: ModelConfig):
+        """Runtime entry to validate against; ``jax`` falls back to the
+        ``torch`` entry (safetensors/bin checkpoints get converted to jnp
+        pytrees at load time)."""
+        entry = info.runtimes.get(model_cfg.runtime)
+        if entry is not None and entry.available:
+            return entry
+        if model_cfg.runtime == "jax":
+            torch_entry = info.runtimes.get("torch")
+            if torch_entry is not None and torch_entry.available:
+                return torch_entry
+        raise DownloadError(
+            f"runtime {model_cfg.runtime!r} not available in model_info for {info.name!r}",
+            repo_id=model_cfg.model,
+        )
+
+    def validate_files(self, path: str, info: ModelInfo, model_cfg: ModelConfig) -> None:
+        """Post-download integrity check against model_info's declared file
+        list for the configured runtime (reference: ``downloader.py:449-513``)."""
+        entry = self._resolve_runtime_entry(info, model_cfg)
+        device = model_cfg.rknn_device
+        declared = entry.files_for(device) if entry.files else []
+        missing: list[str] = []
+        for rel in declared:
+            rel_resolved = rel.format(precision=model_cfg.precision or "fp32")
+            if "*" in rel_resolved:
+                hits = [
+                    os.path.join(dp, f)
+                    for dp, _, fs in os.walk(path)
+                    for f in fs
+                    if fnmatch.fnmatch(os.path.relpath(os.path.join(dp, f), path), rel_resolved)
+                ]
+                if not hits:
+                    missing.append(rel_resolved)
+            elif not os.path.exists(os.path.join(path, rel_resolved)):
+                missing.append(rel_resolved)
+        if missing:
+            raise DownloadError(
+                f"model {info.name!r} is missing declared files: {missing}",
+                repo_id=model_cfg.model,
+            )
+        if model_cfg.dataset and info.datasets:
+            ds = info.datasets.get(model_cfg.dataset)
+            if ds:
+                for rel in (ds.labels, ds.embeddings):
+                    if not os.path.exists(os.path.join(path, rel)):
+                        raise DownloadError(
+                            f"dataset file missing after download: {rel}",
+                            repo_id=model_cfg.model,
+                        )
+
+    def cleanup_model(self, repo_name: str) -> None:
+        """Rollback: remove a partially-downloaded model directory."""
+        d = self.platform.local_dir(repo_name)
+        if os.path.isdir(d):
+            logger.warning("cleaning up partial download at %s", d)
+            shutil.rmtree(d, ignore_errors=True)

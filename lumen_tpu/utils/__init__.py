@@ -1,0 +1,3 @@
+from .logger import setup_logging, get_logger
+
+__all__ = ["setup_logging", "get_logger"]

@@ -1,0 +1,48 @@
+"""Shared logging setup.
+
+One configuration point for every entry script (the reference duplicates a
+colorlog setup in each package's ``server.py``; here it lives once). Colour
+is ANSI-only (no colorlog dependency) and disabled on non-TTY outputs.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_COLORS = {
+    logging.DEBUG: "\x1b[36m",
+    logging.INFO: "\x1b[32m",
+    logging.WARNING: "\x1b[33m",
+    logging.ERROR: "\x1b[31m",
+    logging.CRITICAL: "\x1b[1;31m",
+}
+_RESET = "\x1b[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        color = _COLORS.get(record.levelno)
+        if color and sys.stderr.isatty():
+            return f"{color}{base}{_RESET}"
+        return base
+
+
+def setup_logging(level: str = "INFO") -> None:
+    root = logging.getLogger()
+    root.setLevel(level.upper())
+    # Idempotent: replace our handler if already installed.
+    for h in list(root.handlers):
+        if getattr(h, "_lumen_tpu", False):
+            root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    handler._lumen_tpu = True  # type: ignore[attr-defined]
+    handler.setFormatter(
+        _ColorFormatter("%(asctime)s %(levelname)-8s %(name)s: %(message)s", "%H:%M:%S")
+    )
+    root.addHandler(handler)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+All tests run on CPU with a simulated 8-device mesh so that multi-chip
+sharding logic (DP/TP/SP over a ``jax.sharding.Mesh``) is exercised without
+TPU hardware, mirroring the strategy described in SURVEY.md §4.
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Repo root on sys.path so `import lumen_tpu` works without installation.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

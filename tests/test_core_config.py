@@ -1,0 +1,129 @@
+"""Core config-layer tests (offline, no jax needed)."""
+
+import pytest
+
+from lumen_tpu.core.config import (
+    LumenConfig,
+    load_config,
+    validate_config_dict,
+)
+from lumen_tpu.core.exceptions import ConfigError
+
+
+def make_raw(mode="hub", **over):
+    raw = {
+        "metadata": {"version": "1.0.0", "region": "other", "cache_dir": "~/.lumen/models"},
+        "deployment": {"mode": mode, "services": ["clip"]}
+        if mode == "hub"
+        else {"mode": "single", "service": "clip"},
+        "server": {"port": 50051, "host": "0.0.0.0"},
+        "services": {
+            "clip": {
+                "enabled": True,
+                "package": "lumen_tpu.models.clip",
+                "import_info": {
+                    "registry_class": "lumen_tpu.serving.services.clip.ClipService",
+                },
+                "backend_settings": {"batch_size": 16, "dtype": "bfloat16"},
+                "models": {
+                    "clip": {"model": "ViT-B-32", "runtime": "jax", "dataset": "ImageNet_1k"}
+                },
+            }
+        },
+    }
+    raw.update(over)
+    return raw
+
+
+class TestConfigValidation:
+    def test_valid_hub_config(self):
+        cfg = validate_config_dict(make_raw())
+        assert cfg.deployment.mode == "hub"
+        assert list(cfg.enabled_services()) == ["clip"]
+        assert cfg.services["clip"].models["clip"].runtime == "jax"
+
+    def test_valid_single_config(self):
+        cfg = validate_config_dict(make_raw(mode="single"))
+        assert cfg.deployment.service == "clip"
+
+    def test_single_mode_requires_service(self):
+        raw = make_raw()
+        raw["deployment"] = {"mode": "single"}
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_hub_mode_requires_services(self):
+        raw = make_raw()
+        raw["deployment"] = {"mode": "hub"}
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_deployment_must_reference_defined_services(self):
+        raw = make_raw()
+        raw["deployment"]["services"] = ["clip", "nope"]
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_rknn_requires_device(self):
+        raw = make_raw()
+        raw["services"]["clip"]["models"]["clip"] = {"model": "x", "runtime": "rknn"}
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_port_range_enforced(self):
+        raw = make_raw()
+        raw["server"]["port"] = 80
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_unknown_top_level_key_rejected(self):
+        raw = make_raw()
+        raw["bogus"] = 1
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_reference_onnx_settings_accepted(self):
+        # Reference config files carry onnx_providers / device; they must load.
+        raw = make_raw()
+        raw["services"]["clip"]["backend_settings"] = {
+            "device": "cuda",
+            "batch_size": 8,
+            "onnx_providers": ["CPUExecutionProvider"],
+        }
+        cfg = validate_config_dict(raw)
+        assert cfg.services["clip"].backend_settings.batch_size == 8
+
+    def test_mesh_axes_validation(self):
+        raw = make_raw()
+        raw["services"]["clip"]["backend_settings"] = {"mesh": {"axes": {"data": -1, "model": 2}}}
+        cfg = validate_config_dict(raw)
+        assert cfg.services["clip"].backend_settings.mesh.axes["model"] == 2
+        raw["services"]["clip"]["backend_settings"] = {"mesh": {"axes": {"data": -1, "model": -1}}}
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)
+
+    def test_enabled_services_filters_disabled(self):
+        raw = make_raw()
+        raw["services"]["clip"]["enabled"] = False
+        cfg = validate_config_dict(raw)
+        assert cfg.enabled_services() == {}
+
+
+class TestConfigLoading:
+    def test_load_yaml_roundtrip(self, tmp_path):
+        import yaml
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text(yaml.safe_dump(make_raw()))
+        cfg = load_config(str(p))
+        assert isinstance(cfg, LumenConfig)
+
+    def test_missing_file(self):
+        with pytest.raises(ConfigError):
+            load_config("/nonexistent/cfg.yaml")
+
+    def test_invalid_yaml(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("metadata: [unclosed")
+        with pytest.raises(ConfigError):
+            load_config(str(p))

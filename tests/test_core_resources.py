@@ -1,0 +1,267 @@
+"""Resource layer tests: model_info manifests, result schemas, downloader
+pattern/validation logic. All offline (no hub SDK calls)."""
+
+import json
+
+import pytest
+
+from lumen_tpu.core.config import ModelConfig
+from lumen_tpu.core.downloader import Downloader, allow_patterns_for
+from lumen_tpu.core.exceptions import DownloadError, ModelInfoError, ValidationError
+from lumen_tpu.core.model_info import ModelInfo, load_model_info
+from lumen_tpu.core.result_schemas import (
+    EmbeddingV1,
+    FaceV1,
+    OCRV1,
+    TextGenerationV1,
+    validate_result,
+)
+
+
+def make_model_info(**over):
+    raw = {
+        "name": "ViT-B-32",
+        "version": "1.0.0",
+        "description": "CLIP base model",
+        "model_type": "clip",
+        "embedding_dim": 512,
+        "source": {"format": "huggingface", "repo_id": "LumilioPhotos/ViT-B-32"},
+        "runtimes": {
+            "jax": {"available": True, "files": ["model.safetensors"]},
+            "onnx": {"available": True, "files": ["onnx/vision.fp32.onnx"]},
+            "rknn": {
+                "available": True,
+                "files": {"rk3588": ["rknn/rk3588/vision.rknn"]},
+                "devices": ["rk3588"],
+            },
+        },
+        "datasets": {
+            "ImageNet_1k": {
+                "labels": "datasets/imagenet/labels.json",
+                "embeddings": "datasets/imagenet/embeddings.npy",
+            }
+        },
+    }
+    raw.update(over)
+    return raw
+
+
+class TestModelInfo:
+    def test_valid_manifest(self, tmp_path):
+        (tmp_path / "model_info.json").write_text(json.dumps(make_model_info()))
+        info = load_model_info(str(tmp_path))
+        assert info.embedding_dim == 512
+        assert info.runtime("jax").files_for() == ["model.safetensors"]
+
+    def test_per_device_files(self, tmp_path):
+        (tmp_path / "model_info.json").write_text(json.dumps(make_model_info()))
+        info = load_model_info(str(tmp_path))
+        assert info.runtime("rknn").files_for("rk3588") == ["rknn/rk3588/vision.rknn"]
+        with pytest.raises(ModelInfoError):
+            info.runtime("rknn").files_for("rk9999")
+        with pytest.raises(ModelInfoError):
+            info.runtime("rknn").files_for(None)
+
+    def test_unavailable_runtime_raises(self, tmp_path):
+        raw = make_model_info()
+        raw["runtimes"]["jax"]["available"] = False
+        (tmp_path / "model_info.json").write_text(json.dumps(raw))
+        info = load_model_info(str(tmp_path))
+        with pytest.raises(ModelInfoError):
+            info.runtime("jax")
+
+    def test_missing_manifest(self, tmp_path):
+        with pytest.raises(ModelInfoError):
+            load_model_info(str(tmp_path))
+
+    def test_extra_forbidden(self, tmp_path):
+        raw = make_model_info()
+        raw["surprise"] = True
+        (tmp_path / "model_info.json").write_text(json.dumps(raw))
+        with pytest.raises(ModelInfoError):
+            load_model_info(str(tmp_path))
+
+
+class TestResultSchemas:
+    def test_embedding_roundtrip(self):
+        e = EmbeddingV1(vector=[0.1, 0.2], dim=2, model_id="clip")
+        out = validate_result("embedding_v1", e.to_json_bytes())
+        assert out.dim == 2
+        assert EmbeddingV1.mime() == "application/json;schema=embedding_v1"
+
+    def test_face_roundtrip(self):
+        f = FaceV1(
+            faces=[
+                {
+                    "bbox": [1, 2, 3, 4],
+                    "confidence": 0.9,
+                    "landmarks": [[1, 1]] * 5,
+                    "embedding": [0.0] * 4,
+                }
+            ],
+            count=1,
+            model_id="scrfd",
+        )
+        assert validate_result("face_v1", f.to_json_bytes()).count == 1
+
+    def test_ocr_box_needs_3_points(self):
+        with pytest.raises(Exception):
+            OCRV1(items=[{"box": [[0, 0], [1, 1]], "text": "x", "confidence": 0.5}], count=1, model_id="m")
+
+    def test_text_generation_finish_reasons(self):
+        t = TextGenerationV1(
+            text="a cat",
+            finish_reason="eos_token",
+            generated_tokens=3,
+            input_tokens=10,
+            model_id="vlm",
+            metadata={"temperature": 0.7},
+        )
+        assert validate_result("text_generation_v1", t.to_json_bytes()).finish_reason == "eos_token"
+
+    def test_unknown_schema(self):
+        with pytest.raises(ValidationError):
+            validate_result("nope_v9", b"{}")
+
+    def test_extra_keys_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_result("embedding_v1", b'{"vector":[1],"dim":1,"model_id":"m","x":1}')
+
+
+class TestDownloaderLogic:
+    def test_allow_patterns_jax(self):
+        pats = allow_patterns_for(ModelConfig(model="m", runtime="jax"))
+        assert "*.safetensors" in pats and "model_info.json" in pats
+
+    def test_allow_patterns_onnx_precision(self):
+        pats = allow_patterns_for(ModelConfig(model="m", runtime="onnx", precision="fp16"))
+        assert any("fp16.onnx" in p for p in pats)
+        assert not any(p == "*.onnx" for p in pats)
+
+    def test_allow_patterns_rknn_device_scoped(self):
+        pats = allow_patterns_for(ModelConfig(model="m", runtime="rknn", rknn_device="rk3588"))
+        assert "rknn/rk3588/*" in pats
+
+    def _downloader(self, tmp_path):
+        from tests.test_core_config import make_raw
+        from lumen_tpu.core.config import validate_config_dict
+
+        raw = make_raw()
+        raw["metadata"]["cache_dir"] = str(tmp_path)
+        return Downloader(validate_config_dict(raw))
+
+    def test_validate_files_ok(self, tmp_path):
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+        (model_dir / "model.safetensors").write_bytes(b"x")
+        ds = model_dir / "datasets" / "imagenet"
+        ds.mkdir(parents=True)
+        (ds / "labels.json").write_text("[]")
+        (ds / "embeddings.npy").write_bytes(b"x")
+        info = load_model_info(str(model_dir))
+        cfg = ModelConfig(model="ViT-B-32", runtime="jax", dataset="ImageNet_1k")
+        d.validate_files(str(model_dir), info, cfg)  # should not raise
+
+    def test_validate_files_missing(self, tmp_path):
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+        info = load_model_info(str(model_dir))
+        cfg = ModelConfig(model="ViT-B-32", runtime="jax")
+        with pytest.raises(DownloadError):
+            d.validate_files(str(model_dir), info, cfg)
+
+    def test_cached_model_used_without_network(self, tmp_path):
+        # Air-gapped path: model already on disk -> download_all succeeds
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+        (model_dir / "model.safetensors").write_bytes(b"x")
+        ds = model_dir / "datasets" / "imagenet"
+        ds.mkdir(parents=True)
+        (ds / "labels.json").write_text("[]")
+        (ds / "embeddings.npy").write_bytes(b"x")
+        report = d.download_all()
+        assert report.ok, [r.error for r in report.failures()]
+
+    def test_cached_copy_preserved_on_validation_failure(self, tmp_path):
+        # A pre-existing cached dir must NOT be wiped by rollback even if
+        # validation fails (air-gapped safety).
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+        report = d.download_all()
+        assert not report.ok
+        assert model_dir.exists()
+
+    def test_rollback_on_fresh_download_failure(self, tmp_path, monkeypatch):
+        # Simulate a fresh download that produces an invalid tree: the
+        # partially-downloaded dir must be rolled back.
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+
+        def fake_download(repo, allow_patterns=None, force=False, update=False):
+            model_dir.mkdir(parents=True, exist_ok=True)
+            (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+            return str(model_dir)
+
+        monkeypatch.setattr(d.platform, "download", fake_download)
+        report = d.download_all()
+        assert not report.ok
+        assert not model_dir.exists()
+
+    def test_dataset_files_fetched_in_phase_two(self, tmp_path, monkeypatch):
+        # Phase one leaves dataset files missing; phase two must issue an
+        # update download for exactly those paths.
+        d = self._downloader(tmp_path)
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        calls = []
+
+        def fake_download(repo, allow_patterns=None, force=False, update=False):
+            calls.append((list(allow_patterns or []), update))
+            model_dir.mkdir(parents=True, exist_ok=True)
+            (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+            (model_dir / "model.safetensors").write_bytes(b"x")
+            if update:
+                for rel in allow_patterns:
+                    p = model_dir / rel
+                    p.parent.mkdir(parents=True, exist_ok=True)
+                    p.write_bytes(b"x")
+            return str(model_dir)
+
+        monkeypatch.setattr(d.platform, "download", fake_download)
+        report = d.download_all()
+        assert report.ok, [r.error for r in report.failures()]
+        assert len(calls) == 2 and calls[1][1] is True
+        assert "datasets/imagenet/labels.json" in calls[1][0]
+
+    def test_download_all_reports_platform_unavailable(self, tmp_path, monkeypatch):
+        # PlatformUnavailableError must be reported per-model, not raised.
+        from lumen_tpu.core.exceptions import PlatformUnavailableError
+
+        d = self._downloader(tmp_path)
+
+        def boom(*a, **k):
+            raise PlatformUnavailableError("no hub sdk")
+
+        monkeypatch.setattr(d.platform, "download", boom)
+        report = d.download_all()
+        assert not report.ok
+        assert "no hub sdk" in report.failures()[0].error
+
+    def test_jax_runtime_falls_back_to_torch_entry(self, tmp_path):
+        d = self._downloader(tmp_path)
+        raw = make_model_info()
+        raw["runtimes"] = {"torch": {"available": True, "files": ["pytorch_model.bin"]}}
+        del raw["datasets"]
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(raw))
+        (model_dir / "pytorch_model.bin").write_bytes(b"x")
+        info = load_model_info(str(model_dir))
+        d.validate_files(str(model_dir), info, ModelConfig(model="ViT-B-32", runtime="jax"))
