@@ -72,13 +72,9 @@ class Mdns(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     enabled: bool = False
+    # Optional: the server falls back to "lumen-tpu" when unset (the
+    # reference accepts enabled=true with no name, so we must too).
     service_name: str | None = Field(None, pattern=r"^[a-z][a-z0-9-]*$")
-
-    @model_validator(mode="after")
-    def _name_required_when_enabled(self) -> "Mdns":
-        if self.enabled and not self.service_name:
-            raise ValueError("mdns.service_name is required when mdns.enabled=true")
-        return self
 
 
 class Server(BaseModel):

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import fnmatch
 import logging
+import re
 import os
 import shutil
 from dataclasses import dataclass, field
@@ -84,6 +85,30 @@ def allow_patterns_for(model_cfg: ModelConfig) -> list[str]:
     return patterns
 
 
+_PRECISION_VARIANT = re.compile(r"\.(fp16|fp32|bf16|int8|uint8|q4|q4fp16|q4f16)\.(onnx|rknn|safetensors)$")
+
+
+def _filter_by_precision(declared: list[str], precision: str | None) -> list[str]:
+    """Keep only the declared files relevant to the configured precision.
+
+    Multi-precision manifests declare sibling variants like
+    ``onnx/text.fp32.onnx`` + ``onnx/text.fp16.onnx``; only the configured
+    precision's variants are fetched, so only those may be required
+    (reference behavior: ``downloader.py:484-493``). Files with no
+    precision marker are always required. If no variant matches the
+    configured precision, fall back to requiring the fp32 variants
+    (mirroring the fp32-fallback preference chain).
+    """
+    if not precision:
+        return declared
+    plain = [f for f in declared if not _PRECISION_VARIANT.search(f)]
+    variants = [f for f in declared if _PRECISION_VARIANT.search(f)]
+    matching = [f for f in variants if _PRECISION_VARIANT.search(f).group(1) == precision]
+    if not matching:
+        matching = [f for f in variants if _PRECISION_VARIANT.search(f).group(1) == "fp32"]
+    return plain + matching
+
+
 class Downloader:
     def __init__(self, config: LumenConfig):
         self.config = config
@@ -109,19 +134,35 @@ class Downloader:
         # destroy a cached copy we did not just (re)download.
         was_cached = self.platform.is_cached(model_cfg.model)
         try:
-            path = self.platform.download(
-                model_cfg.model, allow_patterns=allow_patterns_for(model_cfg)
-            )
-            info = load_model_info(path)
-            self._download_datasets(path, info, model_cfg)
-            self.validate_files(path, info, model_cfg)
-            res.ok, res.path = True, path
+            res.path = self._fetch_and_validate(model_cfg)
+            res.ok = True
         except ResourceError as e:
+            if was_cached:
+                # A cached-but-invalid tree (interrupted earlier download,
+                # changed runtime/precision in config): try to repair it
+                # with an incremental update fetch rather than failing on
+                # the cache-hit fast path forever.
+                logger.warning("cached copy of %s invalid (%s); attempting repair", model_cfg.model, e)
+                try:
+                    res.path = self._fetch_and_validate(model_cfg, update=True)
+                    res.ok = True
+                    return res
+                except ResourceError as e2:
+                    e = e2
             logger.error("download failed for %s/%s: %s", svc, alias, e)
             if not was_cached:
                 self.cleanup_model(model_cfg.model)
             res.error = str(e)
         return res
+
+    def _fetch_and_validate(self, model_cfg: ModelConfig, update: bool = False) -> str:
+        path = self.platform.download(
+            model_cfg.model, allow_patterns=allow_patterns_for(model_cfg), update=update
+        )
+        info = load_model_info(path)
+        self._download_datasets(path, info, model_cfg)
+        self.validate_files(path, info, model_cfg)
+        return path
 
     def _download_datasets(self, path: str, info: ModelInfo, model_cfg: ModelConfig) -> None:
         """Phase two: fetch dataset files named in model_info (relative
@@ -162,9 +203,12 @@ class Downloader:
         entry = self._resolve_runtime_entry(info, model_cfg)
         device = model_cfg.rknn_device
         declared = entry.files_for(device) if entry.files else []
+        declared = _filter_by_precision(declared, model_cfg.precision)
         missing: list[str] = []
         for rel in declared:
-            rel_resolved = rel.format(precision=model_cfg.precision or "fp32")
+            # Manifests may template the precision into a filename; plain
+            # replace (not str.format) so literal braces never crash.
+            rel_resolved = rel.replace("{precision}", model_cfg.precision or "fp32")
             if "*" in rel_resolved:
                 hits = [
                     os.path.join(dp, f)

@@ -1,0 +1,19 @@
+"""Serving layer: wire protocol, task registry, routing, gRPC servers.
+
+TPU-native counterpart of the reference's ``src/lumen`` hub package plus the
+per-package service scaffolding it duplicates.
+"""
+
+from .base_service import BaseService, InvalidArgument, ServiceError, Unavailable
+from .registry import TaskDefinition, TaskRegistry
+from .router import HubRouter
+
+__all__ = [
+    "BaseService",
+    "ServiceError",
+    "InvalidArgument",
+    "Unavailable",
+    "TaskDefinition",
+    "TaskRegistry",
+    "HubRouter",
+]

@@ -1,0 +1,216 @@
+"""Shared gRPC servicer base for every model service.
+
+Implements, once, the per-service plumbing the reference repeats in each
+package's ``*_service.py`` (e.g.
+``packages/lumen-clip/src/lumen_clip/general_clip/clip_service.py:208-414``):
+
+- ``Infer`` loop with chunked-payload reassembly keyed by ``correlation_id``
+  (``seq``/``total``/``offset`` contract),
+- handler dispatch through a :class:`~lumen_tpu.serving.registry.TaskRegistry`,
+- unified error mapping to wire ``Error`` records,
+- ``GetCapabilities`` / ``StreamCapabilities`` / ``Health``.
+
+Additionally supports **true server-side streaming**: a task handler may
+return an iterator of ``(bytes, mime, meta)`` chunks, which are forwarded as
+incremental ``InferResponse`` messages (the reference collects VLM "stream"
+chunks into one response, ``fastvlm_service.py:492-506``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import grpc
+from google.protobuf import empty_pb2
+
+from .proto import ml_service_pb2 as pb
+from .proto.ml_service_pb2_grpc import InferenceServicer
+from .registry import TaskRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceError(Exception):
+    """Error with a wire error-code; raised by task handlers."""
+
+    def __init__(self, code: int, message: str, detail: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.detail = detail
+
+
+class InvalidArgument(ServiceError):
+    def __init__(self, message: str, detail: str = ""):
+        super().__init__(pb.ERROR_CODE_INVALID_ARGUMENT, message, detail)
+
+
+class Unavailable(ServiceError):
+    def __init__(self, message: str, detail: str = ""):
+        super().__init__(pb.ERROR_CODE_UNAVAILABLE, message, detail)
+
+
+@dataclass
+class _Assembly:
+    task: str = ""
+    payload_mime: str = ""
+    meta: dict[str, str] = field(default_factory=dict)
+    chunks: dict[int, bytes] = field(default_factory=dict)
+    total: int = 0
+
+    def add(self, req: pb.InferRequest) -> None:
+        if not self.task:
+            self.task = req.task
+            self.payload_mime = req.payload_mime
+        if req.meta:
+            self.meta.update(dict(req.meta))
+        self.chunks[req.seq] = req.payload
+        if req.total:
+            self.total = req.total
+
+    @property
+    def complete(self) -> bool:
+        # total==0 (single-chunk fast path) or all declared chunks present.
+        if self.total == 0:
+            return True
+        return len(self.chunks) >= self.total
+
+    def payload(self) -> bytes:
+        return b"".join(self.chunks[i] for i in sorted(self.chunks))
+
+
+class BaseService(InferenceServicer):
+    """Subclasses populate ``self.registry`` and implement ``capability()``."""
+
+    def __init__(self, registry: TaskRegistry):
+        self.registry = registry
+
+    # -- to override ------------------------------------------------------
+
+    def capability(self) -> pb.Capability:
+        raise NotImplementedError
+
+    def healthy(self) -> bool:
+        return True
+
+    # -- Inference rpc implementation ------------------------------------
+
+    def Infer(self, request_iterator, context) -> Iterator[pb.InferResponse]:
+        buffers: dict[str, _Assembly] = {}
+        for req in request_iterator:
+            cid = req.correlation_id
+            asm = buffers.setdefault(cid, _Assembly())
+            asm.add(req)
+            if not asm.complete:
+                continue
+            del buffers[cid]
+            yield from self._dispatch(cid, asm)
+
+    def _dispatch(self, cid: str, asm: _Assembly) -> Iterator[pb.InferResponse]:
+        task = self.registry.get(asm.task)
+        if task is None:
+            yield self._error(
+                cid,
+                pb.ERROR_CODE_INVALID_ARGUMENT,
+                f"unknown task {asm.task!r}",
+                f"supported: {self.registry.task_names()}",
+            )
+            return
+        payload = asm.payload()
+        if len(payload) > task.max_payload_bytes:
+            yield self._error(
+                cid,
+                pb.ERROR_CODE_INVALID_ARGUMENT,
+                f"payload exceeds limit ({len(payload)} > {task.max_payload_bytes} bytes)",
+            )
+            return
+        t0 = time.perf_counter()
+        try:
+            out = task.handler(payload, asm.payload_mime, asm.meta)
+        except ServiceError as e:
+            yield self._error(cid, e.code, str(e), e.detail)
+            return
+        except Exception as e:  # noqa: BLE001 - handler crash -> INTERNAL
+            logger.exception("task %s failed", asm.task)
+            yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
+            return
+
+        if isinstance(out, tuple):
+            result, mime, meta = out
+            meta = dict(meta)
+            meta["lat_ms"] = f"{(time.perf_counter() - t0) * 1e3:.2f}"
+            yield pb.InferResponse(
+                correlation_id=cid,
+                is_final=True,
+                result=result,
+                meta=meta,
+                result_mime=mime,
+                seq=0,
+                total=1,
+            )
+        else:
+            # Streaming handler: iterator of (bytes, mime, meta) chunks.
+            yield from self._stream_out(cid, asm.task, out, t0)
+
+    def _stream_out(self, cid: str, task_name: str, chunks, t0: float) -> Iterator[pb.InferResponse]:
+        seq = 0
+        pending: tuple[bytes, str, dict[str, str]] | None = None
+        try:
+            for chunk in chunks:
+                if pending is not None:
+                    result, mime, meta = pending
+                    yield pb.InferResponse(
+                        correlation_id=cid,
+                        is_final=False,
+                        result=result,
+                        meta=meta,
+                        result_mime=mime,
+                        seq=seq,
+                    )
+                    seq += 1
+                pending = chunk
+        except ServiceError as e:
+            yield self._error(cid, e.code, str(e), e.detail)
+            return
+        except Exception as e:  # noqa: BLE001
+            logger.exception("streaming task %s failed", task_name)
+            yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
+            return
+        if pending is None:
+            yield self._error(cid, pb.ERROR_CODE_INTERNAL, "streaming handler yielded no chunks")
+            return
+        result, mime, meta = pending
+        meta = dict(meta)
+        meta["lat_ms"] = f"{(time.perf_counter() - t0) * 1e3:.2f}"
+        yield pb.InferResponse(
+            correlation_id=cid,
+            is_final=True,
+            result=result,
+            meta=meta,
+            result_mime=mime,
+            seq=seq,
+            total=seq + 1,
+        )
+
+    @staticmethod
+    def _error(cid: str, code: int, message: str, detail: str = "") -> pb.InferResponse:
+        return pb.InferResponse(
+            correlation_id=cid,
+            is_final=True,
+            error=pb.Error(code=code, message=message, detail=detail),
+        )
+
+    # -- capability / health rpcs ----------------------------------------
+
+    def GetCapabilities(self, request, context) -> pb.Capability:
+        return self.capability()
+
+    def StreamCapabilities(self, request, context) -> Iterator[pb.Capability]:
+        yield self.capability()
+
+    def Health(self, request, context):
+        if not self.healthy():
+            context.abort(grpc.StatusCode.UNAVAILABLE, "service unhealthy")
+        return empty_pb2.Empty()

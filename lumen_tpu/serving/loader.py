@@ -1,0 +1,30 @@
+"""Dotted-path -> object resolution for dynamic service loading.
+
+Same role as the reference ``src/lumen/loader.py:9-45``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+class ServiceLoadError(Exception):
+    pass
+
+
+def resolve(dotted_path: str) -> Any:
+    """Resolve ``pkg.module.Attr`` to the attribute object."""
+    module_path, _, attr = dotted_path.rpartition(".")
+    if not module_path:
+        raise ServiceLoadError(f"not a dotted path: {dotted_path!r}")
+    try:
+        module = importlib.import_module(module_path)
+    except ImportError as e:
+        raise ServiceLoadError(f"cannot import module {module_path!r}: {e}") from e
+    try:
+        return getattr(module, attr)
+    except AttributeError as e:
+        raise ServiceLoadError(
+            f"module {module_path!r} has no attribute {attr!r}"
+        ) from e

@@ -1,0 +1,216 @@
+"""Minimal multicast-DNS service advertiser (dependency-free).
+
+LAN discovery parity with the reference, which registers a zeroconf
+``_lumen._tcp.local.`` service (``src/lumen/server.py:75-149``). The
+``zeroconf`` package is not in the TPU image, so this module speaks just
+enough raw mDNS itself: it answers PTR/SRV/TXT/A queries for the advertised
+instance and sends periodic unsolicited announcements.
+
+Environment overrides mirror the reference: ``ADVERTISE_IP`` (skip
+autodetection), ``SERVICE_UUID`` (stable instance identity), plus
+``SERVICE_STATUS`` / ``SERVICE_VERSION`` merged into TXT properties.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+
+logger = logging.getLogger(__name__)
+
+MDNS_GROUP = "224.0.0.251"
+MDNS_PORT = 5353
+SERVICE_TYPE = "_lumen._tcp.local."
+
+_TYPE_A, _TYPE_PTR, _TYPE_TXT, _TYPE_SRV, _TYPE_ANY = 1, 12, 16, 33, 255
+_CLASS_IN = 1
+_CACHE_FLUSH = 0x8001  # class IN with cache-flush bit
+
+
+def detect_lan_ip() -> str:
+    """Best-effort LAN IP via the UDP connect trick (no packets sent)."""
+    override = os.environ.get("ADVERTISE_IP")
+    if override:
+        return override
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        raw = label.encode("utf-8")
+        out += struct.pack("!B", len(raw)) + raw
+    return out + b"\x00"
+
+
+def _decode_name(data: bytes, off: int) -> tuple[str, int]:
+    """Decode a DNS name honouring compression pointers; returns (name, next_offset)."""
+    labels: list[str] = []
+    jumped = False
+    next_off = off
+    hops = 0
+    while True:
+        if off >= len(data):
+            break
+        length = data[off]
+        if length == 0:
+            if not jumped:
+                next_off = off + 1
+            break
+        if length & 0xC0 == 0xC0:  # compression pointer
+            if off + 1 >= len(data) or hops > 32:
+                break
+            ptr = ((length & 0x3F) << 8) | data[off + 1]
+            if not jumped:
+                next_off = off + 2
+                jumped = True
+            off = ptr
+            hops += 1
+            continue
+        labels.append(data[off + 1 : off + 1 + length].decode("utf-8", "replace"))
+        off += 1 + length
+    return ".".join(labels) + ".", next_off
+
+
+def _record(name: str, rtype: int, rdata: bytes, ttl: int = 120) -> bytes:
+    return _encode_name(name) + struct.pack("!HHIH", rtype, _CACHE_FLUSH if rtype != _TYPE_PTR else _CLASS_IN, ttl, len(rdata)) + rdata
+
+
+class MdnsAdvertiser:
+    """Advertise one service instance; run as a daemon thread."""
+
+    def __init__(
+        self,
+        service_name: str,
+        port: int,
+        properties: dict[str, str] | None = None,
+        ip: str | None = None,
+    ):
+        self.instance = f"{service_name}-{os.environ.get('SERVICE_UUID', uuid.uuid4().hex[:8])}"
+        self.port = port
+        self.ip = ip or detect_lan_ip()
+        props = dict(properties or {})
+        props.setdefault("status", os.environ.get("SERVICE_STATUS", "ready"))
+        props.setdefault("version", os.environ.get("SERVICE_VERSION", "0.1.0"))
+        self.properties = props
+        self._sock: socket.socket | None = None
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- names ------------------------------------------------------------
+
+    @property
+    def instance_name(self) -> str:
+        return f"{self.instance}.{SERVICE_TYPE}"
+
+    @property
+    def host_name(self) -> str:
+        return f"{self.instance}.local."
+
+    # -- packet building ---------------------------------------------------
+
+    def _txt_rdata(self) -> bytes:
+        out = b""
+        for k, v in self.properties.items():
+            kv = f"{k}={v}".encode("utf-8")[:255]
+            out += struct.pack("!B", len(kv)) + kv
+        return out or b"\x00"
+
+    def _answers(self) -> list[bytes]:
+        srv_rdata = struct.pack("!HHH", 0, 0, self.port) + _encode_name(self.host_name)
+        a_rdata = socket.inet_aton(self.ip)
+        return [
+            _record(SERVICE_TYPE, _TYPE_PTR, _encode_name(self.instance_name)),
+            _record(self.instance_name, _TYPE_SRV, srv_rdata),
+            _record(self.instance_name, _TYPE_TXT, self._txt_rdata()),
+            _record(self.host_name, _TYPE_A, a_rdata),
+        ]
+
+    def _response_packet(self) -> bytes:
+        answers = self._answers()
+        header = struct.pack("!HHHHHH", 0, 0x8400, 0, len(answers), 0, 0)
+        return header + b"".join(answers)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM, socket.IPPROTO_UDP)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            sock.bind(("", MDNS_PORT))
+            mreq = socket.inet_aton(MDNS_GROUP) + socket.inet_aton("0.0.0.0")
+            sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+        except OSError as e:
+            logger.warning("mDNS unavailable (%s); discovery disabled", e)
+            sock.close()
+            return
+        sock.settimeout(1.0)
+        self._sock = sock
+        self._thread = threading.Thread(target=self._run, name="mdns", daemon=True)
+        self._thread.start()
+        logger.info("mDNS advertising %s at %s:%d", self.instance_name, self.ip, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+        if self._sock:
+            try:
+                # Goodbye packet: TTL 0 announcement.
+                pkt = struct.pack("!HHHHHH", 0, 0x8400, 0, 1, 0, 0) + _record(
+                    SERVICE_TYPE, _TYPE_PTR, _encode_name(self.instance_name), ttl=0
+                )
+                self._sock.sendto(pkt, (MDNS_GROUP, MDNS_PORT))
+            except OSError:
+                pass
+            self._sock.close()
+            self._sock = None
+
+    def _run(self) -> None:
+        next_announce = 0.0
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now >= next_announce:
+                try:
+                    self._sock.sendto(self._response_packet(), (MDNS_GROUP, MDNS_PORT))
+                except OSError:
+                    pass
+                next_announce = now + 60.0
+            try:
+                data, addr = self._sock.recvfrom(4096)
+                if self._matches_query(data):
+                    self._sock.sendto(self._response_packet(), (MDNS_GROUP, MDNS_PORT))
+            except socket.timeout:
+                pass
+            except OSError:
+                break
+
+    def _matches_query(self, data: bytes) -> bool:
+        if len(data) < 12:
+            return False
+        (tid, flags, qdcount, *_rest) = struct.unpack("!HHHHHH", data[:12])
+        if flags & 0x8000:  # a response, not a query
+            return False
+        off = 12
+        ours = {SERVICE_TYPE.lower(), self.instance_name.lower(), self.host_name.lower()}
+        for _ in range(qdcount):
+            try:
+                qname, off = _decode_name(data, off)
+                off += 4  # qtype + qclass
+            except Exception:  # noqa: BLE001 - malformed packet
+                return False
+            if qname.lower() in ours:
+                return True
+        return False

@@ -1,0 +1,95 @@
+"""Hub router: one gRPC endpoint multiplexing several model services.
+
+Same role as the reference ``src/lumen/router.py:10-87``: a routing table
+from task key -> child service is built from each child's registry; ``Infer``
+peeks at the first message of the stream to pick the child and then forwards
+the whole stream zero-copy; capabilities aggregate; health is the AND of all
+children.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Iterable, Iterator
+
+import grpc
+from google.protobuf import empty_pb2
+
+from .base_service import BaseService
+from .proto import ml_service_pb2 as pb
+from .proto.ml_service_pb2_grpc import InferenceServicer
+
+logger = logging.getLogger(__name__)
+
+
+class HubRouter(InferenceServicer):
+    def __init__(self, services: dict[str, BaseService]):
+        self.services = services
+        self._route_table: dict[str, BaseService] = {}
+        for name, svc in services.items():
+            for task in svc.registry.task_names():
+                if task in self._route_table:
+                    raise ValueError(
+                        f"task {task!r} registered by multiple services "
+                        f"(second: {name!r})"
+                    )
+                self._route_table[task] = svc
+        logger.info(
+            "hub routing table: %s", {t: s.registry.service_name for t, s in self._route_table.items()}
+        )
+
+    def attach_to_server(self, server: grpc.Server) -> None:
+        from .proto.ml_service_pb2_grpc import add_InferenceServicer_to_server
+
+        add_InferenceServicer_to_server(self, server)
+
+    # -- rpcs -------------------------------------------------------------
+
+    def Infer(self, request_iterator: Iterable[pb.InferRequest], context) -> Iterator[pb.InferResponse]:
+        try:
+            first = next(iter(request_iterator))
+        except StopIteration:
+            return
+        target = self._route_table.get(first.task)
+        if target is None:
+            yield pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                error=pb.Error(
+                    code=pb.ERROR_CODE_INVALID_ARGUMENT,
+                    message=f"no service handles task {first.task!r}",
+                    detail=f"known tasks: {sorted(self._route_table)}",
+                ),
+            )
+            return
+        # Re-prepend the consumed first message; forward the stream as-is.
+        yield from target.Infer(itertools.chain([first], request_iterator), context)
+
+    def GetCapabilities(self, request, context) -> pb.Capability:
+        # Aggregate: merge every child capability into one record (the
+        # detailed per-service view is StreamCapabilities).
+        agg = pb.Capability(
+            service_name="hub",
+            runtime="jax-tpu",
+            protocol_version="1.0.0",
+        )
+        for svc in self.services.values():
+            cap = svc.capability()
+            agg.model_ids.extend(cap.model_ids)
+            agg.tasks.extend(cap.tasks)
+            for p in cap.precisions:
+                if p not in agg.precisions:
+                    agg.precisions.append(p)
+            agg.max_concurrency = max(agg.max_concurrency, cap.max_concurrency)
+        return agg
+
+    def StreamCapabilities(self, request, context) -> Iterator[pb.Capability]:
+        for svc in self.services.values():
+            yield svc.capability()
+
+    def Health(self, request, context):
+        for name, svc in self.services.items():
+            if not svc.healthy():
+                context.abort(grpc.StatusCode.UNAVAILABLE, f"service {name!r} unhealthy")
+        return empty_pb2.Empty()

@@ -1,0 +1,154 @@
+"""Hub / single-service gRPC server entry point (console script ``lumen-tpu``).
+
+Startup sequence mirrors the reference hub runner
+(``src/lumen/server.py:188-385``): load+validate config -> ensure model
+artifacts (abort if any download fails) -> instantiate services from their
+configured ``registry_class`` dotted paths -> bind gRPC (with OS-assigned
+port fallback) -> advertise over mDNS -> serve until SIGINT/SIGTERM.
+
+Unlike the reference, ``single`` and ``hub`` modes share this one entry
+point (the reference duplicates a per-package server runner in each of the
+four model packages); single mode is simply a hub with one service.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from concurrent import futures
+
+import grpc
+
+from ..core.config import LumenConfig, ServiceConfig, load_config
+from ..core.downloader import Downloader
+from ..utils.logger import setup_logging
+from .base_service import BaseService
+from .loader import resolve
+from .mdns import MdnsAdvertiser
+from .router import HubRouter
+
+logger = logging.getLogger(__name__)
+
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+]
+
+
+def build_services(config: LumenConfig) -> dict[str, BaseService]:
+    """Instantiate every enabled service via its ``import_info.registry_class``
+    factory (``from_config(service_config, cache_dir)`` classmethod contract,
+    reference: ``src/lumen/service.py:12-49``)."""
+    services: dict[str, BaseService] = {}
+    cache_dir = config.metadata.cache_path
+    for name, svc_cfg in config.enabled_services().items():
+        cls = resolve(svc_cfg.import_info.registry_class)
+        logger.info("loading service %r via %s", name, svc_cfg.import_info.registry_class)
+        services[name] = cls.from_config(svc_cfg, cache_dir)
+    return services
+
+
+def ensure_models(config: LumenConfig) -> None:
+    report = Downloader(config).download_all()
+    if not report.ok:
+        for r in report.failures():
+            logger.error("model fetch failed: %s/%s (%s): %s", r.service, r.alias, r.model, r.error)
+        raise SystemExit(1)
+
+
+class ServerHandle:
+    """A running gRPC server + its lifecycle helpers (returned by ``serve``
+    for tests; the CLI blocks on ``wait``)."""
+
+    def __init__(self, server: grpc.Server, port: int, mdns: MdnsAdvertiser | None):
+        self.server = server
+        self.port = port
+        self.mdns = mdns
+        self._stopped = threading.Event()
+
+    def stop(self, grace: float = 5.0) -> None:
+        if self.mdns:
+            self.mdns.stop()
+        self.server.stop(grace)
+        self._stopped.set()
+
+    def wait(self) -> None:
+        self.server.wait_for_termination()
+
+
+def serve(config: LumenConfig, port_override: int | None = None, skip_download: bool = False) -> ServerHandle:
+    if not skip_download:
+        ensure_models(config)
+    services = build_services(config)
+    if not services:
+        logger.error("no enabled services selected by deployment config")
+        raise SystemExit(1)
+    router = HubRouter(services)
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=10, thread_name_prefix="grpc"),
+        options=GRPC_OPTIONS,
+    )
+    router.attach_to_server(server)
+
+    host = config.server.host
+    port = port_override or config.server.port
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        # Requested port unavailable: fall back to an OS-assigned one
+        # (reference behavior, src/lumen/server.py:242-263).
+        bound = server.add_insecure_port(f"{host}:0")
+        if bound == 0:
+            logger.error("could not bind any port on %s", host)
+            raise SystemExit(1)
+        logger.warning("port %d unavailable; bound %d instead", port, bound)
+    server.start()
+    logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
+    for name, svc in services.items():
+        logger.info("  %s tasks: %s", name, svc.registry.task_names())
+
+    mdns = None
+    mdns_cfg = config.server.mdns
+    if mdns_cfg and mdns_cfg.enabled:
+        mdns = MdnsAdvertiser(
+            mdns_cfg.service_name or "lumen-tpu",
+            bound,
+            properties={"tasks": ",".join(t for s in services.values() for t in s.registry.task_names())},
+        )
+        mdns.start()
+    return ServerHandle(server, bound, mdns)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="lumen-tpu", description="lumen-tpu inference server")
+    parser.add_argument("--config", required=True, help="path to lumen config YAML")
+    parser.add_argument("--port", type=int, default=None, help="override configured port")
+    parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--skip-download", action="store_true", help="assume model artifacts are already cached"
+    )
+    args = parser.parse_args(argv)
+
+    setup_logging(args.log_level)
+    config = load_config(args.config)
+    handle = serve(config, port_override=args.port, skip_download=args.skip_download)
+
+    stop_event = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001
+        logger.info("signal %d received; shutting down", signum)
+        stop_event.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+    while not stop_event.wait(timeout=1.0):
+        pass
+    handle.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,3 +265,41 @@ class TestDownloaderLogic:
         (model_dir / "pytorch_model.bin").write_bytes(b"x")
         info = load_model_info(str(model_dir))
         d.validate_files(str(model_dir), info, ModelConfig(model="ViT-B-32", runtime="jax"))
+
+
+class TestPrecisionFiltering:
+    def test_only_configured_precision_required(self):
+        from lumen_tpu.core.downloader import _filter_by_precision
+
+        declared = ["onnx/text.fp32.onnx", "onnx/text.fp16.onnx", "tokenizer.json"]
+        assert _filter_by_precision(declared, "fp16") == ["tokenizer.json", "onnx/text.fp16.onnx"]
+
+    def test_fp32_fallback_when_precision_missing(self):
+        from lumen_tpu.core.downloader import _filter_by_precision
+
+        declared = ["onnx/text.fp32.onnx"]
+        assert _filter_by_precision(declared, "int8") == ["onnx/text.fp32.onnx"]
+
+    def test_no_precision_requires_all(self):
+        from lumen_tpu.core.downloader import _filter_by_precision
+
+        declared = ["onnx/a.fp16.onnx", "onnx/a.fp32.onnx"]
+        assert _filter_by_precision(declared, None) == declared
+
+    def test_literal_braces_do_not_crash(self, tmp_path):
+        import json
+        from lumen_tpu.core.config import ModelConfig, validate_config_dict
+        from lumen_tpu.core.downloader import Downloader
+        from tests.test_core_config import make_raw
+
+        raw = make_raw()
+        raw["metadata"]["cache_dir"] = str(tmp_path)
+        d = Downloader(validate_config_dict(raw))
+        mi = make_model_info()
+        mi["runtimes"]["jax"]["files"] = ["weird_{variant}.safetensors"]
+        del mi["datasets"]
+        model_dir = tmp_path / "models" / "ViT-B-32"
+        model_dir.mkdir(parents=True)
+        (model_dir / "model_info.json").write_text(json.dumps(mi))
+        report = d.download_all()  # must not raise
+        assert not report.ok

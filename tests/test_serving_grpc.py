@@ -1,0 +1,243 @@
+"""End-to-end gRPC serving tests: real server + real client channel,
+exercising routing, chunk reassembly, streaming, errors, capabilities and
+health — with an echo service standing in for model services (the
+reference's dummy-backend test pattern, SURVEY.md §4)."""
+
+import json
+
+import grpc
+import pytest
+from google.protobuf import empty_pb2
+
+from lumen_tpu.serving import (
+    BaseService,
+    HubRouter,
+    InvalidArgument,
+    TaskDefinition,
+    TaskRegistry,
+)
+from lumen_tpu.serving.proto import ml_service_pb2 as pb
+from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+    InferenceStub,
+    add_InferenceServicer_to_server,
+)
+
+
+class EchoService(BaseService):
+    """Test stand-in service: echo, fail, and a streaming counter."""
+
+    def __init__(self, name="echo"):
+        registry = TaskRegistry(name)
+        registry.register(TaskDefinition(name=f"{name}_echo", handler=self._echo))
+        registry.register(TaskDefinition(name=f"{name}_fail", handler=self._fail))
+        registry.register(
+            TaskDefinition(name=f"{name}_stream", handler=self._stream)
+        )
+        registry.register(
+            TaskDefinition(name=f"{name}_tiny", handler=self._echo, max_payload_bytes=4)
+        )
+        super().__init__(registry)
+        self._healthy = True
+
+    def capability(self):
+        return self.registry.build_capability(
+            model_ids=["echo-v0"], runtime="jax-cpu", precisions=["bf16"]
+        )
+
+    def healthy(self):
+        return self._healthy
+
+    def _echo(self, payload, mime, meta):
+        return payload, mime or "application/octet-stream", {"echoed": "1", **meta}
+
+    def _fail(self, payload, mime, meta):
+        raise InvalidArgument("bad input", detail="test-detail")
+
+    def _stream(self, payload, mime, meta):
+        for i in range(int(meta.get("n", "3"))):
+            yield (f"chunk{i}".encode(), "text/plain", {"i": str(i)})
+
+
+@pytest.fixture()
+def hub():
+    server = grpc.server(
+        __import__("concurrent.futures", fromlist=["ThreadPoolExecutor"]).ThreadPoolExecutor(
+            max_workers=4
+        )
+    )
+    router = HubRouter({"echo": EchoService("echo"), "echo2": EchoService("echo2")})
+    add_InferenceServicer_to_server(router, server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield InferenceStub(channel), router
+    channel.close()
+    server.stop(0)
+
+
+def one_request(task, payload=b"hello", meta=None, cid="c1"):
+    return pb.InferRequest(
+        correlation_id=cid, task=task, payload=payload, meta=meta or {}, payload_mime="text/plain"
+    )
+
+
+@pytest.mark.integration
+class TestInferRouting:
+    def test_echo_roundtrip(self, hub):
+        stub, _ = hub
+        resps = list(stub.Infer(iter([one_request("echo_echo")])))
+        assert len(resps) == 1
+        r = resps[0]
+        assert r.is_final and r.result == b"hello"
+        assert r.correlation_id == "c1"
+        assert "lat_ms" in r.meta and r.meta["echoed"] == "1"
+
+    def test_routing_to_second_service(self, hub):
+        stub, _ = hub
+        (r,) = stub.Infer(iter([one_request("echo2_echo")]))
+        assert r.result == b"hello" and not r.HasField("error")
+
+    def test_unknown_task(self, hub):
+        stub, _ = hub
+        (r,) = stub.Infer(iter([one_request("nope")]))
+        assert r.error.code == pb.ERROR_CODE_INVALID_ARGUMENT
+        assert "no service handles" in r.error.message
+
+    def test_handler_service_error(self, hub):
+        stub, _ = hub
+        (r,) = stub.Infer(iter([one_request("echo_fail")]))
+        assert r.error.code == pb.ERROR_CODE_INVALID_ARGUMENT
+        assert r.error.detail == "test-detail"
+
+    def test_chunked_reassembly(self, hub):
+        stub, _ = hub
+        chunks = [
+            pb.InferRequest(
+                correlation_id="cx",
+                task="echo_echo",
+                payload=p,
+                seq=i,
+                total=3,
+                payload_mime="text/plain",
+            )
+            for i, p in enumerate([b"aa", b"bb", b"cc"])
+        ]
+        (r,) = stub.Infer(iter(chunks))
+        assert r.result == b"aabbcc"
+
+    def test_payload_limit(self, hub):
+        stub, _ = hub
+        (r,) = stub.Infer(iter([one_request("echo_tiny", payload=b"too-long")]))
+        assert r.error.code == pb.ERROR_CODE_INVALID_ARGUMENT
+        assert "exceeds limit" in r.error.message
+
+    def test_streaming_task(self, hub):
+        stub, _ = hub
+        resps = list(stub.Infer(iter([one_request("echo_stream", meta={"n": "4"})])))
+        assert len(resps) == 4
+        assert [r.is_final for r in resps] == [False, False, False, True]
+        assert resps[0].result == b"chunk0" and resps[3].result == b"chunk3"
+        assert resps[3].total == 4
+        assert "lat_ms" in resps[3].meta
+
+    def test_multiple_correlations_one_stream(self, hub):
+        stub, _ = hub
+        reqs = [one_request("echo_echo", cid="a"), one_request("echo_echo", cid="b", payload=b"x")]
+        resps = list(stub.Infer(iter(reqs)))
+        assert {r.correlation_id for r in resps} == {"a", "b"}
+
+
+@pytest.mark.integration
+class TestCapabilitiesAndHealth:
+    def test_get_capabilities_aggregates(self, hub):
+        stub, _ = hub
+        cap = stub.GetCapabilities(empty_pb2.Empty())
+        assert cap.service_name == "hub"
+        names = {t.name for t in cap.tasks}
+        assert "echo_echo" in names and "echo2_stream" in names
+
+    def test_stream_capabilities_per_service(self, hub):
+        stub, _ = hub
+        caps = list(stub.StreamCapabilities(empty_pb2.Empty()))
+        assert {c.service_name for c in caps} == {"echo", "echo2"}
+        assert all(c.protocol_version == "1.0.0" for c in caps)
+
+    def test_health_ok(self, hub):
+        stub, _ = hub
+        stub.Health(empty_pb2.Empty())  # no exception
+
+    def test_health_fans_out(self, hub):
+        stub, router = hub
+        router.services["echo2"]._healthy = False
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.Health(empty_pb2.Empty())
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+
+
+class TestRegistry:
+    def test_duplicate_task_rejected(self):
+        reg = TaskRegistry("s")
+        t = TaskDefinition(name="x", handler=lambda p, m, me: (p, m, {}))
+        reg.register(t)
+        with pytest.raises(ValueError):
+            reg.register(t)
+
+    def test_duplicate_across_services_rejected(self):
+        with pytest.raises(ValueError):
+            HubRouter({"a": EchoService("echo"), "b": EchoService("echo")})
+
+    def test_capability_io_tasks(self):
+        svc = EchoService("echo")
+        cap = svc.capability()
+        t = {x.name: x for x in cap.tasks}["echo_tiny"]
+        assert t.limits["max_payload_bytes"] == "4"
+
+
+class TestLoader:
+    def test_resolve(self):
+        from lumen_tpu.serving.loader import resolve
+
+        assert resolve("lumen_tpu.serving.registry.TaskRegistry") is TaskRegistry
+
+    def test_resolve_errors(self):
+        from lumen_tpu.serving.loader import ServiceLoadError, resolve
+
+        with pytest.raises(ServiceLoadError):
+            resolve("nonexistent_mod.Thing")
+        with pytest.raises(ServiceLoadError):
+            resolve("lumen_tpu.serving.registry.Nope")
+        with pytest.raises(ServiceLoadError):
+            resolve("bare")
+
+
+class TestMdnsPackets:
+    def test_name_codec_roundtrip(self):
+        from lumen_tpu.serving.mdns import _decode_name, _encode_name
+
+        raw = _encode_name("_lumen._tcp.local.")
+        name, off = _decode_name(raw, 0)
+        assert name == "_lumen._tcp.local." and off == len(raw)
+
+    def test_query_matching(self):
+        import struct
+
+        from lumen_tpu.serving.mdns import MdnsAdvertiser, _encode_name
+
+        adv = MdnsAdvertiser("lumen-hub", 50051, ip="127.0.0.1")
+        q = struct.pack("!HHHHHH", 0, 0, 1, 0, 0, 0) + _encode_name("_lumen._tcp.local.") + struct.pack("!HH", 12, 1)
+        assert adv._matches_query(q)
+        q2 = struct.pack("!HHHHHH", 0, 0, 1, 0, 0, 0) + _encode_name("_other._tcp.local.") + struct.pack("!HH", 12, 1)
+        assert not adv._matches_query(q2)
+        # responses must be ignored
+        r = struct.pack("!HHHHHH", 0, 0x8400, 1, 0, 0, 0)
+        assert not adv._matches_query(r)
+
+    def test_response_packet_parses(self):
+        from lumen_tpu.serving.mdns import MdnsAdvertiser
+
+        adv = MdnsAdvertiser("lumen-hub", 50051, ip="192.168.1.10")
+        pkt = adv._response_packet()
+        import struct
+
+        tid, flags, qd, an, ns, ar = struct.unpack("!HHHHHH", pkt[:12])
+        assert flags == 0x8400 and an == 4
