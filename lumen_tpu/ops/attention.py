@@ -1,0 +1,219 @@
+"""Attention ops: XLA reference implementation + Pallas flash-attention.
+
+Used by the CLIP towers (bidirectional), the VLM prefill (causal, long
+sequences — this is where flash attention pays, SURVEY.md §7 step 7) and
+ring attention (``lumen_tpu.parallel.ring_attention`` wraps the blockwise
+math over a ``seq`` mesh axis).
+
+Layouts: ``q/k/v`` are ``[batch, heads, seq, head_dim]``. GQA callers repeat
+KV heads before calling (XLA fuses the broadcast).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128  # VMEM lane width; scratch stats are padded to this
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Plain XLA attention. ``mask``: broadcastable to [B,H,Sq,Sk]; True=keep.
+
+    Causal semantics for sq != sk match a KV-cache decode: query i may
+    attend keys ``<= i + sk - sq`` (``tril`` offset by ``sk - sq``).
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal_mask, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+# -- pallas flash attention -------------------------------------------------
+#
+# Grid: (batch*heads, q_blocks, k_blocks). The TPU grid runs sequentially
+# with the last axis fastest, so the online-softmax running stats for one
+# (head, q_block) live in VMEM scratch across the k_block steps: only one
+# (block_q, d) Q tile and one (block_k, d) K/V tile are VMEM-resident at a
+# time — O(block) memory however long the sequence is.
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    causal: bool,
+    sm_scale: float,
+    offset: int,
+    kv_len: int,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: skip blocks entirely above the (offset) diagonal.
+    if causal:
+        block_live = j * block_k <= (qi + 1) * block_q - 1 + offset
+    else:
+        block_live = j * block_k < kv_len
+
+    @pl.when(block_live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [block_q, block_k]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        live = k_pos < kv_len  # mask K padding
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            live = live & (q_pos + offset >= k_pos)
+        s = jnp.where(live, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused attention via Pallas on TPU (online softmax, O(block) VMEM).
+
+    Handles ``sq != sk`` (KV-cache decode offset) and sequences that are
+    not block multiples (padded K positions are masked inside the kernel).
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    block_q_eff = min(block_q, max(sq, 16))
+    block_k_eff = min(block_k, max(sk, 16))
+    qp = _pad_to(q, 2, block_q_eff)
+    kp = _pad_to(k, 2, block_k_eff)
+    vp = _pad_to(v, 2, block_k_eff)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    num_k_blocks = sk_p // block_k_eff
+
+    qkv = (qp.reshape(b * h, sq_p, d), kp.reshape(b * h, sk_p, d), vp.reshape(b * h, sk_p, d))
+    grid = (b * h, sq_p // block_q_eff, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        sm_scale=sm_scale,
+        offset=sk - sq,
+        kv_len=sk,
+        block_q=block_q_eff,
+        block_k=block_k_eff,
+        num_k_blocks=num_k_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q_eff, d), lambda i, qi, j: (i, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k_eff, d), lambda i, qi, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k_eff, d), lambda i, qi, j: (i, j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q_eff, d), lambda i, qi, j: (i, qi, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q_eff, d), jnp.float32),
+            pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
+            pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*qkv)
+    return out.reshape(b, h, sq_p, d)[:, :, :sq]
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jax.Array:
+    """Dispatch: Pallas flash kernel on TPU for unmasked/causal attention,
+    XLA reference elsewhere (CPU tests, explicit masks)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and mask is None and q.shape[-1] <= 256:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, kv_heads, S, D] -> [B, kv_heads*n_rep, S, D] for GQA."""
+    if n_rep == 1:
+        return x
+    b, h, s, d = x.shape
+    return jnp.broadcast_to(x[:, :, None], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)

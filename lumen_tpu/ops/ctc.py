@@ -1,0 +1,56 @@
+"""CTC greedy decoding for the OCR recognizer.
+
+The dense part (argmax over the vocab at every timestep + per-step
+confidence) is jit-safe and runs batched on device; the collapse/lookup to
+strings is host-side. Semantics match the reference decoder
+(``lumen_ocr/backends/onnxrt_backend.py:596-632``): blank index 0, collapse
+repeats, mean probability of emitted (non-blank, non-repeat) steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def ctc_greedy_device(logits: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, T, V] logits (or probabilities) -> ([B, T] argmax ids, [B, T] probs)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    ids = jnp.argmax(probs, axis=-1)
+    conf = jnp.max(probs, axis=-1)
+    return ids, conf
+
+
+def ctc_collapse(
+    ids: np.ndarray,
+    confs: np.ndarray,
+    vocab: list[str],
+    blank: int = 0,
+) -> tuple[str, float]:
+    """Host collapse of one sequence: drop repeats-then-blanks, join chars,
+    mean confidence over emitted steps (1.0 if nothing emitted)."""
+    prev = -1
+    chars: list[str] = []
+    scores: list[float] = []
+    for t, idx in enumerate(ids):
+        idx = int(idx)
+        if idx != blank and idx != prev:
+            if idx < len(vocab):
+                chars.append(vocab[idx])
+                scores.append(float(confs[t]))
+        prev = idx
+    text = "".join(chars)
+    return text, (float(np.mean(scores)) if scores else 1.0)
+
+
+def load_ctc_vocab(path: str, use_space_char: bool = True) -> list[str]:
+    """Character list: blank placeholder at index 0, then dictionary lines,
+    then optional trailing space (reference: ``onnxrt_backend.py:104-114``)."""
+    with open(path, "r", encoding="utf-8") as f:
+        chars = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+    vocab = ["<blank>"] + chars
+    if use_space_char:
+        vocab.append(" ")
+    return vocab

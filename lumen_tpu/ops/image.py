@@ -1,0 +1,102 @@
+"""Device-side image preprocessing.
+
+The reference does all preprocessing on host with PIL/cv2 per image
+(``onnxrt_backend.py:378-433``); here the dense parts (resize, normalize,
+layout) run batched on TPU so the host only decodes bytes. Host decode
+lives with the model managers (cv2/PIL are control-flow heavy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Normalization statistics (reference: clip loader defaults,
+# packages/lumen-clip/src/lumen_clip/resources/loader.py:101-139).
+OPENAI_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+OPENAI_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+@functools.partial(jax.jit, static_argnames=("size", "method"))
+def resize_bilinear(images: jax.Array, size: tuple[int, int], method: str = "bilinear") -> jax.Array:
+    """[B, H, W, C] uint8/float -> [B, size_h, size_w, C] float32."""
+    b, _, _, c = images.shape
+    return jax.image.resize(
+        images.astype(jnp.float32), (b, size[0], size[1], c), method=method
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def normalize(images: jax.Array, mean: jax.Array, std: jax.Array) -> jax.Array:
+    """[B, H, W, C] in [0, 255] -> normalized float32."""
+    x = images.astype(jnp.float32) / 255.0
+    return (x - mean) / std
+
+
+@functools.partial(jax.jit, static_argnames=("size", "mean", "std"))
+def clip_preprocess(
+    images: jax.Array,
+    size: int = 224,
+    mean: tuple[float, ...] = OPENAI_CLIP_MEAN,
+    std: tuple[float, ...] = OPENAI_CLIP_STD,
+) -> jax.Array:
+    """Batched CLIP preprocessing: resize + normalize, NHWC output.
+
+    Mirrors the reference preprocessor's semantics (direct resize to target,
+    ``onnxrt_backend.py:410-431``) so embeddings stay comparable.
+    """
+    x = resize_bilinear(images, (size, size))
+    return normalize(x, jnp.asarray(mean), jnp.asarray(std))
+
+
+def letterbox_params(h: int, w: int, target: int) -> tuple[float, int, int, int, int]:
+    """Aspect-preserving resize-with-padding geometry (host-side helper).
+
+    Returns ``(scale, new_h, new_w, pad_top, pad_left)``; the inverse maps
+    detector boxes back to original coordinates (reference face pipeline,
+    ``lumen_face/backends/onnxrt_backend.py:749-808``).
+    """
+    scale = min(target / h, target / w)
+    new_h, new_w = int(round(h * scale)), int(round(w * scale))
+    pad_top = (target - new_h) // 2
+    pad_left = (target - new_w) // 2
+    return scale, new_h, new_w, pad_top, pad_left
+
+
+def letterbox_numpy(img: np.ndarray, target: int, fill: int = 0) -> tuple[np.ndarray, float, int, int]:
+    """Host letterbox for a single decoded image [H, W, C] -> [target, target, C]."""
+    import cv2
+
+    h, w = img.shape[:2]
+    scale, new_h, new_w, pad_top, pad_left = letterbox_params(h, w, target)
+    resized = cv2.resize(img, (new_w, new_h), interpolation=cv2.INTER_LINEAR)
+    out = np.full((target, target, img.shape[2]), fill, dtype=img.dtype)
+    out[pad_top : pad_top + new_h, pad_left : pad_left + new_w] = resized
+    return out, scale, pad_top, pad_left
+
+
+def decode_image_bytes(payload: bytes, color: str = "rgb") -> np.ndarray:
+    """Host-side decode to [H, W, 3] uint8 (cv2; PIL fallback for exotic
+    formats)."""
+    import cv2
+
+    buf = np.frombuffer(payload, dtype=np.uint8)
+    img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+    if img is None:
+        from io import BytesIO
+
+        from PIL import Image
+
+        pil = Image.open(BytesIO(payload)).convert("RGB")
+        img = np.asarray(pil)
+        if color == "bgr":
+            img = img[:, :, ::-1]
+        return np.ascontiguousarray(img)
+    if color == "rgb":
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img

@@ -1,0 +1,82 @@
+"""Non-maximum suppression.
+
+Two implementations with one semantics:
+
+- :func:`nms_jax` — static-shape, jit-safe (fixed box count, returns a keep
+  mask) so detection post-processing can stay on device inside a batched
+  program;
+- :func:`nms_numpy` — host variant for the CV-heavy paths, same greedy
+  IoU-suppression semantics as the reference's pure-numpy NMS
+  (``lumen_face/backends/onnxrt_backend.py:391-423``).
+
+Boxes are ``[N, 4]`` as ``(x1, y1, x2, y2)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _iou_matrix(boxes: jnp.ndarray) -> jnp.ndarray:
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold",))
+def nms_jax(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float = 0.4,
+) -> jnp.ndarray:
+    """Greedy NMS as a keep-mask over N static boxes.
+
+    Scan over boxes in score order: a box is kept iff no higher-scoring kept
+    box overlaps it above the threshold. Invalid boxes should carry score
+    -inf (they are never kept).
+    """
+    order = jnp.argsort(-scores)
+    boxes_sorted = boxes[order]
+    iou = _iou_matrix(boxes_sorted)
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # Suppressed if any earlier kept box overlaps too much.
+        overlap = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~overlap.any() & keep[i])
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.isfinite(scores[order]))
+    # Map keep decisions back to original box order.
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms_numpy(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.4) -> np.ndarray:
+    """Host greedy NMS; returns kept indices sorted by descending score."""
+    if len(boxes) == 0:
+        return np.empty((0,), np.int64)
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    order = scores.argsort()[::-1]
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1[order[1:]])
+        yy1 = np.maximum(y1[i], y1[order[1:]])
+        xx2 = np.minimum(x2[i], x2[order[1:]])
+        yy2 = np.minimum(y2[i], y2[order[1:]])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas[order[1:]] - inter, 1e-9)
+        order = order[1:][iou <= iou_threshold]
+    return np.asarray(keep, np.int64)

@@ -1,0 +1,62 @@
+"""Token sampling for autoregressive generation — fully jit-safe.
+
+The reference samples on host per step with numpy
+(``lumen_vlm/backends/onnxrt_backend.py:508-533``: greedy, or temperature +
+top-p over a sorted copy); here sampling lives inside the compiled decode
+loop so generation never round-trips to host per token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """[..., V] -> [...] argmax token ids."""
+    return jnp.argmax(logits, axis=-1)
+
+
+def apply_repetition_penalty(
+    logits: jnp.ndarray, token_mask: jnp.ndarray, penalty: float
+) -> jnp.ndarray:
+    """CTRL-style penalty over tokens already generated (``token_mask``:
+    [..., V] bool). Positive logits are divided, negative multiplied."""
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(token_mask, penalized, logits)
+
+
+def top_p_filter(logits: jnp.ndarray, top_p: jnp.ndarray | float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of sorted tokens whose
+    cumulative probability reaches ``top_p``; the rest get -inf."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # Position k is kept if the cumulative mass BEFORE it is < top_p; the
+    # top-1 token is always kept (top_p=0 must mean greedy, not empty set).
+    keep_sorted = (cumulative - sorted_probs) < top_p
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    # Threshold logit = smallest kept logit.
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= threshold, logits, -jnp.inf)
+
+
+def sample(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray | float = 1.0,
+    top_p: jnp.ndarray | float = 1.0,
+    do_sample: jnp.ndarray | bool = True,
+) -> jnp.ndarray:
+    """Temperature + top-p categorical sampling; falls back to greedy when
+    ``do_sample`` is False or temperature ~ 0. All args may be traced values
+    so one compiled program serves every generation config."""
+    greedy_ids = greedy(logits)
+    safe_temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    scaled = logits.astype(jnp.float32) / safe_temp
+    filtered = top_p_filter(scaled, top_p)
+    sampled_ids = jax.random.categorical(rng, filtered, axis=-1)
+    use_sample = jnp.asarray(do_sample) & (jnp.asarray(temperature, jnp.float32) > 1e-6)
+    return jnp.where(use_sample, sampled_ids, greedy_ids)

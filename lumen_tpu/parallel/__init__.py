@@ -1,0 +1,15 @@
+"""Parallelism: sharding rules, ring attention, multi-host runtime."""
+
+from .distributed import initialize, is_primary
+from .ring_attention import ring_attention
+from .sharding import TRANSFORMER_TP_RULES, replicate, shard_params, spec_for
+
+__all__ = [
+    "initialize",
+    "is_primary",
+    "ring_attention",
+    "shard_params",
+    "replicate",
+    "spec_for",
+    "TRANSFORMER_TP_RULES",
+]

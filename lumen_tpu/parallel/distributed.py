@@ -1,0 +1,65 @@
+"""Multi-host process-group initialization.
+
+The reference's "distributed backend" is gRPC between microservices on one
+LAN (SURVEY.md §2.8); the TPU equivalent is a JAX distributed runtime: one
+process per host, DCN for control, ICI for collectives. This wrapper keeps
+single-host development zero-config while making pod slices a flag change.
+
+Env convention (matches TPU VM metadata/launchers):
+``LUMEN_COORDINATOR`` (host:port), ``LUMEN_NUM_PROCESSES``,
+``LUMEN_PROCESS_ID`` — explicit args win over env.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize the multi-host runtime if configured; returns True when a
+    multi-process group is live, False for single-host operation."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("LUMEN_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("LUMEN_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid_env = os.environ.get("LUMEN_PROCESS_ID")
+        process_id = int(pid_env) if pid_env is not None else None
+
+    if not coordinator_address or num_processes <= 1:
+        logger.info("single-host mode (%d local devices)", jax.local_device_count())
+        return False
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "multi-host runtime up: process %d/%d, %d global / %d local devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.device_count(),
+        jax.local_device_count(),
+    )
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should bind user-facing servers / write
+    checkpoints."""
+    return jax.process_index() == 0

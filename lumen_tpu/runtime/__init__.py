@@ -1,0 +1,52 @@
+"""TPU runtime core: device mesh, dtype policy, weight loading, batching.
+
+The execution layer that replaces the reference's ONNX-Runtime/libtorch
+backends (`SURVEY.md` §2 "native compute" note).
+"""
+
+from .batcher import MicroBatcher, bucket_for, default_buckets
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    build_mesh,
+    data_sharding,
+    local_batch_multiple,
+    replicated,
+    resolve_axes,
+)
+from .policy import Policy, get_policy
+from .weights import (
+    WeightLoadError,
+    apply_rules,
+    assert_tree_shapes,
+    conv_kernel,
+    flatten,
+    linear_kernel,
+    load_state_dict,
+    unflatten,
+)
+
+__all__ = [
+    "MicroBatcher",
+    "bucket_for",
+    "default_buckets",
+    "build_mesh",
+    "resolve_axes",
+    "data_sharding",
+    "replicated",
+    "local_batch_multiple",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "Policy",
+    "get_policy",
+    "WeightLoadError",
+    "load_state_dict",
+    "apply_rules",
+    "unflatten",
+    "flatten",
+    "linear_kernel",
+    "conv_kernel",
+    "assert_tree_shapes",
+]

@@ -1,0 +1,189 @@
+"""Micro-batching queue: the core TPU throughput mechanism.
+
+The reference serves exactly one payload per ONNX session call
+(`SURVEY.md` §2.8 "Batching"); on TPU that strands the MXU. This batcher
+sits between gRPC worker threads and a jit-compiled model function:
+
+- callers ``submit()`` single items and block on a future,
+- a collector thread drains the queue until ``max_batch`` items or
+  ``max_latency_ms`` elapsed since the first item,
+- items are stacked, padded to a static *bucket* size (so XLA compiles one
+  program per bucket, not per batch size), run as ONE device call, and the
+  results are scattered back to the callers.
+
+Shape buckets default to powers of two up to ``max_batch``; a warmup call
+per bucket at startup turns the reference's "model load time" into our
+"compile time" (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def default_buckets(max_batch: int) -> list[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+def bucket_for(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class MicroBatcher:
+    """Batch single-item pytrees through a batched function.
+
+    ``fn(batched_tree, n_valid) -> batched_result_tree`` where every leaf of
+    ``batched_tree`` has a leading bucket-size dim; the result's leaves must
+    share that leading dim (rows past ``n_valid`` are padding and dropped).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[[Any, int], Any],
+        max_batch: int = 8,
+        max_latency_ms: float = 5.0,
+        buckets: list[int] | None = None,
+        name: str = "batcher",
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.fn = fn
+        self.max_batch = max_batch
+        self.max_latency_s = max_latency_ms / 1e3
+        self.buckets = sorted(buckets) if buckets else default_buckets(max_batch)
+        if self.buckets[-1] < max_batch:
+            self.buckets.append(max_batch)
+        self.name = name
+        self._queue: queue.Queue[tuple[Any, Future] | None] = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._closed = threading.Event()
+        # Telemetry for capability metadata / benchmarks.
+        self.stats = {"batches": 0, "items": 0, "padded": 0}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, item: Any) -> Future:
+        if self._closed.is_set():
+            raise RuntimeError(f"{self.name} is closed")
+        fut: Future = Future()
+        self._queue.put((item, fut))
+        return fut
+
+    def __call__(self, item: Any, timeout: float | None = 60.0) -> Any:
+        return self.submit(item).result(timeout=timeout)
+
+    # -- collector thread -------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            first = self._queue.get()
+            if first is None:
+                break
+            batch = [first]
+            deadline = time.monotonic() + self.max_latency_s
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._closed.set()
+                    break
+                batch.append(nxt)
+            self._process(batch)
+        # Drain anything left after close.
+        while True:
+            try:
+                entry = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if entry is not None:
+                entry[1].set_exception(RuntimeError(f"{self.name} closed"))
+
+    def _process(self, batch: list[tuple[Any, Future]]) -> None:
+        items = [b[0] for b in batch]
+        futures = [b[1] for b in batch]
+        n = len(items)
+        size = bucket_for(n, self.buckets)
+        try:
+            stacked = stack_and_pad(items, size)
+            result = self.fn(stacked, n)
+            rows = unstack(result, n)
+        except Exception as e:  # noqa: BLE001 - fan the failure out to callers
+            logger.exception("%s: batched call failed (n=%d)", self.name, n)
+            for f in futures:
+                if not f.cancelled():
+                    f.set_exception(e)
+            return
+        self.stats["batches"] += 1
+        self.stats["items"] += n
+        self.stats["padded"] += size - n
+        for f, row in zip(futures, rows):
+            if not f.cancelled():
+                f.set_result(row)
+
+
+# -- pytree stacking helpers ------------------------------------------------
+
+
+def stack_and_pad(items: list[Any], size: int) -> Any:
+    """Stack a list of same-structure pytrees into one tree with leading dim
+    ``size``; rows past ``len(items)`` repeat the last item (repeating keeps
+    padding numerically harmless for ops like softmax over the batch)."""
+    n = len(items)
+    pad = size - n
+
+    def stack(*leaves):
+        arrs = [np.asarray(x) for x in leaves]
+        if pad:
+            arrs = arrs + [arrs[-1]] * pad
+        return np.stack(arrs)
+
+    return jax.tree_util.tree_map(stack, *items)
+
+
+def unstack(tree: Any, n: int) -> list[Any]:
+    """Split a batched result tree back into ``n`` single-item trees (host
+    numpy; one device->host transfer for the whole batch)."""
+    tree = jax.tree_util.tree_map(np.asarray, tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [
+        jax.tree_util.tree_unflatten(treedef, [leaf[i] for leaf in leaves])
+        for i in range(n)
+    ]

@@ -1,0 +1,87 @@
+"""Device-mesh construction and axis conventions.
+
+Framework-wide logical axis names (used by every sharded model and the
+batch-ingest scheduler):
+
+- ``data``  — batch/data parallelism (throughput scaling),
+- ``model`` — tensor parallelism (attention heads / MLP shards),
+- ``seq``   — sequence/context parallelism (ring attention).
+
+The reference has no device mesh at all (its concurrency is a gRPC thread
+pool over single-model ONNX sessions, ``src/lumen/server.py:232-235``);
+here every model call runs under a ``jax.sharding.Mesh`` even on one chip
+(trivial 1-device mesh), so scaling out is a config change, not a code path.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def resolve_axes(axes: dict[str, int], n_devices: int) -> dict[str, int]:
+    """Resolve a mesh request ({axis: size, one size may be -1}) against the
+    actual device count. The -1 axis absorbs all remaining devices."""
+    fixed = math.prod(s for s in axes.values() if s != -1)
+    if n_devices % fixed != 0:
+        raise ValueError(
+            f"mesh axes {axes} do not divide device count {n_devices} "
+            f"(fixed product {fixed})"
+        )
+    resolved = dict(axes)
+    for name, size in axes.items():
+        if size == -1:
+            resolved[name] = n_devices // fixed
+            break
+    if math.prod(resolved.values()) != n_devices:
+        raise ValueError(
+            f"mesh {resolved} uses {math.prod(resolved.values())} devices, "
+            f"have {n_devices}"
+        )
+    return resolved
+
+
+def build_mesh(
+    axes: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named mesh; default is all devices on one ``data`` axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = axes or {DATA_AXIS: -1}
+    resolved = resolve_axes(axes, len(devices))
+    names = tuple(resolved)
+    shape = tuple(resolved[n] for n in names)
+    if len(devices) == 1:
+        arr = np.array(devices).reshape(shape)
+    else:
+        arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    mesh = Mesh(arr, names)
+    logger.info("mesh: %s over %d device(s)", dict(zip(names, shape)), len(devices))
+    return mesh
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over ``data``; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_multiple(mesh: Mesh) -> int:
+    """Global batch sizes fed to a data-parallel jit must be a multiple of
+    this (the ``data`` axis size)."""
+    return mesh.shape.get(DATA_AXIS, 1)

@@ -1,0 +1,57 @@
+"""Mixed-precision policy.
+
+TPU MXU wants bf16 matmuls; embeddings/results leave the device as fp32.
+One small policy object threads through every model instead of per-backend
+fp16 special cases (reference: CUDA AMP autocast at
+``packages/lumen-clip/src/lumen_clip/backends/torch_backend.py:127-129``,
+ONNX fp16 I/O juggling at ``onnxrt_backend.py:594-659``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+    output_dtype: jnp.dtype
+
+    def cast_params(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    import jax
+
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+_POLICIES = {
+    # name -> (params, compute, output)
+    "bfloat16": Policy(jnp.bfloat16, jnp.bfloat16, jnp.float32),
+    "float32": Policy(jnp.float32, jnp.float32, jnp.float32),
+    # fp16 accepted for config compat; on TPU bf16 is almost always better.
+    "float16": Policy(jnp.float16, jnp.float16, jnp.float32),
+}
+
+
+def get_policy(name: str) -> Policy:
+    try:
+        return _POLICIES[name]
+    except KeyError as e:
+        raise ValueError(f"unknown dtype policy {name!r}; valid: {sorted(_POLICIES)}") from e

@@ -1,0 +1,197 @@
+"""Checkpoint loading: safetensors / torch checkpoints -> jnp parameter trees.
+
+Replaces the reference's opaque-graph model loading (ONNX session files,
+``onnxrt_backend.py``) with explicit weight trees for Flax modules. Handles:
+
+- ``.safetensors`` (single file or ``*.safetensors.index.json`` shards),
+- torch ``.bin``/``.pt`` pickles (``weights_only`` load; torch is CPU-only
+  in this image and used purely as a deserializer),
+- layout conversion helpers (torch ``Linear [out,in]`` -> jax ``[in,out]``,
+  torch conv ``OIHW`` -> flax ``HWIO``),
+- a small regex-rule engine for checkpoint-key -> param-tree-path renames
+  that model converters build on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Callable, Iterable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class WeightLoadError(Exception):
+    pass
+
+
+# -- raw state-dict loading -------------------------------------------------
+
+
+def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    try:
+        return dict(load_file(path))
+    except Exception as e:  # noqa: BLE001
+        # bf16 tensors are not numpy-native; fall back through torch.
+        logger.debug("numpy safetensors load failed (%s); retrying via torch", e)
+        from safetensors.torch import load_file as load_torch
+
+        return {k: _torch_to_numpy(v) for k, v in load_torch(path).items()}
+
+
+def load_sharded_safetensors(index_path: str) -> dict[str, np.ndarray]:
+    with open(index_path, "r", encoding="utf-8") as f:
+        index = json.load(f)
+    base = os.path.dirname(index_path)
+    out: dict[str, np.ndarray] = {}
+    for shard in sorted(set(index["weight_map"].values())):
+        out.update(load_safetensors(os.path.join(base, shard)))
+    return out
+
+
+def load_torch_checkpoint(path: str) -> dict[str, np.ndarray]:
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state and isinstance(state["state_dict"], dict):
+        state = state["state_dict"]
+    return {k: _torch_to_numpy(v) for k, v in state.items() if hasattr(v, "numpy") or hasattr(v, "detach")}
+
+
+def _torch_to_numpy(t) -> np.ndarray:
+    import torch
+
+    t = t.detach()
+    if t.dtype == torch.bfloat16:
+        # numpy has no bf16; round-trip through fp32 (values preserved).
+        t = t.to(torch.float32)
+    return t.cpu().numpy()
+
+
+def load_state_dict(model_dir: str) -> dict[str, np.ndarray]:
+    """Load whatever checkpoint format a model directory carries, preferring
+    safetensors (sharded, then single), then torch pickles."""
+    index = [f for f in os.listdir(model_dir) if f.endswith(".safetensors.index.json")]
+    if index:
+        return load_sharded_safetensors(os.path.join(model_dir, index[0]))
+    st = sorted(f for f in os.listdir(model_dir) if f.endswith(".safetensors"))
+    if st:
+        out: dict[str, np.ndarray] = {}
+        for f in st:
+            out.update(load_safetensors(os.path.join(model_dir, f)))
+        return out
+    binaries = sorted(
+        f for f in os.listdir(model_dir) if f.endswith((".bin", ".pt")) and not f.startswith(".")
+    )
+    if binaries:
+        out = {}
+        for f in binaries:
+            out.update(load_torch_checkpoint(os.path.join(model_dir, f)))
+        return out
+    raise WeightLoadError(f"no checkpoint files found in {model_dir}")
+
+
+# -- layout conversion ------------------------------------------------------
+
+
+def linear_kernel(w: np.ndarray) -> np.ndarray:
+    """torch ``nn.Linear.weight`` [out, in] -> flax ``Dense`` kernel [in, out]."""
+    return np.ascontiguousarray(w.T)
+
+
+def conv_kernel(w: np.ndarray) -> np.ndarray:
+    """torch conv weight OIHW -> flax conv kernel HWIO."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+# -- rename-rule engine -----------------------------------------------------
+
+#: (regex pattern, replacement-template, optional value transform)
+RenameRule = tuple[str, str, Callable[[np.ndarray], np.ndarray] | None]
+
+
+def apply_rules(
+    state: dict[str, np.ndarray],
+    rules: Iterable[RenameRule],
+    strict: bool = False,
+    drop: Iterable[str] = (),
+) -> dict[str, np.ndarray]:
+    """Map checkpoint keys to param-tree paths via the first matching rule.
+
+    Output keys are '/'-separated param paths (e.g.
+    ``vision/blocks_0/attn/qkv/kernel``). ``drop`` patterns are removed
+    silently; unmatched keys raise (strict) or are logged and skipped.
+    """
+    compiled = [(re.compile(p), t, fn) for p, t, fn in rules]
+    dropped = [re.compile(p) for p in drop]
+    out: dict[str, np.ndarray] = {}
+    unmatched: list[str] = []
+    for key, value in state.items():
+        if any(d.search(key) for d in dropped):
+            continue
+        for pat, template, fn in compiled:
+            m = pat.fullmatch(key)
+            if m:
+                new_key = m.expand(template)
+                out[new_key] = fn(value) if fn else value
+                break
+        else:
+            unmatched.append(key)
+    if unmatched:
+        msg = f"{len(unmatched)} checkpoint keys unmatched by rename rules: {unmatched[:8]}"
+        if strict:
+            raise WeightLoadError(msg)
+        logger.warning(msg)
+    return out
+
+
+def unflatten(flat: dict[str, np.ndarray]) -> dict:
+    """'/'-separated flat keys -> nested param dict (a Flax params tree)."""
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+            if not isinstance(node, dict):
+                raise WeightLoadError(f"key {key!r} conflicts with leaf at {p!r}")
+        node[parts[-1]] = value
+    return tree
+
+
+def flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def assert_tree_shapes(loaded: dict, initialized: dict) -> None:
+    """Fidelity gate: a converted checkpoint must match the module's
+    init-time tree exactly (names and shapes) — this is where silent
+    conversion bugs die (SURVEY.md §7 hard part 3)."""
+    lf, rf = flatten(loaded), flatten(initialized)
+    missing = sorted(set(rf) - set(lf))
+    extra = sorted(set(lf) - set(rf))
+    if missing or extra:
+        raise WeightLoadError(
+            f"param tree mismatch: missing={missing[:8]} extra={extra[:8]} "
+            f"(missing {len(missing)}, extra {len(extra)})"
+        )
+    bad = [
+        f"{k}: ckpt{tuple(lf[k].shape)} vs init{tuple(rf[k].shape)}"
+        for k in rf
+        if tuple(lf[k].shape) != tuple(rf[k].shape)
+    ]
+    if bad:
+        raise WeightLoadError(f"param shape mismatches: {bad[:8]}")

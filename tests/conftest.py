@@ -8,13 +8,21 @@ TPU hardware, mirroring the strategy described in SURVEY.md §4.
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
+# Must be set before jax initializes a backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Some pytest entry-point plugins (jaxtyping) import jax BEFORE conftest
+# runs, latching jax_platforms from the shell environment (a real TPU under
+# the driver). Re-point the already-imported config at CPU; backends are
+# initialized lazily, so this sticks as long as no devices were touched yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import lumen_tpu` works without installation.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,187 @@
+"""Ops tests: attention (Pallas kernel vs XLA reference), NMS parity,
+CTC decode, sampling distributions, image preprocessing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lumen_tpu.ops import (
+    attention_reference,
+    clip_preprocess,
+    ctc_collapse,
+    ctc_greedy_device,
+    flash_attention,
+    letterbox_numpy,
+    nms_jax,
+    nms_numpy,
+    repeat_kv,
+    sample,
+    top_p_filter,
+)
+
+
+def rand_qkv(rng, b=2, h=4, sq=64, sk=64, d=32, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    return (
+        jax.random.normal(kq, (b, h, sq, d), dtype),
+        jax.random.normal(kk, (b, h, sk, d), dtype),
+        jax.random.normal(kv, (b, h, sk, d), dtype),
+    )
+
+
+class TestAttention:
+    def test_reference_softmax_rows_sum(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(0))
+        out = attention_reference(q, k, v)
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_matches_reference(self, causal):
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), sq=128, sk=128, d=64)
+        ref = attention_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_flash_unpadded_sequences(self):
+        # seq not a multiple of block: causal path pads and still matches.
+        q, k, v = rand_qkv(jax.random.PRNGKey(2), sq=80, sk=80, d=32)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_causal_first_token_attends_self_only(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), sq=16, sk=16, d=16)
+        out = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out[:, :, 0]), np.asarray(v[:, :, 0]), atol=1e-5)
+
+    def test_repeat_kv(self):
+        x = jnp.arange(2 * 2 * 3 * 4).reshape(2, 2, 3, 4)
+        y = repeat_kv(x, 3)
+        assert y.shape == (2, 6, 3, 4)
+        np.testing.assert_array_equal(np.asarray(y[:, 0]), np.asarray(y[:, 2]))
+
+
+class TestNms:
+    def test_numpy_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = nms_numpy(boxes, scores, 0.4)
+        assert list(keep) == [0, 2]
+
+    def test_jax_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        n = 64
+        xy = rng.uniform(0, 100, (n, 2)).astype(np.float32)
+        wh = rng.uniform(5, 30, (n, 2)).astype(np.float32)
+        boxes = np.concatenate([xy, xy + wh], axis=1)
+        scores = rng.uniform(0, 1, n).astype(np.float32)
+        ref = set(nms_numpy(boxes, scores, 0.5).tolist())
+        keep_mask = np.asarray(nms_jax(jnp.asarray(boxes), jnp.asarray(scores), 0.5))
+        assert set(np.nonzero(keep_mask)[0].tolist()) == ref
+
+    def test_jax_static_shape_with_padding(self):
+        boxes = jnp.zeros((8, 4))
+        scores = jnp.full((8,), -jnp.inf).at[0].set(1.0)
+        boxes = boxes.at[0].set(jnp.array([0, 0, 10, 10]))
+        keep = np.asarray(nms_jax(boxes, scores, 0.4))
+        assert keep[0] and keep.sum() == 1  # -inf rows never kept
+
+
+class TestCtc:
+    def test_collapse_semantics(self):
+        vocab = ["<blank>", "a", "b", "c"]
+        ids = np.array([1, 1, 0, 1, 2, 0, 0, 3])
+        confs = np.ones(8) * 0.5
+        text, conf = ctc_collapse(ids, confs, vocab)
+        assert text == "aabc"
+        assert conf == pytest.approx(0.5)
+
+    def test_empty_sequence(self):
+        text, conf = ctc_collapse(np.zeros(4, int), np.ones(4), ["<blank>", "x"])
+        assert text == "" and conf == 1.0
+
+    def test_device_argmax(self):
+        logits = jnp.zeros((1, 3, 4)).at[0, 0, 2].set(5.0).at[0, 1, 0].set(5.0).at[0, 2, 1].set(5.0)
+        ids, conf = ctc_greedy_device(logits)
+        assert ids.tolist() == [[2, 0, 1]]
+        assert float(conf[0, 0]) > 0.9
+
+
+class TestSampling:
+    def test_greedy_when_do_sample_false(self):
+        logits = jnp.array([[0.1, 5.0, 0.2]])
+        tok = sample(jax.random.PRNGKey(0), logits, temperature=1.0, do_sample=False)
+        assert tok.tolist() == [1]
+
+    def test_temperature_zero_is_greedy(self):
+        logits = jnp.array([[0.1, 5.0, 0.2]])
+        tok = sample(jax.random.PRNGKey(0), logits, temperature=0.0, do_sample=True)
+        assert tok.tolist() == [1]
+
+    def test_top_p_filters_tail(self):
+        logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+        filtered = top_p_filter(logits, 0.7)
+        # 0.5 + 0.3 >= 0.7 -> only the first two survive
+        assert np.isfinite(np.asarray(filtered[0, :2])).all()
+        assert np.isneginf(np.asarray(filtered[0, 2:])).all()
+
+    def test_sampling_respects_distribution(self):
+        logits = jnp.log(jnp.array([0.8, 0.2]))
+        keys = jax.random.split(jax.random.PRNGKey(0), 500)
+        toks = jax.vmap(lambda k: sample(k, logits, temperature=1.0, top_p=1.0))(keys)
+        frac = float(np.mean(np.asarray(toks) == 0))
+        assert 0.7 < frac < 0.9
+
+
+class TestImage:
+    def test_clip_preprocess_shape_and_range(self):
+        imgs = jnp.ones((2, 100, 160, 3), jnp.uint8) * 128
+        out = clip_preprocess(imgs, size=224)
+        assert out.shape == (2, 224, 224, 3)
+        # 128/255 normalized by CLIP stats is near zero.
+        assert abs(float(out.mean())) < 1.0
+
+    def test_letterbox_preserves_aspect(self):
+        img = np.zeros((100, 200, 3), np.uint8)
+        out, scale, pad_top, pad_left = letterbox_numpy(img, 64)
+        assert out.shape == (64, 64, 3)
+        assert scale == pytest.approx(64 / 200)
+        assert pad_top == (64 - 32) // 2 and pad_left == 0
+
+
+class TestAttentionEdgeCases:
+    def test_flash_kv_cache_decode_offset(self):
+        # sq != sk causal: query i attends keys <= i + sk - sq.
+        q, k, v = rand_qkv(jax.random.PRNGKey(9), sq=16, sk=64, d=32)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_flash_noncausal_padded_k(self):
+        # sk not a block multiple: padded K positions must get zero weight.
+        q, k, v = rand_qkv(jax.random.PRNGKey(10), sq=32, sk=40, d=32)
+        ref = attention_reference(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_top_p_zero_is_greedy(self):
+        logits = jnp.array([[0.1, 5.0, 0.2]])
+        for seed in range(5):
+            tok = sample(jax.random.PRNGKey(seed), logits, temperature=1.0, top_p=0.0)
+            assert tok.tolist() == [1]
+
+
+class TestShardingNamedtuplePytree:
+    def test_keypath_str_handles_attr_keys(self):
+        from typing import NamedTuple
+        from lumen_tpu.parallel import shard_params, TRANSFORMER_TP_RULES
+        from lumen_tpu.runtime import build_mesh
+
+        class Params(NamedTuple):
+            kernel: jnp.ndarray
+
+        mesh = build_mesh({"data": -1})
+        sharded = shard_params({"layer": Params(kernel=jnp.ones((4, 4)))}, mesh, TRANSFORMER_TP_RULES)
+        assert sharded["layer"].kernel.shape == (4, 4)
