@@ -228,12 +228,21 @@ class Downloader:
         if model_cfg.dataset and info.datasets:
             ds = info.datasets.get(model_cfg.dataset)
             if ds:
-                for rel in (ds.labels, ds.embeddings):
-                    if not os.path.exists(os.path.join(path, rel)):
-                        raise DownloadError(
-                            f"dataset file missing after download: {rel}",
-                            repo_id=model_cfg.model,
-                        )
+                # Labels are required; precomputed embeddings are optional —
+                # the CLIP manager computes them from labels at startup when
+                # the .npy is absent (reference: clip_model.py:145-172).
+                if not os.path.exists(os.path.join(path, ds.labels)):
+                    raise DownloadError(
+                        f"dataset labels missing after download: {ds.labels}",
+                        repo_id=model_cfg.model,
+                    )
+                if not os.path.exists(os.path.join(path, ds.embeddings)):
+                    logger.warning(
+                        "dataset %r has no precomputed embeddings (%s); they "
+                        "will be computed at startup",
+                        model_cfg.dataset,
+                        ds.embeddings,
+                    )
 
     def cleanup_model(self, repo_name: str) -> None:
         """Rollback: remove a partially-downloaded model directory."""

@@ -1,0 +1,1 @@
+"""Model families: clip, face, ocr, vlm."""

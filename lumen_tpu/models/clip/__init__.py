@@ -1,0 +1,18 @@
+"""CLIP model family: Flax towers, checkpoint conversion, manager."""
+
+from .convert import convert_clip_checkpoint, convert_hf_clip, convert_openclip
+from .manager import CLIPManager, SCENE_LABELS
+from .modeling import CLIPConfig, CLIPModel, TowerConfig
+from .tokenizer import ClipTokenizer
+
+__all__ = [
+    "CLIPConfig",
+    "CLIPModel",
+    "TowerConfig",
+    "CLIPManager",
+    "SCENE_LABELS",
+    "ClipTokenizer",
+    "convert_clip_checkpoint",
+    "convert_hf_clip",
+    "convert_openclip",
+]

@@ -1,0 +1,166 @@
+"""Checkpoint conversion: HF transformers CLIP / OpenCLIP -> Flax params.
+
+Covers the two checkpoint families the reference loads (HF ``CLIPModel`` in
+``torch_backend.py:340-393``, OpenCLIP ``open_clip_pytorch_model.bin`` in
+``torch_backend.py:183-251``). The converted tree is validated against the
+module's init-time tree by ``assert_tree_shapes`` — names AND shapes must
+match exactly before any weight is served.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ...runtime.weights import (
+    apply_rules,
+    assert_tree_shapes,
+    conv_kernel,
+    linear_kernel,
+    unflatten,
+)
+
+logger = logging.getLogger(__name__)
+
+_ATTN = r"(q_proj|k_proj|v_proj)"
+
+HF_RULES = [
+    # text tower
+    (r"text_model\.embeddings\.token_embedding\.weight", r"text/token_embedding/embedding", None),
+    (r"text_model\.embeddings\.position_embedding\.weight", r"text/position_embedding", None),
+    (rf"text_model\.encoder\.layers\.(\d+)\.self_attn\.{_ATTN}\.weight", r"text/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"text_model\.encoder\.layers\.(\d+)\.self_attn\.{_ATTN}\.bias", r"text/blocks_\1/attn/\2/bias", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.weight", r"text/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"text_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.bias", r"text/blocks_\1/attn/out_proj/bias", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.layer_norm1\.weight", r"text/blocks_\1/ln1/scale", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.layer_norm1\.bias", r"text/blocks_\1/ln1/bias", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.layer_norm2\.weight", r"text/blocks_\1/ln2/scale", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.layer_norm2\.bias", r"text/blocks_\1/ln2/bias", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.weight", r"text/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"text_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.bias", r"text/blocks_\1/mlp/fc1/bias", None),
+    (r"text_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.weight", r"text/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"text_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.bias", r"text/blocks_\1/mlp/fc2/bias", None),
+    (r"text_model\.final_layer_norm\.weight", r"text/final_ln/scale", None),
+    (r"text_model\.final_layer_norm\.bias", r"text/final_ln/bias", None),
+    (r"text_projection\.weight", r"text/projection/kernel", linear_kernel),
+    # vision tower ("pre_layrnorm" is HF's actual key spelling)
+    (r"vision_model\.embeddings\.class_embedding", r"vision/class_embedding", None),
+    (r"vision_model\.embeddings\.patch_embedding\.weight", r"vision/patch_embed/kernel", conv_kernel),
+    (r"vision_model\.embeddings\.position_embedding\.weight", r"vision/position_embedding", None),
+    (r"vision_model\.pre_layrnorm\.weight", r"vision/pre_ln/scale", None),
+    (r"vision_model\.pre_layrnorm\.bias", r"vision/pre_ln/bias", None),
+    (rf"vision_model\.encoder\.layers\.(\d+)\.self_attn\.{_ATTN}\.weight", r"vision/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"vision_model\.encoder\.layers\.(\d+)\.self_attn\.{_ATTN}\.bias", r"vision/blocks_\1/attn/\2/bias", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.weight", r"vision/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"vision_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.bias", r"vision/blocks_\1/attn/out_proj/bias", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.layer_norm1\.weight", r"vision/blocks_\1/ln1/scale", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.layer_norm1\.bias", r"vision/blocks_\1/ln1/bias", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.layer_norm2\.weight", r"vision/blocks_\1/ln2/scale", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.layer_norm2\.bias", r"vision/blocks_\1/ln2/bias", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.weight", r"vision/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"vision_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.bias", r"vision/blocks_\1/mlp/fc1/bias", None),
+    (r"vision_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.weight", r"vision/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"vision_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.bias", r"vision/blocks_\1/mlp/fc2/bias", None),
+    (r"vision_model\.post_layernorm\.weight", r"vision/post_ln/scale", None),
+    (r"vision_model\.post_layernorm\.bias", r"vision/post_ln/bias", None),
+    (r"visual_projection\.weight", r"vision/projection/kernel", linear_kernel),
+    (r"logit_scale", r"logit_scale", None),
+]
+
+HF_DROP = [r"position_ids$", r"logit_bias"]
+
+
+def convert_hf_clip(state: dict[str, np.ndarray]) -> dict:
+    flat = apply_rules(state, HF_RULES, drop=HF_DROP)
+    return unflatten(flat)
+
+
+# -- OpenCLIP ---------------------------------------------------------------
+
+OPENCLIP_RULES = [
+    (r"visual\.class_embedding", r"vision/class_embedding", None),
+    (r"visual\.conv1\.weight", r"vision/patch_embed/kernel", conv_kernel),
+    (r"visual\.positional_embedding", r"vision/position_embedding", None),
+    (r"visual\.ln_pre\.weight", r"vision/pre_ln/scale", None),
+    (r"visual\.ln_pre\.bias", r"vision/pre_ln/bias", None),
+    (rf"visual\.transformer\.resblocks\.(\d+)\.attn\.{_ATTN}\.weight", r"vision/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"visual\.transformer\.resblocks\.(\d+)\.attn\.{_ATTN}\.bias", r"vision/blocks_\1/attn/\2/bias", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.attn\.out_proj\.weight", r"vision/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"visual\.transformer\.resblocks\.(\d+)\.attn\.out_proj\.bias", r"vision/blocks_\1/attn/out_proj/bias", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.ln_1\.weight", r"vision/blocks_\1/ln1/scale", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.ln_1\.bias", r"vision/blocks_\1/ln1/bias", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.ln_2\.weight", r"vision/blocks_\1/ln2/scale", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.ln_2\.bias", r"vision/blocks_\1/ln2/bias", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.mlp\.c_fc\.weight", r"vision/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"visual\.transformer\.resblocks\.(\d+)\.mlp\.c_fc\.bias", r"vision/blocks_\1/mlp/fc1/bias", None),
+    (r"visual\.transformer\.resblocks\.(\d+)\.mlp\.c_proj\.weight", r"vision/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"visual\.transformer\.resblocks\.(\d+)\.mlp\.c_proj\.bias", r"vision/blocks_\1/mlp/fc2/bias", None),
+    (r"visual\.ln_post\.weight", r"vision/post_ln/scale", None),
+    (r"visual\.ln_post\.bias", r"vision/post_ln/bias", None),
+    # [width, embed_dim] already in jax orientation
+    (r"visual\.proj", r"vision/projection/kernel", None),
+    (r"token_embedding\.weight", r"text/token_embedding/embedding", None),
+    (r"positional_embedding", r"text/position_embedding", None),
+    (rf"transformer\.resblocks\.(\d+)\.attn\.{_ATTN}\.weight", r"text/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"transformer\.resblocks\.(\d+)\.attn\.{_ATTN}\.bias", r"text/blocks_\1/attn/\2/bias", None),
+    (r"transformer\.resblocks\.(\d+)\.attn\.out_proj\.weight", r"text/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"transformer\.resblocks\.(\d+)\.attn\.out_proj\.bias", r"text/blocks_\1/attn/out_proj/bias", None),
+    (r"transformer\.resblocks\.(\d+)\.ln_1\.weight", r"text/blocks_\1/ln1/scale", None),
+    (r"transformer\.resblocks\.(\d+)\.ln_1\.bias", r"text/blocks_\1/ln1/bias", None),
+    (r"transformer\.resblocks\.(\d+)\.ln_2\.weight", r"text/blocks_\1/ln2/scale", None),
+    (r"transformer\.resblocks\.(\d+)\.ln_2\.bias", r"text/blocks_\1/ln2/bias", None),
+    (r"transformer\.resblocks\.(\d+)\.mlp\.c_fc\.weight", r"text/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"transformer\.resblocks\.(\d+)\.mlp\.c_fc\.bias", r"text/blocks_\1/mlp/fc1/bias", None),
+    (r"transformer\.resblocks\.(\d+)\.mlp\.c_proj\.weight", r"text/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"transformer\.resblocks\.(\d+)\.mlp\.c_proj\.bias", r"text/blocks_\1/mlp/fc2/bias", None),
+    (r"ln_final\.weight", r"text/final_ln/scale", None),
+    (r"ln_final\.bias", r"text/final_ln/bias", None),
+    (r"text_projection", r"text/projection/kernel", None),
+    (r"logit_scale", r"logit_scale", None),
+]
+
+OPENCLIP_DROP = [r"attn_mask", r"\.attn\.in_proj_(weight|bias)$"]
+
+
+def _split_fused_qkv(state: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """OpenCLIP fuses qkv as ``attn.in_proj_weight`` [3*width, width]; split
+    into the separate projections our module (and HF) use."""
+    out = dict(state)
+    for key in list(state):
+        if key.endswith("attn.in_proj_weight"):
+            w = state[key]
+            prefix = key[: -len("in_proj_weight")]
+            wq, wk, wv = np.split(w, 3, axis=0)
+            out[prefix + "q_proj.weight"] = wq
+            out[prefix + "k_proj.weight"] = wk
+            out[prefix + "v_proj.weight"] = wv
+        elif key.endswith("attn.in_proj_bias"):
+            b = state[key]
+            prefix = key[: -len("in_proj_bias")]
+            bq, bk, bv = np.split(b, 3, axis=0)
+            out[prefix + "q_proj.bias"] = bq
+            out[prefix + "k_proj.bias"] = bk
+            out[prefix + "v_proj.bias"] = bv
+    return out
+
+
+def convert_openclip(state: dict[str, np.ndarray]) -> dict:
+    flat = apply_rules(_split_fused_qkv(state), OPENCLIP_RULES, drop=OPENCLIP_DROP)
+    return unflatten(flat)
+
+
+def convert_clip_checkpoint(state: dict[str, np.ndarray], init_params: dict | None = None) -> dict:
+    """Sniff the checkpoint family, convert, and (optionally) gate against
+    the module's initialized tree."""
+    if any(k.startswith(("text_model.", "vision_model.")) for k in state):
+        params = convert_hf_clip(state)
+    elif any(k.startswith(("visual.", "transformer.")) for k in state):
+        params = convert_openclip(state)
+    else:
+        raise ValueError(
+            f"unrecognized CLIP checkpoint family (keys like: {sorted(state)[:5]})"
+        )
+    if init_params is not None:
+        assert_tree_shapes(params, init_params)
+    return params

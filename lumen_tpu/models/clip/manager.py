@@ -1,0 +1,341 @@
+"""CLIP model manager: embeddings + zero-shot classification on TPU.
+
+Business-logic layer mirroring the reference's ``CLIPModelManager``
+(``packages/lumen-clip/src/lumen_clip/general_clip/clip_model.py:48-403``)
+and ``BioCLIPModelManager`` (``expert_bioclip/bioclip_model.py:45-375``),
+rebuilt around jitted Flax towers behind micro-batchers:
+
+- image/text encode are batched device calls (bucketed static shapes), not
+  per-request session runs;
+- classification is a device-side matmul against a resident label-embedding
+  matrix (softmax mode for curated label sets; raw-cosine mode for huge
+  taxonomies, the BioCLIP behavior at ``bioclip_model.py:310-316``);
+- label embeddings load from the dataset's precomputed ``.npy`` or are
+  computed on startup from labels via prompt templates
+  (``clip_model.py:145-172``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.model_info import ModelInfo, load_model_info
+from ...ops.image import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    OPENAI_CLIP_MEAN,
+    OPENAI_CLIP_STD,
+    decode_image_bytes,
+)
+from ...runtime.batcher import MicroBatcher
+from ...runtime.mesh import build_mesh
+from ...runtime.policy import get_policy
+from ...runtime.weights import load_state_dict
+from .convert import convert_clip_checkpoint
+from .modeling import CLIPConfig, CLIPModel
+from .tokenizer import ClipTokenizer
+
+logger = logging.getLogger(__name__)
+
+# Generic scene buckets for the scene-classify task (role of the reference's
+# hardcoded scene prompt list, clip_model.py:90-99; wording is ours).
+SCENE_LABELS = [
+    "indoor room",
+    "city street",
+    "natural landscape",
+    "beach or coastline",
+    "mountains",
+    "forest",
+    "food on a table",
+    "document or screenshot",
+    "people at an event",
+    "animal close-up",
+]
+DEFAULT_PROMPT_TEMPLATE = "a photo of a {}"
+
+
+@dataclass
+class ClassifyResult:
+    labels: list[tuple[str, float]]  # (label, score) best-first
+
+
+class CLIPManager:
+    """One loaded CLIP model + its datasets, ready to serve."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        dataset: str | None = None,
+        dtype: str = "bfloat16",
+        batch_size: int = 8,
+        max_batch_latency_ms: float = 5.0,
+        mesh_axes: dict[str, int] | None = None,
+        classify_mode: Literal["softmax", "cosine"] = "softmax",
+    ):
+        self.model_dir = model_dir
+        self.dataset_name = dataset
+        self.classify_mode = classify_mode
+        self.policy = get_policy(dtype)
+        self.batch_size = batch_size
+        self.max_batch_latency_ms = max_batch_latency_ms
+        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        self.info: ModelInfo = load_model_info(model_dir)
+        self.cfg = self._build_config(model_dir)
+        self.model = CLIPModel(self.cfg)
+        self.model_id = self.info.name
+        self._initialized = False
+        self._image_batcher: MicroBatcher | None = None
+        self._text_batcher: MicroBatcher | None = None
+        self.label_names: list[str] = []
+        self._label_matrix: jax.Array | None = None  # [L, D] unit-norm fp32
+
+    # -- configuration ----------------------------------------------------
+
+    def _build_config(self, model_dir: str) -> CLIPConfig:
+        cfg_path = os.path.join(model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path, "r", encoding="utf-8") as f:
+                raw = json.load(f)
+            if "vision_config" in raw:
+                return CLIPConfig.from_hf(raw)
+        # openclip-style config (open_clip_config.json) — reference loader
+        # distinguishes the two the same way (resources/loader.py:186-204).
+        oc_path = os.path.join(model_dir, "open_clip_config.json")
+        if os.path.exists(oc_path):
+            with open(oc_path, "r", encoding="utf-8") as f:
+                raw = json.load(f).get("model_cfg", {})
+            from .modeling import TowerConfig
+
+            v, t = raw.get("vision_cfg", {}), raw.get("text_cfg", {})
+            return CLIPConfig(
+                embed_dim=raw.get("embed_dim", 512),
+                image_size=v.get("image_size", 224),
+                patch_size=v.get("patch_size", 32),
+                vision=TowerConfig(v.get("width", 768), v.get("layers", 12), v.get("width", 768) // 64),
+                text=TowerConfig(t.get("width", 512), t.get("layers", 12), t.get("heads", 8)),
+                vocab_size=t.get("vocab_size", 49408),
+                context_length=t.get("context_length", 77),
+            )
+        raise FileNotFoundError(f"no config.json / open_clip_config.json in {model_dir}")
+
+    @property
+    def norm_stats(self) -> tuple[tuple[float, ...], tuple[float, ...]]:
+        """Normalization stats; OpenAI-CLIP defaults unless the model name
+        suggests ImageNet stats (reference heuristic, loader.py:101-139)."""
+        name = self.info.name.lower()
+        if "bioclip" in name or "imagenet" in (self.info.extra("norm", "") or ""):
+            return IMAGENET_MEAN, IMAGENET_STD
+        return OPENAI_CLIP_MEAN, OPENAI_CLIP_STD
+
+    # -- initialization ---------------------------------------------------
+
+    def initialize(self) -> None:
+        if self._initialized:
+            return
+        logger.info("loading CLIP weights from %s", self.model_dir)
+        state = load_state_dict(self.model_dir)
+        init = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32),
+                jnp.zeros((1, self.cfg.context_length), jnp.int32),
+            )["params"]
+        )
+        params = convert_clip_checkpoint(state, init)
+        params = self.policy.cast_params(params)
+        self.params = jax.device_put(params)
+        self.tokenizer = ClipTokenizer.from_model_dir(self.model_dir, self.cfg.context_length)
+
+        mean, std = self.norm_stats
+        compute_dtype = self.policy.compute_dtype
+
+        @jax.jit
+        def encode_images(params, pixels_u8):
+            # pixels_u8: [B, S, S, 3] uint8 (resized on host or device-resized
+            # upstream); normalize + cast on device.
+            x = pixels_u8.astype(jnp.float32) / 255.0
+            x = (x - jnp.asarray(mean)) / jnp.asarray(std)
+            z = self.model.apply(
+                {"params": params},
+                x.astype(compute_dtype),
+                method=lambda m, px: m.encode_image(px),
+            )
+            return z  # fp32 unit-norm
+
+        @jax.jit
+        def encode_texts(params, ids):
+            return self.model.apply(
+                {"params": params}, ids, method=lambda m, i: m.encode_text(i)
+            )
+
+        self._encode_images = encode_images
+        self._encode_texts = encode_texts
+
+        self._image_batcher = MicroBatcher(
+            lambda pixels, n: np.asarray(self._encode_images(self.params, pixels)),
+            max_batch=self.batch_size,
+            max_latency_ms=self.max_batch_latency_ms,
+            name="clip-image",
+        ).start()
+        self._text_batcher = MicroBatcher(
+            lambda ids, n: np.asarray(self._encode_texts(self.params, ids)),
+            max_batch=self.batch_size,
+            max_latency_ms=self.max_batch_latency_ms,
+            name="clip-text",
+        ).start()
+
+        self._load_label_embeddings()
+        self._initialized = True
+        logger.info(
+            "CLIP ready: %s embed_dim=%d labels=%d",
+            self.model_id,
+            self.cfg.embed_dim,
+            len(self.label_names),
+        )
+
+    def close(self) -> None:
+        if self._image_batcher:
+            self._image_batcher.close()
+        if self._text_batcher:
+            self._text_batcher.close()
+        self._initialized = False
+
+    # -- datasets ---------------------------------------------------------
+
+    def _load_label_embeddings(self) -> None:
+        if not self.dataset_name or not self.info.datasets:
+            return
+        ds = self.info.datasets.get(self.dataset_name)
+        if ds is None:
+            logger.warning("dataset %r not in model_info; classify disabled", self.dataset_name)
+            return
+        labels_path = os.path.join(self.model_dir, ds.labels)
+        with open(labels_path, "r", encoding="utf-8") as f:
+            raw_labels = json.load(f)
+        self.label_names = [self._label_text(entry) for entry in raw_labels]
+        emb_path = os.path.join(self.model_dir, ds.embeddings)
+        if os.path.exists(emb_path):
+            mat = np.load(emb_path, mmap_mode="r")
+            mat = np.asarray(mat, np.float32)
+            # Axis-order autodetect (reference: bioclip_model.py:287-309).
+            if mat.shape[0] != len(self.label_names) and mat.shape[-1] == len(self.label_names):
+                mat = mat.T
+            if mat.shape[0] != len(self.label_names):
+                raise ValueError(
+                    f"label embedding shape {mat.shape} does not match "
+                    f"{len(self.label_names)} labels"
+                )
+        else:
+            logger.info("no precomputed label embeddings; encoding %d labels", len(self.label_names))
+            mat = self._compute_label_embeddings(self.label_names)
+        mat = mat / np.maximum(np.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
+        self._label_matrix = jnp.asarray(mat)
+
+    @staticmethod
+    def _label_text(entry) -> str:
+        """Dataset label entries are either plain strings or BioCLIP-style
+        ``[[taxonomy...], common_name]`` pairs (reference name extraction,
+        ``bioclip_model.py:192-217``)."""
+        if isinstance(entry, str):
+            return entry
+        if isinstance(entry, (list, tuple)) and len(entry) == 2:
+            taxonomy, common = entry
+            if isinstance(common, str) and common:
+                return common
+            if isinstance(taxonomy, (list, tuple)) and taxonomy:
+                return str(taxonomy[-1])
+        return str(entry)
+
+    def _compute_label_embeddings(self, labels: list[str], template: str = DEFAULT_PROMPT_TEMPLATE) -> np.ndarray:
+        out = []
+        bs = max(self.batch_size, 16)
+        for i in range(0, len(labels), bs):
+            chunk = [template.format(l) for l in labels[i : i + bs]]
+            ids = self.tokenizer.encode_batch(chunk)
+            out.append(np.asarray(self._encode_texts(self.params, jnp.asarray(ids))))
+        return np.concatenate(out, axis=0)
+
+    # -- inference API ----------------------------------------------------
+
+    def encode_image(self, image_bytes: bytes) -> np.ndarray:
+        """Single image bytes -> unit-norm fp32 embedding (batched under the
+        hood with concurrent callers)."""
+        self._ensure_ready()
+        import cv2
+
+        img = decode_image_bytes(image_bytes, color="rgb")
+        size = self.cfg.image_size
+        resized = cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
+        vec = self._image_batcher(resized)
+        return self._check_vector(vec)
+
+    def encode_text(self, text: str) -> np.ndarray:
+        self._ensure_ready()
+        ids = self.tokenizer.encode_batch([text])[0]
+        vec = self._text_batcher(ids)
+        return self._check_vector(vec)
+
+    def classify_image(self, image_bytes: bytes, top_k: int = 5) -> ClassifyResult:
+        self._ensure_ready()
+        if self._label_matrix is None:
+            raise RuntimeError("no dataset loaded; classification unavailable")
+        vec = self.encode_image(image_bytes)
+        return self._classify_vector(vec, self.label_names, self._label_matrix, top_k)
+
+    def classify_scene(self, image_bytes: bytes, top_k: int = 3) -> ClassifyResult:
+        self._ensure_ready()
+        if not hasattr(self, "_scene_matrix"):
+            mat = self._compute_label_embeddings(SCENE_LABELS)
+            mat = mat / np.maximum(np.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
+            self._scene_matrix = jnp.asarray(mat)
+        vec = self.encode_image(image_bytes)
+        return self._classify_vector(vec, SCENE_LABELS, self._scene_matrix, top_k)
+
+    def _classify_vector(
+        self, vec: np.ndarray, names: list[str], matrix: jax.Array, top_k: int
+    ) -> ClassifyResult:
+        sims = np.asarray(matrix @ jnp.asarray(vec))  # cosine: both unit-norm
+        top_k = min(top_k, len(names))
+        idx = np.argpartition(-sims, top_k - 1)[:top_k]
+        idx = idx[np.argsort(-sims[idx])]
+        if self.classify_mode == "cosine":
+            # Raw similarity scores (BioCLIP large-taxonomy behavior).
+            scores = sims[idx]
+        else:
+            # Temperature-scaled stable softmax over ALL labels
+            # (reference: clip_model.py:232-317; temperature = logit scale).
+            temp = float(np.exp(np.asarray(self.params["logit_scale"], np.float32)))
+            logits = sims * temp
+            logits -= logits.max()
+            probs = np.exp(logits)
+            probs /= probs.sum()
+            scores = probs[idx]
+        return ClassifyResult(labels=[(names[i], float(s)) for i, s in zip(idx, scores)])
+
+    # -- utils ------------------------------------------------------------
+
+    def _ensure_ready(self) -> None:
+        if not self._initialized:
+            raise RuntimeError("CLIPManager.initialize() not called")
+
+    @staticmethod
+    def _check_vector(vec: np.ndarray) -> np.ndarray:
+        vec = np.asarray(vec, np.float32)
+        if not np.isfinite(vec).all():
+            raise ValueError("model produced non-finite embedding")
+        n = np.linalg.norm(vec)
+        if n < 1e-6:
+            raise ValueError("model produced zero-norm embedding")
+        return vec / n
+
+    def temperature(self) -> float:
+        return float(np.exp(np.asarray(self.params["logit_scale"], np.float32)))

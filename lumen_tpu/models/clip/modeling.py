@@ -1,0 +1,242 @@
+"""CLIP in Flax: ViT image tower + causal text transformer.
+
+Replaces the reference's opaque ONNX graph pair (vision.onnx + text.onnx,
+``packages/lumen-clip/src/lumen_clip/backends/onnxrt_backend.py:72-745``)
+and its torch/OpenCLIP path (``torch_backend.py:78-883``) with explicit
+modules whose parameter names line up with HF checkpoints (q/k/v/out proj,
+fc1/fc2) so weight conversion is mechanical and the tensor-parallel rules in
+``lumen_tpu.parallel.sharding`` apply unchanged.
+
+Layout notes: images are NHWC (TPU-native); HF/torch NCHW checkpoints only
+affect the patch-embed kernel layout, handled in ``convert.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops.attention import attention_reference
+
+
+@dataclass(frozen=True)
+class TowerConfig:
+    width: int
+    layers: int
+    heads: int
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    embed_dim: int = 512
+    image_size: int = 224
+    patch_size: int = 32
+    vision: TowerConfig = field(default_factory=lambda: TowerConfig(768, 12, 12))
+    text: TowerConfig = field(default_factory=lambda: TowerConfig(512, 12, 8))
+    vocab_size: int = 49408
+    context_length: int = 77
+    hidden_act: str = "quick_gelu"
+    layer_norm_eps: float = 1e-5
+    #: EOT/EOS token id for text pooling; None = argmax convention (OpenAI
+    #: CLIP's EOT is the highest vocab id, so argmax finds it).
+    eot_token_id: int | None = None
+
+    @classmethod
+    def tiny(cls) -> "CLIPConfig":
+        """Small config for tests (fast CPU parity runs)."""
+        return cls(
+            embed_dim=32,
+            image_size=32,
+            patch_size=16,
+            vision=TowerConfig(64, 2, 4),
+            text=TowerConfig(48, 2, 4),
+            vocab_size=128,
+            context_length=16,
+        )
+
+    @classmethod
+    def from_hf(cls, cfg: dict[str, Any]) -> "CLIPConfig":
+        """Build from an HF ``CLIPConfig``-style dict (``config.json``)."""
+        v, t = cfg["vision_config"], cfg["text_config"]
+        return cls(
+            embed_dim=cfg.get("projection_dim", 512),
+            image_size=v.get("image_size", 224),
+            patch_size=v.get("patch_size", 32),
+            vision=TowerConfig(
+                v.get("hidden_size", 768),
+                v.get("num_hidden_layers", 12),
+                v.get("num_attention_heads", 12),
+            ),
+            text=TowerConfig(
+                t.get("hidden_size", 512),
+                t.get("num_hidden_layers", 12),
+                t.get("num_attention_heads", 8),
+            ),
+            vocab_size=t.get("vocab_size", 49408),
+            context_length=t.get("max_position_embeddings", 77),
+            eot_token_id=t.get("eos_token_id"),
+            hidden_act=v.get("hidden_act", "quick_gelu"),
+            layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+        )
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    return getattr(jax.nn, name)
+
+
+class Attention(nn.Module):
+    width: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, causal: bool = False) -> jax.Array:
+        b, s, _ = x.shape
+        head_dim = self.width // self.heads
+        dense = lambda name: nn.Dense(self.width, name=name, dtype=x.dtype)
+        q = dense("q_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        k = dense("k_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        v = dense("v_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        out = attention_reference(q, k, v, causal=causal)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, self.width)
+        return nn.Dense(self.width, name="out_proj", dtype=x.dtype)(out)
+
+
+class Mlp(nn.Module):
+    width: int
+    hidden_act: str
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.Dense(self.width * 4, name="fc1", dtype=x.dtype)(x)
+        h = _act(self.hidden_act)(h)
+        return nn.Dense(self.width, name="fc2", dtype=x.dtype)(h)
+
+
+class Block(nn.Module):
+    width: int
+    heads: int
+    hidden_act: str
+    eps: float
+
+    @nn.compact
+    def __call__(self, x: jax.Array, causal: bool = False) -> jax.Array:
+        # Pre-LN residual blocks (CLIP layout).
+        x = x + Attention(self.width, self.heads, name="attn")(
+            nn.LayerNorm(epsilon=self.eps, name="ln1", dtype=x.dtype)(x), causal=causal
+        )
+        x = x + Mlp(self.width, self.hidden_act, name="mlp")(
+            nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x)
+        )
+        return x
+
+
+class VisionTower(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, pixel_values: jax.Array) -> jax.Array:
+        """[B, H, W, 3] preprocessed floats -> [B, embed_dim] (unnormalized)."""
+        c = self.cfg
+        v = c.vision
+        x = nn.Conv(
+            v.width,
+            kernel_size=(c.patch_size, c.patch_size),
+            strides=(c.patch_size, c.patch_size),
+            use_bias=False,
+            name="patch_embed",
+            dtype=pixel_values.dtype,
+        )(pixel_values)
+        b = x.shape[0]
+        x = x.reshape(b, -1, v.width)  # [B, n_patches, width]
+        cls_tok = self.param("class_embedding", nn.initializers.normal(0.02), (v.width,))
+        x = jnp.concatenate([jnp.broadcast_to(cls_tok, (b, 1, v.width)).astype(x.dtype), x], axis=1)
+        n_pos = x.shape[1]
+        pos = self.param("position_embedding", nn.initializers.normal(0.02), (n_pos, v.width))
+        x = x + pos.astype(x.dtype)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="pre_ln", dtype=x.dtype)(x)
+        for i in range(v.layers):
+            x = Block(v.width, v.heads, c.hidden_act, c.layer_norm_eps, name=f"blocks_{i}")(x)
+        pooled = x[:, 0]
+        pooled = nn.LayerNorm(epsilon=c.layer_norm_eps, name="post_ln", dtype=x.dtype)(pooled)
+        return nn.Dense(c.embed_dim, use_bias=False, name="projection", dtype=x.dtype)(pooled)
+
+
+class TextTower(nn.Module):
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        """[B, S] token ids (right-padded) -> [B, embed_dim] pooled at the
+        EOT position (= argmax of token id, the CLIP convention: EOT has the
+        highest id in the vocab)."""
+        c = self.cfg
+        t = c.text
+        emb = nn.Embed(c.vocab_size, t.width, name="token_embedding")
+        x = emb(input_ids)
+        pos = self.param("position_embedding", nn.initializers.normal(0.02), (c.context_length, t.width))
+        s = input_ids.shape[1]
+        x = x + pos[:s].astype(x.dtype)
+        for i in range(t.layers):
+            x = Block(t.width, t.heads, c.hidden_act, c.layer_norm_eps, name=f"blocks_{i}")(
+                x, causal=True
+            )
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="final_ln", dtype=x.dtype)(x)
+        if c.eot_token_id is not None:
+            # First occurrence of the configured EOT id (HF convention).
+            eot = jnp.argmax((input_ids == c.eot_token_id).astype(jnp.int32), axis=-1)
+        else:
+            eot = jnp.argmax(input_ids, axis=-1)
+        pooled = x[jnp.arange(x.shape[0]), eot]
+        return nn.Dense(c.embed_dim, use_bias=False, name="projection", dtype=x.dtype)(pooled)
+
+
+class CLIPModel(nn.Module):
+    """Dual-tower CLIP; ``logit_scale`` is the exported temperature
+    (reference extracts it via ``get_temperature()``,
+    ``torch_backend.py:830-856``)."""
+
+    cfg: CLIPConfig
+
+    def setup(self):
+        self.vision = VisionTower(self.cfg, name="vision")
+        self.text = TextTower(self.cfg, name="text")
+        self.logit_scale = self.param(
+            "logit_scale", nn.initializers.constant(jnp.log(1 / 0.07)), ()
+        )
+
+    def encode_image(self, pixel_values: jax.Array, normalize: bool = True) -> jax.Array:
+        z = self.vision(pixel_values)
+        return _maybe_normalize(z, normalize)
+
+    def encode_text(self, input_ids: jax.Array, normalize: bool = True) -> jax.Array:
+        z = self.text(input_ids)
+        return _maybe_normalize(z, normalize)
+
+    def __call__(self, pixel_values: jax.Array, input_ids: jax.Array):
+        img = self.encode_image(pixel_values)
+        txt = self.encode_text(input_ids)
+        scale = jnp.exp(self.logit_scale)
+        logits_per_image = scale * img @ txt.T
+        return {
+            "image_embeds": img,
+            "text_embeds": txt,
+            "logits_per_image": logits_per_image,
+            "logits_per_text": logits_per_image.T,
+        }
+
+
+def _maybe_normalize(z: jax.Array, normalize: bool) -> jax.Array:
+    if not normalize:
+        return z
+    # fp32 norm for stability regardless of compute dtype; unit-norm output
+    # is the backend contract (reference base.py:15-19).
+    z32 = z.astype(jnp.float32)
+    return z32 / jnp.maximum(jnp.linalg.norm(z32, axis=-1, keepdims=True), 1e-12)

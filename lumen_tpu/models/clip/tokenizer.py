@@ -1,0 +1,42 @@
+"""Text tokenization for CLIP-family models.
+
+Wraps an HF ``tokenizers`` fast tokenizer (``tokenizer.json`` in the model
+dir — same artifact the reference loads, ``onnxrt_backend.py:307-376``) and
+produces fixed-length right-padded id batches for the text tower.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class ClipTokenizer:
+    def __init__(self, tokenizer, context_length: int, pad_id: int = 0):
+        self._tok = tokenizer
+        self.context_length = context_length
+        self.pad_id = pad_id
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str, context_length: int) -> "ClipTokenizer":
+        from tokenizers import Tokenizer
+
+        path = os.path.join(model_dir, "tokenizer.json")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"tokenizer.json not found in {model_dir}")
+        tok = Tokenizer.from_file(path)
+        pad_id = 0
+        if tok.padding is not None and "pad_id" in tok.padding:
+            pad_id = tok.padding["pad_id"]
+        tok.no_padding()  # we pad ourselves to the static context length
+        tok.enable_truncation(max_length=context_length)
+        return cls(tok, context_length, pad_id)
+
+    def encode_batch(self, texts: list[str]) -> np.ndarray:
+        """-> [B, context_length] int32, right-padded."""
+        out = np.full((len(texts), self.context_length), self.pad_id, np.int32)
+        for i, enc in enumerate(self._tok.encode_batch(list(texts))):
+            ids = enc.ids[: self.context_length]
+            out[i, : len(ids)] = ids
+        return out

@@ -63,8 +63,16 @@ def _ring_attention_local(
         acc_new = acc * alpha[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
         )
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        # Last step's rotation would be discarded; skip the collective.
+        k_next, v_next = jax.lax.cond(
+            i < n - 1,
+            lambda kv: (
+                jax.lax.ppermute(kv[0], axis_name, perm),
+                jax.lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
         return k_next, v_next, acc_new, m_new, l_new
 
     _, _, acc, m, l = jax.lax.fori_loop(0, n, step, (k, v, acc, m, l))

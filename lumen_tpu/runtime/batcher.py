@@ -75,6 +75,9 @@ class MicroBatcher:
         self._queue: queue.Queue[tuple[Any, Future] | None] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._closed = threading.Event()
+        # Guards the closed-check + enqueue pair in submit() against a
+        # concurrent close() draining the queue in between.
+        self._submit_lock = threading.Lock()
         # Telemetry for capability metadata / benchmarks.
         self.stats = {"batches": 0, "items": 0, "padded": 0}
 
@@ -86,20 +89,24 @@ class MicroBatcher:
         return self
 
     def close(self) -> None:
-        if self._closed.is_set():
-            return
-        self._closed.set()
-        self._queue.put(None)
+        with self._submit_lock:
+            if self._closed.is_set():
+                return
+            self._closed.set()
+            # The sentinel lands after any already-submitted item, so the
+            # collector's drain pass sees them all.
+            self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=10)
 
     # -- client side ------------------------------------------------------
 
     def submit(self, item: Any) -> Future:
-        if self._closed.is_set():
-            raise RuntimeError(f"{self.name} is closed")
         fut: Future = Future()
-        self._queue.put((item, fut))
+        with self._submit_lock:
+            if self._closed.is_set():
+                raise RuntimeError(f"{self.name} is closed")
+            self._queue.put((item, fut))
         return fut
 
     def __call__(self, item: Any, timeout: float | None = 60.0) -> Any:

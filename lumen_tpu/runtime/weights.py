@@ -38,8 +38,12 @@ def load_safetensors(path: str) -> dict[str, np.ndarray]:
     try:
         return dict(load_file(path))
     except Exception as e:  # noqa: BLE001
-        # bf16 tensors are not numpy-native; fall back through torch.
-        logger.debug("numpy safetensors load failed (%s); retrying via torch", e)
+        # bf16 tensors are not numpy-native; only that case falls back
+        # through torch — anything else (corrupt file, bad path) re-raises.
+        msg = str(e).lower()
+        if "bfloat16" not in msg and "bf16" not in msg:
+            raise WeightLoadError(f"cannot load safetensors file {path}: {e}") from e
+        logger.debug("bf16 safetensors %s; loading via torch", path)
         from safetensors.torch import load_file as load_torch
 
         return {k: _torch_to_numpy(v) for k, v in load_torch(path).items()}
@@ -161,6 +165,8 @@ def unflatten(flat: dict[str, np.ndarray]) -> dict:
             node = node.setdefault(p, {})
             if not isinstance(node, dict):
                 raise WeightLoadError(f"key {key!r} conflicts with leaf at {p!r}")
+        if isinstance(node.get(parts[-1]), dict):
+            raise WeightLoadError(f"key {key!r} conflicts with existing subtree")
         node[parts[-1]] = value
     return tree
 

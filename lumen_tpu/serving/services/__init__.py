@@ -1,0 +1,1 @@
+"""Per-model gRPC services (clip, face, ocr, vlm)."""

@@ -1,0 +1,206 @@
+"""CLIP gRPC service: embeddings + zero-shot classification tasks.
+
+Covers all three reference service variants in one class, selected by which
+model aliases the config carries (the reference picks the variant the same
+way in its single-mode server, ``packages/lumen-clip/src/lumen_clip/server.py:240-287``):
+
+- alias ``clip``    -> tasks ``clip_text_embed``, ``clip_image_embed``, and
+  ``clip_classify`` / ``clip_scene_classify`` when a dataset is loaded
+  (reference ``GeneralCLIPService``, ``clip_service.py:140-183``);
+- alias ``bioclip`` -> ``bioclip_{text_embed,image_embed,classify}`` with
+  raw-cosine scoring (reference ``BioCLIPService``);
+- both aliases      -> additionally ``smartclip_{text_embed,image_embed,
+  classify,scene_classify,bioclassify}`` (reference ``SmartCLIPService``,
+  including the ``namespace=bioatlas`` meta check at
+  ``smartclip_service.py:450-455``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ...core.config import ServiceConfig
+from ...core.result_schemas import EmbeddingV1, LabelsV1, LabelItem
+from ...models.clip import CLIPManager
+from ..base_service import BaseService, InvalidArgument, Unavailable
+from ..registry import TaskDefinition, TaskRegistry
+
+logger = logging.getLogger(__name__)
+
+IMAGE_MIMES = ("image/jpeg", "image/png", "image/webp", "application/octet-stream")
+
+
+class ClipService(BaseService):
+    def __init__(self, managers: dict[str, CLIPManager], service_name: str = "clip"):
+        self.managers = managers
+        registry = TaskRegistry(service_name)
+        clip = managers.get("clip")
+        bioclip = managers.get("bioclip")
+        if clip is not None:
+            self._register_tasks(registry, "clip", clip, scene=True)
+        if bioclip is not None:
+            self._register_tasks(registry, "bioclip", bioclip, scene=False)
+        if clip is not None and bioclip is not None:
+            self._register_tasks(registry, "smartclip", clip, scene=True)
+            registry.register(
+                TaskDefinition(
+                    name="smartclip_bioclassify",
+                    handler=self._smart_bioclassify,
+                    description="species classification (bioatlas namespace)",
+                    input_mimes=IMAGE_MIMES,
+                    output_mime=LabelsV1.mime(),
+                )
+            )
+        super().__init__(registry)
+
+    def _register_tasks(self, registry: TaskRegistry, prefix: str, mgr: CLIPManager, scene: bool):
+        registry.register(
+            TaskDefinition(
+                name=f"{prefix}_text_embed",
+                handler=lambda p, m, meta, _mgr=mgr: self._text_embed(_mgr, p),
+                description="text -> unit-norm embedding",
+                input_mimes=("text/plain",),
+                output_mime=EmbeddingV1.mime(),
+            )
+        )
+        registry.register(
+            TaskDefinition(
+                name=f"{prefix}_image_embed",
+                handler=lambda p, m, meta, _mgr=mgr: self._image_embed(_mgr, p),
+                description="image -> unit-norm embedding",
+                input_mimes=IMAGE_MIMES,
+                output_mime=EmbeddingV1.mime(),
+            )
+        )
+        if mgr.dataset_name:
+            registry.register(
+                TaskDefinition(
+                    name=f"{prefix}_classify",
+                    handler=lambda p, m, meta, _mgr=mgr: self._classify(_mgr, p, meta),
+                    description="zero-shot classification against the configured dataset",
+                    input_mimes=IMAGE_MIMES,
+                    output_mime=LabelsV1.mime(),
+                )
+            )
+        if scene:
+            registry.register(
+                TaskDefinition(
+                    name=f"{prefix}_scene_classify",
+                    handler=lambda p, m, meta, _mgr=mgr: self._scene(_mgr, p, meta),
+                    description="coarse scene bucket classification",
+                    input_mimes=IMAGE_MIMES,
+                    output_mime=LabelsV1.mime(),
+                )
+            )
+
+    # -- factory ----------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "ClipService":
+        bs = service_config.backend_settings
+        managers: dict[str, CLIPManager] = {}
+        for alias, mc in service_config.models.items():
+            key = "bioclip" if "bioclip" in alias.lower() else "clip"
+            model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
+            managers[key] = CLIPManager(
+                model_dir,
+                dataset=mc.dataset,
+                dtype=bs.dtype,
+                batch_size=bs.batch_size,
+                max_batch_latency_ms=bs.max_batch_latency_ms,
+                mesh_axes=bs.mesh.axes if bs.mesh else None,
+                classify_mode="cosine" if key == "bioclip" else "softmax",
+            )
+        svc = cls(managers)
+        for mgr in managers.values():
+            mgr.initialize()
+        return svc
+
+    def capability(self):
+        ids = [m.model_id for m in self.managers.values()]
+        return self.registry.build_capability(
+            model_ids=ids,
+            runtime=f"jax-{_backend_name()}",
+            max_concurrency=max(m.batch_size for m in self.managers.values()),
+            precisions=["bf16", "fp32"],
+            extra={"embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values())},
+        )
+
+    def healthy(self) -> bool:
+        return all(m._initialized for m in self.managers.values())
+
+    def close(self) -> None:
+        for m in self.managers.values():
+            m.close()
+
+    # -- handlers ---------------------------------------------------------
+
+    def _text_embed(self, mgr: CLIPManager, payload: bytes):
+        try:
+            text = payload.decode("utf-8").strip()
+        except UnicodeDecodeError as e:
+            raise InvalidArgument("payload is not valid UTF-8 text") from e
+        if not text:
+            raise InvalidArgument("empty text payload")
+        vec = mgr.encode_text(text)
+        return self._embedding_result(mgr, vec)
+
+    def _image_embed(self, mgr: CLIPManager, payload: bytes):
+        vec = self._encode_image(mgr, payload)
+        return self._embedding_result(mgr, vec)
+
+    def _classify(self, mgr: CLIPManager, payload: bytes, meta: dict[str, str]):
+        top_k = _int_meta(meta, "top_k", 5)
+        try:
+            result = mgr.classify_image(payload, top_k=top_k)
+        except RuntimeError as e:
+            raise Unavailable(str(e)) from e
+        return self._labels_result(mgr, result)
+
+    def _scene(self, mgr: CLIPManager, payload: bytes, meta: dict[str, str]):
+        result = mgr.classify_scene(payload, top_k=_int_meta(meta, "top_k", 3))
+        return self._labels_result(mgr, result)
+
+    def _smart_bioclassify(self, payload: bytes, mime: str, meta: dict[str, str]):
+        ns = meta.get("namespace", "bioatlas")
+        if ns != "bioatlas":
+            raise InvalidArgument(f"unsupported namespace {ns!r} (expected 'bioatlas')")
+        mgr = self.managers["bioclip"]
+        top_k = _int_meta(meta, "top_k", 5)
+        result = mgr.classify_image(payload, top_k=top_k)
+        return self._labels_result(mgr, result)
+
+    def _encode_image(self, mgr: CLIPManager, payload: bytes):
+        if not payload:
+            raise InvalidArgument("empty image payload")
+        try:
+            return mgr.encode_image(payload)
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
+
+    @staticmethod
+    def _embedding_result(mgr: CLIPManager, vec):
+        body = EmbeddingV1(vector=[float(x) for x in vec], dim=int(vec.shape[0]), model_id=mgr.model_id)
+        return body.to_json_bytes(), EmbeddingV1.mime(), {}
+
+    @staticmethod
+    def _labels_result(mgr: CLIPManager, result):
+        body = LabelsV1(
+            labels=[LabelItem(label=l, score=s) for l, s in result.labels],
+            model_id=mgr.model_id,
+        )
+        return body.to_json_bytes(), LabelsV1.mime(), {}
+
+
+def _int_meta(meta: dict[str, str], key: str, default: int) -> int:
+    try:
+        return int(meta.get(key, default))
+    except ValueError as e:
+        raise InvalidArgument(f"meta {key!r} must be an integer") from e
+
+
+def _backend_name() -> str:
+    import jax
+
+    return jax.default_backend()

@@ -1,0 +1,93 @@
+"""Shared fixture helpers: build a tiny, fully self-contained CLIP model
+directory (random HF weights, tokenizer, manifest, dataset) so manager and
+service tests run offline end-to-end."""
+
+import json
+
+import numpy as np
+
+
+def make_tiny_hf_clip(seed: int = 0):
+    import torch
+    from transformers import CLIPConfig as HFCLIPConfig, CLIPModel as HFCLIPModel
+
+    cfg = HFCLIPConfig(
+        projection_dim=32,
+        text_config={
+            "hidden_size": 48,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "vocab_size": 128,
+            "max_position_embeddings": 16,
+            "intermediate_size": 192,
+            "hidden_act": "quick_gelu",
+            "eos_token_id": 127,
+        },
+        vision_config={
+            "hidden_size": 64,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "image_size": 32,
+            "patch_size": 16,
+            "intermediate_size": 256,
+            "hidden_act": "quick_gelu",
+        },
+    )
+    torch.manual_seed(seed)
+    return HFCLIPModel(cfg).eval()
+
+
+def write_tiny_tokenizer(path: str):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from tokenizers.processors import TemplateProcessing
+
+    vocab = {"<unk>": 0, "a": 1, "photo": 2, "of": 3, "cat": 4, "dog": 5, "car": 6, "<eot>": 127}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.post_processor = TemplateProcessing(
+        single="$A <eot>", special_tokens=[("<eot>", 127)]
+    )
+    tok.save(path)
+
+
+def make_clip_model_dir(tmp_path, with_dataset: bool = True) -> str:
+    """Build <tmp>/models/TinyCLIP with weights/config/tokenizer/manifest."""
+    from safetensors.numpy import save_file
+
+    hf = make_tiny_hf_clip()
+    model_dir = tmp_path / "models" / "TinyCLIP"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    state = {k: v for k, v in state.items() if "position_ids" not in k}
+    save_file(state, str(model_dir / "model.safetensors"))
+    (model_dir / "config.json").write_text(json.dumps(hf.config.to_dict()))
+    write_tiny_tokenizer(str(model_dir / "tokenizer.json"))
+    info = {
+        "name": "TinyCLIP",
+        "version": "1.0.0",
+        "description": "tiny test model",
+        "model_type": "clip",
+        "embedding_dim": 32,
+        "source": {"format": "huggingface", "repo_id": "LumilioPhotos/TinyCLIP"},
+        "runtimes": {"jax": {"available": True, "files": ["model.safetensors"]}},
+    }
+    if with_dataset:
+        info["datasets"] = {
+            "Tiny": {"labels": "datasets/tiny/labels.json", "embeddings": "datasets/tiny/embeddings.npy"}
+        }
+        ds = model_dir / "datasets" / "tiny"
+        ds.mkdir(parents=True, exist_ok=True)
+        (ds / "labels.json").write_text(json.dumps(["cat", "dog", "car"]))
+        # embeddings .npy intentionally absent -> computed at startup
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+def png_bytes(seed: int = 0, size: int = 40) -> bytes:
+    import cv2
+
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 255, (size, size, 3), np.uint8)
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    return buf.tobytes()

@@ -1,0 +1,273 @@
+"""CLIP family tests: numerical parity vs torch/transformers, manager
+behavior on a synthetic model dir, and the gRPC service end-to-end."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.clip_fixtures import make_clip_model_dir, make_tiny_hf_clip, png_bytes
+
+
+@pytest.fixture(scope="module")
+def tiny_model_dir(tmp_path_factory):
+    return make_clip_model_dir(tmp_path_factory.mktemp("clip"))
+
+
+@pytest.fixture(scope="module")
+def manager(tiny_model_dir):
+    from lumen_tpu.models.clip import CLIPManager
+
+    mgr = CLIPManager(tiny_model_dir, dataset="Tiny", dtype="float32", batch_size=4)
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+@pytest.mark.parity
+class TestTorchParity:
+    def test_towers_match_hf(self):
+        import torch
+
+        from lumen_tpu.models.clip import CLIPConfig, CLIPModel, convert_clip_checkpoint
+
+        hf = make_tiny_hf_clip()
+        cfg = CLIPConfig.from_hf(hf.config.to_dict())
+        model = CLIPModel(cfg)
+        init = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 32, 32, 3)),
+            jnp.zeros((1, 16), jnp.int32),
+        )["params"]
+        state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        params = convert_clip_checkpoint(state, init)
+
+        px = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+        ids = np.array(
+            [[1, 5, 9, 127] + [0] * 12, [1, 7, 127] + [0] * 13], np.int64
+        )
+        with torch.no_grad():
+            t_img = hf.get_image_features(pixel_values=torch.tensor(px)).numpy()
+            t_txt = hf.get_text_features(input_ids=torch.tensor(ids)).numpy()
+        j_img = model.apply(
+            {"params": params},
+            jnp.asarray(px.transpose(0, 2, 3, 1)),
+            method=lambda m, x: m.encode_image(x, normalize=False),
+        )
+        j_txt = model.apply(
+            {"params": params},
+            jnp.asarray(ids),
+            method=lambda m, x: m.encode_text(x, normalize=False),
+        )
+        np.testing.assert_allclose(np.asarray(j_img), t_img, atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(j_txt), t_txt, atol=1e-4, rtol=1e-4)
+
+    def test_openclip_checkpoint_converts(self):
+        # Synthesize an OpenCLIP-style state dict with fused qkv and check
+        # the converted tree matches module init exactly.
+        from lumen_tpu.models.clip import CLIPConfig, CLIPModel, convert_clip_checkpoint
+        from lumen_tpu.runtime import flatten
+
+        cfg = CLIPConfig.tiny()
+        model = CLIPModel(cfg)
+        init = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+            jnp.zeros((1, cfg.context_length), jnp.int32),
+        )["params"]
+        flat = flatten(jax.tree.map(np.asarray, init))
+
+        state = {}
+        vw, tw = cfg.vision.width, cfg.text.width
+        state["visual.class_embedding"] = flat["vision/class_embedding"]
+        state["visual.conv1.weight"] = np.transpose(flat["vision/patch_embed/kernel"], (3, 2, 0, 1))
+        state["visual.positional_embedding"] = flat["vision/position_embedding"]
+        state["visual.ln_pre.weight"] = flat["vision/pre_ln/scale"]
+        state["visual.ln_pre.bias"] = flat["vision/pre_ln/bias"]
+        state["visual.ln_post.weight"] = flat["vision/post_ln/scale"]
+        state["visual.ln_post.bias"] = flat["vision/post_ln/bias"]
+        state["visual.proj"] = flat["vision/projection/kernel"]
+        state["token_embedding.weight"] = flat["text/token_embedding/embedding"]
+        state["positional_embedding"] = flat["text/position_embedding"]
+        state["ln_final.weight"] = flat["text/final_ln/scale"]
+        state["ln_final.bias"] = flat["text/final_ln/bias"]
+        state["text_projection"] = flat["text/projection/kernel"]
+        state["logit_scale"] = flat["logit_scale"]
+        for tower, prefix, layers in (
+            ("vision", "visual.transformer.resblocks", cfg.vision.layers),
+            ("text", "transformer.resblocks", cfg.text.layers),
+        ):
+            for i in range(layers):
+                base = f"{tower}/blocks_{i}"
+                wq = flat[f"{base}/attn/q_proj/kernel"].T
+                wk = flat[f"{base}/attn/k_proj/kernel"].T
+                wv = flat[f"{base}/attn/v_proj/kernel"].T
+                state[f"{prefix}.{i}.attn.in_proj_weight"] = np.concatenate([wq, wk, wv], 0)
+                state[f"{prefix}.{i}.attn.in_proj_bias"] = np.concatenate(
+                    [
+                        flat[f"{base}/attn/q_proj/bias"],
+                        flat[f"{base}/attn/k_proj/bias"],
+                        flat[f"{base}/attn/v_proj/bias"],
+                    ]
+                )
+                state[f"{prefix}.{i}.attn.out_proj.weight"] = flat[f"{base}/attn/out_proj/kernel"].T
+                state[f"{prefix}.{i}.attn.out_proj.bias"] = flat[f"{base}/attn/out_proj/bias"]
+                state[f"{prefix}.{i}.ln_1.weight"] = flat[f"{base}/ln1/scale"]
+                state[f"{prefix}.{i}.ln_1.bias"] = flat[f"{base}/ln1/bias"]
+                state[f"{prefix}.{i}.ln_2.weight"] = flat[f"{base}/ln2/scale"]
+                state[f"{prefix}.{i}.ln_2.bias"] = flat[f"{base}/ln2/bias"]
+                state[f"{prefix}.{i}.mlp.c_fc.weight"] = flat[f"{base}/mlp/fc1/kernel"].T
+                state[f"{prefix}.{i}.mlp.c_fc.bias"] = flat[f"{base}/mlp/fc1/bias"]
+                state[f"{prefix}.{i}.mlp.c_proj.weight"] = flat[f"{base}/mlp/fc2/kernel"].T
+                state[f"{prefix}.{i}.mlp.c_proj.bias"] = flat[f"{base}/mlp/fc2/bias"]
+
+        params = convert_clip_checkpoint(state, init)  # gate passes
+        re_flat = flatten(jax.tree.map(np.asarray, params))
+        np.testing.assert_allclose(
+            re_flat["vision/blocks_0/attn/q_proj/kernel"],
+            flat["vision/blocks_0/attn/q_proj/kernel"],
+        )
+
+
+class TestManager:
+    def test_encode_image_unit_norm(self, manager):
+        vec = manager.encode_image(png_bytes())
+        assert vec.shape == (32,)
+        assert np.linalg.norm(vec) == pytest.approx(1.0, abs=1e-5)
+
+    def test_encode_text_unit_norm(self, manager):
+        vec = manager.encode_text("a photo of a cat")
+        assert vec.shape == (32,)
+        assert np.linalg.norm(vec) == pytest.approx(1.0, abs=1e-5)
+
+    def test_encoding_is_deterministic(self, manager):
+        v1 = manager.encode_image(png_bytes(1))
+        v2 = manager.encode_image(png_bytes(1))
+        np.testing.assert_allclose(v1, v2, atol=1e-6)
+
+    def test_classify_returns_topk_softmax(self, manager):
+        res = manager.classify_image(png_bytes(), top_k=2)
+        assert len(res.labels) == 2
+        names = {l for l, _ in res.labels}
+        assert names <= {"cat", "dog", "car"}
+        scores = [s for _, s in res.labels]
+        assert scores == sorted(scores, reverse=True)
+        assert all(0 <= s <= 1 for s in scores)
+
+    def test_scene_classify(self, manager):
+        res = manager.classify_scene(png_bytes(), top_k=3)
+        assert len(res.labels) == 3
+
+    def test_label_embeddings_computed_without_npy(self, manager):
+        assert manager._label_matrix is not None
+        assert manager._label_matrix.shape == (3, 32)
+
+    def test_temperature_exported(self, manager):
+        assert manager.temperature() == pytest.approx(np.exp(np.log(1 / 0.07)), rel=1e-3)
+
+    def test_uninitialized_raises(self, tiny_model_dir):
+        from lumen_tpu.models.clip import CLIPManager
+
+        mgr = CLIPManager(tiny_model_dir, dtype="float32")
+        with pytest.raises(RuntimeError):
+            mgr.encode_text("x")
+
+    def test_bad_image_raises_value_error(self, manager):
+        with pytest.raises(Exception):
+            manager.encode_image(b"not an image")
+
+
+@pytest.mark.integration
+class TestClipServiceGrpc:
+    @pytest.fixture(scope="class")
+    def stub(self, tmp_path_factory):
+        import grpc
+        from concurrent import futures
+
+        from lumen_tpu.core.config import validate_config_dict
+        from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+            InferenceStub,
+            add_InferenceServicer_to_server,
+        )
+        from lumen_tpu.serving.router import HubRouter
+        from lumen_tpu.serving.services.clip_service import ClipService
+
+        tmp = tmp_path_factory.mktemp("svc")
+        make_clip_model_dir(tmp)
+        raw = {
+            "metadata": {"version": "1.0.0", "region": "other", "cache_dir": str(tmp)},
+            "deployment": {"mode": "single", "service": "clip"},
+            "server": {"port": 50051},
+            "services": {
+                "clip": {
+                    "enabled": True,
+                    "package": "lumen_tpu.models.clip",
+                    "import_info": {
+                        "registry_class": "lumen_tpu.serving.services.clip_service.ClipService"
+                    },
+                    "backend_settings": {"batch_size": 4, "dtype": "float32"},
+                    "models": {"clip": {"model": "TinyCLIP", "runtime": "jax", "dataset": "Tiny"}},
+                }
+            },
+        }
+        cfg = validate_config_dict(raw)
+        svc = ClipService.from_config(cfg.services["clip"], str(tmp))
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        router = HubRouter({"clip": svc})
+        add_InferenceServicer_to_server(router, server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        yield InferenceStub(channel)
+        channel.close()
+        server.stop(0)
+        svc.close()
+
+    def _infer(self, stub, task, payload, meta=None, mime="application/octet-stream"):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        (resp,) = stub.Infer(
+            iter(
+                [
+                    pb.InferRequest(
+                        correlation_id="t1",
+                        task=task,
+                        payload=payload,
+                        meta=meta or {},
+                        payload_mime=mime,
+                    )
+                ]
+            )
+        )
+        return resp
+
+    def test_image_embed_roundtrip(self, stub):
+        resp = self._infer(stub, "clip_image_embed", png_bytes(), mime="image/png")
+        assert not resp.HasField("error"), resp.error
+        body = json.loads(resp.result)
+        assert body["dim"] == 32 and len(body["vector"]) == 32
+        assert resp.result_mime.endswith("schema=embedding_v1")
+        assert "lat_ms" in resp.meta
+
+    def test_text_embed_roundtrip(self, stub):
+        resp = self._infer(stub, "clip_text_embed", b"a photo of a dog", mime="text/plain")
+        body = json.loads(resp.result)
+        assert abs(np.linalg.norm(body["vector"]) - 1.0) < 1e-4
+
+    def test_classify_roundtrip(self, stub):
+        resp = self._infer(stub, "clip_classify", png_bytes(), meta={"top_k": "2"}, mime="image/png")
+        body = json.loads(resp.result)
+        assert len(body["labels"]) == 2
+
+    def test_invalid_image_gives_wire_error(self, stub):
+        resp = self._infer(stub, "clip_image_embed", b"junk", mime="image/png")
+        assert resp.HasField("error")
+
+    def test_capabilities_list_tasks(self, stub):
+        from google.protobuf import empty_pb2
+
+        cap = stub.GetCapabilities(empty_pb2.Empty())
+        names = {t.name for t in cap.tasks}
+        assert {"clip_image_embed", "clip_text_embed", "clip_classify", "clip_scene_classify"} <= names
