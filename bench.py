@@ -1,0 +1,114 @@
+"""Benchmark: CLIP ViT-B/32 image-embedding throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the reference's execution model measured on
+this same host: the reference serves CLIP through ONNX-Runtime/libtorch on
+CPU one image per request (SURVEY.md §6 — it publishes no numbers, so the
+baseline must be measured). We measure a torch-CPU forward of the same
+ViT-B/32 vision tower (batch 1, the reference's per-request pattern) and
+report the throughput ratio.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def tpu_images_per_sec(batch: int = 256, iters: int = 30) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lumen_tpu.models.clip.modeling import CLIPConfig, CLIPModel
+
+    cfg = CLIPConfig()  # ViT-B/32
+    model = CLIPModel(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(
+        rng,
+        jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32),
+        jnp.zeros((1, cfg.context_length), jnp.int32),
+    )["params"]
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+
+    @jax.jit
+    def embed(params, pixels_u8):
+        x = pixels_u8.astype(jnp.float32) / 255.0
+        return model.apply(
+            {"params": params},
+            x.astype(jnp.bfloat16),
+            method=lambda m, px: m.encode_image(px),
+        )
+
+    # Preloaded device inputs; timing fences on a host fetch of the LAST
+    # result (device execution is ordered, so this covers the whole chain —
+    # block_until_ready alone does not truly block through remote tunnels).
+    inputs = [
+        jax.device_put(
+            np.random.default_rng(i).integers(0, 255, (batch, cfg.image_size, cfg.image_size, 3), np.uint8)
+        )
+        for i in range(4)
+    ]
+    np.asarray(embed(params, inputs[0]))  # compile + settle
+    t0 = time.perf_counter()
+    out = None
+    for i in range(iters):
+        out = embed(params, inputs[i % len(inputs)])
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def torch_cpu_images_per_sec(iters: int = 8) -> float:
+    """Reference execution model: per-request (batch 1) CPU forward of the
+    same vision tower."""
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModelWithProjection
+
+    cfg = CLIPVisionConfig(
+        hidden_size=768,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        image_size=224,
+        patch_size=32,
+        intermediate_size=3072,
+        projection_dim=512,
+    )
+    model = CLIPVisionModelWithProjection(cfg).eval()
+    x = torch.randn(1, 3, 224, 224)
+    with torch.no_grad():
+        model(pixel_values=x)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            model(pixel_values=x)
+        dt = time.perf_counter() - t0
+    return iters / dt
+
+
+def main():
+    tpu_ips = tpu_images_per_sec()
+    try:
+        cpu_ips = torch_cpu_images_per_sec()
+        vs_baseline = round(tpu_ips / cpu_ips, 2)
+    except Exception:  # noqa: BLE001 - baseline is best-effort
+        vs_baseline = None
+    print(
+        json.dumps(
+            {
+                "metric": "clip_vitb32_image_embed_throughput",
+                "value": round(tpu_ips, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": vs_baseline,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

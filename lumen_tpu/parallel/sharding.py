@@ -10,6 +10,7 @@ shardings, let the compiler do the rest).
 from __future__ import annotations
 
 import logging
+import math
 import re
 from typing import Iterable
 
@@ -53,17 +54,45 @@ def keypath_str(keypath) -> str:
     return "/".join(parts)
 
 
-def shard_params(params, mesh: Mesh, rules: Iterable[ShardingRule] | None = None):
-    """Place a parameter tree onto the mesh according to the rules (axes a
-    rule names that are absent from the mesh degrade to replication)."""
-    rules = list(rules or [])
-    available = set(mesh.axis_names)
+def sanitize_spec(spec: P, shape: tuple[int, ...], mesh: Mesh, path: str = "") -> P:
+    """Degrade a PartitionSpec so it is valid for a concrete leaf: axes not
+    in the mesh replicate, specs longer than the leaf's rank replicate, and
+    a sharded dim must divide evenly (else that dim replicates). Tuple
+    entries (multi-axis sharding of one dim) are supported. Degradations
+    are logged so a typo'd axis or odd dim doesn't silently disable TP."""
+    if len(spec) > len(shape):
+        if len(spec) > 0:
+            logger.debug("spec %s has higher rank than leaf %s%s; replicating", spec, shape, f" at {path}" if path else "")
+        return P()
+    out = []
+    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        if entry is None:
+            out.append(None)
+            continue
+        known = [a for a in axes if a in mesh.axis_names]
+        size = math.prod(mesh.shape[a] for a in known)
+        if len(known) != len(axes) or dim % size != 0:
+            logger.warning(
+                "degrading sharding %s for dim %d%s (unknown axis or indivisible); replicating that dim",
+                entry, dim, f" at {path!r}" if path else "",
+            )
+            out.append(None)
+        else:
+            out.append(entry)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
 
-    def _sanitize(spec: P) -> P:
-        return P(*[a if a in available else None for a in spec])
+
+def shard_params(params, mesh: Mesh, rules: Iterable[ShardingRule] | None = None):
+    """Place a parameter tree onto the mesh according to the rules (specs
+    that don't fit a leaf's rank/shape or the mesh degrade to replication)."""
+    rules = list(rules or [])
 
     def place(keypath, leaf):
-        spec = _sanitize(spec_for(keypath_str(keypath), rules))
+        path = keypath_str(keypath)
+        spec = sanitize_spec(spec_for(path, rules), leaf.shape, mesh, path=path)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)

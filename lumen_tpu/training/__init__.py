@@ -1,0 +1,11 @@
+"""Training: contrastive CLIP fine-tuning with sharded train steps.
+
+The reference is inference-only (SURVEY.md: "NOT a training framework");
+this subsystem is additive TPU-native capability: fine-tune the embedding
+towers on a device mesh (DP batch sharding + Megatron TP on the transformer
+blocks), with the same checkpoint conversion used for serving.
+"""
+
+from .clip_trainer import ClipTrainer, TrainConfig, contrastive_loss
+
+__all__ = ["ClipTrainer", "TrainConfig", "contrastive_loss"]

@@ -81,3 +81,40 @@ class TestDistributed:
 
         assert initialize() is False
         assert is_primary() is True
+
+
+class TestSanitizeSpec:
+    def test_tuple_axes_supported(self):
+        from jax.sharding import PartitionSpec as P
+        from lumen_tpu.parallel.sharding import sanitize_spec
+        from lumen_tpu.runtime import build_mesh
+
+        mesh = build_mesh({"data": 4, "model": 2})
+        assert sanitize_spec(P(("data", "model"), None), (16, 8), mesh) == P(("data", "model"))
+        # indivisible dim degrades that dim only
+        assert sanitize_spec(P(("data", "model"), "model"), (12, 8), mesh) == P(None, "model")
+
+    def test_rank1_spec_on_rank1_leaf(self):
+        from jax.sharding import PartitionSpec as P
+        from lumen_tpu.parallel.sharding import sanitize_spec
+        from lumen_tpu.runtime import build_mesh
+
+        mesh = build_mesh({"data": -1})
+        assert sanitize_spec(P(None, "model"), (64,), mesh) == P()
+
+
+class TestLogitScaleClamp:
+    def test_logit_scale_clamped(self):
+        import jax, jax.numpy as jnp
+        from lumen_tpu.runtime import build_mesh
+        from lumen_tpu.training import ClipTrainer, TrainConfig
+        from tests.test_training import make_batch, tiny_cfg
+
+        mesh = build_mesh({"data": -1})
+        cfg = tiny_cfg()
+        trainer = ClipTrainer(cfg, TrainConfig(learning_rate=1.0, warmup_steps=0, total_steps=5), mesh)
+        params, opt_state = trainer.init_state(jax.random.PRNGKey(0))
+        params["logit_scale"] = jnp.asarray(200.0)  # absurd temperature
+        step = trainer.make_train_step()
+        params, _, metrics = step(params, opt_state, make_batch(8, cfg))
+        assert float(params["logit_scale"]) <= float(jnp.log(100.0)) + 1e-6
