@@ -1,0 +1,121 @@
+"""Face checkpoint conversion.
+
+Two sources:
+
+- **native** checkpoints (the lumen-tpu ``jax`` runtime format): safetensors
+  whose keys are '/'-separated Flax paths prefixed with the variable
+  collection (``params/...`` or ``batch_stats/...``) — loaded directly;
+- **torch IResNet** state dicts (InsightFace ArcFace layout: ``conv1``,
+  ``bn1``, ``prelu``, ``layer{1-4}.{i}.{bn1,conv1,bn2,prelu,conv2,bn3,
+  downsample.0,downsample.1}``, final ``bn2``, ``fc``, ``features``) —
+  converted by rules. The FC kernel needs an NCHW->NHWC flatten permute
+  because torch flattens [C, H, W] and flax flattens [H, W, C].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...runtime.weights import (
+    WeightLoadError,
+    apply_rules,
+    conv_kernel,
+    linear_kernel,
+    unflatten,
+)
+
+
+def split_collections(flat: dict[str, np.ndarray]) -> dict[str, dict]:
+    """'params/a/b', 'batch_stats/a/b' flat keys -> {'params': tree, ...}."""
+    grouped: dict[str, dict[str, np.ndarray]] = {}
+    for key, value in flat.items():
+        coll, _, rest = key.partition("/")
+        if not rest:
+            raise WeightLoadError(f"native checkpoint key missing collection prefix: {key!r}")
+        grouped.setdefault(coll, {})[rest] = value
+    return {coll: unflatten(tree) for coll, tree in grouped.items()}
+
+
+def is_native_checkpoint(state: dict[str, np.ndarray]) -> bool:
+    return all(k.startswith(("params/", "batch_stats/")) for k in state)
+
+
+def flatten_variables(variables: dict) -> dict[str, np.ndarray]:
+    """Inverse of :func:`split_collections` (for saving native checkpoints)."""
+    from ...runtime.weights import flatten
+
+    out: dict[str, np.ndarray] = {}
+    for coll, tree in variables.items():
+        for k, v in flatten(tree).items():
+            out[f"{coll}/{k}"] = np.asarray(v)
+    return out
+
+
+def fc_kernel_from_torch(w: np.ndarray, c: int, h: int, ww: int) -> np.ndarray:
+    """torch FC weight [out, C*H*W] -> flax Dense kernel [(H*W*C), out]."""
+    out_dim = w.shape[0]
+    return np.ascontiguousarray(
+        w.reshape(out_dim, c, h, ww).transpose(0, 2, 3, 1).reshape(out_dim, h * ww * c).T
+    )
+
+
+def _bn(src: str, dst: str):
+    return [
+        (rf"{src}\.weight", rf"params/{dst}/scale", None),
+        (rf"{src}\.bias", rf"params/{dst}/bias", None),
+        (rf"{src}\.running_mean", rf"batch_stats/{dst}/mean", None),
+        (rf"{src}\.running_var", rf"batch_stats/{dst}/var", None),
+    ]
+
+
+def iresnet_rules(final_c: int, final_hw: int):
+    rules = [
+        (r"conv1\.weight", r"params/stem_conv/kernel", conv_kernel),
+        *_bn("bn1", "stem_bn"),
+        (r"prelu\.weight", r"params/stem_prelu/alpha", None),
+        (
+            r"fc\.weight",
+            r"params/fc/kernel",
+            lambda w: fc_kernel_from_torch(w, final_c, final_hw, final_hw),
+        ),
+        (r"fc\.bias", r"params/fc/bias", None),
+        *_bn("bn2", "final_bn"),
+        *_bn("features", "features"),
+    ]
+    # layerS.I.* -> layer{S}_{I}/*
+    rules += [
+        (r"layer(\d+)\.(\d+)\.conv1\.weight", r"params/layer\1_\2/conv1/kernel", conv_kernel),
+        (r"layer(\d+)\.(\d+)\.conv2\.weight", r"params/layer\1_\2/conv2/kernel", conv_kernel),
+        (r"layer(\d+)\.(\d+)\.prelu\.weight", r"params/layer\1_\2/prelu/alpha", None),
+        (r"layer(\d+)\.(\d+)\.downsample\.0\.weight", r"params/layer\1_\2/down_conv/kernel", conv_kernel),
+    ]
+    for bn_name in ("bn1", "bn2", "bn3"):
+        rules += [
+            (rf"layer(\d+)\.(\d+)\.{bn_name}\.weight", rf"params/layer\1_\2/{bn_name}/scale", None),
+            (rf"layer(\d+)\.(\d+)\.{bn_name}\.bias", rf"params/layer\1_\2/{bn_name}/bias", None),
+            (rf"layer(\d+)\.(\d+)\.{bn_name}\.running_mean", rf"batch_stats/layer\1_\2/{bn_name}/mean", None),
+            (rf"layer(\d+)\.(\d+)\.{bn_name}\.running_var", rf"batch_stats/layer\1_\2/{bn_name}/var", None),
+        ]
+    rules += _bn(r"layer(\d+)\.(\d+)\.downsample\.1", r"layer\1_\2/down_bn")
+    return rules
+
+
+def convert_iresnet(state: dict[str, np.ndarray], final_c: int, final_hw: int) -> dict:
+    flat = apply_rules(
+        state,
+        iresnet_rules(final_c, final_hw),
+        drop=[r"num_batches_tracked"],
+    )
+    return split_collections(flat)
+
+
+def convert_face_checkpoint(state: dict[str, np.ndarray], kind: str, **kw) -> dict:
+    """-> {'params': ..., 'batch_stats': ...} variable collections."""
+    if is_native_checkpoint(state):
+        return split_collections(state)
+    if kind == "recognition":
+        return convert_iresnet(state, **kw)
+    raise WeightLoadError(
+        f"no conversion rules for non-native {kind!r} checkpoint "
+        f"(keys like {sorted(state)[:4]}); re-export in the native format"
+    )

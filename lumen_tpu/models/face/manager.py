@@ -1,0 +1,327 @@
+"""Face pipeline manager: detect -> align -> embed on TPU.
+
+Business logic of the reference's ``FaceModelManager``
+(``packages/lumen-face/src/lumen_face/general_face/face_model.py:45-515``)
+with the hot math moved on-device:
+
+- detection decode (anchors, distance2bbox/kps, top-k, NMS) is one jitted
+  program per image-batch (the reference does all of it in numpy per image,
+  ``onnxrt_backend.py:882-1290``);
+- recognition embeds N aligned crops as ONE batched call (the reference
+  loops faces sequentially, SURVEY.md §3.4 note);
+- host side keeps the CV parts: JPEG decode, letterbox, coordinate unmap,
+  similarity-transform alignment (``_align_face_5points``,
+  ``onnxrt_backend.py:1382-1416``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.model_info import load_model_info
+from ...ops.image import decode_image_bytes, letterbox_numpy
+from ...ops.nms import nms_jax
+from ...runtime.batcher import MicroBatcher
+from ...runtime.policy import get_policy
+from ...runtime.weights import load_safetensors
+from .convert import convert_face_checkpoint
+from .modeling import (
+    ARCFACE_TEMPLATE,
+    DetectorConfig,
+    FaceDetector,
+    IResNet,
+    IResNetConfig,
+    decode_detections,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class FaceSpec:
+    """Pack spec: preprocessing + thresholds. Defaults match the InsightFace
+    pack constants (reference ``insightface_specs.py:11-159``); overridable
+    via model_info ``extra_metadata.insightface``."""
+
+    det_size: int = 640
+    det_mean: float = 127.5
+    det_std: float = 128.0
+    score_threshold: float = 0.4
+    nms_threshold: float = 0.4
+    rec_size: int = 112
+    rec_mean: float = 127.5
+    rec_std: float = 127.5
+    rec_color: str = "rgb"  # some packs want bgr crops
+    max_detections: int = 128
+
+    @classmethod
+    def from_extra(cls, extra: dict | None) -> "FaceSpec":
+        spec = cls()
+        for key, value in (extra or {}).items():
+            if hasattr(spec, key):
+                setattr(spec, key, value)
+        return spec
+
+
+@dataclass
+class FaceDetection:
+    bbox: np.ndarray  # [4] x1 y1 x2 y2 (original image coords)
+    confidence: float
+    landmarks: np.ndarray | None = None  # [5, 2]
+    embedding: np.ndarray | None = None  # [512] unit-norm
+
+
+class FaceManager:
+    def __init__(
+        self,
+        model_dir: str,
+        dtype: str = "bfloat16",
+        batch_size: int = 8,
+        max_batch_latency_ms: float = 5.0,
+        detector_cfg: DetectorConfig | None = None,
+        embedder_cfg: IResNetConfig | None = None,
+    ):
+        self.model_dir = model_dir
+        self.info = load_model_info(model_dir)
+        self.model_id = self.info.name
+        self.spec = FaceSpec.from_extra(self.info.extra("insightface"))
+        self.policy = get_policy(dtype)
+        self.batch_size = batch_size
+        self.max_batch_latency_ms = max_batch_latency_ms
+        # Architecture comes from the model dir's manifest
+        # (extra_metadata.detector / .embedder), explicit args win (tests).
+        self.det_cfg = detector_cfg or self._detector_cfg_from_info()
+        self.rec_cfg = embedder_cfg or self._embedder_cfg_from_info()
+        self.detector = FaceDetector(self.det_cfg)
+        self.embedder = IResNet(self.rec_cfg)
+        self._initialized = False
+
+    def _detector_cfg_from_info(self) -> DetectorConfig:
+        extra = self.info.extra("detector") or {}
+        extra.setdefault("input_size", self.spec.det_size)
+        valid = {f.name for f in __import__("dataclasses").fields(DetectorConfig)}
+        cfg_kw = {k: v for k, v in extra.items() if k in valid}
+        if "strides" in cfg_kw:
+            cfg_kw["strides"] = tuple(cfg_kw["strides"])
+        return DetectorConfig(**cfg_kw)
+
+    def _embedder_cfg_from_info(self) -> IResNetConfig:
+        extra = self.info.extra("embedder") or {}
+        extra.setdefault("input_size", self.spec.rec_size)
+        if self.info.embedding_dim:
+            extra.setdefault("embed_dim", self.info.embedding_dim)
+        valid = {f.name for f in __import__("dataclasses").fields(IResNetConfig)}
+        cfg_kw = {k: v for k, v in extra.items() if k in valid}
+        if "layers" in cfg_kw:
+            cfg_kw["layers"] = tuple(cfg_kw["layers"])
+        return IResNetConfig(**cfg_kw)
+
+    # -- init -------------------------------------------------------------
+
+    def _load_variables(self, filename: str, module, example_shape, kind: str):
+        path = os.path.join(self.model_dir, filename)
+        if os.path.exists(path):
+            state = load_safetensors(path)
+            kw = {}
+            if kind == "recognition":
+                final_hw = self.rec_cfg.input_size // 16
+                kw = {"final_c": self.rec_cfg.width * 8, "final_hw": final_hw}
+            variables = convert_face_checkpoint(state, kind, **kw)
+        else:
+            logger.warning("%s missing in %s; using random init (tests only)", filename, self.model_dir)
+            variables = module.init(jax.random.PRNGKey(0), jnp.zeros(example_shape, jnp.float32))
+            variables = dict(variables)
+        variables["params"] = self.policy.cast_params(variables["params"])
+        if "batch_stats" in variables:
+            variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
+        return jax.device_put(variables)
+
+    def initialize(self) -> None:
+        if self._initialized:
+            return
+        s = self.spec
+        det_shape = (1, self.det_cfg.input_size, self.det_cfg.input_size, 3)
+        rec_shape = (1, self.rec_cfg.input_size, self.rec_cfg.input_size, 3)
+        self.det_vars = self._load_variables("detection.safetensors", self.detector, det_shape, "detection")
+        self.rec_vars = self._load_variables("recognition.safetensors", self.embedder, rec_shape, "recognition")
+        compute = self.policy.compute_dtype
+        det_cfg = self.det_cfg
+
+        @jax.jit
+        def run_detector(variables, images_u8, score_thresh, nms_thresh):
+            x = (images_u8.astype(jnp.float32) - s.det_mean) / s.det_std
+            outs = self.detector.apply(variables, x.astype(compute))
+            boxes, kps, scores = decode_detections(
+                outs, det_cfg.input_size, det_cfg.num_anchors, max_detections=s.max_detections
+            )
+            # Below-threshold slots -> -inf so NMS never keeps them.
+            scores = jnp.where(scores >= score_thresh, scores, -jnp.inf)
+            keep = jax.vmap(lambda b, sc: nms_jax(b, sc, s.nms_threshold))(boxes, scores)
+            return boxes, kps, scores, keep
+
+        @jax.jit
+        def run_embedder(variables, crops_u8):
+            x = (crops_u8.astype(jnp.float32) - s.rec_mean) / s.rec_std
+            emb = self.embedder.apply(variables, x.astype(compute)).astype(jnp.float32)
+            return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+
+        self._run_detector = run_detector
+        self._run_embedder = run_embedder
+        self._det_batcher = MicroBatcher(
+            lambda imgs, n: jax.tree_util.tree_map(
+                np.asarray,
+                self._run_detector(self.det_vars, imgs, self.spec.score_threshold, self.spec.nms_threshold),
+            ),
+            max_batch=self.batch_size,
+            max_latency_ms=self.max_batch_latency_ms,
+            name="face-det",
+        ).start()
+        self._rec_batcher = MicroBatcher(
+            lambda crops, n: np.asarray(self._run_embedder(self.rec_vars, crops)),
+            max_batch=max(self.batch_size, 16),
+            max_latency_ms=self.max_batch_latency_ms,
+            name="face-rec",
+        ).start()
+        self._initialized = True
+        logger.info("face manager ready: %s (det %d, rec %d)", self.model_id, self.det_cfg.input_size, self.rec_cfg.input_size)
+
+    def close(self) -> None:
+        if self._initialized:
+            self._det_batcher.close()
+            self._rec_batcher.close()
+            self._initialized = False
+
+    # -- detection --------------------------------------------------------
+
+    def detect_faces(
+        self,
+        image_bytes: bytes,
+        conf_threshold: float | None = None,
+        size_min: float = 0.0,
+        size_max: float = float("inf"),
+        max_faces: int | None = None,
+    ) -> list[FaceDetection]:
+        self._ensure_ready()
+        img = decode_image_bytes(image_bytes, color="rgb")
+        h, w = img.shape[:2]
+        boxed, scale, pad_top, pad_left = letterbox_numpy(img, self.det_cfg.input_size)
+        boxes, kps, scores, keep = self._det_batcher(boxed)
+        conf = self.spec.score_threshold if conf_threshold is None else conf_threshold
+        results: list[FaceDetection] = []
+        for i in np.argsort(-scores):
+            if not keep[i] or not np.isfinite(scores[i]) or scores[i] < conf:
+                continue
+            # Undo letterbox: subtract padding, divide by scale, clip.
+            box = boxes[i].astype(np.float64)
+            box[[0, 2]] = (box[[0, 2]] - pad_left) / scale
+            box[[1, 3]] = (box[[1, 3]] - pad_top) / scale
+            box = np.clip(box, [0, 0, 0, 0], [w, h, w, h])
+            bw, bh = box[2] - box[0], box[3] - box[1]
+            if bw <= 0 or bh <= 0:  # degenerate prediction
+                continue
+            side = max(bw, bh)
+            if not (size_min <= side <= size_max):
+                continue
+            lm = kps[i].astype(np.float64)
+            lm[:, 0] = (lm[:, 0] - pad_left) / scale
+            lm[:, 1] = (lm[:, 1] - pad_top) / scale
+            results.append(
+                FaceDetection(bbox=box.astype(np.float32), confidence=float(scores[i]), landmarks=lm.astype(np.float32))
+            )
+            if max_faces is not None and len(results) >= max_faces:
+                break
+        return results
+
+    # -- recognition ------------------------------------------------------
+
+    def align_crop(self, img: np.ndarray, landmarks: np.ndarray) -> np.ndarray:
+        """5-point similarity-transform alignment to the canonical ArcFace
+        112x112 template (reference ``_align_face_5points``)."""
+        import cv2
+
+        template = np.asarray(ARCFACE_TEMPLATE, np.float32) * (self.rec_cfg.input_size / 112.0)
+        matrix, _ = cv2.estimateAffinePartial2D(
+            np.asarray(landmarks, np.float32), template, method=cv2.LMEDS
+        )
+        if matrix is None:
+            return self._center_crop(img)
+        return cv2.warpAffine(img, matrix, (self.rec_cfg.input_size, self.rec_cfg.input_size))
+
+    def _center_crop(self, img: np.ndarray) -> np.ndarray:
+        import cv2
+
+        return cv2.resize(img, (self.rec_cfg.input_size, self.rec_cfg.input_size))
+
+    def extract_embedding(
+        self, face_image: bytes | np.ndarray, landmarks: np.ndarray | None = None
+    ) -> np.ndarray:
+        self._ensure_ready()
+        img = (
+            decode_image_bytes(face_image, color="rgb")
+            if isinstance(face_image, (bytes, bytearray))
+            else np.asarray(face_image)
+        )
+        crop = self.align_crop(img, landmarks) if landmarks is not None else self._center_crop(img)
+        if self.spec.rec_color == "bgr":
+            crop = crop[:, :, ::-1]
+        return self._rec_batcher(np.ascontiguousarray(crop))
+
+    def detect_and_extract(
+        self, image_bytes: bytes, max_faces: int | None = None, **det_kw
+    ) -> list[FaceDetection]:
+        faces = self.detect_faces(image_bytes, max_faces=max_faces, **det_kw)
+        if not faces:
+            return faces
+        img = decode_image_bytes(image_bytes, color="rgb")
+        crops = []
+        for f in faces:
+            crop = self.align_crop(img, f.landmarks) if f.landmarks is not None else None
+            if crop is None:
+                x1, y1, x2, y2 = [int(round(v)) for v in f.bbox]
+                crop = self._center_crop(img[max(y1, 0) : y2, max(x1, 0) : x2])
+            if self.spec.rec_color == "bgr":
+                crop = crop[:, :, ::-1]
+            crops.append(np.ascontiguousarray(crop))
+        # Concurrent submits coalesce into one batched device call.
+        futures = [self._rec_batcher.submit(c) for c in crops]
+        for f, fut in zip(faces, futures):
+            f.embedding = fut.result(timeout=60)
+        return faces
+
+    # -- comparisons (reference face_model.py:371-429) --------------------
+
+    @staticmethod
+    def compare_faces(emb1: np.ndarray, emb2: np.ndarray) -> float:
+        return float(np.dot(emb1, emb2))
+
+    @staticmethod
+    def find_best_match(
+        query: np.ndarray, gallery: np.ndarray, threshold: float = 0.35
+    ) -> tuple[int, float] | None:
+        if len(gallery) == 0:
+            return None
+        sims = gallery @ query
+        idx = int(np.argmax(sims))
+        if sims[idx] < threshold:
+            return None
+        return idx, float(sims[idx])
+
+    @staticmethod
+    def crop_face(image_bytes: bytes, bbox: np.ndarray, margin: float = 0.0) -> np.ndarray:
+        img = decode_image_bytes(image_bytes, color="rgb")
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = bbox
+        mw, mh = (x2 - x1) * margin, (y2 - y1) * margin
+        x1, y1 = max(int(x1 - mw), 0), max(int(y1 - mh), 0)
+        x2, y2 = min(int(x2 + mw), w), min(int(y2 + mh), h)
+        return img[y1:y2, x1:x2]
+
+    def _ensure_ready(self) -> None:
+        if not self._initialized:
+            raise RuntimeError("FaceManager.initialize() not called")

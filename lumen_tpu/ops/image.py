@@ -86,17 +86,22 @@ def decode_image_bytes(payload: bytes, color: str = "rgb") -> np.ndarray:
     import cv2
 
     buf = np.frombuffer(payload, dtype=np.uint8)
-    img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
-    if img is None:
-        from io import BytesIO
+    try:
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if img is None:
+            from io import BytesIO
 
-        from PIL import Image
+            from PIL import Image
 
-        pil = Image.open(BytesIO(payload)).convert("RGB")
-        img = np.asarray(pil)
-        if color == "bgr":
-            img = img[:, :, ::-1]
-        return np.ascontiguousarray(img)
+            pil = Image.open(BytesIO(payload)).convert("RGB")
+            img = np.asarray(pil)
+            if color == "bgr":
+                img = img[:, :, ::-1]
+            return np.ascontiguousarray(img)
+    except ValueError:
+        raise
+    except Exception as e:  # noqa: BLE001 - normalize any decode failure
+        raise ValueError(f"cannot decode image payload: {e}") from e
     if color == "rgb":
         img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
     return img

@@ -156,10 +156,15 @@ class ClipService(BaseService):
             result = mgr.classify_image(payload, top_k=top_k)
         except RuntimeError as e:
             raise Unavailable(str(e)) from e
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
         return self._labels_result(mgr, result)
 
     def _scene(self, mgr: CLIPManager, payload: bytes, meta: dict[str, str]):
-        result = mgr.classify_scene(payload, top_k=_int_meta(meta, "top_k", 3))
+        try:
+            result = mgr.classify_scene(payload, top_k=_int_meta(meta, "top_k", 3))
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
         return self._labels_result(mgr, result)
 
     def _smart_bioclassify(self, payload: bytes, mime: str, meta: dict[str, str]):
@@ -168,7 +173,10 @@ class ClipService(BaseService):
             raise InvalidArgument(f"unsupported namespace {ns!r} (expected 'bioatlas')")
         mgr = self.managers["bioclip"]
         top_k = _int_meta(meta, "top_k", 5)
-        result = mgr.classify_image(payload, top_k=top_k)
+        try:
+            result = mgr.classify_image(payload, top_k=top_k)
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
         return self._labels_result(mgr, result)
 
     def _encode_image(self, mgr: CLIPManager, payload: bytes):

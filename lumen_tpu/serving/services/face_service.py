@@ -1,0 +1,166 @@
+"""Face gRPC service: detect / embed / detect-and-embed tasks.
+
+Task surface and meta knobs mirror the reference ``GeneralFaceService``
+(``packages/lumen-face/src/lumen_face/general_face/face_service.py:214-590``):
+``face_detect`` (conf/nms thresholds, size_min/max, max_faces),
+``face_embed`` (optional ``landmarks`` JSON in meta), and
+``face_detect_and_embed``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+import numpy as np
+
+from ...core.config import ServiceConfig
+from ...core.result_schemas import FaceItem, FaceV1
+from ...models.face import FaceManager
+from ..base_service import BaseService, InvalidArgument
+from ..registry import TaskDefinition, TaskRegistry
+
+logger = logging.getLogger(__name__)
+
+IMAGE_MIMES = ("image/jpeg", "image/png", "image/webp", "application/octet-stream")
+
+
+class FaceService(BaseService):
+    def __init__(self, manager: FaceManager, service_name: str = "face"):
+        self.manager = manager
+        registry = TaskRegistry(service_name)
+        registry.register(
+            TaskDefinition(
+                name="face_detect",
+                handler=self._detect,
+                description="detect faces: bboxes + landmarks + confidences",
+                input_mimes=IMAGE_MIMES,
+                output_mime=FaceV1.mime(),
+            )
+        )
+        registry.register(
+            TaskDefinition(
+                name="face_embed",
+                handler=self._embed,
+                description="embed one face crop (optional landmarks meta)",
+                input_mimes=IMAGE_MIMES,
+                output_mime=FaceV1.mime(),
+            )
+        )
+        registry.register(
+            TaskDefinition(
+                name="face_detect_and_embed",
+                handler=self._detect_and_embed,
+                description="detect all faces and embed each",
+                input_mimes=IMAGE_MIMES,
+                output_mime=FaceV1.mime(),
+            )
+        )
+        super().__init__(registry)
+
+    @classmethod
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "FaceService":
+        bs = service_config.backend_settings
+        alias, mc = next(iter(service_config.models.items()))
+        model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
+        manager = FaceManager(
+            model_dir,
+            dtype=bs.dtype,
+            batch_size=bs.batch_size,
+            max_batch_latency_ms=bs.max_batch_latency_ms,
+        )
+        manager.initialize()
+        return cls(manager)
+
+    def capability(self):
+        return self.registry.build_capability(
+            model_ids=[self.manager.model_id],
+            runtime="jax-tpu",
+            max_concurrency=self.manager.batch_size,
+            precisions=["bf16", "fp32"],
+            extra={
+                "det_size": str(self.manager.det_cfg.input_size),
+                "embedding_dim": str(self.manager.rec_cfg.embed_dim),
+            },
+        )
+
+    def healthy(self) -> bool:
+        return self.manager._initialized
+
+    def close(self) -> None:
+        self.manager.close()
+
+    # -- handlers ---------------------------------------------------------
+
+    def _det_kwargs(self, meta: dict[str, str]) -> dict:
+        kw = {}
+        if "conf_threshold" in meta:
+            kw["conf_threshold"] = _float_meta(meta, "conf_threshold")
+        if "size_min" in meta:
+            kw["size_min"] = _float_meta(meta, "size_min")
+        if "size_max" in meta:
+            kw["size_max"] = _float_meta(meta, "size_max")
+        if "max_faces" in meta:
+            try:
+                kw["max_faces"] = int(meta["max_faces"])
+            except ValueError as e:
+                raise InvalidArgument("meta max_faces must be an integer") from e
+        return kw
+
+    def _detect(self, payload: bytes, mime: str, meta: dict[str, str]):
+        faces = self._call(lambda: self.manager.detect_faces(payload, **self._det_kwargs(meta)))
+        return self._result(faces)
+
+    def _embed(self, payload: bytes, mime: str, meta: dict[str, str]):
+        landmarks = None
+        if "landmarks" in meta:
+            try:
+                landmarks = np.asarray(json.loads(meta["landmarks"]), np.float32)
+                if landmarks.shape != (5, 2):
+                    raise ValueError(f"expected [5,2], got {landmarks.shape}")
+            except (ValueError, json.JSONDecodeError) as e:
+                raise InvalidArgument(f"invalid landmarks meta: {e}") from e
+        emb = self._call(lambda: self.manager.extract_embedding(payload, landmarks))
+        face = FaceItem(
+            bbox=[0.0, 0.0, 0.0, 0.0],
+            confidence=1.0,
+            landmarks=landmarks.tolist() if landmarks is not None else None,
+            embedding=[float(x) for x in emb],
+        )
+        return self._result_items([face])
+
+    def _detect_and_embed(self, payload: bytes, mime: str, meta: dict[str, str]):
+        faces = self._call(
+            lambda: self.manager.detect_and_extract(payload, **self._det_kwargs(meta))
+        )
+        return self._result(faces)
+
+    def _call(self, fn):
+        try:
+            return fn()
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
+
+    def _result(self, faces):
+        items = [
+            FaceItem(
+                bbox=[float(v) for v in f.bbox],
+                confidence=min(max(f.confidence, 0.0), 1.0),
+                landmarks=f.landmarks.tolist() if f.landmarks is not None else None,
+                embedding=[float(x) for x in f.embedding] if f.embedding is not None else None,
+            )
+            for f in faces
+        ]
+        return self._result_items(items)
+
+    def _result_items(self, items):
+        body = FaceV1(faces=items, count=len(items), model_id=self.manager.model_id)
+        return body.to_json_bytes(), FaceV1.mime(), {}
+
+
+def _float_meta(meta: dict[str, str], key: str) -> float:
+    try:
+        return float(meta[key])
+    except ValueError as e:
+        raise InvalidArgument(f"meta {key!r} must be a number") from e

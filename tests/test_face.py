@@ -1,0 +1,291 @@
+"""Face family tests: decode math, conversion layout, manager pipeline,
+and the gRPC service."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.clip_fixtures import png_bytes
+
+
+def make_face_model_dir(tmp_path, det_size=64, rec_size=32):
+    """Tiny face model dir with NATIVE checkpoints (random weights)."""
+    from safetensors.numpy import save_file
+
+    from lumen_tpu.models.face import (
+        DetectorConfig,
+        FaceDetector,
+        IResNet,
+        IResNetConfig,
+        flatten_variables,
+    )
+
+    model_dir = tmp_path / "models" / "TinyFace"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    det_cfg = DetectorConfig(input_size=det_size, width=8, fpn_width=8)
+    rec_cfg = IResNetConfig(layers=(1, 1, 1, 1), width=8, input_size=rec_size, embed_dim=64)
+    det_vars = FaceDetector(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, det_size, det_size, 3)))
+    rec_vars = IResNet(rec_cfg).init(jax.random.PRNGKey(1), jnp.zeros((1, rec_size, rec_size, 3)))
+    save_file(flatten_variables(dict(det_vars)), str(model_dir / "detection.safetensors"))
+    save_file(flatten_variables(dict(rec_vars)), str(model_dir / "recognition.safetensors"))
+    info = {
+        "name": "TinyFace",
+        "version": "1.0.0",
+        "description": "tiny test face pack",
+        "model_type": "face",
+        "embedding_dim": 64,
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/TinyFace"},
+        "runtimes": {
+            "jax": {"available": True, "files": ["detection.safetensors", "recognition.safetensors"]}
+        },
+        "extra_metadata": {
+            "insightface": {"det_size": det_size, "rec_size": rec_size},
+            "detector": {"input_size": det_size, "width": 8, "fpn_width": 8},
+            "embedder": {"layers": [1, 1, 1, 1], "width": 8, "input_size": rec_size, "embed_dim": 64},
+        },
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir), det_cfg, rec_cfg
+
+
+@pytest.fixture(scope="module")
+def face_setup(tmp_path_factory):
+    from lumen_tpu.models.face import FaceManager
+
+    tmp = tmp_path_factory.mktemp("face")
+    model_dir, det_cfg, rec_cfg = make_face_model_dir(tmp)
+    mgr = FaceManager(model_dir, dtype="float32", batch_size=4, detector_cfg=det_cfg, embedder_cfg=rec_cfg)
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+class TestDecodeMath:
+    def test_distance2bbox(self):
+        from lumen_tpu.models.face import distance2bbox
+
+        centers = jnp.array([[100.0, 50.0]])
+        dist = jnp.array([[10.0, 5.0, 20.0, 15.0]])
+        box = np.asarray(distance2bbox(centers, dist))
+        np.testing.assert_allclose(box, [[90, 45, 120, 65]])
+
+    def test_distance2kps(self):
+        from lumen_tpu.models.face import distance2kps
+
+        centers = jnp.array([[10.0, 20.0]])
+        dist = jnp.array([[1.0, 2.0, -1.0, -2.0]])  # 2 kps
+        kps = np.asarray(distance2kps(centers, dist))
+        np.testing.assert_allclose(kps, [[[11, 22], [9, 18]]])
+
+    def test_anchor_centers_layout(self):
+        from lumen_tpu.models.face import anchor_centers
+
+        c = np.asarray(anchor_centers(64, 32, 2))
+        assert c.shape == (8, 2)  # 2x2 grid x 2 anchors
+        np.testing.assert_allclose(c[0], [0, 0])
+        np.testing.assert_allclose(c[1], [0, 0])  # duplicated per anchor
+        np.testing.assert_allclose(c[2], [32, 0])
+
+    def test_decode_detections_shapes(self):
+        from lumen_tpu.models.face import DetectorConfig, FaceDetector, decode_detections
+
+        cfg = DetectorConfig.tiny()
+        det = FaceDetector(cfg)
+        x = jnp.zeros((2, cfg.input_size, cfg.input_size, 3))
+        variables = det.init(jax.random.PRNGKey(0), x)
+        outs = det.apply(variables, x)
+        boxes, kps, scores = decode_detections(outs, cfg.input_size, cfg.num_anchors, max_detections=32)
+        assert boxes.shape == (2, 32, 4)
+        assert kps.shape == (2, 32, 5, 2)
+        assert scores.shape == (2, 32)
+
+
+class TestIResNet:
+    def test_embedding_shape(self):
+        from lumen_tpu.models.face import IResNet, IResNetConfig
+
+        cfg = IResNetConfig.tiny()
+        model = IResNet(cfg)
+        x = jnp.zeros((2, cfg.input_size, cfg.input_size, 3))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(variables, x)
+        assert out.shape == (2, cfg.embed_dim)
+
+    def test_fc_kernel_permute_matches_torch_flatten(self):
+        from lumen_tpu.models.face.convert import fc_kernel_from_torch
+
+        c, h, w = 3, 2, 2
+        rng = np.random.default_rng(0)
+        x_nhwc = rng.standard_normal((1, h, w, c)).astype(np.float32)
+        weight = rng.standard_normal((5, c * h * w)).astype(np.float32)
+        torch_out = weight @ x_nhwc.transpose(0, 3, 1, 2).reshape(1, -1).T  # torch flatten order
+        jax_out = x_nhwc.reshape(1, -1) @ fc_kernel_from_torch(weight, c, h, w)
+        np.testing.assert_allclose(jax_out.T, torch_out, atol=1e-5)
+
+    def test_torch_iresnet_conversion_tree(self):
+        # Synthetic torch-layout state dict for the tiny config must convert
+        # into exactly the module's variable tree.
+        from lumen_tpu.models.face import IResNet, IResNetConfig
+        from lumen_tpu.models.face.convert import convert_iresnet
+        from lumen_tpu.runtime import flatten
+        from lumen_tpu.runtime.weights import assert_tree_shapes
+
+        cfg = IResNetConfig(layers=(1, 1, 1, 1), width=8, input_size=32, embed_dim=64)
+        model = IResNet(cfg)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+        state = {}
+        state["conv1.weight"] = np.zeros((8, 3, 3, 3), np.float32)
+        for tname, jname in (("bn1", None),):
+            pass
+        def bn(src, n):
+            state[f"{src}.weight"] = np.zeros((n,), np.float32)
+            state[f"{src}.bias"] = np.zeros((n,), np.float32)
+            state[f"{src}.running_mean"] = np.zeros((n,), np.float32)
+            state[f"{src}.running_var"] = np.ones((n,), np.float32)
+            state[f"{src}.num_batches_tracked"] = np.zeros((), np.int64)
+        bn("bn1", 8)
+        state["prelu.weight"] = np.full((8,), 0.25, np.float32)
+        widths = [8, 16, 32, 64]
+        in_w = 8
+        for s, wd in enumerate(widths, start=1):
+            bn(f"layer{s}.0.bn1", in_w)
+            state[f"layer{s}.0.conv1.weight"] = np.zeros((wd, in_w, 3, 3), np.float32)
+            bn(f"layer{s}.0.bn2", wd)
+            state[f"layer{s}.0.prelu.weight"] = np.full((wd,), 0.25, np.float32)
+            state[f"layer{s}.0.conv2.weight"] = np.zeros((wd, wd, 3, 3), np.float32)
+            bn(f"layer{s}.0.bn3", wd)
+            state[f"layer{s}.0.downsample.0.weight"] = np.zeros((wd, in_w, 1, 1), np.float32)
+            bn(f"layer{s}.0.downsample.1", wd)
+            in_w = wd
+        bn("bn2", 64)
+        final_hw = 32 // 16
+        state["fc.weight"] = np.zeros((64, 64 * final_hw * final_hw), np.float32)
+        state["fc.bias"] = np.zeros((64,), np.float32)
+        bn("features", 64)
+        converted = convert_iresnet(state, final_c=64, final_hw=final_hw)
+        assert_tree_shapes(converted["params"], jax.tree.map(np.asarray, variables["params"]))
+        assert_tree_shapes(converted["batch_stats"], jax.tree.map(np.asarray, variables["batch_stats"]))
+
+
+class TestManagerPipeline:
+    def test_detect_returns_list(self, face_setup):
+        faces = face_setup.detect_faces(png_bytes(size=100), conf_threshold=0.0, max_faces=5)
+        assert isinstance(faces, list) and len(faces) <= 5
+        for f in faces:
+            assert f.bbox.shape == (4,)
+            x1, y1, x2, y2 = f.bbox
+            assert 0 <= x1 <= x2 <= 100 and 0 <= y1 <= y2 <= 100
+            assert f.landmarks.shape == (5, 2)
+
+    def test_letterbox_unmap(self, face_setup, monkeypatch):
+        # Inject a synthetic detection at a known letterboxed position and
+        # check it maps back to original image coordinates.
+        det_size = face_setup.det_cfg.input_size  # 64
+        # Image 100x200 -> scale 64/200=0.32, pad_top=(64-32)//2=16
+        boxes = np.full((128, 4), 0, np.float32)
+        boxes[0] = [0 + 0, 16 + 3.2, 32, 16 + 16]  # letterboxed coords
+        kps = np.zeros((128, 5, 2), np.float32)
+        scores = np.full((128,), -np.inf, np.float32)
+        scores[0] = 0.9
+        keep = np.zeros((128,), bool)
+        keep[0] = True
+        monkeypatch.setattr(face_setup, "_det_batcher", lambda img: (boxes, kps, scores, keep))
+        img = np.zeros((100, 200, 3), np.uint8)
+        import cv2
+
+        ok, buf = cv2.imencode(".png", img)
+        faces = face_setup.detect_faces(buf.tobytes())
+        assert len(faces) == 1
+        scale = 64 / 200
+        np.testing.assert_allclose(
+            faces[0].bbox, [0, 3.2 / scale, 32 / scale, 16 / scale], atol=1e-3
+        )
+
+    def test_embedding_unit_norm(self, face_setup):
+        emb = face_setup.extract_embedding(png_bytes(size=50))
+        assert emb.shape == (64,)
+        assert np.linalg.norm(emb) == pytest.approx(1.0, abs=1e-5)
+
+    def test_embedding_with_landmarks_alignment(self, face_setup):
+        lm = np.array([[15, 20], [35, 20], [25, 30], [18, 40], [32, 40]], np.float32)
+        emb = face_setup.extract_embedding(png_bytes(size=50), landmarks=lm)
+        assert np.linalg.norm(emb) == pytest.approx(1.0, abs=1e-5)
+
+    def test_compare_and_match(self, face_setup):
+        e1 = face_setup.extract_embedding(png_bytes(1, size=40))
+        e2 = face_setup.extract_embedding(png_bytes(1, size=40))
+        assert face_setup.compare_faces(e1, e2) == pytest.approx(1.0, abs=1e-4)
+        gallery = np.stack([e1, -e1])
+        idx, sim = face_setup.find_best_match(e2, gallery)
+        assert idx == 0 and sim > 0.9
+        assert face_setup.find_best_match(e2, np.zeros((0, 64))) is None
+
+
+@pytest.mark.integration
+class TestFaceServiceGrpc:
+    @pytest.fixture(scope="class")
+    def stub(self, tmp_path_factory):
+        import grpc
+        from concurrent import futures
+
+        from lumen_tpu.models.face import FaceManager
+        from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+            InferenceStub,
+            add_InferenceServicer_to_server,
+        )
+        from lumen_tpu.serving.router import HubRouter
+        from lumen_tpu.serving.services.face_service import FaceService
+
+        tmp = tmp_path_factory.mktemp("facesvc")
+        model_dir, det_cfg, rec_cfg = make_face_model_dir(tmp)
+        mgr = FaceManager(model_dir, dtype="float32", batch_size=4, detector_cfg=det_cfg, embedder_cfg=rec_cfg)
+        mgr.initialize()
+        svc = FaceService(mgr)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_InferenceServicer_to_server(HubRouter({"face": svc}), server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        yield InferenceStub(channel)
+        channel.close()
+        server.stop(0)
+        svc.close()
+
+    def _infer(self, stub, task, payload, meta=None):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        (resp,) = stub.Infer(
+            iter(
+                [
+                    pb.InferRequest(
+                        correlation_id="f1", task=task, payload=payload,
+                        meta=meta or {}, payload_mime="image/png",
+                    )
+                ]
+            )
+        )
+        return resp
+
+    def test_face_detect(self, stub):
+        resp = self._infer(stub, "face_detect", png_bytes(size=80), meta={"conf_threshold": "0.0", "max_faces": "3"})
+        assert not resp.HasField("error"), resp.error
+        body = json.loads(resp.result)
+        assert body["count"] == len(body["faces"]) <= 3
+
+    def test_face_embed(self, stub):
+        resp = self._infer(stub, "face_embed", png_bytes(size=40))
+        body = json.loads(resp.result)
+        assert len(body["faces"][0]["embedding"]) == 64
+
+    def test_face_detect_and_embed(self, stub):
+        resp = self._infer(stub, "face_detect_and_embed", png_bytes(size=80), meta={"conf_threshold": "0.0"})
+        body = json.loads(resp.result)
+        for f in body["faces"]:
+            assert f["embedding"] is None or len(f["embedding"]) == 64
+
+    def test_invalid_landmarks_meta(self, stub):
+        resp = self._infer(stub, "face_embed", png_bytes(size=40), meta={"landmarks": "[[1,2]]"})
+        assert resp.HasField("error")
