@@ -97,6 +97,23 @@ class ModelInfo(BaseModel):
         return self.extra_metadata.get(key, default)
 
 
+def dataclass_from_extra(cls, extra: dict | None, defaults: dict | None = None, tuple_keys: tuple[str, ...] = ()):
+    """Build an architecture-config dataclass from a manifest ``extra``
+    dict: unknown keys dropped, ``defaults`` applied when absent, listed
+    keys coerced to tuples (JSON has no tuples). Shared by every model
+    family's manager."""
+    import dataclasses
+
+    merged = dict(defaults or {})
+    merged.update(extra or {})
+    valid = {f.name for f in dataclasses.fields(cls)}
+    kw = {k: v for k, v in merged.items() if k in valid}
+    for key in tuple_keys:
+        if key in kw:
+            kw[key] = tuple(kw[key])
+    return cls(**kw)
+
+
 def load_model_info(model_dir: str) -> ModelInfo:
     path = os.path.join(model_dir, MODEL_INFO_FILENAME)
     try:

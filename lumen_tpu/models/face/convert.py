@@ -20,35 +20,19 @@ from ...runtime.weights import (
     WeightLoadError,
     apply_rules,
     conv_kernel,
-    linear_kernel,
-    unflatten,
+    flatten_variables,
+    is_native_checkpoint,
+    split_collections,
 )
 
-
-def split_collections(flat: dict[str, np.ndarray]) -> dict[str, dict]:
-    """'params/a/b', 'batch_stats/a/b' flat keys -> {'params': tree, ...}."""
-    grouped: dict[str, dict[str, np.ndarray]] = {}
-    for key, value in flat.items():
-        coll, _, rest = key.partition("/")
-        if not rest:
-            raise WeightLoadError(f"native checkpoint key missing collection prefix: {key!r}")
-        grouped.setdefault(coll, {})[rest] = value
-    return {coll: unflatten(tree) for coll, tree in grouped.items()}
-
-
-def is_native_checkpoint(state: dict[str, np.ndarray]) -> bool:
-    return all(k.startswith(("params/", "batch_stats/")) for k in state)
-
-
-def flatten_variables(variables: dict) -> dict[str, np.ndarray]:
-    """Inverse of :func:`split_collections` (for saving native checkpoints)."""
-    from ...runtime.weights import flatten
-
-    out: dict[str, np.ndarray] = {}
-    for coll, tree in variables.items():
-        for k, v in flatten(tree).items():
-            out[f"{coll}/{k}"] = np.asarray(v)
-    return out
+__all__ = [
+    "convert_face_checkpoint",
+    "convert_iresnet",
+    "fc_kernel_from_torch",
+    "flatten_variables",
+    "is_native_checkpoint",
+    "split_collections",
+]
 
 
 def fc_kernel_from_torch(w: np.ndarray, c: int, h: int, ww: int) -> np.ndarray:

@@ -18,13 +18,13 @@ from __future__ import annotations
 
 import logging
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...core.model_info import load_model_info
+from ...core.model_info import dataclass_from_extra, load_model_info
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...ops.nms import nms_jax
 from ...runtime.batcher import MicroBatcher
@@ -103,24 +103,20 @@ class FaceManager:
         self._initialized = False
 
     def _detector_cfg_from_info(self) -> DetectorConfig:
-        extra = self.info.extra("detector") or {}
-        extra.setdefault("input_size", self.spec.det_size)
-        valid = {f.name for f in __import__("dataclasses").fields(DetectorConfig)}
-        cfg_kw = {k: v for k, v in extra.items() if k in valid}
-        if "strides" in cfg_kw:
-            cfg_kw["strides"] = tuple(cfg_kw["strides"])
-        return DetectorConfig(**cfg_kw)
+        return dataclass_from_extra(
+            DetectorConfig,
+            self.info.extra("detector"),
+            defaults={"input_size": self.spec.det_size},
+            tuple_keys=("strides",),
+        )
 
     def _embedder_cfg_from_info(self) -> IResNetConfig:
-        extra = self.info.extra("embedder") or {}
-        extra.setdefault("input_size", self.spec.rec_size)
+        defaults = {"input_size": self.spec.rec_size}
         if self.info.embedding_dim:
-            extra.setdefault("embed_dim", self.info.embedding_dim)
-        valid = {f.name for f in __import__("dataclasses").fields(IResNetConfig)}
-        cfg_kw = {k: v for k, v in extra.items() if k in valid}
-        if "layers" in cfg_kw:
-            cfg_kw["layers"] = tuple(cfg_kw["layers"])
-        return IResNetConfig(**cfg_kw)
+            defaults["embed_dim"] = self.info.embedding_dim
+        return dataclass_from_extra(
+            IResNetConfig, self.info.extra("embedder"), defaults=defaults, tuple_keys=("layers",)
+        )
 
     # -- init -------------------------------------------------------------
 
@@ -154,14 +150,16 @@ class FaceManager:
         det_cfg = self.det_cfg
 
         @jax.jit
-        def run_detector(variables, images_u8, score_thresh, nms_thresh):
+        def run_detector(variables, images_u8):
             x = (images_u8.astype(jnp.float32) - s.det_mean) / s.det_std
             outs = self.detector.apply(variables, x.astype(compute))
             boxes, kps, scores = decode_detections(
                 outs, det_cfg.input_size, det_cfg.num_anchors, max_detections=s.max_detections
             )
-            # Below-threshold slots -> -inf so NMS never keeps them.
-            scores = jnp.where(scores >= score_thresh, scores, -jnp.inf)
+            # NMS over the full top-k candidate set; the confidence cut
+            # happens host-side so a per-request conf_threshold below the
+            # pack default still widens the result (NMS processes in score
+            # order, so low-score candidates never suppress higher ones).
             keep = jax.vmap(lambda b, sc: nms_jax(b, sc, s.nms_threshold))(boxes, scores)
             return boxes, kps, scores, keep
 
@@ -175,8 +173,7 @@ class FaceManager:
         self._run_embedder = run_embedder
         self._det_batcher = MicroBatcher(
             lambda imgs, n: jax.tree_util.tree_map(
-                np.asarray,
-                self._run_detector(self.det_vars, imgs, self.spec.score_threshold, self.spec.nms_threshold),
+                np.asarray, self._run_detector(self.det_vars, imgs)
             ),
             max_batch=self.batch_size,
             max_latency_ms=self.max_batch_latency_ms,
@@ -201,14 +198,18 @@ class FaceManager:
 
     def detect_faces(
         self,
-        image_bytes: bytes,
+        image: bytes | np.ndarray,
         conf_threshold: float | None = None,
         size_min: float = 0.0,
         size_max: float = float("inf"),
         max_faces: int | None = None,
     ) -> list[FaceDetection]:
         self._ensure_ready()
-        img = decode_image_bytes(image_bytes, color="rgb")
+        img = (
+            decode_image_bytes(image, color="rgb")
+            if isinstance(image, (bytes, bytearray))
+            else np.asarray(image)
+        )
         h, w = img.shape[:2]
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, self.det_cfg.input_size)
         boxes, kps, scores, keep = self._det_batcher(boxed)
@@ -275,10 +276,11 @@ class FaceManager:
     def detect_and_extract(
         self, image_bytes: bytes, max_faces: int | None = None, **det_kw
     ) -> list[FaceDetection]:
-        faces = self.detect_faces(image_bytes, max_faces=max_faces, **det_kw)
+        # Decode once; detection and cropping share the array.
+        img = decode_image_bytes(image_bytes, color="rgb")
+        faces = self.detect_faces(img, max_faces=max_faces, **det_kw)
         if not faces:
             return faces
-        img = decode_image_bytes(image_bytes, color="rgb")
         crops = []
         for f in faces:
             crop = self.align_crop(img, f.landmarks) if f.landmarks is not None else None

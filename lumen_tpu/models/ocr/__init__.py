@@ -1,0 +1,31 @@
+"""OCR model family: DBNet detector + SVTR-style CTC recognizer."""
+
+from .convert import convert_ocr_checkpoint, flatten_variables
+from .manager import OcrManager, OcrResult, OcrSpec
+from .modeling import DBNet, DBNetConfig, SVTRConfig, SVTRRecognizer
+from .postprocess import (
+    box_score_fast,
+    boxes_from_prob_map,
+    order_quad,
+    rotate_crop,
+    sorted_boxes,
+    unclip_rect,
+)
+
+__all__ = [
+    "OcrManager",
+    "OcrResult",
+    "OcrSpec",
+    "DBNet",
+    "DBNetConfig",
+    "SVTRRecognizer",
+    "SVTRConfig",
+    "convert_ocr_checkpoint",
+    "flatten_variables",
+    "boxes_from_prob_map",
+    "box_score_fast",
+    "unclip_rect",
+    "order_quad",
+    "sorted_boxes",
+    "rotate_crop",
+]

@@ -1,0 +1,32 @@
+"""OCR checkpoint conversion.
+
+Native lumen-tpu checkpoints (``params/...`` / ``batch_stats/...`` flat
+safetensors) load directly. Paddle-format checkpoints have no torch-style
+state dict to convert mechanically — the reference consumes them as opaque
+ONNX graphs (``lumen_ocr/backends/onnxrt_backend.py``) — so non-native
+files get a clear re-export error instead of a silent wrong-weights load.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...runtime.weights import (
+    WeightLoadError,
+    flatten_variables,
+    is_native_checkpoint,
+    split_collections,
+)
+
+__all__ = ["convert_ocr_checkpoint", "flatten_variables"]
+
+
+def convert_ocr_checkpoint(state: dict[str, np.ndarray]) -> dict:
+    """-> {'params': ..., 'batch_stats': ...} variable collections."""
+    if is_native_checkpoint(state):
+        return split_collections(state)
+    raise WeightLoadError(
+        "no conversion rules for non-native OCR checkpoint "
+        f"(keys like {sorted(state)[:4]}); re-export in the native format "
+        "(flatten_variables + safetensors)"
+    )

@@ -1,0 +1,316 @@
+"""OCR pipeline manager: detect text regions -> crop -> recognize on TPU.
+
+Business logic of the reference's ``OcrModelManager`` + ONNX backend
+(``packages/lumen-ocr/src/lumen_ocr/general_ocr/ocr_model.py:27-214``,
+``backends/onnxrt_backend.py:43-633``) restructured for XLA:
+
+- the reference resizes each image to an arbitrary x32 multiple
+  (``limit_side_len=960``), which on TPU would compile a program per unique
+  shape. Here detection letterboxes into a small set of square **static
+  buckets** (default 320/640/960) — one compiled program per bucket;
+- recognition crops are height-``rec_h``, padded into **width buckets** and
+  run as one batched device call per bucket (the reference loops crops one
+  by one through the recognizer);
+- CTC argmax + per-step confidence run on device (`ops.ctc`), the
+  collapse-to-string on host;
+- contours/unclip/warps stay host-side cv2 (control-flow CV, not MXU work).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.model_info import dataclass_from_extra, load_model_info
+from ...ops.ctc import ctc_collapse, ctc_greedy_device, load_ctc_vocab
+from ...ops.image import decode_image_bytes, letterbox_numpy
+from ...runtime.batcher import bucket_for
+from ...runtime.policy import get_policy
+from ...runtime.weights import load_safetensors
+from .convert import convert_ocr_checkpoint
+from .modeling import DBNet, DBNetConfig, SVTRConfig, SVTRRecognizer
+from .postprocess import boxes_from_prob_map, rotate_crop, sorted_boxes
+
+logger = logging.getLogger(__name__)
+
+# PaddleOCR preprocessing conventions (reference defaults at
+# ``onnxrt_backend.py:242-268``): detection uses ImageNet stats, the
+# recognizer uses symmetric (x/255 - 0.5) / 0.5.
+DET_MEAN = (0.485, 0.456, 0.406)
+DET_STD = (0.229, 0.224, 0.225)
+REC_MEAN = (0.5, 0.5, 0.5)
+REC_STD = (0.5, 0.5, 0.5)
+
+
+@dataclass
+class OcrResult:
+    box: np.ndarray  # [4, 2] quad, original-image coords
+    text: str
+    confidence: float
+
+
+@dataclass
+class OcrSpec:
+    """Pipeline knobs; defaults match the reference's det/rec configs.
+    Overridable via model_info ``extra_metadata.ocr``."""
+
+    det_buckets: tuple[int, ...] = (320, 640, 960)
+    det_threshold: float = 0.3
+    box_threshold: float = 0.6
+    unclip_ratio: float = 1.5
+    max_candidates: int = 1000
+    min_size: float = 3.0
+    rec_height: int = 48
+    rec_width_buckets: tuple[int, ...] = (80, 160, 320, 640)
+    rec_batch_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32)
+    det_mean: tuple[float, ...] = DET_MEAN
+    det_std: tuple[float, ...] = DET_STD
+    rec_mean: tuple[float, ...] = REC_MEAN
+    rec_std: tuple[float, ...] = REC_STD
+    rec_threshold: float = 0.5
+    drop_rec_below_threshold: bool = True
+    charset_file: str = "ppocr_keys_v1.txt"
+    use_space_char: bool = True
+
+    @classmethod
+    def from_extra(cls, extra: dict | None) -> "OcrSpec":
+        spec = cls()
+        for key, value in (extra or {}).items():
+            if hasattr(spec, key):
+                if isinstance(value, list):  # JSON has no tuples
+                    value = tuple(value)
+                setattr(spec, key, value)
+        return spec
+
+
+class OcrManager:
+    def __init__(
+        self,
+        model_dir: str,
+        dtype: str = "bfloat16",
+        batch_size: int = 8,
+        det_cfg: DBNetConfig | None = None,
+        rec_cfg: SVTRConfig | None = None,
+    ):
+        self.model_dir = model_dir
+        self.info = load_model_info(model_dir)
+        self.model_id = self.info.name
+        self.spec = OcrSpec.from_extra(self.info.extra("ocr"))
+        self.policy = get_policy(dtype)
+        self.batch_size = batch_size
+        self.vocab = self._load_vocab()
+        self.det_cfg = det_cfg or self._det_cfg_from_info()
+        self.rec_cfg = rec_cfg or self._rec_cfg_from_info()
+        self.detector = DBNet(self.det_cfg)
+        self.recognizer = SVTRRecognizer(self.rec_cfg)
+        self._initialized = False
+
+    def _load_vocab(self) -> list[str]:
+        path = os.path.join(self.model_dir, self.spec.charset_file)
+        if os.path.exists(path):
+            return load_ctc_vocab(path, self.spec.use_space_char)
+        # Printable-ASCII fallback so tests and charset-less dirs still run.
+        logger.warning("charset file %s missing; using ASCII fallback vocab", path)
+        chars = [chr(c) for c in range(33, 127)]
+        return ["<blank>"] + chars + ([" "] if self.spec.use_space_char else [])
+
+    def _det_cfg_from_info(self) -> DBNetConfig:
+        return dataclass_from_extra(DBNetConfig, self.info.extra("detector"))
+
+    def _rec_cfg_from_info(self) -> SVTRConfig:
+        return dataclass_from_extra(
+            SVTRConfig,
+            self.info.extra("recognizer"),
+            defaults={
+                "vocab_size": len(self.vocab),
+                "height": self.spec.rec_height,
+                "max_width": max(self.spec.rec_width_buckets),
+            },
+        )
+
+    # -- init -------------------------------------------------------------
+
+    def _load_variables(self, filename: str, module, example_shape: tuple):
+        path = os.path.join(self.model_dir, filename)
+        if os.path.exists(path):
+            variables = convert_ocr_checkpoint(load_safetensors(path))
+        else:
+            logger.warning("%s missing in %s; using random init (tests only)", filename, self.model_dir)
+            variables = dict(module.init(jax.random.PRNGKey(0), jnp.zeros(example_shape, jnp.float32)))
+        variables["params"] = self.policy.cast_params(variables["params"])
+        if "batch_stats" in variables:
+            variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
+        return jax.device_put(variables)
+
+    def initialize(self) -> None:
+        if self._initialized:
+            return
+        s = self.spec
+        self.det_vars = self._load_variables(
+            "detection.safetensors", self.detector, (1, s.det_buckets[0], s.det_buckets[0], 3)
+        )
+        self.rec_vars = self._load_variables(
+            "recognition.safetensors",
+            self.recognizer,
+            (1, self.rec_cfg.height, s.rec_width_buckets[0], 3),
+        )
+        compute = self.policy.compute_dtype
+        det_mean, det_std = jnp.asarray(s.det_mean), jnp.asarray(s.det_std)
+        rec_mean, rec_std = jnp.asarray(s.rec_mean), jnp.asarray(s.rec_std)
+
+        @jax.jit
+        def run_detector(variables, images_u8):
+            x = (images_u8.astype(jnp.float32) / 255.0 - det_mean) / det_std
+            return self.detector.apply(variables, x.astype(compute))
+
+        @jax.jit
+        def run_recognizer(variables, crops_u8, widths):
+            x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
+            logits = self.recognizer.apply(variables, x.astype(compute))
+            ids, conf = ctc_greedy_device(logits)
+            # Mask timesteps past each crop's true width (padding region):
+            # force blank id 0 / confidence 1 so collapse ignores them.
+            t = logits.shape[1]
+            downsample = crops_u8.shape[2] // t
+            steps = jnp.arange(t)[None, :] * downsample
+            valid = steps < widths[:, None]
+            return jnp.where(valid, ids, 0), jnp.where(valid, conf, 1.0)
+
+        self._run_detector = run_detector
+        self._run_recognizer = run_recognizer
+        self._initialized = True
+        logger.info(
+            "ocr manager ready: %s (det buckets %s, rec h=%d, vocab %d)",
+            self.model_id, s.det_buckets, self.rec_cfg.height, len(self.vocab),
+        )
+
+    def close(self) -> None:
+        self._initialized = False
+
+    # -- detection --------------------------------------------------------
+
+    def _det_bucket(self, h: int, w: int) -> int:
+        side = max(h, w)
+        for b in self.spec.det_buckets:
+            if side <= b:
+                return b
+        return self.spec.det_buckets[-1]
+
+    def detect(
+        self,
+        img: np.ndarray,
+        det_threshold: float | None = None,
+        box_threshold: float | None = None,
+        unclip_ratio: float | None = None,
+    ) -> list[tuple[np.ndarray, float]]:
+        """[H, W, 3] RGB -> list of (quad [4, 2], det score), reading order."""
+        self._ensure_ready()
+        s = self.spec
+        h, w = img.shape[:2]
+        bucket = self._det_bucket(h, w)
+        boxed, scale, pad_top, pad_left = letterbox_numpy(img, bucket)
+        prob = np.asarray(self._run_detector(self.det_vars, boxed[None]))[0]
+        found = boxes_from_prob_map(
+            prob,
+            det_threshold=s.det_threshold if det_threshold is None else det_threshold,
+            box_threshold=s.box_threshold if box_threshold is None else box_threshold,
+            unclip_ratio=s.unclip_ratio if unclip_ratio is None else unclip_ratio,
+            max_candidates=s.max_candidates,
+            min_size=s.min_size,
+            dest_hw=(h, w),
+            scale=scale,
+            pad_top=pad_top,
+            pad_left=pad_left,
+        )
+        if not found:
+            return []
+        order = sorted_boxes([b for b, _ in found])
+        return [found[i] for i in order]
+
+    # -- recognition ------------------------------------------------------
+
+    def _rec_width_bucket(self, w: int) -> int:
+        for b in self.spec.rec_width_buckets:
+            if w <= b:
+                return b
+        return self.spec.rec_width_buckets[-1]
+
+    def recognize_crops(self, crops: list[np.ndarray]) -> list[tuple[str, float]]:
+        """Height-``rec_h`` resize, width-bucket pad, one device call per
+        bucket group, device CTC argmax, host collapse."""
+        self._ensure_ready()
+        import cv2
+
+        rec_h = self.rec_cfg.height
+        prepared: list[tuple[int, np.ndarray, int]] = []  # (bucket, padded, width)
+        for crop in crops:
+            ch, cw = crop.shape[:2]
+            new_w = max(int(round(cw * rec_h / max(ch, 1))), 1)
+            bucket = self._rec_width_bucket(new_w)
+            new_w = min(new_w, bucket)
+            resized = cv2.resize(crop, (new_w, rec_h), interpolation=cv2.INTER_LINEAR)
+            padded = np.zeros((rec_h, bucket, 3), np.uint8)
+            padded[:, :new_w] = resized
+            prepared.append((bucket, padded, new_w))
+        results: list[tuple[str, float] | None] = [None] * len(crops)
+        by_bucket: dict[int, list[int]] = {}
+        for i, (bucket, _, _) in enumerate(prepared):
+            by_bucket.setdefault(bucket, []).append(i)
+        max_bb = max(self.spec.rec_batch_buckets)
+        for bucket, idxs in by_bucket.items():
+            # Pad the batch dim to a static bucket too — otherwise every
+            # distinct crop count compiles a fresh program. Padding rows
+            # carry width 0, so every timestep masks to blank.
+            for start in range(0, len(idxs), max_bb):
+                chunk = idxs[start : start + max_bb]
+                bb = bucket_for(len(chunk), list(self.spec.rec_batch_buckets))
+                batch = np.zeros((bb, self.rec_cfg.height, bucket, 3), np.uint8)
+                widths = np.zeros((bb,), np.int32)
+                for row, i in enumerate(chunk):
+                    batch[row] = prepared[i][1]
+                    widths[row] = prepared[i][2]
+                ids, conf = self._run_recognizer(self.rec_vars, batch, widths)
+                ids, conf = np.asarray(ids), np.asarray(conf)
+                for row, i in enumerate(chunk):
+                    results[i] = ctc_collapse(ids[row], conf[row], self.vocab)
+        return results  # type: ignore[return-value]
+
+    # -- end-to-end -------------------------------------------------------
+
+    def predict(
+        self,
+        image_bytes: bytes,
+        det_threshold: float | None = None,
+        rec_threshold: float | None = None,
+        box_threshold: float | None = None,
+        unclip_ratio: float | None = None,
+    ) -> list[OcrResult]:
+        """Full pipeline on raw image bytes (reference ``predict`` contract,
+        ``lumen_ocr/backends/base.py:63-136``)."""
+        img = decode_image_bytes(image_bytes, color="rgb")
+        boxes = self.detect(
+            img,
+            det_threshold=det_threshold,
+            box_threshold=box_threshold,
+            unclip_ratio=unclip_ratio,
+        )
+        if not boxes:
+            return []
+        crops = [rotate_crop(img, quad) for quad, _ in boxes]
+        texts = self.recognize_crops(crops)
+        thr = self.spec.rec_threshold if rec_threshold is None else rec_threshold
+        out: list[OcrResult] = []
+        for (quad, _), (text, conf) in zip(boxes, texts):
+            if self.spec.drop_rec_below_threshold and (not text or conf < thr):
+                continue
+            out.append(OcrResult(box=quad, text=text, confidence=conf))
+        return out
+
+    def _ensure_ready(self) -> None:
+        if not self._initialized:
+            raise RuntimeError("OcrManager.initialize() not called")

@@ -1,0 +1,210 @@
+"""OCR models in Flax: DBNet text detector + SVTR-style CTC recognizer.
+
+The reference runs PaddleOCR ONNX graphs opaquely and implements the
+pipeline logic around them
+(``packages/lumen-ocr/src/lumen_ocr/backends/onnxrt_backend.py:43-633``).
+Here both nets are explicit Flax modules designed for the MXU:
+
+- :class:`DBNet` — differentiable-binarization detector: ResNet-ish
+  backbone (strides 4/8/16/32), FPN fusion to stride 4, head with two 2x
+  transposed convs back to full resolution, sigmoid probability map. Only
+  the probability branch is needed at inference (the reference's
+  postprocess consumes just the prob map, ``onnxrt_backend.py:380-432``).
+- :class:`SVTRRecognizer` — attention-based text recognizer: conv patch
+  embedding collapses height 48 -> 12 and width /4, global-mixing
+  transformer blocks, mean-pool over height, per-timestep vocab logits for
+  CTC decode (blank 0). Attention beats the CRNN's LSTM recurrence on TPU:
+  every timestep is one big batched matmul instead of a sequential chain.
+
+All BatchNorms run in inference mode (serving framework).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...ops.attention import attention_reference
+
+
+@dataclass(frozen=True)
+class DBNetConfig:
+    width: int = 64  # backbone base width
+    fpn_width: int = 256
+    head_width: int = 64
+
+    @classmethod
+    def tiny(cls) -> "DBNetConfig":
+        return cls(width=8, fpn_width=16, head_width=8)
+
+
+class ConvBnAct(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+    act: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.features,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            use_bias=False,
+            name="conv",
+            dtype=x.dtype,
+        )(x)
+        x = nn.BatchNorm(use_running_average=True, name="bn", dtype=x.dtype)(x)
+        if self.act:
+            x = nn.relu(x)
+        return x
+
+
+class ResBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = ConvBnAct(self.features, stride=self.stride, name="conv1")(x)
+        y = ConvBnAct(self.features, act=False, name="conv2")(y)
+        if self.stride != 1 or x.shape[-1] != self.features:
+            residual = ConvBnAct(self.features, kernel=1, stride=self.stride, act=False, name="down")(x)
+        return nn.relu(y + residual)
+
+
+class DBNet(nn.Module):
+    """[B, H, W, 3] normalized floats -> [B, H, W] probability map in [0, 1].
+
+    H and W must be multiples of 32 (the manager's resize buckets guarantee
+    it, mirroring the reference's x32 rounding at ``onnxrt_backend.py:
+    338-378``).
+    """
+
+    cfg: DBNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        w = c.width
+        x = ConvBnAct(w, stride=2, name="stem")(x)  # /2
+        feats = []
+        x = ResBlock(w, stride=2, name="stage1")(x)  # /4
+        feats.append(x)
+        x = ResBlock(w * 2, stride=2, name="stage2")(x)  # /8
+        feats.append(x)
+        x = ResBlock(w * 4, stride=2, name="stage3")(x)  # /16
+        feats.append(x)
+        x = ResBlock(w * 8, stride=2, name="stage4")(x)  # /32
+        feats.append(x)
+        # FPN: lateral 1x1 to fpn_width, top-down nearest-up add.
+        laterals = [
+            ConvBnAct(c.fpn_width, kernel=1, name=f"lateral{i}")(f) for i, f in enumerate(feats)
+        ]
+        for i in range(len(laterals) - 2, -1, -1):
+            up = jax.image.resize(
+                laterals[i + 1],
+                laterals[i].shape[:3] + laterals[i + 1].shape[3:],
+                method="nearest",
+            )
+            laterals[i] = laterals[i] + up
+        # Smooth each level to fpn_width/4 and concat at stride 4.
+        quarter = max(c.fpn_width // 4, 1)
+        target = laterals[0].shape
+        merged = []
+        for i, lat in enumerate(laterals):
+            p = ConvBnAct(quarter, name=f"smooth{i}")(lat)
+            if p.shape[1:3] != target[1:3]:
+                p = jax.image.resize(p, (p.shape[0],) + target[1:3] + (quarter,), method="nearest")
+            merged.append(p)
+        fuse = jnp.concatenate(merged, axis=-1)  # [B, H/4, W/4, 4*quarter]
+        # DB probability head: conv + 2x (transposed conv x2) -> full res.
+        h = ConvBnAct(c.head_width, name="head_conv")(fuse)
+        h = nn.ConvTranspose(
+            c.head_width, (2, 2), strides=(2, 2), use_bias=False, name="head_up1", dtype=h.dtype
+        )(h)
+        h = nn.BatchNorm(use_running_average=True, name="head_bn1", dtype=h.dtype)(h)
+        h = nn.relu(h)
+        h = nn.ConvTranspose(1, (2, 2), strides=(2, 2), name="head_up2", dtype=h.dtype)(h)
+        return jax.nn.sigmoid(h[..., 0].astype(jnp.float32))
+
+
+# -- recognizer -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SVTRConfig:
+    vocab_size: int = 6625  # ppocr_keys_v1 (6623) + blank + space
+    height: int = 48
+    max_width: int = 640  # widest rec bucket; pos embed is sized for it
+    width: int = 64  # embed dim
+    heads: int = 4
+    layers: int = 4
+    hidden_act: str = "gelu"
+    eps: float = 1e-6
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 40) -> "SVTRConfig":
+        return cls(vocab_size=vocab_size, height=32, max_width=64, width=16, heads=2, layers=1)
+
+
+class _MixBlock(nn.Module):
+    width: int
+    heads: int
+    hidden_act: str
+    eps: float
+
+    @nn.compact
+    def __call__(self, x):
+        # Pre-LN residual transformer block, global token mixing.
+        b, s, w = x.shape
+        h = nn.LayerNorm(epsilon=self.eps, name="ln1", dtype=x.dtype)(x)
+        head_dim = w // self.heads
+        dense = lambda name: nn.Dense(w, name=name, dtype=x.dtype)
+        q = dense("q_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        k = dense("k_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        v = dense("v_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
+        attn = attention_reference(q, k, v).transpose(0, 2, 1, 3).reshape(b, s, w)
+        x = x + nn.Dense(w, name="out_proj", dtype=x.dtype)(attn)
+        h = nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x)
+        h = nn.Dense(w * 4, name="fc1", dtype=x.dtype)(h)
+        h = jax.nn.gelu(h, approximate=True)
+        return x + nn.Dense(w, name="fc2", dtype=x.dtype)(h)
+
+
+class SVTRRecognizer(nn.Module):
+    """[B, height, W, 3] normalized crops -> [B, W//4, vocab] CTC logits.
+
+    Timestep count is W//4 (two stride-2 stages in the patch embed), so a
+    320-wide crop yields 80 CTC steps — same order as the reference's
+    recognizer (``_rec_preprocess`` height-48 resize, ``onnxrt_backend.py:
+    557-594``).
+    """
+
+    cfg: SVTRConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        x = ConvBnAct(c.width // 2, stride=2, name="patch1")(x)  # H/2, W/2
+        x = ConvBnAct(c.width, stride=2, name="patch2")(x)  # H/4, W/4
+        b, h, w, d = x.shape
+        tokens = x.reshape(b, h * w, d)
+        # 2D positional grid sized for the widest bucket, sliced per actual
+        # width so every bucket shares the same (prefix of) positions.
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, c.height // 4, c.max_width // 4, d),
+        )
+        tokens = tokens + pos[:, :h, :w].reshape(1, h * w, d).astype(tokens.dtype)
+        for i in range(c.layers):
+            tokens = _MixBlock(c.width, c.heads, c.hidden_act, c.eps, name=f"block{i}")(tokens)
+        tokens = nn.LayerNorm(epsilon=c.eps, name="ln_out", dtype=tokens.dtype)(tokens)
+        feat = tokens.reshape(b, h, w, d).mean(axis=1)  # pool height -> [B, T, d]
+        return nn.Dense(c.vocab_size, name="ctc_head", dtype=feat.dtype)(feat)

@@ -182,6 +182,37 @@ def flatten(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
     return out
 
 
+# -- native checkpoint format ------------------------------------------------
+#
+# The lumen-tpu "jax" runtime format: safetensors whose keys are
+# '/'-separated Flax paths prefixed with the variable collection
+# (``params/...`` or ``batch_stats/...``). Shared by every model family.
+
+
+def is_native_checkpoint(state: dict[str, np.ndarray]) -> bool:
+    return all(k.startswith(("params/", "batch_stats/")) for k in state)
+
+
+def split_collections(flat: dict[str, np.ndarray]) -> dict[str, dict]:
+    """'params/a/b', 'batch_stats/a/b' flat keys -> {'params': tree, ...}."""
+    grouped: dict[str, dict[str, np.ndarray]] = {}
+    for key, value in flat.items():
+        coll, _, rest = key.partition("/")
+        if not rest:
+            raise WeightLoadError(f"native checkpoint key missing collection prefix: {key!r}")
+        grouped.setdefault(coll, {})[rest] = value
+    return {coll: unflatten(tree) for coll, tree in grouped.items()}
+
+
+def flatten_variables(variables: dict) -> dict[str, np.ndarray]:
+    """Inverse of :func:`split_collections` (for saving native checkpoints)."""
+    out: dict[str, np.ndarray] = {}
+    for coll, tree in variables.items():
+        for k, v in flatten(tree).items():
+            out[f"{coll}/{k}"] = np.asarray(v)
+    return out
+
+
 def assert_tree_shapes(loaded: dict, initialized: dict) -> None:
     """Fidelity gate: a converted checkpoint must match the module's
     init-time tree exactly (names and shapes) — this is where silent

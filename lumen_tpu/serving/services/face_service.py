@@ -2,7 +2,8 @@
 
 Task surface and meta knobs mirror the reference ``GeneralFaceService``
 (``packages/lumen-face/src/lumen_face/general_face/face_service.py:214-590``):
-``face_detect`` (conf/nms thresholds, size_min/max, max_faces),
+``face_detect`` (conf_threshold, size_min/max, max_faces; the NMS
+threshold is a pack-spec constant baked into the compiled program),
 ``face_embed`` (optional ``landmarks`` JSON in meta), and
 ``face_detect_and_embed``.
 """

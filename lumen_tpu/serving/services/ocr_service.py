@@ -1,0 +1,95 @@
+"""OCR gRPC service: single ``ocr`` task.
+
+Task surface and meta knobs mirror the reference ``GeneralOcrService``
+(``packages/lumen-ocr/src/lumen_ocr/general_ocr/ocr_service.py:239-276``):
+meta ``det_thresh``, ``rec_thresh``, ``box_thresh``, ``unclip_ratio``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ...core.config import ServiceConfig
+from ...core.result_schemas import OcrItem, OCRV1
+from ...models.ocr import OcrManager
+from ..base_service import BaseService, InvalidArgument
+from ..registry import TaskDefinition, TaskRegistry
+
+logger = logging.getLogger(__name__)
+
+IMAGE_MIMES = ("image/jpeg", "image/png", "image/webp", "application/octet-stream")
+
+
+class OcrService(BaseService):
+    def __init__(self, manager: OcrManager, service_name: str = "ocr"):
+        self.manager = manager
+        registry = TaskRegistry(service_name)
+        registry.register(
+            TaskDefinition(
+                name="ocr",
+                handler=self._ocr,
+                description="detect and recognize text: boxes + strings + confidences",
+                input_mimes=IMAGE_MIMES,
+                output_mime=OCRV1.mime(),
+            )
+        )
+        super().__init__(registry)
+
+    @classmethod
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "OcrService":
+        bs = service_config.backend_settings
+        alias, mc = next(iter(service_config.models.items()))
+        model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
+        manager = OcrManager(model_dir, dtype=bs.dtype, batch_size=bs.batch_size)
+        manager.initialize()
+        return cls(manager)
+
+    def capability(self):
+        return self.registry.build_capability(
+            model_ids=[self.manager.model_id],
+            runtime="jax-tpu",
+            max_concurrency=self.manager.batch_size,
+            precisions=["bf16", "fp32"],
+            extra={
+                "det_buckets": ",".join(str(b) for b in self.manager.spec.det_buckets),
+                "rec_height": str(self.manager.rec_cfg.height),
+                "vocab_size": str(len(self.manager.vocab)),
+            },
+        )
+
+    def healthy(self) -> bool:
+        return self.manager._initialized
+
+    def close(self) -> None:
+        self.manager.close()
+
+    # -- handler ----------------------------------------------------------
+
+    def _ocr(self, payload: bytes, mime: str, meta: dict[str, str]):
+        kw = {}
+        for meta_key, arg in (
+            ("det_thresh", "det_threshold"),
+            ("rec_thresh", "rec_threshold"),
+            ("box_thresh", "box_threshold"),
+            ("unclip_ratio", "unclip_ratio"),
+        ):
+            if meta_key in meta:
+                try:
+                    kw[arg] = float(meta[meta_key])
+                except ValueError as e:
+                    raise InvalidArgument(f"meta {meta_key!r} must be a number") from e
+        try:
+            results = self.manager.predict(payload, **kw)
+        except ValueError as e:
+            raise InvalidArgument(f"cannot process image: {e}") from e
+        items = [
+            OcrItem(
+                box=[[float(x), float(y)] for x, y in r.box],
+                text=r.text,
+                confidence=min(max(r.confidence, 0.0), 1.0),
+            )
+            for r in results
+        ]
+        body = OCRV1(items=items, count=len(items), model_id=self.manager.model_id)
+        return body.to_json_bytes(), OCRV1.mime(), {}

@@ -138,8 +138,6 @@ class TestIResNet:
         variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
         state = {}
         state["conv1.weight"] = np.zeros((8, 3, 3, 3), np.float32)
-        for tname, jname in (("bn1", None),):
-            pass
         def bn(src, n):
             state[f"{src}.weight"] = np.zeros((n,), np.float32)
             state[f"{src}.bias"] = np.zeros((n,), np.float32)

@@ -1,0 +1,304 @@
+"""OCR family tests: modeling shapes, postprocess geometry, CTC semantics,
+manager pipeline, and the gRPC service."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def make_ocr_model_dir(tmp_path, vocab_chars="0123456789abcdef"):
+    """Tiny OCR model dir with NATIVE checkpoints (random weights)."""
+    from safetensors.numpy import save_file
+
+    from lumen_tpu.models.ocr import (
+        DBNet,
+        DBNetConfig,
+        SVTRConfig,
+        SVTRRecognizer,
+        flatten_variables,
+    )
+
+    model_dir = tmp_path / "models" / "TinyOCR"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    det_cfg = DBNetConfig.tiny()
+    vocab_size = 1 + len(vocab_chars) + 1  # blank + chars + space
+    rec_cfg = SVTRConfig.tiny(vocab_size=vocab_size)
+    det_vars = DBNet(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    rec_vars = SVTRRecognizer(rec_cfg).init(
+        jax.random.PRNGKey(1), jnp.zeros((1, rec_cfg.height, 32, 3))
+    )
+    save_file(flatten_variables(dict(det_vars)), str(model_dir / "detection.safetensors"))
+    save_file(flatten_variables(dict(rec_vars)), str(model_dir / "recognition.safetensors"))
+    (model_dir / "ppocr_keys_v1.txt").write_text("\n".join(vocab_chars) + "\n")
+    info = {
+        "name": "TinyOCR",
+        "version": "1.0.0",
+        "description": "tiny test ocr pack",
+        "model_type": "ocr",
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/TinyOCR"},
+        "runtimes": {
+            "jax": {"available": True, "files": ["detection.safetensors", "recognition.safetensors"]}
+        },
+        "extra_metadata": {
+            "ocr": {
+                "det_buckets": [64, 128],
+                "rec_width_buckets": [32, 64],
+                "rec_height": rec_cfg.height,
+                "rec_threshold": 0.0,
+                "drop_rec_below_threshold": False,
+            },
+            "detector": {"width": 8, "fpn_width": 16, "head_width": 8},
+            "recognizer": {
+                "vocab_size": vocab_size,
+                "height": rec_cfg.height,
+                "width": 16,
+                "heads": 2,
+                "layers": 1,
+            },
+        },
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+def text_image(w=120, h=60):
+    """Synthetic image with a bright text-like bar on dark background."""
+    import cv2
+
+    img = np.zeros((h, w, 3), np.uint8)
+    cv2.rectangle(img, (10, 20), (w - 10, 40), (255, 255, 255), -1)
+    return img
+
+
+def encode_png(img):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    return buf.tobytes()
+
+
+@pytest.fixture(scope="module")
+def ocr_mgr(tmp_path_factory):
+    from lumen_tpu.models.ocr import OcrManager
+
+    tmp = tmp_path_factory.mktemp("ocr")
+    model_dir = make_ocr_model_dir(tmp)
+    mgr = OcrManager(model_dir, dtype="float32")
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+class TestModeling:
+    def test_dbnet_full_res_prob_map(self):
+        from lumen_tpu.models.ocr import DBNet, DBNetConfig
+
+        cfg = DBNetConfig.tiny()
+        x = jnp.zeros((2, 64, 96, 3))
+        variables = DBNet(cfg).init(jax.random.PRNGKey(0), x)
+        prob = DBNet(cfg).apply(variables, x)
+        assert prob.shape == (2, 64, 96)
+        p = np.asarray(prob)
+        assert (p >= 0).all() and (p <= 1).all()
+
+    def test_recognizer_timesteps(self):
+        from lumen_tpu.models.ocr import SVTRConfig, SVTRRecognizer
+
+        cfg = SVTRConfig.tiny(vocab_size=12)
+        x = jnp.zeros((3, cfg.height, 64, 3))
+        variables = SVTRRecognizer(cfg).init(jax.random.PRNGKey(0), x)
+        logits = SVTRRecognizer(cfg).apply(variables, x)
+        assert logits.shape == (3, 16, 12)  # W/4 timesteps
+
+
+class TestPostprocess:
+    def test_boxes_from_prob_map_finds_rectangle(self):
+        from lumen_tpu.models.ocr import boxes_from_prob_map
+
+        prob = np.zeros((64, 64), np.float32)
+        prob[20:30, 8:56] = 0.9
+        found = boxes_from_prob_map(prob, det_threshold=0.3, box_threshold=0.5, dest_hw=(64, 64))
+        assert len(found) == 1
+        quad, score = found[0]
+        assert score > 0.8
+        xs, ys = quad[:, 0], quad[:, 1]
+        # Unclip grows the box beyond the painted region.
+        assert xs.min() <= 8 and xs.max() >= 55
+        assert ys.min() <= 20 and ys.max() >= 29
+
+    def test_unclip_rect_offset_distance(self):
+        from lumen_tpu.models.ocr import unclip_rect
+
+        rect = ((50.0, 50.0), (40.0, 10.0), 0.0)
+        (cx, cy), (w, h), ang = unclip_rect(rect, unclip_ratio=1.5)
+        d = (40 * 10) * 1.5 / (2 * (40 + 10))
+        assert (cx, cy) == (50.0, 50.0)
+        assert w == pytest.approx(40 + 2 * d)
+        assert h == pytest.approx(10 + 2 * d)
+
+    def test_order_quad_clockwise_from_tl(self):
+        from lumen_tpu.models.ocr import order_quad
+
+        pts = np.array([[10, 10], [50, 10], [50, 30], [10, 30]], np.float32)
+        for perm in ([2, 0, 3, 1], [3, 2, 1, 0]):
+            out = order_quad(pts[perm])
+            np.testing.assert_allclose(out, pts)
+
+    def test_sorted_boxes_reading_order(self):
+        from lumen_tpu.models.ocr import sorted_boxes
+
+        b_right = np.array([[60, 10], [90, 10], [90, 20], [60, 20]], np.float32)
+        b_left = np.array([[10, 12], [40, 12], [40, 22], [10, 22]], np.float32)  # same line
+        b_below = np.array([[10, 50], [40, 50], [40, 60], [10, 60]], np.float32)
+        order = sorted_boxes([b_right, b_below, b_left])
+        assert order == [2, 0, 1]  # left-first on the top line, then below
+
+    def test_rotate_crop_vertical_rot90(self):
+        from lumen_tpu.models.ocr import rotate_crop
+
+        img = np.arange(100 * 100 * 3, dtype=np.uint8).reshape(100, 100, 3)
+        tall = np.array([[10, 10], [30, 10], [30, 90], [10, 90]], np.float32)
+        crop = rotate_crop(img, tall)
+        assert crop.shape[1] > crop.shape[0]  # rotated to horizontal
+
+
+class TestCtc:
+    def test_collapse_blank_and_repeats(self):
+        from lumen_tpu.ops.ctc import ctc_collapse
+
+        vocab = ["<blank>", "a", "b"]
+        ids = np.array([1, 1, 0, 1, 2, 2, 0, 0])
+        conf = np.array([0.9, 0.8, 0.5, 0.7, 0.6, 0.5, 0.1, 0.1])
+        text, score = ctc_collapse(ids, conf, vocab)
+        assert text == "aab"
+        assert score == pytest.approx(np.mean([0.9, 0.7, 0.6]))
+
+    def test_device_argmax(self):
+        from lumen_tpu.ops.ctc import ctc_greedy_device
+
+        logits = jnp.asarray(np.random.default_rng(0).standard_normal((2, 5, 7)))
+        ids, conf = ctc_greedy_device(logits)
+        assert ids.shape == (2, 5) and conf.shape == (2, 5)
+        np.testing.assert_array_equal(np.asarray(ids), np.argmax(np.asarray(logits), -1))
+
+
+class TestManager:
+    def test_detect_shapes_and_coords(self, ocr_mgr, monkeypatch):
+        # Synthetic prob map via monkeypatched detector: box coords must be
+        # un-letterboxed into original image space.
+        h, w = 50, 100  # -> bucket 128, scale 1.28, pads
+        prob = np.zeros((1, 128, 128), np.float32)
+        # letterbox: scale=1.28, new_h=64, new_w=128, pad_top=32, pad_left=0
+        prob[0, 40:56, 10:120] = 0.95
+        monkeypatch.setattr(ocr_mgr, "_run_detector", lambda v, x: prob)
+        img = np.zeros((h, w, 3), np.uint8)
+        boxes = ocr_mgr.detect(img)
+        assert len(boxes) == 1
+        quad, score = boxes[0]
+        assert quad.shape == (4, 2)
+        assert quad[:, 0].max() <= w - 1 and quad[:, 1].max() <= h - 1
+        # y center in original coords: (48 - 32) / 1.28 = 12.5
+        assert abs(np.mean(quad[:, 1]) - 12.5) < 3
+
+    def test_recognize_crops_buckets(self, ocr_mgr):
+        crops = [
+            np.random.default_rng(i).integers(0, 255, (40, 20 * (i + 1), 3), np.uint8)
+            for i in range(3)
+        ]
+        out = ocr_mgr.recognize_crops(crops)
+        assert len(out) == 3
+        for text, conf in out:
+            assert isinstance(text, str)
+            assert 0.0 <= conf <= 1.0
+
+    def test_predict_end_to_end(self, ocr_mgr):
+        results = ocr_mgr.predict(encode_png(text_image()), det_threshold=0.1, rec_threshold=0.0)
+        assert isinstance(results, list)
+        for r in results:
+            assert r.box.shape == (4, 2)
+            assert isinstance(r.text, str)
+
+    def test_padding_steps_are_blank(self, ocr_mgr):
+        # A narrow crop in a wide bucket: timesteps past its true width must
+        # come back as blank (id 0), so padding cannot leak characters.
+        crop = np.full((ocr_mgr.rec_cfg.height, 8, 3), 200, np.uint8)
+        prepared_w = 8
+        batch = np.zeros((1, ocr_mgr.rec_cfg.height, 64, 3), np.uint8)
+        batch[0, :, :prepared_w] = crop
+        ids, conf = ocr_mgr._run_recognizer(
+            ocr_mgr.rec_vars, jnp.asarray(batch), jnp.asarray([prepared_w], jnp.int32)
+        )
+        ids = np.asarray(ids)[0]
+        t_valid = prepared_w // 4
+        assert (ids[t_valid:] == 0).all()
+
+
+@pytest.mark.integration
+class TestOcrServiceGrpc:
+    @pytest.fixture(scope="class")
+    def stub(self, tmp_path_factory):
+        import grpc
+        from concurrent import futures
+
+        from lumen_tpu.models.ocr import OcrManager
+        from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+            InferenceStub,
+            add_InferenceServicer_to_server,
+        )
+        from lumen_tpu.serving.router import HubRouter
+        from lumen_tpu.serving.services.ocr_service import OcrService
+
+        tmp = tmp_path_factory.mktemp("ocrsvc")
+        model_dir = make_ocr_model_dir(tmp)
+        mgr = OcrManager(model_dir, dtype="float32")
+        mgr.initialize()
+        svc = OcrService(mgr)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        add_InferenceServicer_to_server(HubRouter({"ocr": svc}), server)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+        yield InferenceStub(channel)
+        channel.close()
+        server.stop(0)
+        svc.close()
+
+    def _infer(self, stub, payload, meta=None):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        (resp,) = stub.Infer(
+            iter(
+                [
+                    pb.InferRequest(
+                        correlation_id="o1", task="ocr", payload=payload,
+                        meta=meta or {}, payload_mime="image/png",
+                    )
+                ]
+            )
+        )
+        return resp
+
+    def test_ocr_task(self, stub):
+        resp = self._infer(stub, encode_png(text_image()), meta={"det_thresh": "0.1", "rec_thresh": "0.0"})
+        assert not resp.HasField("error"), resp.error
+        body = json.loads(resp.result)
+        assert body["count"] == len(body["items"])
+        assert body["model_id"] == "TinyOCR"
+        for item in body["items"]:
+            assert len(item["box"]) >= 3
+            assert 0.0 <= item["confidence"] <= 1.0
+
+    def test_bad_meta_is_invalid_argument(self, stub):
+        resp = self._infer(stub, encode_png(text_image()), meta={"det_thresh": "zzz"})
+        assert resp.HasField("error")
+
+    def test_capability_includes_ocr(self, stub):
+        from google.protobuf import empty_pb2
+
+        cap = stub.GetCapabilities(empty_pb2.Empty())
+        names = [t.name for t in cap.tasks]
+        assert "ocr" in names
