@@ -79,13 +79,19 @@ class OcrSpec:
 
     @classmethod
     def from_extra(cls, extra: dict | None) -> "OcrSpec":
-        spec = cls()
-        for key, value in (extra or {}).items():
-            if hasattr(spec, key):
-                if isinstance(value, list):  # JSON has no tuples
-                    value = tuple(value)
-                setattr(spec, key, value)
-        return spec
+        return dataclass_from_extra(
+            cls,
+            extra,
+            tuple_keys=(
+                "det_buckets",
+                "rec_width_buckets",
+                "rec_batch_buckets",
+                "det_mean",
+                "det_std",
+                "rec_mean",
+                "rec_std",
+            ),
+        )
 
 
 class OcrManager:
@@ -194,13 +200,6 @@ class OcrManager:
 
     # -- detection --------------------------------------------------------
 
-    def _det_bucket(self, h: int, w: int) -> int:
-        side = max(h, w)
-        for b in self.spec.det_buckets:
-            if side <= b:
-                return b
-        return self.spec.det_buckets[-1]
-
     def detect(
         self,
         img: np.ndarray,
@@ -212,7 +211,7 @@ class OcrManager:
         self._ensure_ready()
         s = self.spec
         h, w = img.shape[:2]
-        bucket = self._det_bucket(h, w)
+        bucket = bucket_for(max(h, w), list(s.det_buckets))
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, bucket)
         prob = np.asarray(self._run_detector(self.det_vars, boxed[None]))[0]
         found = boxes_from_prob_map(
@@ -234,12 +233,6 @@ class OcrManager:
 
     # -- recognition ------------------------------------------------------
 
-    def _rec_width_bucket(self, w: int) -> int:
-        for b in self.spec.rec_width_buckets:
-            if w <= b:
-                return b
-        return self.spec.rec_width_buckets[-1]
-
     def recognize_crops(self, crops: list[np.ndarray]) -> list[tuple[str, float]]:
         """Height-``rec_h`` resize, width-bucket pad, one device call per
         bucket group, device CTC argmax, host collapse."""
@@ -251,7 +244,7 @@ class OcrManager:
         for crop in crops:
             ch, cw = crop.shape[:2]
             new_w = max(int(round(cw * rec_h / max(ch, 1))), 1)
-            bucket = self._rec_width_bucket(new_w)
+            bucket = bucket_for(new_w, list(self.spec.rec_width_buckets))
             new_w = min(new_w, bucket)
             resized = cv2.resize(crop, (new_w, rec_h), interpolation=cv2.INTER_LINEAR)
             padded = np.zeros((rec_h, bucket, 3), np.uint8)

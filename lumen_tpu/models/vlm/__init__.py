@@ -1,0 +1,31 @@
+"""VLM family: ViT vision encoder + Qwen2-style decoder, static-KV-cache
+generation (reference: ``packages/lumen-vlm``)."""
+
+from .chat import ChatMessage, VlmTokenizer, render_chat
+from .generate import GenerateOutput, Generator
+from .manager import GenerationChunk, GenerationResult, VLMManager
+from .modeling import (
+    DecoderConfig,
+    VisionTowerConfig,
+    VLMConfig,
+    VLMModel,
+    init_kv_cache,
+    merge_image_embeddings,
+)
+
+__all__ = [
+    "ChatMessage",
+    "VlmTokenizer",
+    "render_chat",
+    "Generator",
+    "GenerateOutput",
+    "GenerationChunk",
+    "GenerationResult",
+    "VLMManager",
+    "DecoderConfig",
+    "VisionTowerConfig",
+    "VLMConfig",
+    "VLMModel",
+    "init_kv_cache",
+    "merge_image_embeddings",
+]

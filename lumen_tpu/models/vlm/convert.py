@@ -1,0 +1,120 @@
+"""Checkpoint conversion: HF LLaVA/Qwen2-style VLM -> Flax params.
+
+The reference consumes pre-exported ONNX graphs and never touches raw
+checkpoints; we load the source safetensors directly (FastVLM-style repos
+ship a Qwen2 language model + vision tower + 2-layer projector). Converted
+trees are shape-gated against the module's init tree before serving, same
+as the other families (``lumen_tpu/models/clip/convert.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ...runtime.weights import (
+    apply_rules,
+    assert_tree_shapes,
+    conv_kernel,
+    is_native_checkpoint,
+    linear_kernel,
+    split_collections,
+    unflatten,
+)
+
+logger = logging.getLogger(__name__)
+
+_QKV = r"(q_proj|k_proj|v_proj)"
+
+DECODER_RULES = [
+    (r"model\.embed_tokens\.weight", r"decoder/embed_tokens/embedding", None),
+    (rf"model\.layers\.(\d+)\.self_attn\.{_QKV}\.weight", r"decoder/layers_\1/attn/\2/kernel", linear_kernel),
+    (rf"model\.layers\.(\d+)\.self_attn\.{_QKV}\.bias", r"decoder/layers_\1/attn/\2/bias", None),
+    (r"model\.layers\.(\d+)\.self_attn\.o_proj\.weight", r"decoder/layers_\1/attn/o_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.gate_proj\.weight", r"decoder/layers_\1/mlp/gate_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.up_proj\.weight", r"decoder/layers_\1/mlp/up_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.down_proj\.weight", r"decoder/layers_\1/mlp/down_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.input_layernorm\.weight", r"decoder/layers_\1/input_norm/scale", None),
+    (r"model\.layers\.(\d+)\.post_attention_layernorm\.weight", r"decoder/layers_\1/post_attn_norm/scale", None),
+    (r"model\.norm\.weight", r"decoder/final_norm/scale", None),
+    (r"lm_head\.weight", r"decoder/lm_head/kernel", linear_kernel),
+]
+
+VISION_RULES = [
+    (r"vision_tower\.patch_embed\.weight", r"vision/patch_embed/kernel", conv_kernel),
+    (r"vision_tower\.patch_embed\.bias", r"vision/patch_embed/bias", None),
+    (r"vision_tower\.position_embedding", r"vision/position_embedding", None),
+    (rf"vision_tower\.blocks\.(\d+)\.attn\.{_QKV}\.weight", r"vision/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"vision_tower\.blocks\.(\d+)\.attn\.{_QKV}\.bias", r"vision/blocks_\1/attn/\2/bias", None),
+    (r"vision_tower\.blocks\.(\d+)\.attn\.out_proj\.weight", r"vision/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"vision_tower\.blocks\.(\d+)\.attn\.out_proj\.bias", r"vision/blocks_\1/attn/out_proj/bias", None),
+    (r"vision_tower\.blocks\.(\d+)\.norm1\.weight", r"vision/blocks_\1/ln1/scale", None),
+    (r"vision_tower\.blocks\.(\d+)\.norm1\.bias", r"vision/blocks_\1/ln1/bias", None),
+    (r"vision_tower\.blocks\.(\d+)\.norm2\.weight", r"vision/blocks_\1/ln2/scale", None),
+    (r"vision_tower\.blocks\.(\d+)\.norm2\.bias", r"vision/blocks_\1/ln2/bias", None),
+    (r"vision_tower\.blocks\.(\d+)\.mlp\.fc1\.weight", r"vision/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"vision_tower\.blocks\.(\d+)\.mlp\.fc1\.bias", r"vision/blocks_\1/mlp/fc1/bias", None),
+    (r"vision_tower\.blocks\.(\d+)\.mlp\.fc2\.weight", r"vision/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"vision_tower\.blocks\.(\d+)\.mlp\.fc2\.bias", r"vision/blocks_\1/mlp/fc2/bias", None),
+    (r"vision_tower\.post_norm\.weight", r"vision/post_ln/scale", None),
+    (r"vision_tower\.post_norm\.bias", r"vision/post_ln/bias", None),
+    (r"multi_modal_projector\.linear_1\.weight", r"vision/proj_fc1/kernel", linear_kernel),
+    (r"multi_modal_projector\.linear_1\.bias", r"vision/proj_fc1/bias", None),
+    (r"multi_modal_projector\.linear_2\.weight", r"vision/proj_fc2/kernel", linear_kernel),
+    (r"multi_modal_projector\.linear_2\.bias", r"vision/proj_fc2/bias", None),
+    # HF-CLIP-style vision tower naming (llava checkpoints that embed a
+    # CLIPVisionModel): map encoder layers onto the same block tree.
+    (r"vision_tower\.vision_model\.embeddings\.patch_embedding\.weight", r"vision/patch_embed/kernel", conv_kernel),
+    (r"vision_tower\.vision_model\.embeddings\.patch_embedding\.bias", r"vision/patch_embed/bias", None),
+    (r"vision_tower\.vision_model\.embeddings\.position_embedding\.weight", r"vision/position_embedding", None),
+    (rf"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.self_attn\.{_QKV}\.weight", r"vision/blocks_\1/attn/\2/kernel", linear_kernel),
+    (rf"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.self_attn\.{_QKV}\.bias", r"vision/blocks_\1/attn/\2/bias", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.weight", r"vision/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.self_attn\.out_proj\.bias", r"vision/blocks_\1/attn/out_proj/bias", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.layer_norm1\.weight", r"vision/blocks_\1/ln1/scale", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.layer_norm1\.bias", r"vision/blocks_\1/ln1/bias", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.layer_norm2\.weight", r"vision/blocks_\1/ln2/scale", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.layer_norm2\.bias", r"vision/blocks_\1/ln2/bias", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.weight", r"vision/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.mlp\.fc1\.bias", r"vision/blocks_\1/mlp/fc1/bias", None),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.weight", r"vision/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"vision_tower\.vision_model\.encoder\.layers\.(\d+)\.mlp\.fc2\.bias", r"vision/blocks_\1/mlp/fc2/bias", None),
+    (r"vision_tower\.vision_model\.post_layernorm\.weight", r"vision/post_ln/scale", None),
+    (r"vision_tower\.vision_model\.post_layernorm\.bias", r"vision/post_ln/bias", None),
+]
+
+DROP = [
+    r"rotary_emb\.inv_freq$",
+    r"position_ids$",
+    r"vision_tower\.vision_model\.embeddings\.class_embedding",
+    r"vision_tower\.vision_model\.pre_layrnorm\.",
+]
+
+
+def convert_vlm_checkpoint(
+    state: dict[str, np.ndarray],
+    init_params: dict | None = None,
+    tie_word_embeddings: bool = True,
+) -> dict:
+    """Normalize prefixes (``language_model.`` wrappers), convert, and gate
+    against the init tree. Native (``/``-pathed) checkpoints pass through."""
+    if is_native_checkpoint(state):
+        params = split_collections(state)["params"]
+        if init_params is not None:
+            assert_tree_shapes(params, init_params)
+        return params
+    normalized: dict[str, np.ndarray] = {}
+    for key, val in state.items():
+        key = key.removeprefix("language_model.")
+        if key.startswith("model.vision_tower."):
+            key = key.removeprefix("model.")
+        normalized[key] = val
+    drop = list(DROP)
+    if tie_word_embeddings:
+        drop.append(r"^lm_head\.weight$")
+    flat = apply_rules(normalized, DECODER_RULES + VISION_RULES, drop=drop)
+    params = unflatten(flat)
+    if init_params is not None:
+        assert_tree_shapes(params, init_params)
+    return params

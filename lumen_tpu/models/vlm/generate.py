@@ -1,0 +1,274 @@
+"""Fused autoregressive generation: prefill + ``lax.while_loop`` decode.
+
+The reference decodes with a host python loop — one onnxruntime session
+call per token, rebuilding the attention mask and renaming ``present.*``
+outputs each step (``packages/lumen-vlm/src/lumen_vlm/backends/
+onnxrt_backend.py:298-356``, ``:480-492``). Here the entire loop — embed,
+decoder forward over the static KV cache, repetition penalty, temperature/
+top-p sampling, EOS check — is ONE compiled XLA program; the host sees only
+the final token buffer. Streaming keeps a host loop for chunk delivery but
+each step is still a single compiled call (no mask rebuilds, no renames).
+
+Sampling semantics follow the reference (``:508-533``): greedy when
+``do_sample`` is false or temperature ~ 0, else temperature + nucleus.
+Generation params are traced scalars, so one compiled program serves every
+request config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.sampling import apply_repetition_penalty, sample
+from .modeling import VLMConfig, VLMModel, init_kv_cache
+
+
+@dataclass
+class GenerateOutput:
+    tokens: jax.Array  # [B, max_new_cap] generated ids, pad-filled after EOS
+    n_generated: jax.Array  # [B] count of live tokens (EOS included)
+    stopped_eos: jax.Array  # [B] bool: hit EOS (vs length cap)
+
+
+class Generator:
+    """Compiled generation programs for one ``VLMModel``.
+
+    ``max_seq`` bounds prompt+vision+new tokens (the KV buffer size);
+    ``max_new_cap`` is the static output-buffer size. Both are compile-time
+    constants — the per-request ``max_new_tokens`` is a traced value bounded
+    by the cap.
+    """
+
+    def __init__(
+        self,
+        model: VLMModel,
+        cfg: VLMConfig,
+        max_seq: int = 2048,
+        max_new_cap: int = 512,
+        cache_dtype=jnp.bfloat16,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.max_seq = max_seq
+        self.max_new_cap = max_new_cap
+        self.cache_dtype = cache_dtype
+        self._generate = jax.jit(self._generate_impl)
+        self._prefill = jax.jit(self._prefill_impl)
+        self._step = jax.jit(self._step_impl)
+
+    # -- shared pieces ------------------------------------------------------
+
+    def _decode(self, params, embeds, positions, caches, offset, kv_valid_len):
+        return self.model.apply(
+            {"params": params},
+            embeds,
+            positions,
+            caches,
+            offset,
+            kv_valid_len,
+            method=VLMModel.decode,
+        )
+
+    def _embed(self, params, ids):
+        return self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
+
+    def _seen_from_prompt(self, prompt_ids: jax.Array, lengths: jax.Array) -> jax.Array:
+        """[B, V] bool mask of tokens present in the (unpadded) prompt, for
+        the repetition penalty."""
+        b, s = prompt_ids.shape
+        valid = jnp.arange(s)[None, :] < lengths[:, None]
+        seen = jnp.zeros((b, self.cfg.decoder.vocab_size), bool)
+        bidx = jnp.arange(b)[:, None]
+        return seen.at[bidx, jnp.where(valid, prompt_ids, 0)].max(valid)
+
+    def _sample_next(self, rng, logits, seen, temperature, top_p, do_sample, rep_penalty):
+        logits = logits.astype(jnp.float32)
+        logits = apply_repetition_penalty(logits, seen, rep_penalty)
+        return sample(rng, logits, temperature, top_p, do_sample)
+
+    def _prefill_core(self, params, embeds, positions, lengths):
+        b = embeds.shape[0]
+        caches = init_kv_cache(self.cfg, b, self.max_seq, self.cache_dtype)
+        logits, caches = self._decode(
+            params, embeds, positions, caches, jnp.zeros((), jnp.int32), lengths
+        )
+        last = logits[jnp.arange(b), lengths - 1]  # [B, V] next-token logits
+        return caches, last
+
+    # -- fused non-streaming path -------------------------------------------
+
+    def _generate_impl(
+        self,
+        params,
+        embeds,  # [B, L, H] merged prompt embeddings (right-padded)
+        positions,  # [B, L]
+        lengths,  # [B] live token count
+        prompt_ids,  # [B, S_text] original text ids (for repetition penalty)
+        rng,
+        max_new_tokens,  # traced scalar <= max_new_cap
+        temperature,
+        top_p,
+        do_sample,
+        repetition_penalty,
+    ):
+        cfg = self.cfg
+        b = embeds.shape[0]
+        caches, last_logits = self._prefill_core(params, embeds, positions, lengths)
+        seen = self._seen_from_prompt(prompt_ids, lengths)
+        rng, sub = jax.random.split(rng)
+        tok0 = self._sample_next(
+            sub, last_logits, seen, temperature, top_p, do_sample, repetition_penalty
+        ).astype(jnp.int32)
+
+        buf = jnp.full((b, self.max_new_cap), cfg.pad_token_id, jnp.int32)
+        state = dict(
+            caches=caches,
+            cur_tok=tok0,
+            cur_len=lengths.astype(jnp.int32),  # cache slots filled so far
+            t=jnp.zeros((), jnp.int32),
+            rng=rng,
+            done=jnp.zeros((b,), bool),
+            buf=buf,
+            seen=seen,
+            n_gen=jnp.zeros((b,), jnp.int32),
+        )
+
+        def cond(s):
+            return (s["t"] < max_new_tokens) & ~jnp.all(s["done"])
+
+        def body(s):
+            active = ~s["done"]
+            tok = jnp.where(active, s["cur_tok"], cfg.pad_token_id)
+            buf = s["buf"].at[:, s["t"]].set(tok)
+            n_gen = s["n_gen"] + active.astype(jnp.int32)
+            seen = s["seen"].at[jnp.arange(b), s["cur_tok"]].max(active)
+            done = s["done"] | (s["cur_tok"] == cfg.eos_token_id)
+
+            # Next-token forward (skipped work when everyone is done: the
+            # while_loop cond stops the whole program instead).
+            tok_embed = self._embed(params, s["cur_tok"][:, None])  # [B,1,H]
+            logits, caches = self._decode(
+                params,
+                tok_embed.astype(embeds.dtype),
+                s["cur_len"][:, None],
+                s["caches"],
+                s["cur_len"],
+                s["cur_len"] + 1,
+            )
+            rng, sub = jax.random.split(s["rng"])
+            nxt = self._sample_next(
+                sub, logits[:, 0], seen, temperature, top_p, do_sample, repetition_penalty
+            ).astype(jnp.int32)
+            return dict(
+                caches=caches,
+                cur_tok=nxt,
+                cur_len=s["cur_len"] + active.astype(jnp.int32),
+                t=s["t"] + 1,
+                rng=rng,
+                done=done,
+                buf=buf,
+                seen=seen,
+                n_gen=n_gen,
+            )
+
+        state = jax.lax.while_loop(cond, body, state)
+        return state["buf"], state["n_gen"], state["done"]
+
+    def generate(
+        self,
+        params,
+        embeds,
+        positions,
+        lengths,
+        prompt_ids,
+        rng,
+        max_new_tokens: int = 256,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        do_sample: bool = False,
+        repetition_penalty: float = 1.0,
+    ) -> GenerateOutput:
+        cap = min(int(max_new_tokens), self.max_new_cap)
+        buf, n_gen, done = self._generate(
+            params,
+            embeds,
+            positions,
+            lengths,
+            prompt_ids,
+            rng,
+            jnp.asarray(cap, jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(do_sample, bool),
+            jnp.asarray(repetition_penalty, jnp.float32),
+        )
+        return GenerateOutput(tokens=buf, n_generated=n_gen, stopped_eos=done)
+
+    # -- streaming path (host loop, one compiled call per step) -------------
+
+    def _prefill_impl(
+        self, params, embeds, positions, lengths, prompt_ids, rng,
+        temperature, top_p, do_sample, repetition_penalty,
+    ):
+        caches, last_logits = self._prefill_core(params, embeds, positions, lengths)
+        seen = self._seen_from_prompt(prompt_ids, lengths)
+        tok0 = self._sample_next(
+            rng, last_logits, seen, temperature, top_p, do_sample, repetition_penalty
+        ).astype(jnp.int32)
+        return caches, tok0, seen
+
+    def _step_impl(
+        self, params, caches, cur_tok, cur_len, seen, rng,
+        temperature, top_p, do_sample, repetition_penalty,
+    ):
+        b = cur_tok.shape[0]
+        seen = seen.at[jnp.arange(b), cur_tok].max(True)
+        tok_embed = self._embed(params, cur_tok[:, None]).astype(self.cache_dtype)
+        logits, caches = self._decode(
+            params, tok_embed, cur_len[:, None], caches, cur_len, cur_len + 1
+        )
+        nxt = self._sample_next(
+            rng, logits[:, 0], seen, temperature, top_p, do_sample, repetition_penalty
+        ).astype(jnp.int32)
+        return caches, nxt, seen
+
+    def stream(
+        self,
+        params,
+        embeds,
+        positions,
+        lengths,
+        prompt_ids,
+        rng,
+        max_new_tokens: int = 256,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        do_sample: bool = False,
+        repetition_penalty: float = 1.0,
+    ):
+        """Yield generated token ids one at a time (batch size 1 semantics:
+        yields ints). Stops after EOS or ``max_new_tokens``."""
+        t_ = jnp.asarray(temperature, jnp.float32)
+        p_ = jnp.asarray(top_p, jnp.float32)
+        s_ = jnp.asarray(do_sample, bool)
+        r_ = jnp.asarray(repetition_penalty, jnp.float32)
+        rng, sub = jax.random.split(rng)
+        caches, tok, seen = self._prefill(
+            params, embeds, positions, lengths, prompt_ids, sub, t_, p_, s_, r_
+        )
+        cur_len = lengths.astype(jnp.int32)
+        cap = min(int(max_new_tokens), self.max_new_cap)
+        for _ in range(cap):
+            tok_host = int(tok[0])
+            yield tok_host
+            if tok_host == self.cfg.eos_token_id:
+                return
+            rng, sub = jax.random.split(rng)
+            caches, tok, seen = self._step(
+                params, caches, tok, cur_len, seen, sub, t_, p_, s_, r_
+            )
+            cur_len = cur_len + 1

@@ -1,0 +1,401 @@
+"""VLM manager: multimodal caption/chat generation on TPU.
+
+Business-logic layer mirroring the reference ``FastVLMModelManager``
+(``packages/lumen-vlm/src/lumen_vlm/fastvlm/fastvlm_model.py:51-400``) over
+the TPU-native stack: host does image decode + letterbox + tokenize; device
+runs ONE compiled prepare program (normalize -> vision encode -> token embed
+-> image-token splice) and ONE compiled generate program (prefill +
+while_loop decode, ``generate.py``). Prompt lengths are padded to static
+buckets so the number of distinct compiles is bounded.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.model_info import ModelInfo, load_model_info
+from ...ops.image import decode_image_bytes
+from ...runtime.policy import get_policy
+from ...runtime.weights import load_state_dict
+from .chat import ChatMessage, VlmTokenizer
+from .convert import convert_vlm_checkpoint
+from .generate import Generator
+from .modeling import VLMConfig, VLMModel, merge_image_embeddings
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PREFILL_BUCKETS = (64, 128, 256, 512, 1024)
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    tokens: list[int]
+    finish_reason: str  # stop | length | eos_token | stop_sequence | error
+    input_tokens: int
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class GenerationChunk:
+    text: str
+    tokens: list[int]
+    is_final: bool = False
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class VLMManager:
+    def __init__(
+        self,
+        model_dir: str,
+        dtype: str = "bfloat16",
+        max_seq: int = 2048,
+        max_new_cap: int = 512,
+        prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+    ):
+        self.model_dir = model_dir
+        self.policy = get_policy(dtype)
+        self.max_seq = max_seq
+        self.max_new_cap = max_new_cap
+        self.prefill_buckets = sorted(prefill_buckets)
+        self.info: ModelInfo = load_model_info(model_dir)
+        self.cfg = self._build_config(model_dir)
+        self.model = VLMModel(self.cfg)
+        self.model_id = self.info.name
+        self._initialized = False
+        self._lock = threading.Lock()  # one generation stream at a time
+        self._seed = 0
+
+    def _build_config(self, model_dir: str) -> VLMConfig:
+        cfg_path = os.path.join(model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path, "r", encoding="utf-8") as f:
+                return VLMConfig.from_hf(json.load(f))
+        # model_info extra_metadata fallback (the reference's only source,
+        # ``backends/base.py:472-480``).
+        meta = self.info.extra_metadata or {}
+        if "generation_config" in meta:
+            gen = dict(meta["generation_config"])
+            kv = dict(meta.get("kv_cache_config", {}))
+            vis = dict(meta.get("vision_config", {}))
+            text_cfg = {
+                "vocab_size": gen.get("vocab_size"),
+                "bos_token_id": gen.get("bos_token_id"),
+                "eos_token_id": gen.get("eos_token_id"),
+                "pad_token_id": gen.get("pad_token_id"),
+                "max_position_embeddings": gen.get("max_position_embeddings"),
+                "hidden_size": kv.get("hidden_size"),
+                "num_hidden_layers": kv.get("num_hidden_layers"),
+                "num_attention_heads": kv.get("num_attention_heads"),
+                "num_key_value_heads": kv.get("num_key_value_heads"),
+                "head_dim": kv.get("head_dim"),
+            }
+            vision_cfg = {
+                "image_size": vis.get("image_size"),
+                "patch_size": vis.get("patch_size"),
+                "image_mean": vis.get("mean"),
+                "image_std": vis.get("std"),
+            }
+            raw = {
+                # Absent manifest keys must fall through to from_hf's
+                # defaults, so drop None-valued entries instead of passing
+                # them (dict.get(k, default) would return the None).
+                "text_config": {k: v for k, v in text_cfg.items() if v is not None},
+                "vision_config": {k: v for k, v in vision_cfg.items() if v is not None},
+            }
+            if gen.get("image_token_index") is not None:
+                raw["image_token_index"] = gen["image_token_index"]
+            return VLMConfig.from_hf(raw)
+        raise FileNotFoundError(f"no config.json or generation_config metadata in {model_dir}")
+
+    # -- initialization ----------------------------------------------------
+
+    def initialize(self) -> None:
+        if self._initialized:
+            return
+        logger.info("loading VLM weights from %s", self.model_dir)
+        state = load_state_dict(self.model_dir)
+        init = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 4), jnp.int32),
+                jnp.zeros(
+                    (1, self.cfg.vision.image_size, self.cfg.vision.image_size, 3), jnp.float32
+                ),
+            )["params"]
+        )
+        params = convert_vlm_checkpoint(
+            state, init, tie_word_embeddings=self.cfg.decoder.tie_word_embeddings
+        )
+        params = self.policy.cast_params(params)
+        self.params = jax.device_put(params)
+        self.tokenizer = VlmTokenizer.from_model_dir(self.model_dir)
+        # A prompt bucket is usable only if prompt + vision tokens + the
+        # decode budget fit in the KV buffer.
+        v = self.cfg.vision.num_tokens
+        self.prefill_buckets = [
+            b for b in self.prefill_buckets if b - 1 + v + self.max_new_cap + 1 <= self.max_seq
+        ]
+        if not self.prefill_buckets:
+            raise ValueError(
+                f"max_seq={self.max_seq} too small for any prompt bucket "
+                f"(+{v} vision tokens, +{self.max_new_cap} decode budget)"
+            )
+        compute = self.policy.compute_dtype
+        self.generator = Generator(
+            self.model, self.cfg, self.max_seq, self.max_new_cap, cache_dtype=compute
+        )
+
+        vis_cfg = self.cfg.vision
+        mean = jnp.asarray(vis_cfg.mean)
+        std = jnp.asarray(vis_cfg.std)
+
+        @jax.jit
+        def prepare(params, pixels_u8, ids, length):
+            x = pixels_u8.astype(jnp.float32) / 255.0
+            x = ((x - mean) / std).astype(compute)
+            vis = self.model.apply({"params": params}, x, method=VLMModel.encode_vision)
+            text = self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
+            return merge_image_embeddings(
+                text.astype(compute), vis, ids, self.cfg.image_token_id, length
+            )
+
+        @jax.jit
+        def prepare_text(params, ids, length):
+            text = self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
+            b, s = ids.shape
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            return text.astype(compute), positions, length
+
+        self._prepare = prepare
+        self._prepare_text = prepare_text
+        self._initialized = True
+        logger.info(
+            "VLM ready: %s layers=%d hidden=%d vision_tokens=%d",
+            self.model_id,
+            self.cfg.decoder.layers,
+            self.cfg.decoder.hidden_size,
+            vis_cfg.num_tokens,
+        )
+
+    def close(self) -> None:
+        self._initialized = False
+
+    # -- prompt prep -------------------------------------------------------
+
+    def _encode_prompt(self, messages: Sequence[ChatMessage], has_image: bool) -> list[int]:
+        prompt = self.tokenizer.render(messages, add_generation_prompt=True)
+        ids = self.tokenizer.encode(prompt)
+        if has_image and self.cfg.image_token_id not in ids:
+            # Template without an <image> slot: splice the placeholder up
+            # front (reference requires the token to appear in the prompt,
+            # ``onnxrt_backend.py:240-296``).
+            ids = [self.cfg.image_token_id] + ids
+        return ids
+
+    def _bucket_len(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds the largest bucket {self.prefill_buckets[-1]}")
+
+    def _prepare_inputs(self, messages, image_bytes):
+        import cv2
+
+        has_image = bool(image_bytes)
+        ids = self._encode_prompt(messages, has_image)
+        n = len(ids)
+        bucket = self._bucket_len(n)
+        padded = np.full((1, bucket), self.cfg.pad_token_id, np.int32)
+        padded[0, :n] = ids
+        length = jnp.asarray([n], jnp.int32)
+        if has_image:
+            img = decode_image_bytes(image_bytes, color="rgb")
+            size = self.cfg.vision.image_size
+            # Pad-to-square letterbox, reference ``_run_vision_encoder:661-729``.
+            h, w = img.shape[:2]
+            scale = size / max(h, w)
+            nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+            resized = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
+            canvas = np.zeros((size, size, 3), np.uint8)
+            canvas[:nh, :nw] = resized
+            embeds, positions, lengths = self._prepare(
+                self.params, jnp.asarray(canvas[None]), jnp.asarray(padded), length
+            )
+        else:
+            embeds, positions, lengths = self._prepare_text(
+                self.params, jnp.asarray(padded), length
+            )
+        return embeds, positions, lengths, jnp.asarray(padded), n
+
+    def _next_rng(self) -> jax.Array:
+        self._seed += 1
+        return jax.random.PRNGKey(self._seed)
+
+    # -- generation --------------------------------------------------------
+
+    def generate(
+        self,
+        messages: Sequence[ChatMessage],
+        image_bytes: bytes | None = None,
+        max_new_tokens: int = 256,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        do_sample: bool = False,
+        repetition_penalty: float = 1.0,
+        stop_sequences: Sequence[str] | None = None,
+    ) -> GenerationResult:
+        self._ensure_ready()
+        t0 = time.perf_counter()
+        with self._lock:
+            embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
+                messages, image_bytes
+            )
+            out = self.generator.generate(
+                self.params,
+                embeds,
+                positions,
+                lengths,
+                prompt_ids,
+                self._next_rng(),
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                do_sample=do_sample,
+                repetition_penalty=repetition_penalty,
+            )
+        n_gen = int(out.n_generated[0])
+        tokens = [int(t) for t in np.asarray(out.tokens[0][:n_gen])]
+        text = self.tokenizer.decode(tokens)
+        finish = "eos_token" if bool(out.stopped_eos[0]) else "length"
+        text, hit = _truncate_on_stop(text, stop_sequences)
+        if hit:
+            finish = "stop_sequence"
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        return GenerationResult(
+            text=text.strip(),
+            tokens=tokens,
+            finish_reason=finish,
+            input_tokens=n_input,
+            metadata={
+                "temperature": temperature,
+                "top_p": top_p,
+                "repetition_penalty": repetition_penalty,
+                "do_sample": do_sample,
+                "generation_time_ms": round(dt_ms, 2),
+                "tokens_per_second": round(n_gen / max(dt_ms / 1e3, 1e-9), 2),
+            },
+        )
+
+    def generate_stream(
+        self,
+        messages: Sequence[ChatMessage],
+        image_bytes: bytes | None = None,
+        max_new_tokens: int = 256,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        do_sample: bool = False,
+        repetition_penalty: float = 1.0,
+        stop_sequences: Sequence[str] | None = None,
+    ) -> Iterator[GenerationChunk]:
+        """Incremental generation: yields text deltas as tokens arrive
+        (true streaming — the reference collects all chunks into one
+        response, ``fastvlm_service.py:492-506``)."""
+        self._ensure_ready()
+        t0 = time.perf_counter()
+        # Hold back enough text that a stop sequence straddling a chunk
+        # boundary can still be cut before emission.
+        holdback = max((len(s) for s in stop_sequences), default=1) - 1 if stop_sequences else 0
+        with self._lock:
+            embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
+                messages, image_bytes
+            )
+            tokens: list[int] = []
+            emitted = ""
+            finish = "length"
+            final_text: str | None = None
+            for tok in self.generator.stream(
+                self.params,
+                embeds,
+                positions,
+                lengths,
+                prompt_ids,
+                self._next_rng(),
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                do_sample=do_sample,
+                repetition_penalty=repetition_penalty,
+            ):
+                tokens.append(tok)
+                if tok == self.cfg.eos_token_id:
+                    finish = "eos_token"
+                    break
+                text = self.tokenizer.decode(tokens)
+                # Byte-level BPE can split a multi-byte character across
+                # tokens: the partial decode ends in U+FFFD and is not a
+                # prefix of the next decode. Emit only stable prefixes.
+                if text.endswith("�"):
+                    continue
+                if stop_sequences:
+                    truncated, hit = _truncate_on_stop(text, stop_sequences)
+                    if hit:
+                        finish = "stop_sequence"
+                        final_text = truncated
+                        break
+                if not text.startswith(emitted):
+                    continue  # transient divergence; wait for re-extension
+                delta = text[len(emitted) : max(len(text) - holdback, len(emitted))]
+                if delta:
+                    emitted += delta
+                    yield GenerationChunk(text=delta, tokens=[tok])
+            if final_text is None:
+                final_text = self.tokenizer.decode(tokens)
+            # Flush the held-back tail so the stream equals generate().
+            if final_text.startswith(emitted) and len(final_text) > len(emitted):
+                tail = final_text[len(emitted) :]
+                emitted = final_text
+                yield GenerationChunk(text=tail, tokens=[])
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        yield GenerationChunk(
+            text="",
+            tokens=[],
+            is_final=True,
+            metadata={
+                "finish_reason": finish,
+                "generated_tokens": len(tokens),
+                "input_tokens": n_input,
+                "generation_time_ms": round(dt_ms, 2),
+            },
+        )
+
+    # -- utils -------------------------------------------------------------
+
+    def _ensure_ready(self) -> None:
+        if not self._initialized:
+            raise RuntimeError("VLMManager.initialize() not called")
+
+
+def _truncate_on_stop(text: str, stop_sequences: Sequence[str] | None) -> tuple[str, bool]:
+    """Cut at the earliest stop sequence (reference ``stop_on_sequences``,
+    ``backends/base.py:530-541``)."""
+    if not stop_sequences:
+        return text, False
+    best = -1
+    for stop in stop_sequences:
+        idx = text.find(stop)
+        if idx != -1 and (best == -1 or idx < best):
+            best = idx
+    if best == -1:
+        return text, False
+    return text[:best], True

@@ -1,0 +1,182 @@
+"""VLM gRPC service: ``vlm_generate`` + ``vlm_generate_stream``.
+
+Task surface mirrors the reference ``GeneralFastVLMService``
+(``packages/lumen-vlm/src/lumen_vlm/fastvlm/fastvlm_service.py:47-621``):
+chat messages ride as JSON in request ``meta`` (``_extract_messages_from_
+meta:539-560``), the image is the payload, generation knobs are meta
+fields. Unlike the reference — whose "stream" task collects every chunk
+into one response (``:492-506``) — ``vlm_generate_stream`` here emits true
+incremental ``InferResponse`` chunks through the streaming path in
+``BaseService``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from ...core.config import ServiceConfig
+from ...core.result_schemas import TextGenerationV1
+from ...models.vlm import ChatMessage, VLMManager
+from ..base_service import BaseService, InvalidArgument
+from ..registry import TaskDefinition, TaskRegistry
+
+logger = logging.getLogger(__name__)
+
+IMAGE_MIMES = ("image/jpeg", "image/png", "image/webp", "application/octet-stream")
+
+
+class VlmService(BaseService):
+    def __init__(self, manager: VLMManager, service_name: str = "vlm"):
+        self.manager = manager
+        registry = TaskRegistry(service_name)
+        registry.register(
+            TaskDefinition(
+                name="vlm_generate",
+                handler=self._generate,
+                description="multimodal caption/chat generation (single response)",
+                input_mimes=IMAGE_MIMES,
+                output_mime=TextGenerationV1.mime(),
+            )
+        )
+        registry.register(
+            TaskDefinition(
+                name="vlm_generate_stream",
+                handler=self._generate_stream,
+                description="multimodal generation with incremental streaming chunks",
+                input_mimes=IMAGE_MIMES,
+                output_mime=TextGenerationV1.mime(),
+            )
+        )
+        super().__init__(registry)
+
+    @classmethod
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "VlmService":
+        bs = service_config.backend_settings
+        alias, mc = next(iter(service_config.models.items()))
+        model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
+        manager = VLMManager(model_dir, dtype=bs.dtype)
+        manager.initialize()
+        return cls(manager)
+
+    def capability(self):
+        return self.registry.build_capability(
+            model_ids=[self.manager.model_id],
+            runtime="jax-tpu",
+            max_concurrency=1,
+            precisions=["bf16", "fp32"],
+            extra={
+                "max_new_cap": str(self.manager.max_new_cap),
+                "max_seq": str(self.manager.max_seq),
+                "vision_tokens": str(self.manager.cfg.vision.num_tokens),
+                "vocab_size": str(self.manager.cfg.decoder.vocab_size),
+            },
+        )
+
+    def healthy(self) -> bool:
+        return self.manager._initialized
+
+    def close(self) -> None:
+        self.manager.close()
+
+    # -- request parsing ---------------------------------------------------
+
+    def _parse_request(self, payload: bytes, meta: dict[str, str]):
+        raw = meta.get("messages")
+        if not raw:
+            raise InvalidArgument("meta 'messages' (JSON list of {role, content}) is required")
+        try:
+            entries = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise InvalidArgument(f"meta 'messages' is not valid JSON: {e}") from e
+        if not isinstance(entries, list) or not entries:
+            raise InvalidArgument("meta 'messages' must be a non-empty JSON list")
+        messages = []
+        for entry in entries:
+            if not isinstance(entry, dict) or "role" not in entry or "content" not in entry:
+                raise InvalidArgument("each message needs 'role' and 'content'")
+            messages.append(ChatMessage(role=str(entry["role"]), content=str(entry["content"])))
+
+        kw = {}
+        for key, cast in (
+            ("max_new_tokens", int),
+            ("temperature", float),
+            ("top_p", float),
+            ("repetition_penalty", float),
+        ):
+            if key in meta:
+                try:
+                    kw[key] = cast(meta[key])
+                except ValueError as e:
+                    raise InvalidArgument(f"meta {key!r} must be a {cast.__name__}") from e
+        if "do_sample" in meta:
+            kw["do_sample"] = meta["do_sample"].lower() in ("1", "true", "yes")
+        if "stop_sequences" in meta:
+            try:
+                stops = json.loads(meta["stop_sequences"])
+            except json.JSONDecodeError:
+                stops = [meta["stop_sequences"]]
+            if not isinstance(stops, list):
+                stops = [str(stops)]
+            kw["stop_sequences"] = [str(s) for s in stops]
+        return messages, payload or None, kw
+
+    # -- handlers ----------------------------------------------------------
+
+    def _generate(self, payload: bytes, mime: str, meta: dict[str, str]):
+        messages, image, kw = self._parse_request(payload, meta)
+        try:
+            result = self.manager.generate(messages, image_bytes=image, **kw)
+        except ValueError as e:
+            # bad image bytes / over-long prompt -> client error, not INTERNAL
+            raise InvalidArgument(f"cannot process request: {e}") from e
+        body = TextGenerationV1(
+            text=result.text,
+            finish_reason=result.finish_reason,
+            generated_tokens=len(result.tokens),
+            input_tokens=result.input_tokens,
+            model_id=self.manager.model_id,
+            metadata=result.metadata,
+        )
+        return body.to_json_bytes(), TextGenerationV1.mime(), {}
+
+    def _generate_stream(self, payload: bytes, mime: str, meta: dict[str, str]):
+        messages, image, kw = self._parse_request(payload, meta)
+
+        def chunks():
+            pieces: list[str] = []
+            n_chunks = 0
+            stream = _reraise_value_errors(
+                self.manager.generate_stream(messages, image_bytes=image, **kw)
+            )
+            for chunk in stream:
+                if chunk.is_final:
+                    body = TextGenerationV1(
+                        text="".join(pieces),
+                        finish_reason=str(chunk.metadata.get("finish_reason", "stop")),
+                        generated_tokens=int(chunk.metadata.get("generated_tokens", 0)),
+                        input_tokens=int(chunk.metadata.get("input_tokens", 0)),
+                        model_id=self.manager.model_id,
+                        metadata={**chunk.metadata, "streaming_chunks": n_chunks},
+                    )
+                    yield body.to_json_bytes(), TextGenerationV1.mime(), {}
+                else:
+                    pieces.append(chunk.text)
+                    n_chunks += 1
+                    yield (
+                        chunk.text.encode("utf-8"),
+                        "text/plain; charset=utf-8",
+                        {"chunk": "delta"},
+                    )
+
+        return chunks()
+
+
+def _reraise_value_errors(it):
+    """Map manager ValueErrors (bad image, over-long prompt) to the wire
+    INVALID_ARGUMENT code; ``BaseService._stream_out`` handles the rest."""
+    try:
+        yield from it
+    except ValueError as e:
+        raise InvalidArgument(f"cannot process request: {e}") from e
