@@ -213,6 +213,33 @@ class FaceManager:
         h, w = img.shape[:2]
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, self.det_cfg.input_size)
         boxes, kps, scores, keep = self._det_batcher(boxed)
+        return self.detections_from_outputs(
+            boxes, kps, scores, keep,
+            scale=scale, pad_top=pad_top, pad_left=pad_left, image_hw=(h, w),
+            conf_threshold=conf_threshold, size_min=size_min, size_max=size_max,
+            max_faces=max_faces,
+        )
+
+    def detections_from_outputs(
+        self,
+        boxes: np.ndarray,
+        kps: np.ndarray,
+        scores: np.ndarray,
+        keep: np.ndarray,
+        *,
+        scale: float,
+        pad_top: int,
+        pad_left: int,
+        image_hw: tuple[int, int],
+        conf_threshold: float | None = None,
+        size_min: float = 0.0,
+        size_max: float = float("inf"),
+        max_faces: int | None = None,
+    ) -> list[FaceDetection]:
+        """Host half of detection: score/keep filtering + letterbox unmap.
+        Shared by the per-request path above and the batch-ingest pipeline
+        (``lumen_tpu/pipeline/photo.py``), so threshold semantics can't drift."""
+        h, w = image_hw
         conf = self.spec.score_threshold if conf_threshold is None else conf_threshold
         results: list[FaceDetection] = []
         for i in np.argsort(-scores):
@@ -281,6 +308,13 @@ class FaceManager:
         faces = self.detect_faces(img, max_faces=max_faces, **det_kw)
         if not faces:
             return faces
+        self.embed_detections(img, faces)
+        return faces
+
+    def embed_detections(self, img: np.ndarray, faces: list[FaceDetection]) -> None:
+        """Fill ``embedding`` on each detection: align-crop (or bbox-crop
+        fallback), per-spec color order, ONE coalesced embedder call. Shared
+        with the batch-ingest pipeline."""
         crops = []
         for f in faces:
             crop = self.align_crop(img, f.landmarks) if f.landmarks is not None else None
@@ -294,7 +328,6 @@ class FaceManager:
         futures = [self._rec_batcher.submit(c) for c in crops]
         for f, fut in zip(faces, futures):
             f.embedding = fut.result(timeout=60)
-        return faces
 
     # -- comparisons (reference face_model.py:371-429) --------------------
 

@@ -214,6 +214,32 @@ class OcrManager:
         bucket = bucket_for(max(h, w), list(s.det_buckets))
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, bucket)
         prob = np.asarray(self._run_detector(self.det_vars, boxed[None]))[0]
+        return self.boxes_from_det_output(
+            prob,
+            image_hw=(h, w),
+            scale=scale,
+            pad_top=pad_top,
+            pad_left=pad_left,
+            det_threshold=det_threshold,
+            box_threshold=box_threshold,
+            unclip_ratio=unclip_ratio,
+        )
+
+    def boxes_from_det_output(
+        self,
+        prob: np.ndarray,
+        *,
+        image_hw: tuple[int, int],
+        scale: float,
+        pad_top: int,
+        pad_left: int,
+        det_threshold: float | None = None,
+        box_threshold: float | None = None,
+        unclip_ratio: float | None = None,
+    ) -> list[tuple[np.ndarray, float]]:
+        """Host half of detection: prob map -> ordered (quad, score) list.
+        Shared by the per-request path above and the batch-ingest pipeline."""
+        s = self.spec
         found = boxes_from_prob_map(
             prob,
             det_threshold=s.det_threshold if det_threshold is None else det_threshold,
@@ -221,7 +247,7 @@ class OcrManager:
             unclip_ratio=s.unclip_ratio if unclip_ratio is None else unclip_ratio,
             max_candidates=s.max_candidates,
             min_size=s.min_size,
-            dest_hw=(h, w),
+            dest_hw=image_hw,
             scale=scale,
             pad_top=pad_top,
             pad_left=pad_left,
@@ -294,6 +320,16 @@ class OcrManager:
         )
         if not boxes:
             return []
+        return self.recognize_boxes(img, boxes, rec_threshold=rec_threshold)
+
+    def recognize_boxes(
+        self,
+        img: np.ndarray,
+        boxes: list[tuple[np.ndarray, float]],
+        rec_threshold: float | None = None,
+    ) -> list[OcrResult]:
+        """Crop each detected quad, recognize, and apply the rec-confidence
+        drop policy. Shared with the batch-ingest pipeline."""
         crops = [rotate_crop(img, quad) for quad, _ in boxes]
         texts = self.recognize_crops(crops)
         thr = self.spec.rec_threshold if rec_threshold is None else rec_threshold

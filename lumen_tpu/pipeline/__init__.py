@@ -1,0 +1,20 @@
+"""Batch-ingest pipeline: data-parallel bulk indexing over a device mesh.
+
+The reference has no bulk-ingest path at all — its nearest mechanism is
+per-request hub routing (``src/lumen/router.py:22-46``, SURVEY.md §6 "Full
+ingest"). This subpackage is the new TPU-native capability that closes that
+gap: a scheduler that streams a library of images through fixed-shape,
+data-parallel device batches (CLIP + face + OCR [+ VLM]) with host-side
+decode overlapped against device execution.
+"""
+
+from lumen_tpu.pipeline.ingest import IngestPipeline, IngestStats, Stage
+from lumen_tpu.pipeline.photo import PhotoIngestPipeline, PhotoRecord
+
+__all__ = [
+    "IngestPipeline",
+    "IngestStats",
+    "Stage",
+    "PhotoIngestPipeline",
+    "PhotoRecord",
+]

@@ -1,0 +1,246 @@
+"""Generic data-parallel ingest scheduler.
+
+Execution model (the three overlapped lanes):
+
+1. **host decode/preprocess** — a producer thread drains the item iterator,
+   fans per-item work over a thread pool, stacks results into fixed-shape
+   numpy batches (padding the tail batch), and transfers them to the mesh
+   with a ``data``-axis sharding;
+2. **device** — the consumer dispatches every stage's jitted function on a
+   prepared batch and keeps up to ``inflight`` batches un-fetched, so XLA's
+   async dispatch pipelines batch *k+1* behind batch *k*;
+3. **host postprocess** — once a batch's device work is fetched (one
+   device->host transfer per stage), per-item ``postprocess`` runs and a
+   merged record per item is yielded in order.
+
+Static shapes everywhere: every stage's ``preprocess`` must return leaves of
+one fixed shape, and the batch size is constant (tail padded), so each stage
+compiles exactly once (SURVEY.md §7 design stance (1)-(2)).
+
+The reference has no equivalent component; its per-request hot loop is one
+ONNX session call per payload (``SURVEY.md`` §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import jax
+
+from lumen_tpu.runtime.batcher import stack_and_pad, unstack
+from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Stage:
+    """One device-batched step of an ingest pipeline.
+
+    - ``preprocess(decoded)`` -> fixed-shape numpy pytree for one item (host,
+      runs in the decode worker pool);
+    - ``device_fn(batched_tree)`` -> batched device result tree (should be
+      ``jax.jit``-ed; inputs arrive sharded over the ``data`` mesh axis);
+    - ``postprocess(decoded, row)`` -> the per-item record value (host).
+    """
+
+    name: str
+    preprocess: Callable[[Any], Any]
+    device_fn: Callable[[Any], Any]
+    postprocess: Callable[[Any, Any], Any] = field(default=lambda decoded, row: row)
+
+
+@dataclass
+class IngestStats:
+    items: int = 0
+    batches: int = 0
+    wall_s: float = 0.0
+    decode_s: float = 0.0  # producer-lane time (decode + preprocess + transfer)
+    device_s: float = 0.0  # consumer time blocked on device fetches
+    post_s: float = 0.0
+
+    @property
+    def items_per_sec(self) -> float:
+        return self.items / self.wall_s if self.wall_s > 0 else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "items": self.items,
+            "batches": self.batches,
+            "wall_s": round(self.wall_s, 4),
+            "items_per_sec": round(self.items_per_sec, 2),
+            "decode_s": round(self.decode_s, 4),
+            "device_s": round(self.device_s, 4),
+            "post_s": round(self.post_s, 4),
+        }
+
+
+class _Batch:
+    __slots__ = ("decoded", "inputs", "outputs", "n")
+
+    def __init__(self, decoded: list, inputs: dict[str, Any], n: int):
+        self.decoded = decoded
+        self.inputs = inputs  # stage name -> sharded device tree
+        self.outputs: dict[str, Any] = {}
+        self.n = n
+
+
+class IngestPipeline:
+    """Stream items through data-parallel device stages over a mesh.
+
+    ``batch_size`` must be a multiple of the mesh's ``data`` axis size (it is
+    the GLOBAL batch; each device sees ``batch_size / data`` rows).
+    """
+
+    def __init__(
+        self,
+        mesh: jax.sharding.Mesh,
+        stages: Sequence[Stage],
+        decode: Callable[[Any], Any] = lambda item: item,
+        batch_size: int = 64,
+        prefetch: int = 2,
+        inflight: int = 2,
+        workers: int | None = None,
+    ):
+        if not stages:
+            raise ValueError("need at least one stage")
+        dp = mesh.shape.get(DATA_AXIS, 1)
+        if batch_size % dp != 0:
+            raise ValueError(
+                f"batch_size {batch_size} not a multiple of '{DATA_AXIS}' axis size {dp}"
+            )
+        self.mesh = mesh
+        self.stages = list(stages)
+        self.decode = decode
+        self.batch_size = batch_size
+        self.prefetch = max(prefetch, 1)
+        self.inflight = max(inflight, 1)
+        self.workers = workers or min(os.cpu_count() or 4, 16)
+        self._sharding = data_sharding(mesh)
+        self.stats = IngestStats()  # stats of the most recent run()
+
+    # -- producer lane ----------------------------------------------------
+
+    def _prepare(self, pool: ThreadPoolExecutor, raw_items: list) -> _Batch:
+        decoded = list(pool.map(self.decode, raw_items))
+        inputs: dict[str, Any] = {}
+        for stage in self.stages:
+            trees = list(pool.map(stage.preprocess, decoded))
+            stacked = stack_and_pad(trees, self.batch_size)
+            inputs[stage.name] = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(leaf, self._sharding), stacked
+            )
+        return _Batch(decoded, inputs, len(raw_items))
+
+    @staticmethod
+    def _offer(out: queue.Queue, entry, stop: threading.Event) -> bool:
+        """put() that gives up when the consumer has stopped (an abandoned
+        run() generator must not leave the producer parked on a full queue)."""
+        while not stop.is_set():
+            try:
+                out.put(entry, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self, items: Iterable[Any], out: queue.Queue, stop: threading.Event) -> None:
+        try:
+            with ThreadPoolExecutor(self.workers, thread_name_prefix="ingest-prep") as pool:
+                chunk: list = []
+                for item in items:
+                    if stop.is_set():
+                        return
+                    chunk.append(item)
+                    if len(chunk) == self.batch_size:
+                        t0 = time.perf_counter()
+                        batch = self._prepare(pool, chunk)
+                        self.stats.decode_s += time.perf_counter() - t0
+                        if not self._offer(out, batch, stop):
+                            return
+                        chunk = []
+                if chunk and not stop.is_set():
+                    t0 = time.perf_counter()
+                    batch = self._prepare(pool, chunk)
+                    self.stats.decode_s += time.perf_counter() - t0
+                    if not self._offer(out, batch, stop):
+                        return
+            self._offer(out, None, stop)
+        except BaseException as e:  # noqa: BLE001 - surface in the consumer
+            self._offer(out, e, stop)
+
+    # -- consumer ---------------------------------------------------------
+
+    def run(self, items: Iterable[Any]) -> Iterator[dict]:
+        """Yield one record dict per input item, in input order. Record keys
+        are stage names plus ``_index``."""
+        self.stats = IngestStats()  # fresh stats per run
+        start = time.perf_counter()
+        ready: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        producer = threading.Thread(
+            target=self._producer, args=(items, ready, stop), name="ingest-producer", daemon=True
+        )
+        producer.start()
+        pending: deque[_Batch] = deque()
+        index = 0
+        try:
+            done = False
+            while not done or pending:
+                # Dispatch up to `inflight` batches before fetching results.
+                # Only BLOCK for a new batch when none is pending; with a
+                # completed batch in hand, a slow producer must not delay its
+                # results (no head-of-line blocking on the item source).
+                while not done and len(pending) < self.inflight:
+                    try:
+                        got = ready.get(block=not pending)
+                    except queue.Empty:
+                        break
+                    if got is None:
+                        done = True
+                        break
+                    if isinstance(got, BaseException):
+                        raise got
+                    for stage in self.stages:
+                        got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
+                    pending.append(got)
+                if not pending:
+                    break
+                batch = pending.popleft()
+                t0 = time.perf_counter()
+                rows_by_stage = {
+                    s.name: unstack(batch.outputs[s.name], batch.n) for s in self.stages
+                }
+                self.stats.device_s += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for i in range(batch.n):
+                    record: dict[str, Any] = {"_index": index}
+                    for s in self.stages:
+                        record[s.name] = s.postprocess(batch.decoded[i], rows_by_stage[s.name][i])
+                    index += 1
+                    yield record
+                self.stats.post_s += time.perf_counter() - t0
+                self.stats.items += batch.n
+                self.stats.batches += 1
+        finally:
+            stop.set()
+            # Unblock a producer parked on a full queue; _offer's timeout
+            # makes it observe `stop` within 100ms even if we drain nothing.
+            while producer.is_alive():
+                try:
+                    ready.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+                producer.join(timeout=0.05)
+            self.stats.wall_s = time.perf_counter() - start
+
+    def run_all(self, items: Iterable[Any]) -> list[dict]:
+        return list(self.run(items))

@@ -1,0 +1,235 @@
+"""The concrete photo-indexing ingest pipeline: CLIP + face + OCR (+ VLM).
+
+Composes the per-family managers into `IngestPipeline` stages. Each stage's
+dense forward (CLIP towers, SCRFD detector, DBNet detector) runs as ONE
+data-parallel device call per global batch, sharded over the mesh's ``data``
+axis; the irregular tails (face-crop embedding, OCR crop recognition, VLM
+captioning) run through the managers' own bucketed batchers.
+
+This is the north-star capability from SURVEY.md §6 (full-library ingest);
+the reference has nothing comparable — it processes one payload per gRPC
+message (``SURVEY.md`` §2.8 "Batching").
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+from lumen_tpu.ops.image import decode_image_bytes, letterbox_numpy
+from lumen_tpu.parallel.sharding import replicate
+from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PhotoRecord:
+    index: int
+    clip_embedding: np.ndarray | None = None
+    labels: list[tuple[str, float]] = field(default_factory=list)
+    faces: list = field(default_factory=list)  # models.face.FaceDetection
+    ocr: list = field(default_factory=list)  # models.ocr.OcrResult
+    caption: str | None = None
+
+
+class PhotoIngestPipeline:
+    """Bulk photo indexing over a device mesh.
+
+    Pass any subset of initialized managers; stages are built only for the
+    families provided. ``items`` fed to :meth:`run` are raw image bytes (or
+    anything ``decode_image_bytes`` accepts).
+    """
+
+    def __init__(
+        self,
+        mesh,
+        clip=None,
+        face=None,
+        ocr=None,
+        vlm=None,
+        batch_size: int = 64,
+        classify_top_k: int = 0,
+        ocr_det_size: int | None = None,
+        caption: bool = False,
+        caption_prompt: str = "Describe this photo in one sentence.",
+        caption_max_tokens: int = 32,
+        prefetch: int = 2,
+        inflight: int = 2,
+        workers: int | None = None,
+    ):
+        if clip is None and face is None and ocr is None:
+            raise ValueError("need at least one of clip/face/ocr managers")
+        if caption and vlm is None:
+            raise ValueError("caption=True requires a vlm manager")
+        for mgr in (clip, face, ocr, vlm):
+            if mgr is not None:
+                mgr._ensure_ready()  # stages reach into post-initialize state
+        self.clip, self.face, self.ocr, self.vlm = clip, face, ocr, vlm
+        self.ocr_det_size = ocr_det_size
+        # Re-place manager weights replicated over the pipeline mesh so the
+        # per-request and ingest paths share ONE device copy (a second
+        # replicated copy per family could evict HBM needed for activations).
+        if clip is not None:
+            clip.params = replicate(clip.params, mesh)
+        if face is not None:
+            face.det_vars = replicate(face.det_vars, mesh)
+        if ocr is not None:
+            ocr.det_vars = replicate(ocr.det_vars, mesh)
+        self.classify_top_k = classify_top_k
+        self.caption = caption
+        self.caption_prompt = caption_prompt
+        self.caption_max_tokens = caption_max_tokens
+
+        stages = []
+        if clip is not None:
+            stages.append(self._clip_stage(mesh))
+        if face is not None:
+            stages.append(self._face_stage(mesh))
+        if ocr is not None:
+            stages.append(self._ocr_stage(mesh))
+        self.engine = IngestPipeline(
+            mesh,
+            stages,
+            decode=self._decode,
+            batch_size=batch_size,
+            prefetch=prefetch,
+            inflight=inflight,
+            workers=workers,
+        )
+
+    # -- decode -----------------------------------------------------------
+
+    @staticmethod
+    def _decode(item) -> dict:
+        img = (
+            decode_image_bytes(item, color="rgb")
+            if isinstance(item, (bytes, bytearray))
+            else np.asarray(item)
+        )
+        return {"img": img, "meta": {}}
+
+    # -- stages -----------------------------------------------------------
+
+    def _clip_stage(self, mesh) -> Stage:
+        mgr = self.clip
+        size = mgr.cfg.image_size
+
+        def preprocess(decoded: dict) -> np.ndarray:
+            import cv2
+
+            return cv2.resize(decoded["img"], (size, size), interpolation=cv2.INTER_LINEAR)
+
+        def device_fn(pixels):
+            return mgr._encode_images(mgr.params, pixels)
+
+        def postprocess(decoded: dict, vec: np.ndarray):
+            vec = mgr._check_vector(vec)
+            out = {"embedding": vec}
+            if self.classify_top_k > 0 and mgr._label_matrix is not None:
+                res = mgr._classify_vector(
+                    vec, mgr.label_names, mgr._label_matrix, self.classify_top_k
+                )
+                out["labels"] = res.labels
+            return out
+
+        return Stage("clip", preprocess, device_fn, postprocess)
+
+    def _face_stage(self, mesh) -> Stage:
+        mgr = self.face
+        det_size = mgr.det_cfg.input_size
+
+        def preprocess(decoded: dict) -> np.ndarray:
+            boxed, scale, pad_top, pad_left = letterbox_numpy(decoded["img"], det_size)
+            h, w = decoded["img"].shape[:2]
+            decoded["meta"]["face"] = (scale, pad_top, pad_left, h, w)
+            return boxed
+
+        def device_fn(images):
+            return mgr._run_detector(mgr.det_vars, images)
+
+        def postprocess(decoded: dict, row):
+            boxes, kps, scores, keep = row
+            scale, pad_top, pad_left, h, w = decoded["meta"]["face"]
+            faces = mgr.detections_from_outputs(
+                boxes, kps, scores, keep,
+                scale=scale, pad_top=pad_top, pad_left=pad_left, image_hw=(h, w),
+            )
+            if faces:
+                mgr.embed_detections(decoded["img"], faces)
+            return faces
+
+        return Stage("face", preprocess, device_fn, postprocess)
+
+    def _ocr_stage(self, mesh) -> Stage:
+        from lumen_tpu.runtime.batcher import bucket_for
+
+        mgr = self.ocr
+        # One static det bucket for the whole ingest run (per-image bucket
+        # choice would fragment the data-parallel batch into ragged shapes).
+        # Defaults to the LARGEST bucket so bulk ingest matches the
+        # per-request path's quality on big photos; dial down via
+        # ``ocr_det_size`` to trade recall for throughput.
+        buckets = sorted(mgr.spec.det_buckets)
+        det_size = bucket_for(self.ocr_det_size or buckets[-1], buckets)
+
+        def preprocess(decoded: dict) -> np.ndarray:
+            boxed, scale, pad_top, pad_left = letterbox_numpy(decoded["img"], det_size)
+            decoded["meta"]["ocr"] = (scale, pad_top, pad_left)
+            return boxed
+
+        def device_fn(images):
+            return mgr._run_detector(mgr.det_vars, images)
+
+        def postprocess(decoded: dict, prob):
+            scale, pad_top, pad_left = decoded["meta"]["ocr"]
+            img = decoded["img"]
+            found = mgr.boxes_from_det_output(
+                np.asarray(prob),
+                image_hw=img.shape[:2],
+                scale=scale,
+                pad_top=pad_top,
+                pad_left=pad_left,
+            )
+            if not found:
+                return []
+            return mgr.recognize_boxes(img, found)
+
+        return Stage("ocr", preprocess, device_fn, postprocess)
+
+    # -- run --------------------------------------------------------------
+
+    def run(self, items: Iterable[Any]) -> Iterator[PhotoRecord]:
+        for raw in self.engine.run(items):
+            rec = PhotoRecord(index=raw["_index"])
+            if "clip" in raw:
+                rec.clip_embedding = raw["clip"]["embedding"]
+                rec.labels = raw["clip"].get("labels", [])
+            if "face" in raw:
+                rec.faces = raw["face"]
+            if "ocr" in raw:
+                rec.ocr = raw["ocr"]
+            yield rec
+
+    def run_with_captions(self, items: list[bytes]) -> list[PhotoRecord]:
+        """Caption path: VLM generation is autoregressive (one lax.while_loop
+        per image) and dominates cost, so it runs after the dense sweep."""
+        records = list(self.run(items))
+        if self.caption and self.vlm is not None:
+            from lumen_tpu.models.vlm.chat import ChatMessage
+
+            for rec, payload in zip(records, items):
+                result = self.vlm.generate(
+                    [ChatMessage(role="user", content=self.caption_prompt)],
+                    image_bytes=payload,
+                    max_new_tokens=self.caption_max_tokens,
+                )
+                rec.caption = result.text
+        return records
+
+    @property
+    def stats(self):
+        return self.engine.stats

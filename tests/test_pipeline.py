@@ -1,0 +1,185 @@
+"""Batch-ingest pipeline tests: generic scheduler semantics on a simulated
+8-device mesh, plus the concrete CLIP+face+OCR photo pipeline end-to-end
+with tiny offline model dirs (SURVEY.md §4 multi-chip CPU-mesh strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lumen_tpu.pipeline import IngestPipeline, PhotoIngestPipeline, Stage
+from lumen_tpu.runtime.mesh import build_mesh
+from tests.clip_fixtures import make_clip_model_dir, png_bytes
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh({"data": -1})
+
+
+pytestmark = pytest.mark.multichip
+
+
+class TestIngestEngine:
+    def test_order_values_and_padding(self, mesh):
+        stage = Stage(
+            name="double",
+            preprocess=lambda item: np.array([item], np.float32),
+            device_fn=jax.jit(lambda x: x * 2),
+            postprocess=lambda decoded, row: float(row[0]),
+        )
+        pipe = IngestPipeline(mesh, [stage], batch_size=8)
+        items = list(range(20))  # 2 full batches + ragged tail of 4
+        records = pipe.run_all(items)
+        assert [r["_index"] for r in records] == items
+        assert [r["double"] for r in records] == [2.0 * i for i in items]
+        assert pipe.stats.items == 20
+        assert pipe.stats.batches == 3
+        assert pipe.stats.items_per_sec > 0
+
+    def test_device_inputs_are_data_sharded(self, mesh):
+        seen = {}
+
+        def device_fn(x):
+            seen["sharding"] = x.sharding
+            return x
+
+        stage = Stage(
+            name="probe",
+            preprocess=lambda item: np.zeros((4,), np.float32),
+            device_fn=device_fn,
+        )
+        IngestPipeline(mesh, [stage], batch_size=8).run_all(range(8))
+        spec = seen["sharding"].spec
+        assert spec[0] == "data"
+
+    def test_multiple_stages_merge_into_one_record(self, mesh):
+        mk = lambda f: Stage(  # noqa: E731
+            name=f.__name__,
+            preprocess=lambda item: np.array([item], np.float32),
+            device_fn=jax.jit(f),
+            postprocess=lambda decoded, row: float(row[0]),
+        )
+
+        def add1(x):
+            return x + 1
+
+        def neg(x):
+            return -x
+
+        records = IngestPipeline(mesh, [mk(add1), mk(neg)], batch_size=8).run_all(range(5))
+        assert records[3]["add1"] == 4.0
+        assert records[3]["neg"] == -3.0
+
+    def test_decode_shared_across_stages(self, mesh):
+        calls = []
+
+        def decode(item):
+            calls.append(item)
+            return item
+
+        stage = Stage(
+            name="s",
+            preprocess=lambda d: np.array([d], np.float32),
+            device_fn=jax.jit(lambda x: x),
+        )
+        IngestPipeline(mesh, [stage, Stage("t", stage.preprocess, stage.device_fn)],
+                       decode=decode, batch_size=8).run_all(range(6))
+        assert sorted(calls) == list(range(6))  # decoded once per item
+
+    def test_producer_error_propagates(self, mesh):
+        def bad_decode(item):
+            raise ValueError("boom")
+
+        stage = Stage(
+            name="s",
+            preprocess=lambda d: np.array([d], np.float32),
+            device_fn=jax.jit(lambda x: x),
+        )
+        pipe = IngestPipeline(mesh, [stage], decode=bad_decode, batch_size=8)
+        with pytest.raises(ValueError, match="boom"):
+            pipe.run_all(range(4))
+
+    def test_batch_size_must_divide_data_axis(self, mesh):
+        stage = Stage("s", lambda d: np.zeros(1), jax.jit(lambda x: x))
+        with pytest.raises(ValueError, match="multiple"):
+            IngestPipeline(mesh, [stage], batch_size=6)  # data axis is 8
+
+    def test_empty_input(self, mesh):
+        stage = Stage("s", lambda d: np.zeros(1, np.float32), jax.jit(lambda x: x))
+        assert IngestPipeline(mesh, [stage], batch_size=8).run_all([]) == []
+
+
+class TestPhotoIngest:
+    @pytest.fixture(scope="class")
+    def clip_mgr(self, tmp_path_factory):
+        from lumen_tpu.models.clip import CLIPManager
+
+        model_dir = make_clip_model_dir(tmp_path_factory.mktemp("pclip"))
+        mgr = CLIPManager(model_dir, dataset="Tiny", dtype="float32", batch_size=4)
+        mgr.initialize()
+        yield mgr
+        mgr.close()
+
+    @pytest.fixture(scope="class")
+    def face_mgr(self, tmp_path_factory):
+        from lumen_tpu.models.face import FaceManager
+        from tests.test_face import make_face_model_dir
+
+        model_dir, det_cfg, rec_cfg = make_face_model_dir(tmp_path_factory.mktemp("pface"))
+        mgr = FaceManager(
+            model_dir, dtype="float32", batch_size=4, detector_cfg=det_cfg, embedder_cfg=rec_cfg
+        )
+        mgr.initialize()
+        yield mgr
+        mgr.close()
+
+    @pytest.fixture(scope="class")
+    def ocr_mgr(self, tmp_path_factory):
+        from lumen_tpu.models.ocr import OcrManager
+        from tests.test_ocr import make_ocr_model_dir
+
+        model_dir = make_ocr_model_dir(tmp_path_factory.mktemp("pocr"))
+        mgr = OcrManager(model_dir, dtype="float32")
+        mgr.initialize()
+        yield mgr
+        mgr.close()
+
+    def test_full_photo_pipeline(self, mesh, clip_mgr, face_mgr, ocr_mgr):
+        pipe = PhotoIngestPipeline(
+            mesh, clip=clip_mgr, face=face_mgr, ocr=ocr_mgr, batch_size=8, classify_top_k=2
+        )
+        items = [png_bytes(seed=i) for i in range(10)]
+        records = list(pipe.run(items))
+        assert len(records) == 10
+        for i, rec in enumerate(records):
+            assert rec.index == i
+            assert rec.clip_embedding is not None
+            np.testing.assert_allclose(np.linalg.norm(rec.clip_embedding), 1.0, rtol=1e-4)
+            assert len(rec.labels) == 2
+            assert isinstance(rec.faces, list)
+            assert isinstance(rec.ocr, list)
+        assert pipe.stats.items == 10
+
+    def test_pipeline_matches_single_item_manager(self, mesh, clip_mgr):
+        """The data-parallel sharded path must agree numerically with the
+        per-request manager path."""
+        payload = png_bytes(seed=3)
+        pipe = PhotoIngestPipeline(mesh, clip=clip_mgr, batch_size=8)
+        rec = list(pipe.run([payload] * 3))[0]
+        direct = clip_mgr.encode_image(payload)
+        np.testing.assert_allclose(rec.clip_embedding, direct, atol=2e-5)
+
+    def test_face_results_match_manager(self, mesh, face_mgr):
+        payload = png_bytes(seed=5, size=96)
+        pipe = PhotoIngestPipeline(mesh, face=face_mgr, batch_size=8)
+        rec = list(pipe.run([payload] * 2))[0]
+        direct = face_mgr.detect_and_extract(payload)
+        assert len(rec.faces) == len(direct)
+        for got, want in zip(rec.faces, direct):
+            np.testing.assert_allclose(got.bbox, want.bbox, atol=1e-3)
+            np.testing.assert_allclose(got.embedding, want.embedding, atol=2e-5)
+
+    def test_requires_a_manager(self, mesh):
+        with pytest.raises(ValueError):
+            PhotoIngestPipeline(mesh)
