@@ -1,0 +1,19 @@
+"""Control-plane application (reference: ``lumen-app``, SURVEY.md §2.7).
+
+A local web app that walks a user from bare machine to running inference
+server: hardware detection -> config generation -> environment install ->
+server supervision, over REST (``/api/v1/{config,hardware,install,server}``)
+plus a WebSocket log stream (``/ws/logs``).
+
+TPU-flavored rebuild decisions:
+- presets describe TPU topologies (v5e/v6e/CPU meshes), not CUDA/CoreML
+  driver stacks (reference ``services/config.py:41-279``);
+- the installer provisions a plain ``venv`` and verifies imports — the
+  reference's micromamba machinery (``utils/installation/``) is unnecessary
+  on TPU VMs where python + jax ship with the image;
+- the HTTP layer is aiohttp (no FastAPI dependency in the image).
+"""
+
+from lumen_tpu.app.state import AppState
+
+__all__ = ["AppState"]

@@ -1,0 +1,321 @@
+"""REST + WebSocket API (reference routers ``lumen-app/src/lumen_app/api/``
+and WS log stream ``websockets/logs.py``).
+
+Routes (same surface as the reference, ``main.py:64-68``):
+
+- ``GET  /health``
+- ``POST /api/v1/config/generate``      {preset, tier, region, cache_dir, port}
+- ``GET  /api/v1/config/current``
+- ``POST /api/v1/config/validate``      {config: <dict>} | {path}
+- ``POST /api/v1/config/save``          {path}
+- ``GET  /api/v1/config/yaml``
+- ``GET  /api/v1/config/presets``
+- ``GET  /api/v1/hardware/info``
+- ``GET  /api/v1/hardware/detect``
+- ``POST /api/v1/install/setup``        {venv_path?, packages?, config_path?, download?}
+- ``GET  /api/v1/install/tasks``
+- ``GET  /api/v1/install/status/{task_id}``
+- ``POST /api/v1/install/cancel/{task_id}``
+- ``GET  /api/v1/server/status``
+- ``POST /api/v1/server/start``         {config_path?}
+- ``POST /api/v1/server/stop``
+- ``POST /api/v1/server/restart``
+- ``GET  /api/v1/metrics``
+- ``WS   /ws/logs``  frames {type: connected|log|heartbeat} with 1s heartbeat
+  (reference ``websockets/logs.py:18-158``)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any
+
+from aiohttp import WSMsgType, web
+
+from lumen_tpu.app.config_gen import TIERS, config_to_yaml, generate_config
+from lumen_tpu.app.hardware import detect_hardware, hardware_report
+from lumen_tpu.app.install import InstallOptions, InstallOrchestrator
+from lumen_tpu.app.presets import PRESETS
+from lumen_tpu.app.server_manager import ServerManager
+from lumen_tpu.app.state import AppState
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_S = 1.0
+
+STATE_KEY: web.AppKey[AppState] = web.AppKey("state", AppState)
+ORCHESTRATOR_KEY: web.AppKey[InstallOrchestrator] = web.AppKey(
+    "orchestrator", InstallOrchestrator
+)
+MANAGER_KEY: web.AppKey[ServerManager] = web.AppKey("manager", ServerManager)
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def _bad_request(e: Exception) -> web.Response:
+    return _json_error(400, str(e))
+
+
+async def _body(request: web.Request) -> dict[str, Any]:
+    if request.can_read_body:
+        try:
+            return await request.json()
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text=json.dumps({"error": f"invalid JSON: {e}"}))
+    return {}
+
+
+def build_app(state: AppState | None = None) -> web.Application:
+    state = state or AppState()
+    orchestrator = InstallOrchestrator(state)
+    manager = ServerManager(state)
+    state.server_manager = manager
+
+    app = web.Application()
+    app[STATE_KEY] = state
+    app[ORCHESTRATOR_KEY] = orchestrator
+    app[MANAGER_KEY] = manager
+    _bg_tasks: set[asyncio.Task] = set()
+
+    # -- health -----------------------------------------------------------
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "subscribers": state.subscriber_count})
+
+    # -- config -----------------------------------------------------------
+
+    async def config_generate(request: web.Request) -> web.Response:
+        body = await _body(request)
+        try:
+            cfg = generate_config(
+                preset_name=body.get("preset", "cpu"),
+                tier=body.get("tier", "light_weight"),
+                region=body.get("region", "other"),
+                cache_dir=body.get("cache_dir", "~/.lumen-tpu"),
+                port=int(body.get("port", 50051)),
+                mdns=bool(body.get("mdns", True)),
+            )
+        except ValueError as e:
+            return _bad_request(e)
+        state.config = cfg
+        # The previous save (if any) no longer matches the new config; a
+        # path-less /server/start must not launch the stale YAML.
+        state.config_path = None
+        state.broadcast_log(f"config generated (preset={body.get('preset', 'cpu')})")
+        return web.json_response(cfg.model_dump(exclude_none=True))
+
+    async def config_current(request: web.Request) -> web.Response:
+        if state.config is None:
+            return _json_error(404, "no config generated or loaded yet")
+        return web.json_response(state.config.model_dump(exclude_none=True))
+
+    async def config_validate(request: web.Request) -> web.Response:
+        from lumen_tpu.core.config import load_config, validate_config_dict
+
+        body = await _body(request)
+        try:
+            if "path" in body:
+                cfg = load_config(body["path"])
+            elif "config" in body:
+                cfg = validate_config_dict(body["config"])
+            else:
+                return _json_error(400, "provide 'config' (dict) or 'path'")
+        except Exception as e:  # noqa: BLE001 - validation errors reported to client
+            return web.json_response({"valid": False, "error": str(e)})
+        return web.json_response({"valid": True, "services": sorted(cfg.services)})
+
+    async def config_save(request: web.Request) -> web.Response:
+        body = await _body(request)
+        if state.config is None:
+            return _json_error(404, "no config to save")
+        path = os.path.expanduser(body.get("path", "lumen-config.yaml"))
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(config_to_yaml(state.config))
+        state.config_path = path
+        state.broadcast_log(f"config saved to {path}")
+        return web.json_response({"path": path})
+
+    async def config_yaml(request: web.Request) -> web.Response:
+        if state.config is None:
+            return _json_error(404, "no config generated or loaded yet")
+        return web.Response(text=config_to_yaml(state.config), content_type="text/yaml")
+
+    async def config_presets(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "presets": {
+                    name: {
+                        "description": p.description,
+                        "platform": p.platform,
+                        "chips": p.chips,
+                        "mesh_axes": p.mesh_axes,
+                        "dtype": p.dtype,
+                        "batch_size": p.batch_size,
+                        "max_tier": p.max_tier,
+                    }
+                    for name, p in PRESETS.items()
+                },
+                "tiers": list(TIERS),
+            }
+        )
+
+    # -- hardware ---------------------------------------------------------
+
+    async def hardware_info(request: web.Request) -> web.Response:
+        hw = await asyncio.to_thread(detect_hardware)
+        return web.json_response(hw.as_dict())
+
+    async def hardware_detect(request: web.Request) -> web.Response:
+        report = await asyncio.to_thread(hardware_report)
+        return web.json_response(report)
+
+    # -- install ----------------------------------------------------------
+
+    async def install_setup(request: web.Request) -> web.Response:
+        body = await _body(request)
+        options = InstallOptions(
+            venv_path=body.get("venv_path"),
+            packages=list(body.get("packages", [])),
+            config_path=body.get("config_path") if body.get("download") else None,
+            cache_dir=body.get("cache_dir"),
+        )
+        task = orchestrator.create_task(options)
+        runner = asyncio.ensure_future(orchestrator.run(task))
+        # Hold a strong reference: the loop only weak-refs tasks, and a
+        # GC'd runner would strand the install at status=running forever.
+        _bg_tasks.add(runner)
+        runner.add_done_callback(_bg_tasks.discard)
+        return web.json_response(task.as_dict(), status=202)
+
+    async def install_tasks(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"tasks": [t.as_dict() for t in state.install_tasks.values()]}
+        )
+
+    async def install_status(request: web.Request) -> web.Response:
+        task = state.install_tasks.get(request.match_info["task_id"])
+        if task is None:
+            return _json_error(404, "unknown task")
+        return web.json_response(task.as_dict())
+
+    async def install_cancel(request: web.Request) -> web.Response:
+        task = state.install_tasks.get(request.match_info["task_id"])
+        if task is None:
+            return _json_error(404, "unknown task")
+        await orchestrator.cancel(task)
+        return web.json_response({"task_id": task.task_id, "cancelling": True})
+
+    # -- server -----------------------------------------------------------
+
+    async def server_status(request: web.Request) -> web.Response:
+        info = manager.info()
+        info["healthy"] = await manager.health_check()
+        return web.json_response(info)
+
+    async def server_start(request: web.Request) -> web.Response:
+        body = await _body(request)
+        path = body.get("config_path") or state.config_path
+        if not path:
+            return _json_error(400, "no config_path given and none saved")
+        try:
+            info = await manager.start(path, extra_args=list(body.get("extra_args", [])))
+        except RuntimeError as e:
+            return _json_error(409, str(e))
+        return web.json_response(info)
+
+    async def server_stop(request: web.Request) -> web.Response:
+        await manager.stop()
+        return web.json_response(manager.info())
+
+    async def server_restart(request: web.Request) -> web.Response:
+        try:
+            info = await manager.restart()
+        except RuntimeError as e:
+            return _json_error(409, str(e))
+        return web.json_response(info)
+
+    # -- metrics ----------------------------------------------------------
+
+    async def metrics(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "subscribers": state.subscriber_count,
+                "install_tasks": len(state.install_tasks),
+                "server": manager.info(),
+            }
+        )
+
+    # -- websocket log stream --------------------------------------------
+
+    async def ws_logs(request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        q = state.subscribe()
+        await ws.send_json({"type": "connected"})
+
+        async def sender() -> None:
+            while True:
+                try:
+                    event = await asyncio.wait_for(q.get(), timeout=HEARTBEAT_S)
+                    await ws.send_json({"type": "log", **event.as_dict()})
+                except asyncio.TimeoutError:
+                    await ws.send_json({"type": "heartbeat"})
+
+        send_task = asyncio.ensure_future(sender())
+        try:
+            async for msg in ws:  # drain client frames until close
+                if msg.type == WSMsgType.ERROR:
+                    break
+        finally:
+            send_task.cancel()
+            try:
+                await send_task
+            except (asyncio.CancelledError, ConnectionResetError, RuntimeError):
+                pass
+            state.unsubscribe(q)
+        return ws
+
+    app.router.add_get("/health", health)
+    v1 = "/api/v1"
+    app.router.add_post(f"{v1}/config/generate", config_generate)
+    app.router.add_get(f"{v1}/config/current", config_current)
+    app.router.add_post(f"{v1}/config/validate", config_validate)
+    app.router.add_post(f"{v1}/config/save", config_save)
+    app.router.add_get(f"{v1}/config/yaml", config_yaml)
+    app.router.add_get(f"{v1}/config/presets", config_presets)
+    app.router.add_get(f"{v1}/hardware/info", hardware_info)
+    app.router.add_get(f"{v1}/hardware/detect", hardware_detect)
+    app.router.add_post(f"{v1}/install/setup", install_setup)
+    app.router.add_get(f"{v1}/install/tasks", install_tasks)
+    app.router.add_get(f"{v1}/install/status/{{task_id}}", install_status)
+    app.router.add_post(f"{v1}/install/cancel/{{task_id}}", install_cancel)
+    app.router.add_get(f"{v1}/server/status", server_status)
+    app.router.add_post(f"{v1}/server/start", server_start)
+    app.router.add_post(f"{v1}/server/stop", server_stop)
+    app.router.add_post(f"{v1}/server/restart", server_restart)
+    app.router.add_get(f"{v1}/metrics", metrics)
+    app.router.add_get("/ws/logs", ws_logs)
+
+    # Static SPA (web wizard), if built/present.
+    web_dir = os.path.join(os.path.dirname(__file__), "web")
+    if os.path.isdir(web_dir):
+        async def index(request: web.Request) -> web.FileResponse:
+            return web.FileResponse(os.path.join(web_dir, "index.html"))
+
+        app.router.add_get("/", index)
+        app.router.add_static("/ui", web_dir)
+
+    async def _on_startup(app: web.Application) -> None:
+        state.bind_loop(asyncio.get_running_loop())
+
+    async def _on_cleanup(app: web.Application) -> None:
+        await manager.stop(force=True)
+
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+    return app

@@ -1,0 +1,123 @@
+"""Hardware detection for the control plane.
+
+Reference equivalent: per-accelerator subprocess probes (nvidia-smi, NPU
+driver checks, ``utils/env_checker.py:60-457``). On a TPU VM the authority
+is JAX itself: the platform/device-kind/count of ``jax.devices()``, read in
+a SUBPROCESS so the control plane never holds the TPU (initializing a
+backend in-process would lock the chip away from the server it spawns).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+from lumen_tpu.app.presets import detect_preset, supported_presets
+
+logger = logging.getLogger(__name__)
+
+_PROBE = r"""
+import json
+try:
+    import jax
+    devs = jax.devices()
+    print(json.dumps({
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": devs[0].device_kind if devs else "",
+        "device_count": len(devs),
+        "process_count": jax.process_count(),
+    }))
+except Exception as e:
+    print(json.dumps({"platform": "none", "device_kind": "", "device_count": 0,
+                      "process_count": 0, "error": str(e)}))
+"""
+
+
+@dataclass
+class HardwareInfo:
+    platform: str  # "tpu" | "cpu" | "none"
+    device_kind: str
+    device_count: int
+    process_count: int = 1
+    cpu_count: int = 1
+    memory_gb: float = 0.0
+    error: str | None = None
+    env: dict[str, str] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "device_count": self.device_count,
+            "process_count": self.process_count,
+            "cpu_count": self.cpu_count,
+            "memory_gb": round(self.memory_gb, 2),
+            "error": self.error,
+            "env": self.env,
+        }
+
+
+def _host_memory_gb() -> float:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal"):
+                    return int(line.split()[1]) / 1e6
+    except OSError:
+        pass
+    return 0.0
+
+
+def detect_hardware(timeout: float = 60.0) -> HardwareInfo:
+    """Probe accelerators in a subprocess; never initializes a backend in
+    the control-plane process."""
+    probe = {"platform": "none", "device_kind": "", "device_count": 0, "process_count": 0}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env={**os.environ},
+        )
+        for line in (out.stdout or "").strip().splitlines():
+            try:
+                probe = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    except (subprocess.TimeoutExpired, OSError) as e:
+        probe["error"] = str(e)
+
+    tpu_env = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(("TPU_", "JAX_", "PALLAS_")) and "KEY" not in k and "TOKEN" not in k
+    }
+    return HardwareInfo(
+        platform=probe.get("platform", "none"),
+        device_kind=probe.get("device_kind", ""),
+        device_count=int(probe.get("device_count", 0)),
+        process_count=int(probe.get("process_count", 0) or 1),
+        cpu_count=os.cpu_count() or 1,
+        memory_gb=_host_memory_gb(),
+        error=probe.get("error"),
+        env=tpu_env,
+    )
+
+
+def hardware_report(hw: HardwareInfo | None = None) -> dict:
+    """Detection + the preset recommendation the wizard shows."""
+    hw = hw or detect_hardware()
+    plat = "tpu" if hw.platform == "tpu" else "cpu"
+    best = detect_preset(plat, hw.device_count)
+    supported = supported_presets(plat, hw.device_count)
+    return {
+        "hardware": hw.as_dict(),
+        "recommended_preset": best.name,
+        "supported_presets": [p.name for p in supported],
+    }

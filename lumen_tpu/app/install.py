@@ -1,0 +1,232 @@
+"""Install orchestrator: async task machine provisioning a runtime env.
+
+Reference equivalent: ``InstallOrchestrator`` (micromamba download -> env
+create -> driver install -> wheel install -> verify,
+``lumen-app/src/lumen_app/services/install_orchestrator.py:33-819``).
+
+TPU VMs ship python+jax in the image, so the plan here is: python check ->
+[optional venv create] -> [optional pip install] -> import verify ->
+[optional model download]. Steps run as subprocesses with their output
+bridged into the app log broadcast; cancellation kills the running step and
+(matching the reference's cache-wipe semantics,
+``install_orchestrator.py:710-763``) clears the partially-populated cache
+dir when requested.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+
+logger = logging.getLogger(__name__)
+
+VERIFY_IMPORTS = ["jax", "flax", "optax", "numpy", "grpc", "lumen_tpu"]
+
+
+class StepStatus(str, Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    SKIPPED = "skipped"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class InstallStep:
+    name: str
+    status: StepStatus = StepStatus.PENDING
+    detail: str = ""
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "status": self.status.value, "detail": self.detail}
+
+
+@dataclass
+class InstallOptions:
+    venv_path: str | None = None  # None -> use the current interpreter env
+    packages: list[str] = field(default_factory=list)  # extra pip installs
+    config_path: str | None = None  # when set, download models for it
+    cache_dir: str | None = None  # wiped on cancellation (reference parity)
+    verify_imports: list[str] = field(default_factory=lambda: list(VERIFY_IMPORTS))
+
+
+@dataclass
+class InstallTask:
+    task_id: str
+    options: InstallOptions
+    steps: list[InstallStep]
+    status: StepStatus = StepStatus.PENDING
+    error: str | None = None
+    created_at: float = field(default_factory=time.time)
+    _proc: asyncio.subprocess.Process | None = None
+    _cancelled: bool = False
+
+    @property
+    def progress(self) -> int:
+        """% of steps finished (reference ``install_orchestrator.py:640-645``)."""
+        done = sum(
+            1
+            for s in self.steps
+            if s.status in (StepStatus.COMPLETED, StepStatus.SKIPPED)
+        )
+        return int(100 * done / max(len(self.steps), 1))
+
+    def as_dict(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "status": self.status.value,
+            "progress": self.progress,
+            "steps": [s.as_dict() for s in self.steps],
+            "error": self.error,
+            "created_at": self.created_at,
+        }
+
+
+class InstallOrchestrator:
+    def __init__(self, state) -> None:
+        self.state = state  # AppState (log broadcast + task store)
+
+    # -- public API -------------------------------------------------------
+
+    def create_task(self, options: InstallOptions) -> InstallTask:
+        steps = [InstallStep("check_python")]
+        if options.venv_path:
+            steps.append(InstallStep("create_venv"))
+        if options.packages:
+            steps.append(InstallStep("install_packages"))
+        steps.append(InstallStep("verify_imports"))
+        if options.config_path:
+            steps.append(InstallStep("download_models"))
+        task = InstallTask(task_id=uuid.uuid4().hex[:12], options=options, steps=steps)
+        self.state.install_tasks[task.task_id] = task
+        return task
+
+    async def run(self, task: InstallTask) -> InstallTask:
+        task.status = StepStatus.RUNNING
+        self._log(task, f"install task {task.task_id} started ({len(task.steps)} steps)")
+        try:
+            for step in task.steps:
+                if task._cancelled:
+                    raise asyncio.CancelledError
+                step.status = StepStatus.RUNNING
+                self._log(task, f"step {step.name}...")
+                await getattr(self, f"_step_{step.name}")(task, step)
+                if step.status == StepStatus.RUNNING:
+                    step.status = StepStatus.COMPLETED
+                self._log(task, f"step {step.name}: {step.status.value}")
+            task.status = StepStatus.COMPLETED
+            self._log(task, f"install task {task.task_id} completed")
+        except asyncio.CancelledError:
+            await self._handle_cancellation(task)
+        except Exception as e:  # noqa: BLE001 - recorded on the task
+            task.status = StepStatus.FAILED
+            task.error = str(e)
+            for s in task.steps:
+                if s.status == StepStatus.RUNNING:
+                    s.status = StepStatus.FAILED
+                    s.detail = str(e)
+            self._log(task, f"install task failed: {e}", level="error")
+        return task
+
+    async def cancel(self, task: InstallTask) -> None:
+        task._cancelled = True
+        if task._proc and task._proc.returncode is None:
+            task._proc.kill()
+
+    # -- steps ------------------------------------------------------------
+
+    async def _step_check_python(self, task: InstallTask, step: InstallStep) -> None:
+        v = sys.version_info
+        step.detail = f"python {v.major}.{v.minor}.{v.micro}"
+        if (v.major, v.minor) < (3, 11):
+            raise RuntimeError(f"python >= 3.11 required, found {step.detail}")
+
+    async def _step_create_venv(self, task: InstallTask, step: InstallStep) -> None:
+        path = task.options.venv_path
+        rc, out = await self._exec(task, sys.executable, "-m", "venv", "--system-site-packages", path)
+        if rc != 0:
+            raise RuntimeError(f"venv creation failed: {out[-500:]}")
+        step.detail = path
+
+    async def _step_install_packages(self, task: InstallTask, step: InstallStep) -> None:
+        python = self._env_python(task)
+        rc, out = await self._exec(task, python, "-m", "pip", "install", *task.options.packages)
+        if rc != 0:
+            raise RuntimeError(f"pip install failed: {out[-500:]}")
+        step.detail = ", ".join(task.options.packages)
+
+    async def _step_verify_imports(self, task: InstallTask, step: InstallStep) -> None:
+        """Reference ``InstallationVerifier.verify_imports`` (python -c in
+        the target env, ``utils/installation/verifier.py:11-95``)."""
+        mods = task.options.verify_imports
+        code = "import importlib,sys\n" + "\n".join(
+            f"importlib.import_module({m!r})" for m in mods
+        )
+        rc, out = await self._exec(task, self._env_python(task), "-c", code)
+        if rc != 0:
+            raise RuntimeError(f"import verification failed: {out[-500:]}")
+        step.detail = f"{len(mods)} modules importable"
+
+    async def _step_download_models(self, task: InstallTask, step: InstallStep) -> None:
+        code = (
+            "from lumen_tpu.core.config import load_config\n"
+            "from lumen_tpu.core.downloader import Downloader\n"
+            f"report = Downloader(load_config({task.options.config_path!r})).download_all()\n"
+            "import sys; sys.exit(0 if report.ok else 1)\n"
+        )
+        rc, out = await self._exec(task, self._env_python(task), "-c", code)
+        if rc != 0:
+            raise RuntimeError(f"model download failed: {out[-800:]}")
+        step.detail = "models cached"
+
+    # -- helpers ----------------------------------------------------------
+
+    def _env_python(self, task: InstallTask) -> str:
+        if task.options.venv_path:
+            return f"{task.options.venv_path}/bin/python"
+        return sys.executable
+
+    async def _exec(self, task: InstallTask, *cmd: str) -> tuple[int, str]:
+        proc = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            limit=1 << 20,  # pip/downloader can emit very long lines
+        )
+        task._proc = proc
+        chunks: list[str] = []
+        assert proc.stdout is not None
+        async for raw in proc.stdout:
+            line = raw.decode(errors="replace").rstrip()
+            chunks.append(line)
+            self._log(task, line, source="install")
+        await proc.wait()
+        task._proc = None
+        if task._cancelled:
+            raise asyncio.CancelledError
+        return proc.returncode or 0, "\n".join(chunks)
+
+    async def _handle_cancellation(self, task: InstallTask) -> None:
+        task.status = StepStatus.CANCELLED
+        for s in task.steps:
+            if s.status in (StepStatus.RUNNING, StepStatus.PENDING):
+                s.status = StepStatus.CANCELLED
+        cache = task.options.cache_dir
+        if cache:
+            # Reference semantics: cancellation wipes the partial cache
+            # (``install_orchestrator.py:710-763``).
+            await asyncio.to_thread(shutil.rmtree, cache, True)
+            self._log(task, f"cancelled; cleared cache dir {cache}")
+        else:
+            self._log(task, "cancelled")
+
+    def _log(self, task: InstallTask, message: str, level: str = "info", source: str = "install") -> None:
+        logger.log(logging.ERROR if level == "error" else logging.INFO, "[%s] %s", task.task_id, message)
+        self.state.broadcast_log(message, level=level, source=source)

@@ -1,0 +1,109 @@
+"""Hardware presets: TPU topologies instead of GPU driver stacks.
+
+Reference equivalent: ``DeviceConfig`` classmethod presets carrying
+onnx-providers + micromamba yamls (``lumen-app/src/lumen_app/services/
+config.py:41-279``) and the ``PresetRegistry`` platform-support rules
+(``utils/preset_registry.py:16-244``). Here a preset carries what a TPU
+deployment actually varies on: device platform, mesh axes, compute dtype,
+and batch size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class DevicePreset:
+    name: str
+    description: str
+    platform: str  # "tpu" | "cpu"
+    chips: int  # devices the mesh expects (0 = use all present)
+    mesh_axes: dict[str, int] = field(default_factory=lambda: {"data": -1})
+    dtype: str = "bfloat16"
+    batch_size: int = 32
+    # Service tiers this preset can comfortably run.
+    max_tier: str = "full"
+
+
+PRESETS: dict[str, DevicePreset] = {
+    p.name: p
+    for p in [
+        DevicePreset(
+            name="cpu",
+            description="CPU-only (JAX CPU backend); correctness/dev tier",
+            platform="cpu",
+            chips=0,
+            dtype="float32",
+            batch_size=4,
+            max_tier="light_weight",
+        ),
+        DevicePreset(
+            name="tpu_v5e_1",
+            description="Single v5e chip",
+            platform="tpu",
+            chips=1,
+            batch_size=32,
+        ),
+        DevicePreset(
+            name="tpu_v5e_4",
+            description="v5e-4 slice, data-parallel mesh",
+            platform="tpu",
+            chips=4,
+            mesh_axes={"data": -1},
+            batch_size=128,
+        ),
+        DevicePreset(
+            name="tpu_v5e_8",
+            description="v5e-8 slice, data-parallel mesh",
+            platform="tpu",
+            chips=8,
+            mesh_axes={"data": -1},
+            batch_size=256,
+        ),
+        DevicePreset(
+            name="tpu_v5e_16_dp_tp",
+            description="v5e-16 pod slice, 8-way data x 2-way tensor mesh",
+            platform="tpu",
+            chips=16,
+            mesh_axes={"data": -1, "model": 2},
+            batch_size=512,
+        ),
+        DevicePreset(
+            name="tpu_v6e_8",
+            description="v6e-8 slice, data-parallel mesh",
+            platform="tpu",
+            chips=8,
+            batch_size=384,
+        ),
+    ]
+}
+
+# Order presets are tried during auto-detection (most capable first).
+DETECTION_ORDER = [
+    "tpu_v5e_16_dp_tp",
+    "tpu_v6e_8",
+    "tpu_v5e_8",
+    "tpu_v5e_4",
+    "tpu_v5e_1",
+    "cpu",
+]
+
+
+def supported_presets(platform: str, device_count: int) -> list[DevicePreset]:
+    """Presets runnable on the detected hardware (reference platform-support
+    matrix, ``preset_registry.py:118-170``)."""
+    out = []
+    for name in DETECTION_ORDER:
+        p = PRESETS[name]
+        if p.platform == "cpu":
+            out.append(p)
+        elif p.platform == platform and 0 < p.chips <= device_count:
+            out.append(p)
+    return out
+
+
+def detect_preset(platform: str, device_count: int) -> DevicePreset:
+    """Best preset for the hardware; falls back to cpu."""
+    matches = supported_presets(platform, device_count)
+    return matches[0] if matches else PRESETS["cpu"]

@@ -1,0 +1,167 @@
+"""Managed gRPC server subprocess (reference ``services/server_manager.py``).
+
+Spawns ``python -m lumen_tpu.serving.server --config <path>`` (the process
+boundary of SURVEY.md §3.5), captures merged stdout/stderr into the app log
+broadcast, waits for the readiness line, health-checks over gRPC, and
+supports stop (SIGTERM -> kill) and restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import signal
+import sys
+import time
+from enum import Enum
+
+logger = logging.getLogger(__name__)
+
+# Emitted by lumen_tpu.serving.server.serve() once the port is bound.
+READY_RE = re.compile(r"serving \d+ service\(s\) on (\S+):(\d+)")
+
+
+class ServerStatus(str, Enum):
+    STOPPED = "stopped"
+    STARTING = "starting"
+    RUNNING = "running"
+    FAILED = "failed"
+
+
+class ServerManager:
+    #: generous StreamReader limit — one over-long child log line must
+    #: not kill the capture task before the readiness line is seen
+    STREAM_LIMIT = 1 << 20
+
+    def __init__(self, state, ready_timeout: float = 600.0) -> None:
+        self.state = state
+        self.ready_timeout = ready_timeout  # first jit compile can be slow
+        self.proc: asyncio.subprocess.Process | None = None
+        self.status = ServerStatus.STOPPED
+        self.port: int | None = None
+        self.config_path: str | None = None
+        self.extra_args: list[str] = []
+        self.started_at: float | None = None
+        self._ready = asyncio.Event()
+        self._capture_task: asyncio.Task | None = None
+        # Serializes start/stop/restart: two concurrent starts must not both
+        # pass the running-check and leak an unmanaged child.
+        self._lifecycle = asyncio.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self, config_path: str, extra_args: list[str] | None = None) -> dict:
+        async with self._lifecycle:
+            return await self._start_locked(config_path, extra_args)
+
+    async def _start_locked(self, config_path: str, extra_args: list[str] | None) -> dict:
+        if self.proc and self.proc.returncode is None:
+            raise RuntimeError("server already running; stop it first")
+        self._ready.clear()
+        self.status = ServerStatus.STARTING
+        self.config_path = config_path
+        self.extra_args = list(extra_args or [])
+        self.port = None
+        cmd = [sys.executable, "-m", "lumen_tpu.serving.server", "--config", config_path]
+        cmd += self.extra_args
+        self.state.broadcast_log(f"starting server: {' '.join(cmd)}", source="server")
+        self.proc = await asyncio.create_subprocess_exec(
+            *cmd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            limit=self.STREAM_LIMIT,
+        )
+        self.started_at = time.time()
+        self._capture_task = asyncio.ensure_future(self._capture_logs())
+        try:
+            await asyncio.wait_for(self._ready.wait(), timeout=self.ready_timeout)
+        except asyncio.TimeoutError:
+            self.status = ServerStatus.FAILED
+            await self._stop_locked(force=True)
+            raise RuntimeError(f"server not ready within {self.ready_timeout}s") from None
+        if self.status != ServerStatus.RUNNING:  # process died before ready
+            raise RuntimeError("server exited during startup; see logs")
+        return self.info()
+
+    async def _capture_logs(self) -> None:
+        """Readiness scan + log bridge (reference ``server_manager.py:317-382``)."""
+        assert self.proc and self.proc.stdout
+        async for raw in self.proc.stdout:
+            line = raw.decode(errors="replace").rstrip()
+            self.state.broadcast_log(line, source="server")
+            m = READY_RE.search(line)
+            if m:
+                self.port = int(m.group(2))
+                self.status = ServerStatus.RUNNING
+                self._ready.set()
+        # EOF: process exited.
+        rc = await self.proc.wait()
+        if self.status in (ServerStatus.STARTING, ServerStatus.RUNNING):
+            self.status = ServerStatus.FAILED if rc else ServerStatus.STOPPED
+        self.state.broadcast_log(f"server exited with code {rc}", source="server")
+        self._ready.set()  # unblock any waiter
+
+    async def stop(self, force: bool = False, grace: float = 10.0) -> None:
+        async with self._lifecycle:
+            await self._stop_locked(force=force, grace=grace)
+
+    async def _stop_locked(self, force: bool = False, grace: float = 10.0) -> None:
+        if not self.proc:
+            self.status = ServerStatus.STOPPED
+            return
+        if self.proc.returncode is None:
+            self.proc.send_signal(signal.SIGKILL if force else signal.SIGTERM)
+            try:
+                await asyncio.wait_for(self.proc.wait(), timeout=grace)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+                await self.proc.wait()
+        if self._capture_task:
+            await self._capture_task
+            self._capture_task = None
+        self.proc = None
+        self.port = None
+        self.status = ServerStatus.STOPPED
+
+    async def restart(self) -> dict:
+        async with self._lifecycle:
+            if not self.config_path:
+                raise RuntimeError("no previous start to restart")
+            path, args = self.config_path, list(self.extra_args)
+            await self._stop_locked()
+            return await self._start_locked(path, args)
+
+    # -- introspection ----------------------------------------------------
+
+    async def health_check(self, timeout: float = 5.0) -> bool:
+        """gRPC ``Health`` probe against the child (requires RUNNING)."""
+        if self.status != ServerStatus.RUNNING or not self.port:
+            return False
+
+        def _probe() -> bool:
+            import grpc
+            from google.protobuf import empty_pb2
+
+            from lumen_tpu.serving.proto import ml_service_pb2_grpc
+
+            with grpc.insecure_channel(f"127.0.0.1:{self.port}") as chan:
+                stub = ml_service_pb2_grpc.InferenceStub(chan)
+                # Health returns Empty and signals unhealthiness via RPC
+                # status (proto contract: ml_service.proto:31).
+                stub.Health(empty_pb2.Empty(), timeout=timeout)
+                return True
+
+        try:
+            return await asyncio.to_thread(_probe)
+        except Exception:  # noqa: BLE001 - any RPC failure is "unhealthy"
+            return False
+
+    def info(self) -> dict:
+        return {
+            "status": self.status.value,
+            "pid": self.proc.pid if self.proc and self.proc.returncode is None else None,
+            "port": self.port,
+            "config_path": self.config_path,
+            "uptime_s": round(time.time() - self.started_at, 1) if self.started_at and self.status == ServerStatus.RUNNING else None,
+        }

@@ -1,0 +1,330 @@
+"""Control-plane app tests: REST surface, WS log stream, install task
+machine, server manager lifecycle. Runs fully offline — the managed-server
+test uses the echo service so no model weights or TPU are needed.
+
+pytest-asyncio isn't in the image, so each test drives its own event loop
+via a small ``run_async`` helper around aiohttp's TestServer/TestClient.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+import yaml
+
+from lumen_tpu.app.api import STATE_KEY, build_app
+from lumen_tpu.app.install import InstallOptions, InstallOrchestrator, StepStatus
+from lumen_tpu.app.presets import PRESETS, detect_preset, supported_presets
+from lumen_tpu.app.state import AppState
+
+
+def run_async(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+async def make_client(app):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def with_client(fn):
+    """Run ``fn(client)`` against a fresh app; closes everything after."""
+
+    async def runner():
+        client = await make_client(build_app())
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return run_async(runner())
+
+
+class TestPresets:
+    def test_detect_tpu(self):
+        assert detect_preset("tpu", 8).name in ("tpu_v5e_8", "tpu_v6e_8")
+        assert detect_preset("tpu", 16).name == "tpu_v5e_16_dp_tp"
+        assert detect_preset("tpu", 1).name == "tpu_v5e_1"
+        assert detect_preset("cpu", 0).name == "cpu"
+
+    def test_supported_contains_cpu_always(self):
+        for plat, n in [("tpu", 4), ("cpu", 0)]:
+            names = [p.name for p in supported_presets(plat, n)]
+            assert "cpu" in names
+
+    def test_presets_have_valid_mesh(self):
+        for p in PRESETS.values():
+            assert sum(1 for v in p.mesh_axes.values() if v == -1) <= 1
+
+
+class TestConfigApi:
+    def test_generate_validate_yaml_roundtrip(self):
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/config/generate",
+                json={"preset": "tpu_v5e_8", "tier": "full", "region": "other"},
+            )
+            assert r.status == 200
+            cfg = await r.json()
+            assert set(cfg["services"]) == {"clip", "face", "ocr", "vlm"}
+            assert cfg["services"]["clip"]["backend_settings"]["dtype"] == "bfloat16"
+
+            r = await client.get("/api/v1/config/current")
+            assert r.status == 200
+
+            r = await client.get("/api/v1/config/yaml")
+            text = await r.text()
+            parsed = yaml.safe_load(text)
+            assert parsed["deployment"]["mode"] == "hub"
+
+            r = await client.post("/api/v1/config/validate", json={"config": parsed})
+            assert (await r.json())["valid"] is True
+            return True
+
+        assert with_client(fn)
+
+    def test_generate_rejects_bad_preset_and_tier(self):
+        async def fn(client):
+            r = await client.post("/api/v1/config/generate", json={"preset": "nope"})
+            assert r.status == 400
+            # cpu preset is capped below the full tier
+            r = await client.post(
+                "/api/v1/config/generate", json={"preset": "cpu", "tier": "full"}
+            )
+            assert r.status == 400
+            return True
+
+        assert with_client(fn)
+
+    def test_current_404_before_generate(self):
+        async def fn(client):
+            r = await client.get("/api/v1/config/current")
+            assert r.status == 404
+            return True
+
+        assert with_client(fn)
+
+    def test_region_cn_selects_cn_clip(self):
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/config/generate",
+                json={"preset": "tpu_v5e_4", "tier": "light_weight", "region": "cn"},
+            )
+            cfg = await r.json()
+            assert "CN-CLIP" in cfg["services"]["clip"]["models"]["clip"]["model"]
+            return True
+
+        assert with_client(fn)
+
+    def test_presets_endpoint(self):
+        async def fn(client):
+            r = await client.get("/api/v1/config/presets")
+            data = await r.json()
+            assert "tpu_v5e_8" in data["presets"]
+            assert data["tiers"] == ["minimal", "light_weight", "full"]
+            return True
+
+        assert with_client(fn)
+
+    def test_save_writes_yaml(self, tmp_path):
+        async def fn(client):
+            await client.post("/api/v1/config/generate", json={"preset": "cpu"})
+            path = str(tmp_path / "cfg.yaml")
+            r = await client.post("/api/v1/config/save", json={"path": path})
+            assert r.status == 200
+            assert os.path.exists(path)
+            from lumen_tpu.core.config import load_config
+
+            cfg = load_config(path)
+            assert "ocr" in cfg.services
+            return True
+
+        assert with_client(fn)
+
+
+class TestHardwareApi:
+    def test_detect_reports_preset(self):
+        async def fn(client):
+            r = await client.get("/api/v1/hardware/detect")
+            data = await r.json()
+            assert "recommended_preset" in data
+            assert data["recommended_preset"] in PRESETS
+            assert data["hardware"]["cpu_count"] >= 1
+            return True
+
+        assert with_client(fn)
+
+
+class TestInstallOrchestrator:
+    def test_full_run_offline(self):
+        async def fn():
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
+            task = orch.create_task(InstallOptions(verify_imports=["json", "os"]))
+            await orch.run(task)
+            assert task.status == StepStatus.COMPLETED
+            assert task.progress == 100
+            names = [s.name for s in task.steps]
+            assert names == ["check_python", "verify_imports"]
+            return True
+
+        assert run_async(fn())
+
+    def test_failed_import_marks_task_failed(self):
+        async def fn():
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
+            task = orch.create_task(
+                InstallOptions(verify_imports=["definitely_not_a_module_xyz"])
+            )
+            await orch.run(task)
+            assert task.status == StepStatus.FAILED
+            assert task.error
+            return True
+
+        assert run_async(fn())
+
+    def test_cancel_clears_cache_dir(self, tmp_path):
+        async def fn():
+            cache = tmp_path / "cache"
+            cache.mkdir()
+            (cache / "partial.bin").write_bytes(b"x")
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
+            # A pip step that would block forever; cancel it right away.
+            task = orch.create_task(
+                InstallOptions(cache_dir=str(cache), verify_imports=["time"])
+            )
+            task._cancelled = True
+            await orch.run(task)
+            assert task.status == StepStatus.CANCELLED
+            assert not cache.exists()
+            return True
+
+        assert run_async(fn())
+
+    def test_install_api_roundtrip(self):
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/install/setup", json={"packages": []}
+            )
+            assert r.status == 202
+            task_id = (await r.json())["task_id"]
+            for _ in range(100):
+                r = await client.get(f"/api/v1/install/status/{task_id}")
+                data = await r.json()
+                if data["status"] in ("completed", "failed"):
+                    break
+                await asyncio.sleep(0.1)
+            assert data["status"] == "completed"
+            r = await client.get("/api/v1/install/tasks")
+            assert len((await r.json())["tasks"]) == 1
+            return True
+
+        assert with_client(fn)
+
+
+def make_echo_config(tmp_path) -> str:
+    cfg = {
+        "metadata": {"version": "1.0.0", "region": "other", "cache_dir": str(tmp_path)},
+        "deployment": {"mode": "hub", "services": ["echo"]},
+        "server": {"port": 50999, "host": "127.0.0.1"},
+        "services": {
+            "echo": {
+                "enabled": True,
+                "package": "lumen_tpu.serving",
+                "import_info": {
+                    "registry_class": "lumen_tpu.serving.echo.EchoService"
+                },
+                "models": {"echo": {"model": "echo", "runtime": "jax"}},
+            }
+        },
+    }
+    path = tmp_path / "echo.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+@pytest.mark.integration
+class TestServerManagerApi:
+    def test_start_status_health_stop(self, tmp_path):
+        config_path = make_echo_config(tmp_path)
+
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/server/start",
+                json={"config_path": config_path, "extra_args": ["--skip-download", "--port", "0"]},
+            )
+            assert r.status == 200, await r.text()
+            info = await r.json()
+            assert info["status"] == "running"
+            assert info["port"]
+
+            r = await client.get("/api/v1/server/status")
+            status = await r.json()
+            assert status["healthy"] is True
+            assert status["pid"]
+
+            # double-start conflicts
+            r = await client.post(
+                "/api/v1/server/start", json={"config_path": config_path}
+            )
+            assert r.status == 409
+
+            # restart reuses the original extra_args (skip-download, port 0)
+            r = await client.post("/api/v1/server/restart")
+            assert r.status == 200, await r.text()
+            assert (await r.json())["status"] == "running"
+
+            r = await client.post("/api/v1/server/stop")
+            assert (await r.json())["status"] == "stopped"
+            return True
+
+        assert with_client(fn)
+
+
+class TestWsLogs:
+    def test_connected_log_heartbeat_frames(self):
+        async def fn(client):
+            app_state = client.app[STATE_KEY]
+            ws = await client.ws_connect("/ws/logs")
+            first = json.loads((await ws.receive()).data)
+            assert first["type"] == "connected"
+            app_state.broadcast_log("hello-ws", source="test")
+            got_log = got_heartbeat = False
+            for _ in range(5):
+                msg = json.loads((await ws.receive()).data)
+                if msg["type"] == "log" and msg["message"] == "hello-ws":
+                    got_log = True
+                if msg["type"] == "heartbeat":
+                    got_heartbeat = True
+                if got_log and got_heartbeat:
+                    break
+            await ws.close()
+            assert got_log and got_heartbeat
+            return True
+
+        assert with_client(fn)
+
+    def test_unsubscribe_on_close(self):
+        async def fn(client):
+            app_state = client.app[STATE_KEY]
+            ws = await client.ws_connect("/ws/logs")
+            await ws.receive()  # connected
+            assert app_state.subscriber_count == 1
+            await ws.close()
+            for _ in range(20):
+                if app_state.subscriber_count == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert app_state.subscriber_count == 0
+            return True
+
+        assert with_client(fn)
