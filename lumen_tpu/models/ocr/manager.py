@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.model_info import dataclass_from_extra, load_model_info
-from ...ops.ctc import ctc_collapse, ctc_greedy_device, load_ctc_vocab
+from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...runtime.batcher import bucket_for
 from ...runtime.policy import get_policy
@@ -294,9 +294,12 @@ class OcrManager:
                     batch[row] = prepared[i][1]
                     widths[row] = prepared[i][2]
                 ids, conf = self._run_recognizer(self.rec_vars, batch, widths)
-                ids, conf = np.asarray(ids), np.asarray(conf)
+                # Slice off batch-bucket padding rows before the host collapse.
+                ids = np.asarray(ids)[: len(chunk)]
+                conf = np.asarray(conf)[: len(chunk)]
+                collapsed = ctc_collapse_rows(ids, conf, self.vocab)
                 for row, i in enumerate(chunk):
-                    results[i] = ctc_collapse(ids[row], conf[row], self.vocab)
+                    results[i] = collapsed[row]
         return results  # type: ignore[return-value]
 
     # -- end-to-end -------------------------------------------------------

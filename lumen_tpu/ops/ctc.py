@@ -23,6 +23,16 @@ def ctc_greedy_device(logits: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return ids, conf
 
 
+def _emitted_to_text(
+    emitted: list[tuple[int, float]], vocab: list[str]
+) -> tuple[str, float]:
+    """Shared tail of both collapse paths: out-of-vocab filter, char join,
+    mean confidence over emitted steps (1.0 if nothing emitted)."""
+    kept = [(vocab[i], c) for i, c in emitted if i < len(vocab)]
+    text = "".join(ch for ch, _ in kept)
+    return text, (float(np.mean([c for _, c in kept])) if kept else 1.0)
+
+
 def ctc_collapse(
     ids: np.ndarray,
     confs: np.ndarray,
@@ -32,17 +42,36 @@ def ctc_collapse(
     """Host collapse of one sequence: drop repeats-then-blanks, join chars,
     mean confidence over emitted steps (1.0 if nothing emitted)."""
     prev = -1
-    chars: list[str] = []
-    scores: list[float] = []
+    emitted: list[tuple[int, float]] = []
     for t, idx in enumerate(ids):
         idx = int(idx)
         if idx != blank and idx != prev:
-            if idx < len(vocab):
-                chars.append(vocab[idx])
-                scores.append(float(confs[t]))
+            emitted.append((idx, float(confs[t])))
         prev = idx
-    text = "".join(chars)
-    return text, (float(np.mean(scores)) if scores else 1.0)
+    return _emitted_to_text(emitted, vocab)
+
+
+def ctc_collapse_rows(
+    ids: np.ndarray,
+    confs: np.ndarray,
+    vocab: list[str],
+    blank: int = 0,
+) -> list[tuple[str, float]]:
+    """Collapse a [B, T] batch; native C core when available (one GIL-free
+    call for the whole batch), else the per-row python collapse above."""
+    from lumen_tpu import native
+
+    ids = np.asarray(ids)
+    confs = np.asarray(confs)
+    if native.available() and ids.ndim == 2:
+        out_ids, out_confs, counts = native.ctc_collapse_batch(ids, confs, blank)
+        results = []
+        for b in range(ids.shape[0]):
+            n = int(counts[b])
+            emitted = [(int(i), float(c)) for i, c in zip(out_ids[b, :n], out_confs[b, :n])]
+            results.append(_emitted_to_text(emitted, vocab))
+        return results
+    return [ctc_collapse(ids[b], confs[b], vocab, blank) for b in range(ids.shape[0])]
 
 
 def load_ctc_vocab(path: str, use_space_char: bool = True) -> list[str]:

@@ -69,8 +69,22 @@ def letterbox_params(h: int, w: int, target: int) -> tuple[float, int, int, int,
 
 
 def letterbox_numpy(img: np.ndarray, target: int, fill: int = 0) -> tuple[np.ndarray, float, int, int]:
-    """Host letterbox for a single decoded image [H, W, C] -> [target, target, C]."""
-    import cv2
+    """Host letterbox for a single decoded image [H, W, C] -> [target, target, C].
+
+    cv2 (SIMD resize) when present; otherwise the fused native C letterbox,
+    so the serving path also works in a no-OpenCV environment.
+    """
+    try:
+        import cv2
+    except ImportError:
+        cv2 = None
+    if cv2 is None and img.dtype == np.uint8:
+        from lumen_tpu import native
+
+        if native.available():
+            return native.letterbox_u8(img, target, fill)
+    if cv2 is None:
+        raise RuntimeError("letterbox requires cv2 or the native host-ops library")
 
     h, w = img.shape[:2]
     scale, new_h, new_w, pad_top, pad_left = letterbox_params(h, w, target)

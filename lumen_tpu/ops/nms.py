@@ -62,9 +62,17 @@ def nms_jax(
 
 
 def nms_numpy(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.4) -> np.ndarray:
-    """Host greedy NMS; returns kept indices sorted by descending score."""
+    """Host greedy NMS; returns kept indices sorted by descending score.
+
+    Delegates to the native C core when available (GIL-free, no O(N) python
+    loop); the numpy path below is the reference implementation and fallback.
+    """
     if len(boxes) == 0:
         return np.empty((0,), np.int64)
+    from lumen_tpu import native
+
+    if native.available():
+        return native.nms_f32(boxes, scores, iou_threshold)
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
     areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
     order = scores.argsort()[::-1]
