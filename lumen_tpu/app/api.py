@@ -247,6 +247,10 @@ def build_app(state: AppState | None = None) -> web.Application:
                 "subscribers": state.subscriber_count,
                 "install_tasks": len(state.install_tasks),
                 "server": manager.info(),
+                # Per-task latency histograms from the managed server's
+                # observability sidecar (None unless started with
+                # --metrics-port).
+                "inference": await manager.fetch_metrics(),
             }
         )
 

@@ -20,6 +20,8 @@ logger = logging.getLogger(__name__)
 
 # Emitted by lumen_tpu.serving.server.serve() once the port is bound.
 READY_RE = re.compile(r"serving \d+ service\(s\) on (\S+):(\d+)")
+# Emitted by the observability sidecar when --metrics-port is passed.
+METRICS_RE = re.compile(r"metrics endpoint on http://(\S+):(\d+)/metrics")
 
 
 class ServerStatus(str, Enum):
@@ -63,6 +65,7 @@ class ServerManager:
         self.config_path = config_path
         self.extra_args = list(extra_args or [])
         self.port = None
+        self.metrics_port = None
         cmd = [sys.executable, "-m", "lumen_tpu.serving.server", "--config", config_path]
         cmd += self.extra_args
         self.state.broadcast_log(f"starting server: {' '.join(cmd)}", source="server")
@@ -95,6 +98,9 @@ class ServerManager:
                 self.port = int(m.group(2))
                 self.status = ServerStatus.RUNNING
                 self._ready.set()
+            m = METRICS_RE.search(line)
+            if m:
+                self.metrics_port = int(m.group(2))
         # EOF: process exited.
         rc = await self.proc.wait()
         if self.status in (ServerStatus.STARTING, ServerStatus.RUNNING):
@@ -122,6 +128,7 @@ class ServerManager:
             self._capture_task = None
         self.proc = None
         self.port = None
+        self.metrics_port = None
         self.status = ServerStatus.STOPPED
 
     async def restart(self) -> dict:
@@ -157,11 +164,31 @@ class ServerManager:
         except Exception:  # noqa: BLE001 - any RPC failure is "unhealthy"
             return False
 
+    async def fetch_metrics(self, timeout: float = 5.0) -> dict | None:
+        """Snapshot of the child's per-task latency metrics (requires the
+        server to have been started with --metrics-port)."""
+        if self.status != ServerStatus.RUNNING or not self.metrics_port:
+            return None
+
+        def _fetch() -> dict:
+            import json
+            import urllib.request
+
+            url = f"http://127.0.0.1:{self.metrics_port}/metrics.json"
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return json.loads(r.read().decode())
+
+        try:
+            return await asyncio.to_thread(_fetch)
+        except Exception:  # noqa: BLE001 - metrics are best-effort
+            return None
+
     def info(self) -> dict:
         return {
             "status": self.status.value,
             "pid": self.proc.pid if self.proc and self.proc.returncode is None else None,
             "port": self.port,
+            "metrics_port": self.metrics_port,
             "config_path": self.config_path,
             "uptime_s": round(time.time() - self.started_at, 1) if self.started_at and self.status == ServerStatus.RUNNING else None,
         }

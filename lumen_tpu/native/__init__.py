@@ -106,9 +106,11 @@ def load() -> ctypes.CDLL | None:
                         return _lib
                     logger.info("native host-ops ABI mismatch; rebuilding")
                     _unlink_quiet(_LIB_PATH)
-                except OSError as e:
-                    # Stale/corrupt artifact (e.g. from an older toolchain):
-                    # remove it so the rebuild below gets a clean slate.
+                except (OSError, AttributeError) as e:
+                    # Stale/corrupt artifact (OSError: unloadable;
+                    # AttributeError: loadable but missing a symbol, e.g.
+                    # built from older sources): remove it so the rebuild
+                    # below gets a clean slate.
                     logger.warning("native host-ops load failed: %s", e)
                     _unlink_quiet(_LIB_PATH)
             if attempt == 0 and not _build():

@@ -75,7 +75,9 @@ def nms_numpy(boxes: np.ndarray, scores: np.ndarray, iou_threshold: float = 0.4)
         return native.nms_f32(boxes, scores, iou_threshold)
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
     areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
-    order = scores.argsort()[::-1]
+    # Stable sort so score ties break deterministically (higher index first
+    # after the reverse) and agree with the native C path's tie-break.
+    order = scores.argsort(kind="stable")[::-1]
     keep = []
     while order.size:
         i = order[0]

@@ -26,6 +26,7 @@ from typing import Iterator
 import grpc
 from google.protobuf import empty_pb2
 
+from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
 from .proto.ml_service_pb2_grpc import InferenceServicer
 from .registry import TaskRegistry
@@ -130,17 +131,21 @@ class BaseService(InferenceServicer):
         try:
             out = task.handler(payload, asm.payload_mime, asm.meta)
         except ServiceError as e:
+            metrics.count_error(asm.task)
             yield self._error(cid, e.code, str(e), e.detail)
             return
         except Exception as e:  # noqa: BLE001 - handler crash -> INTERNAL
             logger.exception("task %s failed", asm.task)
+            metrics.count_error(asm.task)
             yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
             return
 
         if isinstance(out, tuple):
             result, mime, meta = out
             meta = dict(meta)
-            meta["lat_ms"] = f"{(time.perf_counter() - t0) * 1e3:.2f}"
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            metrics.observe(asm.task, lat_ms)
+            meta["lat_ms"] = f"{lat_ms:.2f}"
             yield pb.InferResponse(
                 correlation_id=cid,
                 is_final=True,
@@ -172,18 +177,23 @@ class BaseService(InferenceServicer):
                     seq += 1
                 pending = chunk
         except ServiceError as e:
+            metrics.count_error(task_name)
             yield self._error(cid, e.code, str(e), e.detail)
             return
         except Exception as e:  # noqa: BLE001
             logger.exception("streaming task %s failed", task_name)
+            metrics.count_error(task_name)
             yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
             return
         if pending is None:
+            metrics.count_error(task_name)
             yield self._error(cid, pb.ERROR_CODE_INTERNAL, "streaming handler yielded no chunks")
             return
         result, mime, meta = pending
         meta = dict(meta)
-        meta["lat_ms"] = f"{(time.perf_counter() - t0) * 1e3:.2f}"
+        lat_ms = (time.perf_counter() - t0) * 1e3
+        metrics.observe(task_name, lat_ms)
+        meta["lat_ms"] = f"{lat_ms:.2f}"
         yield pb.InferResponse(
             correlation_id=cid,
             is_final=True,

@@ -1,0 +1,128 @@
+"""HTTP observability sidecar for the gRPC serving process.
+
+A stdlib ``http.server`` thread exposing:
+
+- ``GET  /metrics``        — Prometheus text exposition,
+- ``GET  /metrics.json``   — JSON snapshot (per-task p50/p90/p99, errors),
+- ``POST /profiler/start`` — begin a ``jax.profiler`` trace
+  (body/query ``dir=...``, default ``/tmp/lumen-tpu-trace``),
+- ``POST /profiler/stop``  — end the trace; response carries the trace dir.
+
+Fills SURVEY.md §5's gap ("Tracing/profiling: none" in the reference): the
+profiler endpoints give on-demand XLA/TPU traces viewable in TensorBoard or
+Perfetto, and the histograms come from the per-dispatch hook in
+``base_service.py``. Enabled with ``lumen-tpu --metrics-port N``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_TRACE_DIR = "/tmp/lumen-tpu-trace"
+
+
+class _ProfilerState:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.active_dir: str | None = None
+
+    def start(self, trace_dir: str) -> tuple[bool, str]:
+        import jax
+
+        with self.lock:
+            if self.active_dir:
+                return False, f"trace already running into {self.active_dir}"
+            jax.profiler.start_trace(trace_dir)
+            self.active_dir = trace_dir
+            return True, trace_dir
+
+    def stop(self) -> tuple[bool, str]:
+        import jax
+
+        with self.lock:
+            if not self.active_dir:
+                return False, "no trace running"
+            trace_dir, self.active_dir = self.active_dir, None
+            jax.profiler.stop_trace()
+            return True, trace_dir
+
+
+class MetricsServer:
+    """Threaded HTTP sidecar; ``start()`` returns the bound port."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self.host = host
+        self.port = port
+        self.profiler = _ProfilerState()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        profiler = self.profiler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A002 - silence stdlib access log
+                logger.debug("metrics: " + fmt, *args)
+
+            def _send(self, code: int, body: str, content_type: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 - stdlib API
+                path = urlparse(self.path).path
+                if path == "/metrics":
+                    self._send(200, "\n".join(metrics.prometheus_lines()) + "\n", "text/plain; version=0.0.4")
+                elif path == "/metrics.json":
+                    self._send(200, json.dumps(metrics.snapshot()))
+                elif path == "/health":
+                    self._send(200, json.dumps({"status": "ok"}))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                parsed = urlparse(self.path)
+                if parsed.path == "/profiler/start":
+                    q = parse_qs(parsed.query)
+                    trace_dir = q.get("dir", [DEFAULT_TRACE_DIR])[0]
+                    try:
+                        ok, detail = profiler.start(trace_dir)
+                    except Exception as e:  # noqa: BLE001 - report to client
+                        self._send(500, json.dumps({"error": str(e)}))
+                        return
+                    self._send(200 if ok else 409, json.dumps({"tracing": ok, "dir": detail}))
+                elif parsed.path == "/profiler/stop":
+                    try:
+                        ok, detail = profiler.stop()
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, json.dumps({"error": str(e)}))
+                        return
+                    self._send(200 if ok else 409, json.dumps({"stopped": ok, "dir": detail}))
+                else:
+                    self._send(404, json.dumps({"error": "not found"}))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+        )
+        self._thread.start()
+        logger.info("metrics endpoint on http://%s:%d/metrics", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

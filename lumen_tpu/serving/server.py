@@ -63,15 +63,24 @@ class ServerHandle:
     """A running gRPC server + its lifecycle helpers (returned by ``serve``
     for tests; the CLI blocks on ``wait``)."""
 
-    def __init__(self, server: grpc.Server, port: int, mdns: MdnsAdvertiser | None):
+    def __init__(
+        self,
+        server: grpc.Server,
+        port: int,
+        mdns: MdnsAdvertiser | None,
+        metrics_server=None,
+    ):
         self.server = server
         self.port = port
         self.mdns = mdns
+        self.metrics_server = metrics_server
         self._stopped = threading.Event()
 
     def stop(self, grace: float = 5.0) -> None:
         if self.mdns:
             self.mdns.stop()
+        if self.metrics_server:
+            self.metrics_server.stop()
         self.server.stop(grace)
         self._stopped.set()
 
@@ -79,7 +88,12 @@ class ServerHandle:
         self.server.wait_for_termination()
 
 
-def serve(config: LumenConfig, port_override: int | None = None, skip_download: bool = False) -> ServerHandle:
+def serve(
+    config: LumenConfig,
+    port_override: int | None = None,
+    skip_download: bool = False,
+    metrics_port: int | None = None,
+) -> ServerHandle:
     if not skip_download:
         ensure_models(config)
     services = build_services(config)
@@ -106,6 +120,18 @@ def serve(config: LumenConfig, port_override: int | None = None, skip_download: 
             raise SystemExit(1)
         logger.warning("port %d unavailable; bound %d instead", port, bound)
     server.start()
+
+    # Sidecar starts (and logs its endpoint) BEFORE the readiness line:
+    # supervisors treat that line as "fully up", so everything they may
+    # immediately query must already be announced. Binds loopback only —
+    # profiler control must not be reachable from the network.
+    metrics_server = None
+    if metrics_port is not None:
+        from .observability import MetricsServer
+
+        metrics_server = MetricsServer(port=metrics_port, host="127.0.0.1")
+        metrics_server.start()
+
     logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
     for name, svc in services.items():
         logger.info("  %s tasks: %s", name, svc.registry.task_names())
@@ -119,7 +145,7 @@ def serve(config: LumenConfig, port_override: int | None = None, skip_download: 
             properties={"tasks": ",".join(t for s in services.values() for t in s.registry.task_names())},
         )
         mdns.start()
-    return ServerHandle(server, bound, mdns)
+    return ServerHandle(server, bound, mdns, metrics_server)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -130,11 +156,22 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--skip-download", action="store_true", help="assume model artifacts are already cached"
     )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="expose /metrics + jax profiler control on this HTTP port (0 = auto)",
+    )
     args = parser.parse_args(argv)
 
     setup_logging(args.log_level)
     config = load_config(args.config)
-    handle = serve(config, port_override=args.port, skip_download=args.skip_download)
+    handle = serve(
+        config,
+        port_override=args.port,
+        skip_download=args.skip_download,
+        metrics_port=args.metrics_port,
+    )
 
     stop_event = threading.Event()
 

@@ -1,0 +1,130 @@
+"""Per-task latency histograms and counters.
+
+The reference's only observability is a per-request ``lat_ms`` response
+field (SURVEY.md §5 "Tracing/profiling: none"); here every dispatch also
+lands in a process-global registry with log-scale latency histograms, so
+operators get p50/p90/p99 per task without scraping response metadata.
+Snapshots are exported by the serving server's HTTP metrics endpoint
+(``lumen_tpu.serving.observability``) in JSON and Prometheus text formats.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from bisect import bisect_left
+from typing import Iterator
+
+
+def _default_bounds() -> list[float]:
+    """Log-spaced latency bucket upper bounds in ms: 0.1ms .. ~100s."""
+    return [0.1 * (10 ** (i / 6)) for i in range(37)]  # x10 every 6 buckets
+
+
+class LatencyHistogram:
+    """Thread-safe fixed-bucket histogram (ms)."""
+
+    def __init__(self, bounds: list[float] | None = None):
+        self.bounds = bounds if bounds is not None else _default_bounds()
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 overflow bucket
+        self.total = 0
+        self.sum_ms = 0.0
+        self.min_ms = math.inf
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, ms: float) -> None:
+        idx = bisect_left(self.bounds, ms)
+        with self._lock:
+            self.counts[idx] += 1
+            self.total += 1
+            self.sum_ms += ms
+            self.min_ms = min(self.min_ms, ms)
+            self.max_ms = max(self.max_ms, ms)
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile (bucket upper bound); 0.0 when empty."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            rank = q * self.total
+            seen = 0
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= rank:
+                    return self.bounds[i] if i < len(self.bounds) else self.max_ms
+            return self.max_ms
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total, s = self.total, self.sum_ms
+            mn = 0.0 if math.isinf(self.min_ms) else self.min_ms
+            mx = self.max_ms
+        return {
+            "count": total,
+            "mean_ms": round(s / total, 3) if total else 0.0,
+            "min_ms": round(mn, 3),
+            "max_ms": round(mx, 3),
+            "p50_ms": round(self.percentile(0.50), 3),
+            "p90_ms": round(self.percentile(0.90), 3),
+            "p99_ms": round(self.percentile(0.99), 3),
+        }
+
+
+class MetricsRegistry:
+    """Task name -> latency histogram + ok/error counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hist: dict[str, LatencyHistogram] = {}
+        self._errors: dict[str, int] = {}
+        self.started_at = time.time()
+
+    def observe(self, task: str, ms: float) -> None:
+        hist = self._hist.get(task)
+        if hist is None:
+            with self._lock:
+                hist = self._hist.setdefault(task, LatencyHistogram())
+        hist.observe(ms)
+
+    def count_error(self, task: str) -> None:
+        with self._lock:
+            self._errors[task] = self._errors.get(task, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hists = dict(self._hist)
+            errors = dict(self._errors)
+        return {
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "tasks": {
+                name: {**h.snapshot(), "errors": errors.get(name, 0)}
+                for name, h in sorted(hists.items())
+            },
+            "errors": {
+                name: n for name, n in sorted(errors.items()) if name not in hists
+            },
+        }
+
+    def prometheus_lines(self) -> Iterator[str]:
+        """Prometheus text exposition of the same data."""
+        snap = self.snapshot()
+        yield "# TYPE lumen_task_requests_total counter"
+        for name, s in snap["tasks"].items():
+            yield f'lumen_task_requests_total{{task="{name}"}} {s["count"]}'
+        yield "# TYPE lumen_task_errors_total counter"
+        for name, s in snap["tasks"].items():
+            yield f'lumen_task_errors_total{{task="{name}"}} {s["errors"]}'
+        for name, n in snap["errors"].items():
+            yield f'lumen_task_errors_total{{task="{name}"}} {n}'
+        yield "# TYPE lumen_task_latency_ms summary"
+        for name, s in snap["tasks"].items():
+            for q, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"), ("0.99", "p99_ms")):
+                yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
+            yield f'lumen_task_latency_ms_sum{{task="{name}"}} {round(s["mean_ms"] * s["count"], 3)}'
+            yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
+
+
+#: process-global registry used by the serving layer
+metrics = MetricsRegistry()

@@ -260,7 +260,10 @@ class TestServerManagerApi:
         async def fn(client):
             r = await client.post(
                 "/api/v1/server/start",
-                json={"config_path": config_path, "extra_args": ["--skip-download", "--port", "0"]},
+                json={
+                    "config_path": config_path,
+                    "extra_args": ["--skip-download", "--port", "0", "--metrics-port", "0"],
+                },
             )
             assert r.status == 200, await r.text()
             info = await r.json()
@@ -277,6 +280,27 @@ class TestServerManagerApi:
                 "/api/v1/server/start", json={"config_path": config_path}
             )
             assert r.status == 409
+
+            # inference metrics flow: run one echo Infer against the managed
+            # server, then read its latency histogram through the app
+            import grpc
+
+            from lumen_tpu.serving.proto import ml_service_pb2 as pb
+            from lumen_tpu.serving.proto import ml_service_pb2_grpc
+
+            def infer_once(port):
+                with grpc.insecure_channel(f"127.0.0.1:{port}") as chan:
+                    stub = ml_service_pb2_grpc.InferenceStub(chan)
+                    req = pb.InferRequest(correlation_id="m1", task="echo", payload=b"hi")
+                    return list(stub.Infer(iter([req]), timeout=30))
+
+            responses = await asyncio.to_thread(infer_once, info["port"])
+            assert responses and responses[-1].is_final
+
+            r = await client.get("/api/v1/metrics")
+            m = await r.json()
+            assert m["server"]["metrics_port"]
+            assert m["inference"]["tasks"]["echo"]["count"] >= 1
 
             # restart reuses the original extra_args (skip-download, port 0)
             r = await client.post("/api/v1/server/restart")

@@ -1,0 +1,141 @@
+"""Observability tests: latency histograms, the metrics registry, the
+dispatch hook in BaseService, and the HTTP metrics/profiler sidecar."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from lumen_tpu.serving.observability import MetricsServer
+from lumen_tpu.utils.metrics import LatencyHistogram, MetricsRegistry
+
+
+class TestLatencyHistogram:
+    def test_empty(self):
+        h = LatencyHistogram()
+        s = h.snapshot()
+        assert s["count"] == 0 and s["p50_ms"] == 0.0
+
+    def test_percentiles_bracket_data(self):
+        h = LatencyHistogram()
+        rng = np.random.default_rng(0)
+        samples = rng.uniform(1.0, 100.0, 1000)
+        for x in samples:
+            h.observe(float(x))
+        s = h.snapshot()
+        assert s["count"] == 1000
+        # Bucketed estimate: within one log-bucket (factor 10^(1/6) ~ 1.47)
+        # on either side of the exact quantile.
+        p50 = np.percentile(samples, 50)
+        assert p50 / 1.5 <= s["p50_ms"] <= p50 * 1.5
+        assert s["p99_ms"] >= np.percentile(samples, 90)
+        # snapshot rounds to 3 decimals
+        assert s["min_ms"] == pytest.approx(samples.min(), abs=1e-3)
+        assert s["max_ms"] == pytest.approx(samples.max(), abs=1e-3)
+
+    def test_overflow_bucket(self):
+        h = LatencyHistogram(bounds=[1.0, 10.0])
+        h.observe(5000.0)
+        assert h.snapshot()["p50_ms"] == pytest.approx(5000.0)
+
+    def test_thread_safety_totals(self):
+        import threading
+
+        h = LatencyHistogram()
+
+        def worker():
+            for _ in range(1000):
+                h.observe(1.0)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert h.snapshot()["count"] == 8000
+
+
+class TestRegistry:
+    def test_observe_and_errors(self):
+        reg = MetricsRegistry()
+        reg.observe("clip_image_embed", 12.0)
+        reg.observe("clip_image_embed", 14.0)
+        reg.count_error("ocr")
+        snap = reg.snapshot()
+        assert snap["tasks"]["clip_image_embed"]["count"] == 2
+        assert snap["errors"]["ocr"] == 1
+
+    def test_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.observe("face_detect", 3.0)
+        text = "\n".join(reg.prometheus_lines())
+        assert 'lumen_task_requests_total{task="face_detect"} 1' in text
+        assert 'quantile="0.99"' in text
+
+
+class TestDispatchHook:
+    def test_infer_records_latency_and_errors(self):
+        from tests.test_serving_grpc import EchoService, one_request
+        from lumen_tpu.utils import metrics as m
+
+        svc = EchoService("echom")
+        list(svc.Infer(iter([one_request("echom_echo", b"x")]), None))
+        snap = m.metrics.snapshot()
+        assert snap["tasks"]["echom_echo"]["count"] >= 1
+        before = snap.get("errors", {}).get("echom_fail", 0)
+        list(svc.Infer(iter([one_request("echom_fail", b"x")]), None))
+        snap = m.metrics.snapshot()
+        errors = {**snap["errors"], **{k: v["errors"] for k, v in snap["tasks"].items()}}
+        assert errors.get("echom_fail", 0) == before + 1
+
+
+class TestMetricsServer:
+    @pytest.fixture()
+    def server(self):
+        srv = MetricsServer(port=0, host="127.0.0.1")
+        port = srv.start()
+        yield f"http://127.0.0.1:{port}"
+        srv.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    def _post(self, url):
+        req = urllib.request.Request(url, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_metrics_endpoints(self, server):
+        from lumen_tpu.utils.metrics import metrics
+
+        metrics.observe("http_test_task", 7.0)
+        status, body = self._get(server + "/metrics.json")
+        assert status == 200
+        assert "http_test_task" in json.loads(body)["tasks"]
+        status, text = self._get(server + "/metrics")
+        assert status == 200
+        assert "lumen_task_requests_total" in text
+
+    def test_profiler_start_stop(self, server, tmp_path):
+        status, body = self._post(server + f"/profiler/start?dir={tmp_path}")
+        assert status == 200, body
+        # double start conflicts
+        status, _ = self._post(server + f"/profiler/start?dir={tmp_path}")
+        assert status == 409
+        status, body = self._post(server + "/profiler/stop")
+        assert status == 200
+        assert json.loads(body)["dir"] == str(tmp_path)
+        # trace artifacts written
+        assert any(tmp_path.rglob("*")), "expected trace output files"
+        # double stop conflicts
+        status, _ = self._post(server + "/profiler/stop")
+        assert status == 409
+
+    def test_unknown_routes(self, server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError):
+            self._get(server + "/nope")
