@@ -4,8 +4,8 @@ A stdlib ``http.server`` thread exposing:
 
 - ``GET  /metrics``        — Prometheus text exposition,
 - ``GET  /metrics.json``   — JSON snapshot (per-task p50/p90/p99, errors),
-- ``POST /profiler/start`` — begin a ``jax.profiler`` trace
-  (body/query ``dir=...``, default ``/tmp/lumen-tpu-trace``),
+- ``POST /profiler/start`` — begin a ``jax.profiler`` trace (query
+  parameter ``dir=...``, default ``/tmp/lumen-tpu-trace``),
 - ``POST /profiler/stop``  — end the trace; response carries the trace dir.
 
 Fills SURVEY.md §5's gap ("Tracing/profiling: none" in the reference): the
@@ -50,15 +50,19 @@ class _ProfilerState:
         with self.lock:
             if not self.active_dir:
                 return False, "no trace running"
-            trace_dir, self.active_dir = self.active_dir, None
+            # Clear state only AFTER stop succeeds: a stop_trace failure
+            # must stay stoppable/observable, not wedge the profiler.
             jax.profiler.stop_trace()
+            trace_dir, self.active_dir = self.active_dir, None
             return True, trace_dir
 
 
 class MetricsServer:
     """Threaded HTTP sidecar; ``start()`` returns the bound port."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        # Loopback default on purpose: /profiler/* is unauthenticated
+        # control; exposing it beyond the host must be an explicit choice.
         self.host = host
         self.port = port
         self.profiler = _ProfilerState()

@@ -63,6 +63,7 @@ class LatencyHistogram:
             mx = self.max_ms
         return {
             "count": total,
+            "sum_ms": round(s, 3),
             "mean_ms": round(s / total, 3) if total else 0.0,
             "min_ms": round(mn, 3),
             "max_ms": round(mx, 3),
@@ -96,15 +97,19 @@ class MetricsRegistry:
         with self._lock:
             hists = dict(self._hist)
             errors = dict(self._errors)
+        tasks = {
+            name: {**h.snapshot(), "errors": errors.get(name, 0)}
+            for name, h in hists.items()
+        }
+        # Tasks that only ever failed still belong in the table (a
+        # 100%-failing task must not be invisible to consumers).
+        empty = LatencyHistogram(bounds=[]).snapshot()
+        for name, n in errors.items():
+            if name not in tasks:
+                tasks[name] = {**empty, "errors": n}
         return {
             "uptime_s": round(time.time() - self.started_at, 1),
-            "tasks": {
-                name: {**h.snapshot(), "errors": errors.get(name, 0)}
-                for name, h in sorted(hists.items())
-            },
-            "errors": {
-                name: n for name, n in sorted(errors.items()) if name not in hists
-            },
+            "tasks": dict(sorted(tasks.items())),
         }
 
     def prometheus_lines(self) -> Iterator[str]:
@@ -116,13 +121,11 @@ class MetricsRegistry:
         yield "# TYPE lumen_task_errors_total counter"
         for name, s in snap["tasks"].items():
             yield f'lumen_task_errors_total{{task="{name}"}} {s["errors"]}'
-        for name, n in snap["errors"].items():
-            yield f'lumen_task_errors_total{{task="{name}"}} {n}'
         yield "# TYPE lumen_task_latency_ms summary"
         for name, s in snap["tasks"].items():
             for q, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"), ("0.99", "p99_ms")):
                 yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
-            yield f'lumen_task_latency_ms_sum{{task="{name}"}} {round(s["mean_ms"] * s["count"], 3)}'
+            yield f'lumen_task_latency_ms_sum{{task="{name}"}} {s["sum_ms"]}'
             yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
 
 

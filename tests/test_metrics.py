@@ -62,7 +62,9 @@ class TestRegistry:
         reg.count_error("ocr")
         snap = reg.snapshot()
         assert snap["tasks"]["clip_image_embed"]["count"] == 2
-        assert snap["errors"]["ocr"] == 1
+        # error-only tasks appear in the same table with count 0
+        assert snap["tasks"]["ocr"]["errors"] == 1
+        assert snap["tasks"]["ocr"]["count"] == 0
 
     def test_prometheus_exposition(self):
         reg = MetricsRegistry()
@@ -81,11 +83,10 @@ class TestDispatchHook:
         list(svc.Infer(iter([one_request("echom_echo", b"x")]), None))
         snap = m.metrics.snapshot()
         assert snap["tasks"]["echom_echo"]["count"] >= 1
-        before = snap.get("errors", {}).get("echom_fail", 0)
+        before = snap["tasks"].get("echom_fail", {}).get("errors", 0)
         list(svc.Infer(iter([one_request("echom_fail", b"x")]), None))
         snap = m.metrics.snapshot()
-        errors = {**snap["errors"], **{k: v["errors"] for k, v in snap["tasks"].items()}}
-        assert errors.get("echom_fail", 0) == before + 1
+        assert snap["tasks"]["echom_fail"]["errors"] == before + 1
 
 
 class TestMetricsServer:
