@@ -109,6 +109,7 @@ class IngestPipeline:
         prefetch: int = 2,
         inflight: int = 2,
         workers: int | None = None,
+        annotate: Callable[[Any], dict] | None = None,
     ):
         if not stages:
             raise ValueError("need at least one stage")
@@ -124,6 +125,9 @@ class IngestPipeline:
         self.prefetch = max(prefetch, 1)
         self.inflight = max(inflight, 1)
         self.workers = workers or min(os.cpu_count() or 4, 16)
+        #: optional per-item record enrichment from the decoded value (e.g.
+        #: surfacing decode-failure markers set by a fault-tolerant decode)
+        self.annotate = annotate
         self._sharding = data_sharding(mesh)
         self.stats = IngestStats()  # stats of the most recent run()
 
@@ -225,6 +229,8 @@ class IngestPipeline:
                     record: dict[str, Any] = {"_index": index}
                     for s in self.stages:
                         record[s.name] = s.postprocess(batch.decoded[i], rows_by_stage[s.name][i])
+                    if self.annotate is not None:
+                        record.update(self.annotate(batch.decoded[i]))
                     index += 1
                     yield record
                 self.stats.post_s += time.perf_counter() - t0

@@ -34,6 +34,7 @@ class PhotoRecord:
     faces: list = field(default_factory=list)  # models.face.FaceDetection
     ocr: list = field(default_factory=list)  # models.ocr.OcrResult
     caption: str | None = None
+    error: str | None = None  # decode failure (on_decode_error="record")
 
 
 class PhotoIngestPipeline:
@@ -60,7 +61,14 @@ class PhotoIngestPipeline:
         prefetch: int = 2,
         inflight: int = 2,
         workers: int | None = None,
+        on_decode_error: str = "raise",
     ):
+        if on_decode_error not in ("raise", "record"):
+            raise ValueError("on_decode_error must be 'raise' or 'record'")
+        # "record": a corrupt/undecodable image yields a PhotoRecord with
+        # .error set instead of aborting the whole bulk run (one bad file
+        # must not kill a multi-hour library index).
+        self.on_decode_error = on_decode_error
         if clip is None and face is None and ocr is None:
             raise ValueError("need at least one of clip/face/ocr managers")
         if caption and vlm is None:
@@ -99,17 +107,25 @@ class PhotoIngestPipeline:
             prefetch=prefetch,
             inflight=inflight,
             workers=workers,
+            annotate=lambda d: {"_error": d["error"]} if "error" in d else {},
         )
 
     # -- decode -----------------------------------------------------------
 
-    @staticmethod
-    def _decode(item) -> dict:
-        img = (
-            decode_image_bytes(item, color="rgb")
-            if isinstance(item, (bytes, bytearray))
-            else np.asarray(item)
-        )
+    def _decode(self, item) -> dict:
+        try:
+            img = (
+                decode_image_bytes(item, color="rgb")
+                if isinstance(item, (bytes, bytearray))
+                else np.asarray(item)
+            )
+            if img.ndim != 3 or img.shape[2] != 3:
+                raise ValueError(f"expected HWC RGB image, got shape {img.shape}")
+        except ValueError as e:
+            if self.on_decode_error == "raise":
+                raise
+            # Placeholder keeps batch shapes static; stages skip real work.
+            return {"img": np.zeros((8, 8, 3), np.uint8), "meta": {}, "error": str(e)}
         return {"img": img, "meta": {}}
 
     # -- stages -----------------------------------------------------------
@@ -127,6 +143,8 @@ class PhotoIngestPipeline:
             return mgr._encode_images(mgr.params, pixels)
 
         def postprocess(decoded: dict, vec: np.ndarray):
+            if "error" in decoded:
+                return {"embedding": None}
             vec = mgr._check_vector(vec)
             out = {"embedding": vec}
             if self.classify_top_k > 0 and mgr._label_matrix is not None:
@@ -152,6 +170,8 @@ class PhotoIngestPipeline:
             return mgr._run_detector(mgr.det_vars, images)
 
         def postprocess(decoded: dict, row):
+            if "error" in decoded:
+                return []
             boxes, kps, scores, keep = row
             scale, pad_top, pad_left, h, w = decoded["meta"]["face"]
             faces = mgr.detections_from_outputs(
@@ -185,6 +205,8 @@ class PhotoIngestPipeline:
             return mgr._run_detector(mgr.det_vars, images)
 
         def postprocess(decoded: dict, prob):
+            if "error" in decoded:
+                return []
             scale, pad_top, pad_left = decoded["meta"]["ocr"]
             img = decoded["img"]
             found = mgr.boxes_from_det_output(
@@ -204,8 +226,8 @@ class PhotoIngestPipeline:
 
     def run(self, items: Iterable[Any]) -> Iterator[PhotoRecord]:
         for raw in self.engine.run(items):
-            rec = PhotoRecord(index=raw["_index"])
-            if "clip" in raw:
+            rec = PhotoRecord(index=raw["_index"], error=raw.get("_error"))
+            if "clip" in raw and raw["clip"] is not None:
                 rec.clip_embedding = raw["clip"]["embedding"]
                 rec.labels = raw["clip"].get("labels", [])
             if "face" in raw:

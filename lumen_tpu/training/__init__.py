@@ -6,6 +6,7 @@ towers on a device mesh (DP batch sharding + Megatron TP on the transformer
 blocks), with the same checkpoint conversion used for serving.
 """
 
+from .checkpoint import TrainCheckpointer
 from .clip_trainer import ClipTrainer, TrainConfig, contrastive_loss
 
-__all__ = ["ClipTrainer", "TrainConfig", "contrastive_loss"]
+__all__ = ["ClipTrainer", "TrainCheckpointer", "TrainConfig", "contrastive_loss"]

@@ -1,0 +1,98 @@
+"""Training checkpoint/resume on orbax.
+
+The reference is inference-only — its "checkpointing" is the on-disk model
+cache (SURVEY.md §5 "Checkpoint/resume"); the training subsystem here adds
+real state checkpointing: params + optimizer state + step, async-capable,
+retention-managed, restored with the SAME shardings the trainer placed
+(orbax records and re-applies the mesh layout, so resume works across
+restarts of a multi-chip job).
+
+Multi-host: orbax coordinates all processes internally; every process must
+call save/restore collectively (do NOT gate on ``is_primary``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Save/restore ``{params, opt_state, step}`` bundles under a directory.
+
+    Thin policy wrapper over ``ocp.CheckpointManager``: keep the newest
+    ``max_to_keep`` steps, optionally keep one checkpoint every
+    ``keep_period`` steps forever.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        keep_period: int | None = None,
+        async_save: bool = True,
+    ):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            keep_period=keep_period,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, step: int, params: Any, opt_state: Any, wait: bool = False) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+        if wait:
+            self._mgr.wait_until_finished()
+
+    # -- restore ----------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, step: int | None = None, params_like: Any = None, opt_state_like: Any = None
+    ) -> tuple[int, Any, Any]:
+        """Restore (step, params, opt_state). Pass ``*_like`` abstract
+        targets (e.g. the freshly-initialized state) so arrays come back
+        with the trainer's shardings; without them orbax restores the
+        layouts recorded at save time."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+
+        def as_restore(tree):
+            return ocp.args.StandardRestore(tree) if tree is not None else ocp.args.StandardRestore()
+
+        out = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=as_restore(params_like),
+                opt_state=as_restore(opt_state_like),
+            ),
+        )
+        logger.info("restored checkpoint step %d from %s", step, self.directory)
+        return step, out["params"], out["opt_state"]
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

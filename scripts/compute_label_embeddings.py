@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Offline label-embedding precompute.
+
+Role of the reference's ``packages/lumen-clip/scripts/
+compute_bioclip_npy_embeddings.py``: given a model dir and a labels JSON
+(plain strings or BioCLIP-style ``[[taxonomy...], common]`` entries), encode
+every label with the text tower and write the matrix as ``.npy`` so servers
+skip the at-startup encode (``CLIPManager._load_label_embeddings``).
+
+Usage:
+    python scripts/compute_label_embeddings.py \
+        --model-dir ~/.lumen-tpu/models/MobileCLIP2-S2 \
+        --labels path/to/labels.json \
+        --output path/to/embeddings.npy \
+        [--template "a photo of a {}"] [--batch-size 256] [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--model-dir", required=True)
+    parser.add_argument("--labels", required=True, help="labels JSON file")
+    parser.add_argument("--output", required=True, help=".npy output path")
+    parser.add_argument("--template", default="a photo of a {}")
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from lumen_tpu.models.clip.manager import CLIPManager
+
+    with open(args.labels, encoding="utf-8") as f:
+        raw = json.load(f)
+    labels = [CLIPManager._label_text(entry) for entry in raw]
+    print(f"{len(labels)} labels loaded from {args.labels}")
+
+    mgr = CLIPManager(args.model_dir, dtype=args.dtype, batch_size=args.batch_size)
+    mgr.initialize()
+    try:
+        t0 = time.perf_counter()
+        mat = mgr._compute_label_embeddings(labels, template=args.template)
+        mat = mat / np.maximum(np.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
+        dt = time.perf_counter() - t0
+    finally:
+        mgr.close()
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)) or ".", exist_ok=True)
+    np.save(args.output, mat.astype(np.float32))
+    print(
+        f"wrote {mat.shape} fp32 embeddings to {args.output} "
+        f"({len(labels) / dt:.1f} labels/sec)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Bulk photo indexing: run a directory through the data-parallel ingest
+pipeline (CLIP embed [+classify] + face detect/embed + OCR) and write one
+JSON record per image.
+
+No reference equivalent — this is the SURVEY.md §6 north-star capability
+(full-library ingest) as a CLI.
+
+Usage:
+    python scripts/ingest.py --config lumen-config.yaml --input photos/ \
+        --output index.jsonl [--batch-size 64] [--classify-top-k 5] \
+        [--families clip,face,ocr] [--limit N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".webp", ".bmp", ".tiff"}
+
+
+def iter_images(root: str, limit: int | None):
+    n = 0
+    for dirpath, _, names in sorted(os.walk(root)):
+        for name in sorted(names):
+            if os.path.splitext(name)[1].lower() in IMAGE_EXTS:
+                yield os.path.join(dirpath, name)
+                n += 1
+                if limit and n >= limit:
+                    return
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--config", required=True, help="lumen config YAML")
+    parser.add_argument("--input", required=True, help="image file or directory")
+    parser.add_argument("--output", required=True, help="JSONL output path")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--classify-top-k", type=int, default=0)
+    parser.add_argument(
+        "--families",
+        default="clip,face,ocr",
+        help="comma list from {clip,face,ocr} (families must be in the config)",
+    )
+    parser.add_argument("--limit", type=int, default=None)
+    parser.add_argument("--embed-encoding", choices=["list", "b64"], default="b64",
+                        help="embedding serialization (b64 = little-endian fp32)")
+    parser.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                        help="force a JAX platform (e.g. cpu for a dry run)")
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from lumen_tpu.core.config import load_config
+    from lumen_tpu.pipeline import PhotoIngestPipeline
+    from lumen_tpu.runtime.mesh import build_mesh
+    from lumen_tpu.serving.server import build_services
+
+    config = load_config(args.config)
+    services = build_services(config)
+    wanted = {f.strip() for f in args.families.split(",") if f.strip()}
+    managers: dict[str, object] = {}
+    for name, svc in services.items():
+        if name not in wanted:
+            continue
+        # face/ocr services hold .manager; the CLIP service holds a
+        # .managers dict keyed by variant (clip/bioclip).
+        mgr = getattr(svc, "manager", None)
+        if mgr is None:
+            mgr = getattr(svc, "managers", {}).get("clip")
+        if mgr is not None:
+            managers[name] = mgr
+    missing = wanted - set(managers)
+    if missing:
+        print(f"config has no enabled service for: {sorted(missing)}", file=sys.stderr)
+        return 2
+
+    mesh = build_mesh()
+    pipe = PhotoIngestPipeline(
+        mesh,
+        clip=managers.get("clip"),
+        face=managers.get("face"),
+        ocr=managers.get("ocr"),
+        batch_size=args.batch_size,
+        classify_top_k=args.classify_top_k,
+        # One corrupt file must not abort a multi-hour library index; bad
+        # images come out as {"path", "error"} rows instead.
+        on_decode_error="record",
+    )
+
+    paths = list(iter_images(args.input, args.limit)) if os.path.isdir(args.input) else [args.input]
+    if not paths:
+        print("no images found", file=sys.stderr)
+        return 2
+    print(f"indexing {len(paths)} images over {mesh.devices.size} device(s)...")
+
+    def encode_vec(vec):
+        if vec is None:
+            return None
+        if args.embed_encoding == "list":
+            return [round(float(x), 6) for x in vec]
+        import numpy as np
+
+        return base64.b64encode(np.asarray(vec, "<f4").tobytes()).decode()
+
+    def payloads():
+        for p in paths:
+            try:
+                with open(p, "rb") as f:
+                    yield f.read()
+            except OSError:
+                yield b""  # undecodable -> recorded as an error row
+
+    t0 = time.perf_counter()
+    n_errors = 0
+    with open(args.output, "w", encoding="utf-8") as out:
+        for rec in pipe.run(payloads()):
+            row = {"path": paths[rec.index]}
+            if rec.error:
+                row["error"] = rec.error
+                n_errors += 1
+            if rec.clip_embedding is not None:
+                row["clip_embedding"] = encode_vec(rec.clip_embedding)
+            if rec.labels:
+                row["labels"] = [{"label": l, "score": round(s, 4)} for l, s in rec.labels]
+            if rec.faces:
+                row["faces"] = [
+                    {
+                        "bbox": [round(float(v), 2) for v in f.bbox],
+                        "confidence": round(float(f.confidence), 4),
+                        "embedding": encode_vec(f.embedding),
+                    }
+                    for f in rec.faces
+                ]
+            if rec.ocr:
+                row["ocr"] = [
+                    {
+                        "box": [[round(float(x), 1), round(float(y), 1)] for x, y in r.box],
+                        "text": r.text,
+                        "confidence": round(float(r.confidence), 4),
+                    }
+                    for r in rec.ocr
+                ]
+            out.write(json.dumps(row) + "\n")
+    dt = time.perf_counter() - t0
+    print(
+        f"done: {len(paths)} images in {dt:.1f}s "
+        f"({len(paths) / dt:.1f} images/sec, {n_errors} errors) -> {args.output}"
+    )
+    print("stage stats:", json.dumps(pipe.stats.as_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Face-detection debug overlay.
+
+Role of the reference's ``packages/lumen-face/scripts/
+visualize_detection.py``: run the detector on an image and write a copy
+with boxes, landmarks, and confidences drawn, for human inspection of
+threshold/alignment behavior.
+
+Usage:
+    python scripts/visualize_detection.py \
+        --model-dir ~/.lumen-tpu/models/buffalo_l \
+        --image photo.jpg [--output photo.det.jpg] \
+        [--conf 0.4] [--max-faces 50] [--crops-dir crops/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--model-dir", required=True)
+    parser.add_argument("--image", required=True)
+    parser.add_argument("--output", default=None, help="default: <image>.det.<ext>")
+    parser.add_argument("--conf", type=float, default=None, help="confidence threshold")
+    parser.add_argument("--max-faces", type=int, default=None)
+    parser.add_argument("--crops-dir", default=None, help="also dump aligned 112x112 crops")
+    parser.add_argument("--dtype", default="float32", choices=["bfloat16", "float32"])
+    args = parser.parse_args(argv)
+
+    import cv2
+    import numpy as np
+
+    from lumen_tpu.models.face import FaceManager
+
+    with open(args.image, "rb") as f:
+        payload = f.read()
+
+    mgr = FaceManager(args.model_dir, dtype=args.dtype)
+    mgr.initialize()
+    try:
+        from lumen_tpu.ops.image import decode_image_bytes
+
+        img = decode_image_bytes(payload, color="rgb")
+        faces = mgr.detect_faces(img, conf_threshold=args.conf, max_faces=args.max_faces)
+        canvas = cv2.cvtColor(img, cv2.COLOR_RGB2BGR)
+        for i, face in enumerate(faces):
+            x1, y1, x2, y2 = [int(round(v)) for v in face.bbox]
+            cv2.rectangle(canvas, (x1, y1), (x2, y2), (80, 220, 80), 2)
+            cv2.putText(
+                canvas,
+                f"{i}:{face.confidence:.2f}",
+                (x1, max(y1 - 6, 12)),
+                cv2.FONT_HERSHEY_SIMPLEX,
+                0.5,
+                (80, 220, 80),
+                1,
+                cv2.LINE_AA,
+            )
+            if face.landmarks is not None:
+                for lx, ly in face.landmarks:
+                    cv2.circle(canvas, (int(round(lx)), int(round(ly))), 2, (80, 120, 255), -1)
+            if args.crops_dir:
+                os.makedirs(args.crops_dir, exist_ok=True)
+                crop = mgr.align_crop(img, face.landmarks) if face.landmarks is not None else None
+                if crop is not None:
+                    cv2.imwrite(
+                        os.path.join(args.crops_dir, f"face_{i:03d}.png"),
+                        cv2.cvtColor(crop, cv2.COLOR_RGB2BGR),
+                    )
+        out = args.output
+        if out is None:
+            root, ext = os.path.splitext(args.image)
+            out = f"{root}.det{ext or '.png'}"
+        cv2.imwrite(out, canvas)
+        print(f"{len(faces)} face(s); overlay written to {out}")
+        for i, face in enumerate(faces):
+            print(f"  {i}: bbox={np.round(face.bbox, 1).tolist()} conf={face.confidence:.3f}")
+    finally:
+        mgr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -183,3 +183,20 @@ class TestPhotoIngest:
     def test_requires_a_manager(self, mesh):
         with pytest.raises(ValueError):
             PhotoIngestPipeline(mesh)
+
+    def test_corrupt_image_aborts_by_default(self, mesh, clip_mgr):
+        pipe = PhotoIngestPipeline(mesh, clip=clip_mgr, batch_size=8)
+        items = [png_bytes(seed=0), b"not an image", png_bytes(seed=1)]
+        with pytest.raises(ValueError):
+            list(pipe.run(items))
+
+    def test_corrupt_image_recorded_not_fatal(self, mesh, clip_mgr):
+        pipe = PhotoIngestPipeline(
+            mesh, clip=clip_mgr, batch_size=8, on_decode_error="record"
+        )
+        items = [png_bytes(seed=0), b"not an image", png_bytes(seed=1)]
+        records = list(pipe.run(items))
+        assert len(records) == 3
+        assert records[0].error is None and records[0].clip_embedding is not None
+        assert records[1].error and records[1].clip_embedding is None
+        assert records[2].error is None and records[2].clip_embedding is not None
