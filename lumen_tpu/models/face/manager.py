@@ -59,6 +59,10 @@ class FaceSpec:
     rec_std: float = 127.5
     rec_color: str = "rgb"  # some packs want bgr crops
     max_detections: int = 128
+    # Default detection size gate (max(face w, h) in px); stock packs set
+    # 32/1000 (reference insightface_specs min_face/max_face).
+    min_face: float = 0.0
+    max_face: float = float("inf")
 
     @classmethod
     def from_extra(cls, extra: dict | None) -> "FaceSpec":
@@ -90,7 +94,14 @@ class FaceManager:
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
         self.model_id = self.info.name
-        self.spec = FaceSpec.from_extra(self.info.extra("insightface"))
+        # Spec precedence matches the reference (``_apply_pack_overrides``,
+        # onnxrt_backend.py:266-285): known-pack overrides are applied ON TOP
+        # of the manifest's extras — for a stock pack name the pack constants
+        # win, so the same model dir behaves identically on both stacks.
+        from .packs import pack_overrides
+
+        merged_extra = {**(self.info.extra("insightface") or {}), **pack_overrides(self.info.name)}
+        self.spec = FaceSpec.from_extra(merged_extra)
         self.policy = get_policy(dtype)
         self.batch_size = batch_size
         self.max_batch_latency_ms = max_batch_latency_ms
@@ -200,8 +211,8 @@ class FaceManager:
         self,
         image: bytes | np.ndarray,
         conf_threshold: float | None = None,
-        size_min: float = 0.0,
-        size_max: float = float("inf"),
+        size_min: float | None = None,
+        size_max: float | None = None,
         max_faces: int | None = None,
     ) -> list[FaceDetection]:
         self._ensure_ready()
@@ -232,8 +243,8 @@ class FaceManager:
         pad_left: int,
         image_hw: tuple[int, int],
         conf_threshold: float | None = None,
-        size_min: float = 0.0,
-        size_max: float = float("inf"),
+        size_min: float | None = None,
+        size_max: float | None = None,
         max_faces: int | None = None,
     ) -> list[FaceDetection]:
         """Host half of detection: score/keep filtering + letterbox unmap.
@@ -241,6 +252,10 @@ class FaceManager:
         (``lumen_tpu/pipeline/photo.py``), so threshold semantics can't drift."""
         h, w = image_hw
         conf = self.spec.score_threshold if conf_threshold is None else conf_threshold
+        # Size gate defaults come from the pack spec (min_face/max_face);
+        # explicit request values still win.
+        size_min = self.spec.min_face if size_min is None else size_min
+        size_max = self.spec.max_face if size_max is None else size_max
         results: list[FaceDetection] = []
         for i in np.argsort(-scores):
             if not keep[i] or not np.isfinite(scores[i]) or scores[i] < conf:

@@ -287,3 +287,37 @@ class TestFaceServiceGrpc:
     def test_invalid_landmarks_meta(self, stub):
         resp = self._infer(stub, "face_embed", png_bytes(size=40), meta={"landmarks": "[[1,2]]"})
         assert resp.HasField("error")
+
+
+class TestPackSpecs:
+    def test_known_pack_overrides(self):
+        from lumen_tpu.models.face.packs import pack_overrides
+
+        spec = pack_overrides("buffalo_l")
+        assert spec["rec_color"] == "bgr"
+        assert spec["det_size"] == 640
+        assert spec["min_face"] == 32 and spec["max_face"] == 1000
+        assert pack_overrides("AntelopeV2")  # case-insensitive exact match
+        # Substrings must NOT match — unrelated models containing a pack
+        # name would silently inherit BGR preprocessing.
+        assert pack_overrides("waterbuffalo_small") == {}
+        assert pack_overrides("SomeOtherFaceModel") == {}
+
+    def test_pack_overrides_win_over_manifest(self):
+        """Reference parity: ``_apply_pack_overrides`` runs AFTER manifest
+        extras, so pack constants win for stock pack names."""
+        from lumen_tpu.models.face.manager import FaceSpec
+        from lumen_tpu.models.face.packs import pack_overrides
+
+        merged = {"score_threshold": 0.7, **pack_overrides("buffalo_s")}
+        spec = FaceSpec.from_extra(merged)
+        assert spec.score_threshold == 0.4  # pack wins (reference behavior)
+        assert spec.rec_color == "bgr"
+
+    def test_size_gate_defaults_from_spec(self):
+        from lumen_tpu.models.face.manager import FaceSpec
+
+        spec = FaceSpec.from_extra({"min_face": 32, "max_face": 1000})
+        assert spec.min_face == 32 and spec.max_face == 1000
+        # unknown models keep the permissive defaults
+        assert FaceSpec.from_extra(None).min_face == 0.0
