@@ -159,6 +159,8 @@ class BackendSettings(BaseModel):
     mesh: MeshConfig | None = None
     max_batch_latency_ms: float = Field(5.0, ge=0)
     batch_buckets: list[int] | None = None
+    # Compile every batch bucket at startup instead of on first request.
+    warmup: bool = False
 
 
 class ServiceConfig(BaseModel):

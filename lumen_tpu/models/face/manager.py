@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass
 
 import jax
@@ -27,7 +28,8 @@ import numpy as np
 from ...core.model_info import dataclass_from_extra, load_model_info
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...ops.nms import nms_jax
-from ...runtime.batcher import MicroBatcher
+from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
+from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
 from .convert import convert_face_checkpoint
@@ -90,6 +92,8 @@ class FaceManager:
         max_batch_latency_ms: float = 5.0,
         detector_cfg: DetectorConfig | None = None,
         embedder_cfg: IResNetConfig | None = None,
+        mesh_axes: dict[str, int] | None = None,
+        warmup: bool = False,
     ):
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
@@ -105,6 +109,8 @@ class FaceManager:
         self.policy = get_policy(dtype)
         self.batch_size = batch_size
         self.max_batch_latency_ms = max_batch_latency_ms
+        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        self.warmup = warmup
         # Architecture comes from the model dir's manifest
         # (extra_metadata.detector / .embedder), explicit args win (tests).
         self.det_cfg = detector_cfg or self._detector_cfg_from_info()
@@ -147,7 +153,9 @@ class FaceManager:
         variables["params"] = self.policy.cast_params(variables["params"])
         if "batch_stats" in variables:
             variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
-        return jax.device_put(variables)
+        from ...parallel.sharding import replicate
+
+        return replicate(variables, self.mesh)
 
     def initialize(self) -> None:
         if self._initialized:
@@ -182,20 +190,40 @@ class FaceManager:
 
         self._run_detector = run_detector
         self._run_embedder = run_embedder
+        dp = self.mesh.shape.get("data", 1)
+        det_buckets = mesh_buckets(self.batch_size, dp)
+        rec_buckets = mesh_buckets(max(self.batch_size, 16), dp)
         self._det_batcher = MicroBatcher(
-            lambda imgs, n: jax.tree_util.tree_map(
-                np.asarray, self._run_detector(self.det_vars, imgs)
+            mesh_sharded(
+                lambda imgs, n: jax.tree_util.tree_map(
+                    np.asarray, self._run_detector(self.det_vars, imgs)
+                ),
+                self.mesh,
             ),
-            max_batch=self.batch_size,
+            max_batch=det_buckets[-1],
             max_latency_ms=self.max_batch_latency_ms,
+            buckets=det_buckets,
             name="face-det",
         ).start()
         self._rec_batcher = MicroBatcher(
-            lambda crops, n: np.asarray(self._run_embedder(self.rec_vars, crops)),
-            max_batch=max(self.batch_size, 16),
+            mesh_sharded(
+                lambda crops, n: np.asarray(self._run_embedder(self.rec_vars, crops)),
+                self.mesh,
+            ),
+            max_batch=rec_buckets[-1],
             max_latency_ms=self.max_batch_latency_ms,
+            buckets=rec_buckets,
             name="face-rec",
         ).start()
+        if self.warmup:
+            t0 = time.perf_counter()
+            ds, rs = self.det_cfg.input_size, self.rec_cfg.input_size
+            warmup_batcher(self._det_batcher, lambda b: np.zeros((b, ds, ds, 3), np.uint8))
+            warmup_batcher(self._rec_batcher, lambda b: np.zeros((b, rs, rs, 3), np.uint8))
+            logger.info(
+                "face warmup: %d+%d buckets in %.1fs",
+                len(det_buckets), len(rec_buckets), time.perf_counter() - t0,
+            )
         self._initialized = True
         logger.info("face manager ready: %s (det %d, rec %d)", self.model_id, self.det_cfg.input_size, self.rec_cfg.input_size)
 

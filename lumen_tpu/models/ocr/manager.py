@@ -102,12 +102,14 @@ class OcrManager:
         batch_size: int = 8,
         det_cfg: DBNetConfig | None = None,
         rec_cfg: SVTRConfig | None = None,
+        warmup: bool = False,
     ):
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
         self.model_id = self.info.name
         self.spec = OcrSpec.from_extra(self.info.extra("ocr"))
         self.policy = get_policy(dtype)
+        self.warmup = warmup
         self.batch_size = batch_size
         self.vocab = self._load_vocab()
         self.det_cfg = det_cfg or self._det_cfg_from_info()
@@ -189,6 +191,25 @@ class OcrManager:
 
         self._run_detector = run_detector
         self._run_recognizer = run_recognizer
+        if self.warmup:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            # Compile the common shapes up front: every det bucket, plus the
+            # smallest rec width x batch bucket (the long tail of rec shapes
+            # compiles on demand).
+            for b in s.det_buckets:
+                np.asarray(self._run_detector(self.det_vars, jnp.zeros((1, b, b, 3), jnp.uint8)))
+            rw, rb = min(s.rec_width_buckets), min(s.rec_batch_buckets)
+            jax.tree_util.tree_map(
+                np.asarray,
+                self._run_recognizer(
+                    self.rec_vars,
+                    jnp.zeros((rb, self.rec_cfg.height, rw, 3), jnp.uint8),
+                    jnp.zeros((rb,), jnp.int32),
+                ),
+            )
+            logger.info("ocr warmup in %.1fs", _time.perf_counter() - t0)
         self._initialized = True
         logger.info(
             "ocr manager ready: %s (det buckets %s, rec h=%d, vocab %d)",

@@ -62,9 +62,11 @@ class VLMManager:
         max_seq: int = 2048,
         max_new_cap: int = 512,
         prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+        warmup: bool = False,
     ):
         self.model_dir = model_dir
         self.policy = get_policy(dtype)
+        self.warmup = warmup
         self.max_seq = max_seq
         self.max_new_cap = max_new_cap
         self.prefill_buckets = sorted(prefill_buckets)
@@ -180,6 +182,13 @@ class VLMManager:
         self._prepare = prepare
         self._prepare_text = prepare_text
         self._initialized = True
+        if self.warmup:
+            # Compile the dominant path up front (smallest prompt bucket:
+            # text embed + prefill + one decode step); the image-prefill
+            # variant still compiles on its first request.
+            t0 = time.perf_counter()
+            self.generate([ChatMessage(role="user", content="hi")], max_new_tokens=1)
+            logger.info("vlm warmup (text path) in %.1fs", time.perf_counter() - t0)
         logger.info(
             "VLM ready: %s layers=%d hidden=%d vision_tokens=%d",
             self.model_id,

@@ -40,6 +40,40 @@ def default_buckets(max_batch: int) -> list[int]:
     return out
 
 
+def mesh_buckets(max_batch: int, dp: int) -> list[int]:
+    """Batch-size buckets for a data-parallel mesh: every bucket must be a
+    multiple of the ``data`` axis size so the leading dim shards evenly."""
+    if dp <= 1:
+        return default_buckets(max_batch)
+    max_batch = max(max_batch, dp)
+    if max_batch % dp:
+        max_batch = ((max_batch // dp) + 1) * dp
+    return [dp * b for b in default_buckets(max_batch // dp)]
+
+
+def mesh_sharded(fn, mesh):
+    """Wrap a ``fn(batched_tree, n)`` so the stacked batch is placed with a
+    ``data``-axis sharding before the device call (serving-side DP: one
+    micro-batch spreads across all mesh devices)."""
+    from .mesh import data_sharding
+
+    sharding = data_sharding(mesh)
+
+    def wrapped(tree, n):
+        tree = jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+        return fn(tree, n)
+
+    return wrapped
+
+
+def warmup_batcher(batcher: "MicroBatcher", make_dummy: Callable[[int], Any]) -> None:
+    """Compile every bucket through the batcher's OWN callable — the same
+    code path real traffic takes, so the compile cache is guaranteed to hit
+    (a hand-rolled warmup twin could silently drift from the serving fn)."""
+    for b in batcher.buckets:
+        batcher.fn(make_dummy(b), b)
+
+
 def bucket_for(n: int, buckets: list[int]) -> int:
     for b in buckets:
         if n <= b:

@@ -162,7 +162,18 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="expose /metrics + jax profiler control on this HTTP port (0 = auto)",
     )
+    parser.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu"],
+        help="force a JAX platform (e.g. cpu for a hardware-free dry run)",
+    )
     args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     setup_logging(args.log_level)
     config = load_config(args.config)

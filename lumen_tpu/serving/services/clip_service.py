@@ -111,6 +111,7 @@ class ClipService(BaseService):
                 max_batch_latency_ms=bs.max_batch_latency_ms,
                 mesh_axes=bs.mesh.axes if bs.mesh else None,
                 classify_mode="cosine" if key == "bioclip" else "softmax",
+                warmup=bs.warmup,
             )
         svc = cls(managers)
         for mgr in managers.values():

@@ -70,6 +70,8 @@ class FaceService(BaseService):
             dtype=bs.dtype,
             batch_size=bs.batch_size,
             max_batch_latency_ms=bs.max_batch_latency_ms,
+            mesh_axes=bs.mesh.axes if bs.mesh else None,
+            warmup=bs.warmup,
         )
         manager.initialize()
         return cls(manager)

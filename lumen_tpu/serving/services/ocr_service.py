@@ -41,7 +41,9 @@ class OcrService(BaseService):
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
-        manager = OcrManager(model_dir, dtype=bs.dtype, batch_size=bs.batch_size)
+        manager = OcrManager(
+            model_dir, dtype=bs.dtype, batch_size=bs.batch_size, warmup=bs.warmup
+        )
         manager.initialize()
         return cls(manager)
 

@@ -56,7 +56,7 @@ class VlmService(BaseService):
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
-        manager = VLMManager(model_dir, dtype=bs.dtype)
+        manager = VLMManager(model_dir, dtype=bs.dtype, warmup=bs.warmup)
         manager.initialize()
         return cls(manager)
 

@@ -271,3 +271,31 @@ class TestClipServiceGrpc:
         cap = stub.GetCapabilities(empty_pb2.Empty())
         names = {t.name for t in cap.tasks}
         assert {"clip_image_embed", "clip_text_embed", "clip_classify", "clip_scene_classify"} <= names
+
+
+class TestMeshServing:
+    def test_dp_mesh_manager_with_warmup_matches_single(self, tiny_model_dir):
+        """Serving-side DP: manager on an 8-device data mesh (sharded
+        micro-batches, replicated params, warmed-up buckets) must produce
+        the same embeddings as the default manager."""
+        from lumen_tpu.models.clip import CLIPManager
+
+        mgr = CLIPManager(
+            tiny_model_dir, dtype="float32", batch_size=16,
+            mesh_axes={"data": -1}, warmup=True,
+        )
+        mgr.initialize()
+        try:
+            assert mgr.mesh.devices.size == 8
+            payload = png_bytes(seed=7)
+            vec = mgr.encode_image(payload)
+            base = CLIPManager(tiny_model_dir, dtype="float32", batch_size=4)
+            base.initialize()
+            try:
+                np.testing.assert_allclose(vec, base.encode_image(payload), atol=2e-5)
+            finally:
+                base.close()
+            tvec = mgr.encode_text("a photo")
+            np.testing.assert_allclose(np.linalg.norm(tvec), 1.0, rtol=1e-4)
+        finally:
+            mgr.close()

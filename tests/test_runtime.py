@@ -230,3 +230,33 @@ class TestWeights:
     def test_no_checkpoint_raises(self, tmp_path):
         with pytest.raises(WeightLoadError):
             load_state_dict(str(tmp_path))
+
+
+class TestMeshBatching:
+    def test_mesh_buckets_multiples(self):
+        from lumen_tpu.runtime.batcher import mesh_buckets
+
+        assert mesh_buckets(8, 1) == [1, 2, 4, 8]
+        assert mesh_buckets(32, 8) == [8, 16, 32]
+        assert mesh_buckets(8, 8) == [8]
+        # max_batch rounded up to a dp multiple
+        assert mesh_buckets(12, 8) == [8, 16]
+
+    def test_mesh_sharded_places_on_data_axis(self):
+        import jax
+        import numpy as np
+
+        from lumen_tpu.runtime.batcher import mesh_sharded
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        mesh = build_mesh({"data": -1})
+        seen = {}
+
+        def fn(x, n):
+            seen["spec"] = x.sharding.spec
+            return np.asarray(x)
+
+        wrapped = mesh_sharded(fn, mesh)
+        out = wrapped(np.zeros((8, 4), np.float32), 8)
+        assert seen["spec"][0] == "data"
+        assert out.shape == (8, 4)
