@@ -184,7 +184,10 @@ def build_app(state: AppState | None = None) -> web.Application:
             config_path=body.get("config_path") if body.get("download") else None,
             cache_dir=body.get("cache_dir"),
         )
-        task = orchestrator.create_task(options)
+        try:
+            task = orchestrator.create_task(options)
+        except OSError as e:  # unwritable/raced cache_dir is a caller error
+            return _json_error(400, f"cache_dir unusable: {e}")
         runner = asyncio.ensure_future(orchestrator.run(task))
         # Hold a strong reference: the loop only weak-refs tasks, and a
         # GC'd runner would strand the install at status=running forever.

@@ -23,6 +23,7 @@ import time
 import uuid
 from dataclasses import dataclass, field
 from enum import Enum
+from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
@@ -67,6 +68,11 @@ class InstallTask:
     created_at: float = field(default_factory=time.time)
     _proc: asyncio.subprocess.Process | None = None
     _cancelled: bool = False
+    #: resolved (expanduser'd) cache dir this install CREATED, or None when
+    #: it pre-existed / wasn't requested — cancellation may only wipe a dir
+    #: this install itself made, never a pre-existing path the
+    #: (unauthenticated) API request happened to name
+    _owned_cache_dir: Path | None = None
 
     @property
     def progress(self) -> int:
@@ -105,6 +111,16 @@ class InstallOrchestrator:
         if options.config_path:
             steps.append(InstallStep("download_models"))
         task = InstallTask(task_id=uuid.uuid4().hex[:12], options=options, steps=steps)
+        if options.cache_dir:
+            cache = Path(options.cache_dir).expanduser()
+            if not cache.exists():
+                # Create the dir NOW and stamp ownership, so the
+                # cancellation wipe has an unambiguous claim: it removes
+                # only a dir this task made (no check-then-delete window in
+                # which another process's dir could appear at the path).
+                cache.mkdir(parents=True)
+                (cache / f".lumen-install-{task.task_id}").touch()
+                task._owned_cache_dir = cache
         self.state.install_tasks[task.task_id] = task
         return task
 
@@ -133,6 +149,9 @@ class InstallOrchestrator:
                     s.status = StepStatus.FAILED
                     s.detail = str(e)
             self._log(task, f"install task failed: {e}", level="error")
+        finally:
+            if task.status != StepStatus.CANCELLED:
+                self._drop_ownership_marker(task)
         return task
 
     async def cancel(self, task: InstallTask) -> None:
@@ -218,14 +237,36 @@ class InstallOrchestrator:
         for s in task.steps:
             if s.status in (StepStatus.RUNNING, StepStatus.PENDING):
                 s.status = StepStatus.CANCELLED
-        cache = task.options.cache_dir
-        if cache:
-            # Reference semantics: cancellation wipes the partial cache
-            # (``install_orchestrator.py:710-763``).
-            await asyncio.to_thread(shutil.rmtree, cache, True)
-            self._log(task, f"cancelled; cleared cache dir {cache}")
+        owned = task._owned_cache_dir
+        # Reference semantics: cancellation wipes the partial cache
+        # (``install_orchestrator.py:710-763``) — but only a dir this
+        # install created (ownership marker stamped in create_task). A
+        # pre-existing request-supplied path must survive: the control
+        # plane is unauthenticated when bound beyond loopback, and rmtree
+        # on an arbitrary path is a deletion primitive.
+        if owned is not None and (owned / f".lumen-install-{task.task_id}").exists():
+            await asyncio.to_thread(shutil.rmtree, owned, True)
+            self._log(task, f"cancelled; cleared cache dir {owned}")
+        elif task.options.cache_dir:
+            self._log(
+                task,
+                f"cancelled; left cache dir {task.options.cache_dir} in place "
+                "(not created by this install)",
+            )
         else:
             self._log(task, "cancelled")
+
+    def _drop_ownership_marker(self, task: InstallTask) -> None:
+        """Terminal non-cancelled state: the dir stays, so remove the
+        hidden ownership marker rather than leaking it into the user's
+        model cache."""
+        if task._owned_cache_dir is not None:
+            marker = task._owned_cache_dir / f".lumen-install-{task.task_id}"
+            try:
+                marker.unlink(missing_ok=True)
+            except OSError:  # cache dir vanished underneath us — nothing to clean
+                pass
+            task._owned_cache_dir = None
 
     def _log(self, task: InstallTask, message: str, level: str = "info", source: str = "install") -> None:
         logger.log(logging.ERROR if level == "error" else logging.INFO, "[%s] %s", task.task_id, message)

@@ -42,6 +42,7 @@ class ServerManager:
         self.proc: asyncio.subprocess.Process | None = None
         self.status = ServerStatus.STOPPED
         self.port: int | None = None
+        self.metrics_port: int | None = None
         self.config_path: str | None = None
         self.extra_args: list[str] = []
         self.started_at: float | None = None

@@ -81,6 +81,22 @@ class PhotoIngestPipeline:
         # Re-place manager weights replicated over the pipeline mesh so the
         # per-request and ingest paths share ONE device copy (a second
         # replicated copy per family could evict HBM needed for activations).
+        # The managers' own micro-batchers keep sharding inputs with their
+        # OWN mesh, so the pipeline mesh must cover the identical device
+        # set/order — otherwise per-request serving after ingest hits
+        # device-assignment mismatches or silent resharding.
+        pipeline_devs = tuple(mesh.devices.flat)
+        for name, mgr in (("clip", clip), ("face", face), ("ocr", ocr), ("vlm", vlm)):
+            if mgr is None:
+                continue
+            mgr_mesh = getattr(mgr, "mesh", None)
+            if mgr_mesh is not None and tuple(mgr_mesh.devices.flat) != pipeline_devs:
+                raise ValueError(
+                    f"{name} manager mesh devices {tuple(str(d) for d in mgr_mesh.devices.flat)} "
+                    f"differ from pipeline mesh devices {tuple(str(d) for d in pipeline_devs)}; "
+                    "build the pipeline with the managers' mesh (or managers with the "
+                    "pipeline's) so both paths share one device placement"
+                )
         if clip is not None:
             clip.params = replicate(clip.params, mesh)
         if face is not None:

@@ -190,22 +190,46 @@ class TestInstallOrchestrator:
 
         assert run_async(fn())
 
-    def test_cancel_clears_cache_dir(self, tmp_path):
+    def test_cancel_clears_cache_dir_it_created(self, tmp_path):
         async def fn():
             cache = tmp_path / "cache"
-            cache.mkdir()
-            (cache / "partial.bin").write_bytes(b"x")
             state = AppState()
             state.bind_loop(asyncio.get_running_loop())
             orch = InstallOrchestrator(state)
-            # A pip step that would block forever; cancel it right away.
+            # Dir does not exist at task creation: create_task makes it and
+            # stamps ownership, so cancellation wipes the partial contents
+            # (reference semantics).
+            task = orch.create_task(
+                InstallOptions(cache_dir=str(cache), verify_imports=["time"])
+            )
+            assert cache.exists()  # created + owned by the task
+            (cache / "partial.bin").write_bytes(b"x")
+            task._cancelled = True
+            await orch.run(task)
+            assert task.status == StepStatus.CANCELLED
+            assert not cache.exists()
+            return True
+
+        assert run_async(fn())
+
+    def test_cancel_spares_preexisting_cache_dir(self, tmp_path):
+        async def fn():
+            # A request-supplied path that already existed must survive
+            # cancellation: the unauthenticated control plane must not be a
+            # delete-any-directory primitive (ADVICE r1).
+            cache = tmp_path / "precious"
+            cache.mkdir()
+            (cache / "keep.bin").write_bytes(b"x")
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
             task = orch.create_task(
                 InstallOptions(cache_dir=str(cache), verify_imports=["time"])
             )
             task._cancelled = True
             await orch.run(task)
             assert task.status == StepStatus.CANCELLED
-            assert not cache.exists()
+            assert (cache / "keep.bin").exists()
             return True
 
         assert run_async(fn())
@@ -250,6 +274,31 @@ def make_echo_config(tmp_path) -> str:
     path = tmp_path / "echo.yaml"
     path.write_text(yaml.safe_dump(cfg))
     return str(path)
+
+
+class TestServerStatusBeforeStart:
+    def test_status_and_metrics_before_any_start(self):
+        """A fresh ServerManager must answer status/metrics/stop without a
+        prior start (ADVICE r1: metrics_port was unset until first start)."""
+
+        from lumen_tpu.app.server_manager import ServerManager
+
+        info = ServerManager(AppState()).info()
+        assert info["status"] == "stopped"
+        assert info["metrics_port"] is None
+
+        async def fn(client):
+            r = await client.get("/api/v1/server/status")
+            assert r.status == 200
+            data = await r.json()
+            assert data["status"] == "stopped"
+            r = await client.get("/api/v1/metrics")
+            assert r.status == 200
+            r = await client.post("/api/v1/server/stop")
+            assert r.status == 200
+            return True
+
+        assert with_client(fn)
 
 
 @pytest.mark.integration
