@@ -20,7 +20,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.attention import attention_reference
+from ...ops.attention import attention
 
 
 @dataclass(frozen=True)
@@ -104,7 +104,7 @@ class Attention(nn.Module):
         q = dense("q_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         k = dense("k_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         v = dense("v_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
-        out = attention_reference(q, k, v, causal=causal)
+        out = attention(q, k, v, causal=causal)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, self.width)
         return nn.Dense(self.width, name="out_proj", dtype=x.dtype)(out)
 

@@ -27,7 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.attention import attention_reference
+from ...ops.attention import attention
 
 
 @dataclass(frozen=True)
@@ -169,7 +169,7 @@ class _MixBlock(nn.Module):
         q = dense("q_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         k = dense("k_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         v = dense("v_proj")(h).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
-        attn = attention_reference(q, k, v).transpose(0, 2, 1, 3).reshape(b, s, w)
+        attn = attention(q, k, v).transpose(0, 2, 1, 3).reshape(b, s, w)
         x = x + nn.Dense(w, name="out_proj", dtype=x.dtype)(attn)
         h = nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x)
         h = nn.Dense(w * 4, name="fc1", dtype=x.dtype)(h)

@@ -30,7 +30,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.attention import attention_reference, repeat_kv
+from ...ops.attention import attention, attention_cached, repeat_kv
 
 
 @dataclass(frozen=True)
@@ -225,22 +225,30 @@ class DecoderAttention(nn.Module):
                 new_v = cache["v"].at[bidx, :, off].set(v[:, :, 0].astype(cache["v"].dtype))
             cache = {"k": new_k, "v": new_v}
             keys, values = new_k.astype(x.dtype), new_v.astype(x.dtype)
-            max_seq = keys.shape[2]
-            key_slots = jnp.arange(max_seq)
-            # key live iff its slot is filled AND causally visible:
-            # slot < kv_valid_len[b] (prefill garbage beyond the true prompt
-            # length is excluded; decode overwrites those slots in order)
-            # and slot <= absolute position of the query.
-            live = key_slots[None, :] < kv_valid_len[:, None]  # [B, K]
-            causal = key_slots[None, None, :] <= positions[:, :, None]  # [B, S, K]
-            mask = (live[:, None, :] & causal)[:, None]  # [B, 1, S, K]
+            n_rep = c.heads // c.kv_heads
+            # Key slot j is visible iff filled AND causally reachable:
+            # j < kv_valid_len[b] (prefill garbage beyond the true prompt
+            # length is excluded; decode overwrites slots in order) and
+            # j <= absolute query position. ``positions`` rows are
+            # contiguous (arange-offset), so the whole mask is carried by
+            # two [B] scalars — on TPU this dispatches to the Pallas flash
+            # kernel for prefill-size queries (mask computed in-kernel,
+            # dead key blocks skipped) and plain XLA for 1-token decode.
+            out = attention_cached(
+                q,
+                repeat_kv(keys, n_rep),
+                repeat_kv(values, n_rep),
+                q_offsets=positions[:, 0],
+                kv_valid=kv_valid_len,
+            )
         else:
             keys, values = k, v
-            causal = positions[:, :, None] >= positions[:, None, :]
-            mask = causal[:, None]
+            n_rep = c.heads // c.kv_heads
+            # Cacheless forward: positions are arange rows (see
+            # ``VLMModel.__call__`` / ``merge_image_embeddings``), so the
+            # positions-pairwise mask is exactly the causal triangle.
+            out = attention(q, repeat_kv(keys, n_rep), repeat_kv(values, n_rep), causal=True)
 
-        n_rep = c.heads // c.kv_heads
-        out = attention_reference(q, repeat_kv(keys, n_rep), repeat_kv(values, n_rep), mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, c.heads * dh)
         return nn.Dense(c.hidden_size, use_bias=False, name="o_proj", dtype=x.dtype)(out), cache
 

@@ -1,6 +1,14 @@
 """Compute kernels: attention (XLA + Pallas), image ops, NMS, CTC, sampling."""
 
-from .attention import attention, attention_reference, flash_attention, repeat_kv
+from .attention import (
+    attention,
+    attention_cached,
+    attention_reference,
+    flash_attention,
+    flash_attention_cache,
+    flash_enabled,
+    repeat_kv,
+)
 from .ctc import ctc_collapse, ctc_greedy_device, load_ctc_vocab
 from .image import (
     IMAGENET_MEAN,
@@ -19,8 +27,11 @@ from .sampling import apply_repetition_penalty, greedy, sample, top_p_filter
 
 __all__ = [
     "attention",
+    "attention_cached",
     "attention_reference",
     "flash_attention",
+    "flash_attention_cache",
+    "flash_enabled",
     "repeat_kv",
     "ctc_greedy_device",
     "ctc_collapse",

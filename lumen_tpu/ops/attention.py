@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +22,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width; scratch stats are padded to this
+
+
+def _on_tpu() -> bool:
+    """True when the default backend executes on TPU hardware. The axon
+    PJRT tunnel registers the platform as ``"axon"`` (canonicalized to tpu
+    for lowering), so checking for ``"tpu"`` alone misses the real chip."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # backend init can fail; callers fall back to XLA
+        return False
+
+
+def flash_enabled() -> bool:
+    """Would :func:`attention` route an unmasked call through the Pallas
+    kernel right now? (Reported by ``bench.py`` so perf numbers record
+    which attention path produced them.)"""
+    return _flash_usable(0, None)
 
 
 def attention_reference(
@@ -195,6 +213,165 @@ def flash_attention(
     return out.reshape(b, h, sq_p, d)[:, :, :sq]
 
 
+# -- cache-aware flash attention (VLM prefill/decode path) ------------------
+#
+# Same online-softmax scheme, but masking is driven by two [B] scalar-
+# prefetch arrays instead of a static causal triangle:
+#   q_offsets[b]  absolute position of sample b's FIRST query token
+#                 (query i is at q_offsets[b] + i; positions are contiguous)
+#   kv_valid[b]   number of live key slots (prefill: prompt length;
+#                 decode: cache fill level + 1)
+# key j is visible to query i iff  j <= q_offsets[b] + i  AND  j < kv_valid[b]
+# — exactly the (live & causal) mask of the VLM cache path
+# (models/vlm/modeling.py:228-240), computed in-kernel instead of as a
+# [B, 1, S, K] bool tensor in HBM.
+
+
+def _flash_cache_kernel(
+    q_off_ref,  # [B] int32 (SMEM, prefetched)
+    kv_valid_ref,  # [B] int32 (SMEM, prefetched)
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    heads: int,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    i = pl.program_id(0)  # fused batch*heads index
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    b = i // heads
+    q_off = q_off_ref[b]
+    kv_valid = kv_valid_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Skip blocks fully above the causal diagonal or past the live slots.
+    max_k_this_q = q_off + (qi + 1) * block_q - 1  # largest visible key pos
+    block_live = (j * block_k <= max_k_this_q) & (j * block_k < kv_valid)
+
+    @pl.when(block_live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        q_abs = q_off + qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        live = (k_pos < kv_valid) & (k_pos <= q_abs)
+        s = jnp.where(live, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+)
+def flash_attention_cache(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offsets: jax.Array,
+    kv_valid: jax.Array,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention against a KV buffer with per-sample causal offsets
+    and live-slot counts (see block comment above)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    block_q_eff = min(block_q, max(sq, 16))
+    block_k_eff = min(block_k, max(sk, 16))
+    qp = _pad_to(q, 2, block_q_eff)
+    kp = _pad_to(k, 2, block_k_eff)
+    vp = _pad_to(v, 2, block_k_eff)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    num_k_blocks = sk_p // block_k_eff
+    # Padded key slots beyond sk must never win: kv_valid <= sk by contract.
+
+    kernel = functools.partial(
+        _flash_cache_kernel,
+        heads=h,
+        sm_scale=sm_scale,
+        block_q=block_q_eff,
+        block_k=block_k_eff,
+        num_k_blocks=num_k_blocks,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * h, sq_p // block_q_eff, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q_eff, d), lambda i, qi, j, *_: (i, qi, 0)),
+            pl.BlockSpec((1, block_k_eff, d), lambda i, qi, j, *_: (i, j, 0)),
+            pl.BlockSpec((1, block_k_eff, d), lambda i, qi, j, *_: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q_eff, d), lambda i, qi, j, *_: (i, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q_eff, d), jnp.float32),
+            pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
+            pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(
+        q_offsets.astype(jnp.int32),
+        kv_valid.astype(jnp.int32),
+        qp.reshape(b * h, sq_p, d),
+        kp.reshape(b * h, sk_p, d),
+        vp.reshape(b * h, sk_p, d),
+    )
+    return out.reshape(b, h, sq_p, d)[:, :, :sq]
+
+
+def _flash_usable(head_dim: int, mask) -> bool:
+    force = os.environ.get("LUMEN_FLASH")
+    if force == "0":
+        return False
+    if mask is not None or head_dim > 256:
+        return False
+    return force == "1" or _on_tpu()
+
+
+def _interpret_mode() -> bool:
+    """Pallas ``interpret=True`` when flash is forced on a non-TPU backend
+    (tests exercise the kernel path on CPU)."""
+    return not _on_tpu()
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -204,11 +381,38 @@ def attention(
     scale: float | None = None,
 ) -> jax.Array:
     """Dispatch: Pallas flash kernel on TPU for unmasked/causal attention,
-    XLA reference elsewhere (CPU tests, explicit masks)."""
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu and mask is None and q.shape[-1] <= 256:
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+    XLA reference elsewhere (CPU tests, explicit masks). ``LUMEN_FLASH=0``
+    disables the kernel; ``LUMEN_FLASH=1`` forces it (interpret mode off
+    TPU, for tests)."""
+    if _flash_usable(q.shape[-1], mask):
+        return flash_attention(q, k, v, causal=causal, scale=scale, interpret=_interpret_mode())
     return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+def attention_cached(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_offsets: jax.Array,
+    kv_valid: jax.Array,
+    scale: float | None = None,
+    min_flash_q: int = 32,
+) -> jax.Array:
+    """Cache-path dispatch: the Pallas cache kernel when profitable (prefill-
+    size query blocks on TPU), else the XLA reference with the equivalent
+    [B, 1, Sq, Sk] mask. Single-token decode stays on XLA — a [B,H,1,K]
+    product is bandwidth-bound and gains nothing from the kernel."""
+    sq, sk = q.shape[2], k.shape[2]
+    if _flash_usable(q.shape[-1], None) and sq >= min_flash_q:
+        return flash_attention_cache(
+            q, k, v, q_offsets, kv_valid, scale=scale, interpret=_interpret_mode()
+        )
+    key_slots = jnp.arange(sk)
+    q_abs = q_offsets[:, None] + jnp.arange(sq)[None, :]  # [B, Sq]
+    live = key_slots[None, :] < kv_valid[:, None]  # [B, Sk]
+    causal = key_slots[None, None, :] <= q_abs[:, :, None]  # [B, Sq, Sk]
+    mask = (live[:, None, :] & causal)[:, None]  # [B, 1, Sq, Sk]
+    return attention_reference(q, k, v, mask=mask, scale=scale)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

@@ -7,11 +7,13 @@ import numpy as np
 import pytest
 
 from lumen_tpu.ops import (
+    attention_cached,
     attention_reference,
     clip_preprocess,
     ctc_collapse,
     ctc_greedy_device,
     flash_attention,
+    flash_attention_cache,
     letterbox_numpy,
     nms_jax,
     nms_numpy,
@@ -19,6 +21,18 @@ from lumen_tpu.ops import (
     sample,
     top_p_filter,
 )
+
+
+def cache_mask_reference(q, k, v, q_offsets, kv_valid):
+    """Ground truth: the VLM cache mask built as an explicit bool tensor
+    (pre-flash semantics of ``models/vlm/modeling.py``)."""
+    sq, sk = q.shape[2], k.shape[2]
+    slots = jnp.arange(sk)
+    q_abs = q_offsets[:, None] + jnp.arange(sq)[None, :]
+    live = slots[None, :] < kv_valid[:, None]
+    causal = slots[None, None, :] <= q_abs[:, :, None]
+    mask = (live[:, None, :] & causal)[:, None]
+    return attention_reference(q, k, v, mask=mask)
 
 
 def rand_qkv(rng, b=2, h=4, sq=64, sk=64, d=32, dtype=jnp.float32):
@@ -149,6 +163,104 @@ class TestImage:
         assert out.shape == (64, 64, 3)
         assert scale == pytest.approx(64 / 200)
         assert pad_top == (64 - 32) // 2 and pad_left == 0
+
+
+class TestFlashCacheKernel:
+    """The (q_offsets, kv_valid) kernel that carries the VLM prefill/decode
+    mask as two [B] scalars instead of a [B,1,S,K] bool tensor."""
+
+    def test_prefill_matches_mask_reference(self):
+        # Prompt lengths differ per sample; queries right-padded.
+        q, k, v = rand_qkv(jax.random.PRNGKey(10), b=3, sq=48, sk=96, d=32)
+        q_off = jnp.zeros((3,), jnp.int32)
+        kv_valid = jnp.asarray([48, 17, 33], jnp.int32)
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = flash_attention_cache(
+            q, k, v, q_off, kv_valid, block_q=16, block_k=16, interpret=True
+        )
+        # Compare only live query rows (padded rows are discarded downstream).
+        for b, n in enumerate([48, 17, 33]):
+            np.testing.assert_allclose(
+                np.asarray(out[b, :, :n]), np.asarray(ref[b, :, :n]), atol=2e-5, rtol=2e-5
+            )
+
+    def test_decode_single_token_per_sample_offsets(self):
+        # One query per sample at different cache fill levels.
+        q, k, v = rand_qkv(jax.random.PRNGKey(11), b=3, sq=1, sk=64, d=32)
+        q_off = jnp.asarray([5, 20, 63], jnp.int32)
+        kv_valid = q_off + 1
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = flash_attention_cache(
+            q, k, v, q_off, kv_valid, block_q=16, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_chunked_prefill_nonzero_offset(self):
+        # Second prefill chunk: queries start at absolute position 32 and
+        # must see the 32 earlier cache slots plus their own prefix.
+        q, k, v = rand_qkv(jax.random.PRNGKey(12), b=2, sq=32, sk=64, d=32)
+        q_off = jnp.asarray([32, 32], jnp.int32)
+        kv_valid = jnp.asarray([64, 50], jnp.int32)
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = flash_attention_cache(
+            q, k, v, q_off, kv_valid, block_q=16, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_dispatcher_reference_path_matches(self):
+        # attention_cached off-TPU routes to XLA with the equivalent mask.
+        q, k, v = rand_qkv(jax.random.PRNGKey(13), b=2, sq=40, sk=64, d=32)
+        q_off = jnp.zeros((2,), jnp.int32)
+        kv_valid = jnp.asarray([40, 25], jnp.int32)
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = attention_cached(q, k, v, q_off, kv_valid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_dispatcher_forced_flash_matches(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FLASH", "1")
+        q, k, v = rand_qkv(jax.random.PRNGKey(14), b=2, sq=40, sk=64, d=32)
+        q_off = jnp.zeros((2,), jnp.int32)
+        kv_valid = jnp.asarray([40, 25], jnp.int32)
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = attention_cached(q, k, v, q_off, kv_valid)
+        for b, n in enumerate([40, 25]):
+            np.testing.assert_allclose(
+                np.asarray(out[b, :, :n]), np.asarray(ref[b, :, :n]), atol=2e-5, rtol=2e-5
+            )
+
+
+@pytest.mark.tpu
+class TestFlashOnChip:
+    """Real-TPU runs of both kernels (skipped on the CPU CI mesh; executed
+    when the suite is pointed at the chip with JAX_PLATFORMS=axon)."""
+
+    def _require_tpu(self):
+        if jax.default_backend() not in ("tpu", "axon"):
+            pytest.skip("no TPU backend")
+
+    def test_flash_matches_reference_on_tpu(self):
+        self._require_tpu()
+        q, k, v = rand_qkv(jax.random.PRNGKey(0), b=2, h=4, sq=256, sk=256, d=64, dtype=jnp.bfloat16)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+        )
+
+    def test_flash_cache_matches_reference_on_tpu(self):
+        self._require_tpu()
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), b=2, h=4, sq=128, sk=256, d=64, dtype=jnp.bfloat16)
+        q_off = jnp.zeros((2,), jnp.int32)
+        kv_valid = jnp.asarray([128, 77], jnp.int32)
+        ref = cache_mask_reference(q, k, v, q_off, kv_valid)
+        out = flash_attention_cache(q, k, v, q_off, kv_valid)
+        for b, n in enumerate([128, 77]):
+            np.testing.assert_allclose(
+                np.asarray(out[b, :, :n], np.float32),
+                np.asarray(ref[b, :, :n], np.float32),
+                atol=3e-2,
+                rtol=3e-2,
+            )
 
 
 class TestAttentionEdgeCases:
