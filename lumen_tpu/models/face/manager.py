@@ -94,6 +94,7 @@ class FaceManager:
         embedder_cfg: IResNetConfig | None = None,
         mesh_axes: dict[str, int] | None = None,
         warmup: bool = False,
+        allow_random_init: bool = False,
     ):
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
@@ -117,6 +118,7 @@ class FaceManager:
         self.rec_cfg = embedder_cfg or self._embedder_cfg_from_info()
         self.detector = FaceDetector(self.det_cfg)
         self.embedder = IResNet(self.rec_cfg)
+        self.allow_random_init = allow_random_init
         self._initialized = False
 
     def _detector_cfg_from_info(self) -> DetectorConfig:
@@ -146,10 +148,17 @@ class FaceManager:
                 final_hw = self.rec_cfg.input_size // 16
                 kw = {"final_c": self.rec_cfg.width * 8, "final_hw": final_hw}
             variables = convert_face_checkpoint(state, kind, **kw)
-        else:
-            logger.warning("%s missing in %s; using random init (tests only)", filename, self.model_dir)
+        elif self.allow_random_init:
+            logger.warning("%s missing in %s; RANDOM INIT (allow_random_init=True, tests only)", filename, self.model_dir)
             variables = module.init(jax.random.PRNGKey(0), jnp.zeros(example_shape, jnp.float32))
             variables = dict(variables)
+        else:
+            # A missing checkpoint must hard-fail: serving random weights
+            # returns confident garbage with HTTP 200s (round-1 verdict).
+            raise FileNotFoundError(
+                f"no {kind} weights in {self.model_dir}: expected {filename} "
+                f"or a {kind} .onnx graph; pass allow_random_init=True only in tests"
+            )
         variables["params"] = self.policy.cast_params(variables["params"])
         if "batch_stats" in variables:
             variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
@@ -161,32 +170,73 @@ class FaceManager:
         if self._initialized:
             return
         s = self.spec
-        det_shape = (1, self.det_cfg.input_size, self.det_cfg.input_size, 3)
-        rec_shape = (1, self.rec_cfg.input_size, self.rec_cfg.input_size, 3)
-        self.det_vars = self._load_variables("detection.safetensors", self.detector, det_shape, "detection")
-        self.rec_vars = self._load_variables("recognition.safetensors", self.embedder, rec_shape, "recognition")
         compute = self.policy.compute_dtype
         det_cfg = self.det_cfg
+        from ...parallel.sharding import replicate
+        from .graph import ArcFaceGraph, ScrfdGraph, find_onnx_models
 
-        @jax.jit
-        def run_detector(variables, images_u8):
-            x = (images_u8.astype(jnp.float32) - s.det_mean) / s.det_std
-            outs = self.detector.apply(variables, x.astype(compute))
-            boxes, kps, scores = decode_detections(
-                outs, det_cfg.input_size, det_cfg.num_anchors, max_detections=s.max_detections
-            )
-            # NMS over the full top-k candidate set; the confidence cut
-            # happens host-side so a per-request conf_threshold below the
-            # pack default still widens the result (NMS processes in score
-            # order, so low-score candidates never suppress higher ones).
-            keep = jax.vmap(lambda b, sc: nms_jax(b, sc, s.nms_threshold))(boxes, scores)
-            return boxes, kps, scores, keep
+        onnx_models = find_onnx_models(self.model_dir)
 
-        @jax.jit
-        def run_embedder(variables, crops_u8):
-            x = (crops_u8.astype(jnp.float32) - s.rec_mean) / s.rec_std
-            emb = self.embedder.apply(variables, x.astype(compute)).astype(jnp.float32)
-            return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+        if "detection" in onnx_models:
+            # Real InsightFace pack: run the actual SCRFD graph via the
+            # ONNX->JAX bridge (reference runs the same file through
+            # onnxruntime, ``onnxrt_backend.py:485-745``).
+            graph_det = ScrfdGraph.from_path(onnx_models["detection"], num_anchors=det_cfg.num_anchors)
+            self.det_vars = replicate(dict(graph_det.module.params), self.mesh)
+            logger.info("face detector: SCRFD graph %s (%d MB params)", onnx_models["detection"], graph_det.module.param_bytes() >> 20)
+
+            @jax.jit
+            def run_detector(variables, images_u8):
+                x = (images_u8.astype(jnp.float32) - s.det_mean) / s.det_std
+                outs = graph_det(variables, x.transpose(0, 3, 1, 2))
+                boxes, kps, scores = decode_detections(
+                    outs,
+                    det_cfg.input_size,
+                    det_cfg.num_anchors,
+                    max_detections=s.max_detections,
+                    scores_are_logits=False,  # SCRFD graphs end in Sigmoid
+                )
+                keep = jax.vmap(lambda b, sc: nms_jax(b, sc, s.nms_threshold))(boxes, scores)
+                return boxes, kps, scores, keep
+
+        else:
+            det_shape = (1, det_cfg.input_size, det_cfg.input_size, 3)
+            self.det_vars = self._load_variables("detection.safetensors", self.detector, det_shape, "detection")
+
+            @jax.jit
+            def run_detector(variables, images_u8):
+                x = (images_u8.astype(jnp.float32) - s.det_mean) / s.det_std
+                outs = self.detector.apply(variables, x.astype(compute))
+                boxes, kps, scores = decode_detections(
+                    outs, det_cfg.input_size, det_cfg.num_anchors, max_detections=s.max_detections
+                )
+                # NMS over the full top-k candidate set; the confidence cut
+                # happens host-side so a per-request conf_threshold below the
+                # pack default still widens the result (NMS processes in score
+                # order, so low-score candidates never suppress higher ones).
+                keep = jax.vmap(lambda b, sc: nms_jax(b, sc, s.nms_threshold))(boxes, scores)
+                return boxes, kps, scores, keep
+
+        if "recognition" in onnx_models:
+            graph_rec = ArcFaceGraph.from_path(onnx_models["recognition"])
+            self.rec_vars = replicate(dict(graph_rec.module.params), self.mesh)
+            logger.info("face embedder: ArcFace graph %s", onnx_models["recognition"])
+
+            @jax.jit
+            def run_embedder(variables, crops_u8):
+                x = (crops_u8.astype(jnp.float32) - s.rec_mean) / s.rec_std
+                emb = graph_rec(variables, x.transpose(0, 3, 1, 2)).astype(jnp.float32)
+                return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+
+        else:
+            rec_shape = (1, self.rec_cfg.input_size, self.rec_cfg.input_size, 3)
+            self.rec_vars = self._load_variables("recognition.safetensors", self.embedder, rec_shape, "recognition")
+
+            @jax.jit
+            def run_embedder(variables, crops_u8):
+                x = (crops_u8.astype(jnp.float32) - s.rec_mean) / s.rec_std
+                emb = self.embedder.apply(variables, x.astype(compute)).astype(jnp.float32)
+                return emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
 
         self._run_detector = run_detector
         self._run_embedder = run_embedder

@@ -270,12 +270,18 @@ def decode_detections(
     num_anchors: int,
     stride_scale_distances: bool = True,
     max_detections: int = 128,
+    scores_are_logits: bool = True,
 ):
     """Decode all strides to a fixed-size candidate set (jit-safe).
 
     Returns (boxes [B, N, 4], kps [B, N, K, 2], scores [B, N]) where N =
     ``max_detections``, selected by top-score across all strides; invalid
     slots carry score -inf. NMS runs afterwards (``ops.nms.nms_jax``).
+
+    ``scores_are_logits``: the Flax detector head emits raw logits; real
+    SCRFD ONNX graphs end in a Sigmoid, so their scores must pass through
+    unchanged (reference consumes them directly, ``onnxrt_backend.py:
+    882-1154``).
     """
     all_boxes, all_kps, all_scores = [], [], []
     for stride, out in outputs.items():
@@ -283,7 +289,9 @@ def decode_detections(
         scale = float(stride) if stride_scale_distances else 1.0
         boxes = distance2bbox(centers[None], out["bbox"].astype(jnp.float32) * scale)
         kps = distance2kps(centers[None], out["kps"].astype(jnp.float32) * scale)
-        scores = jax.nn.sigmoid(out["scores"].astype(jnp.float32))
+        scores = out["scores"].astype(jnp.float32)
+        if scores_are_logits:
+            scores = jax.nn.sigmoid(scores)
         all_boxes.append(boxes)
         all_kps.append(kps)
         all_scores.append(scores)

@@ -1,0 +1,8 @@
+"""ONNX -> JAX bridge: load the reference's ONNX model zoo (InsightFace
+SCRFD/ArcFace packs, PP-OCR det/rec) as jittable XLA programs with a real
+params pytree — no onnxruntime, no foreign runtime in the serving path."""
+
+from .executor import OnnxModule
+from .proto import OnnxGraph, load_onnx, parse_onnx
+
+__all__ = ["OnnxModule", "OnnxGraph", "load_onnx", "parse_onnx"]

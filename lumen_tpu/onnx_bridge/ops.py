@@ -1,0 +1,864 @@
+"""ONNX op set -> JAX lowering rules for the bridge executor.
+
+Coverage target: the CNN/transformer op mix of the reference's model zoo —
+InsightFace SCRFD detectors + ArcFace embedders and PP-OCR det/rec graphs
+(consumed by onnxruntime in the reference, ``packages/lumen-face/.../
+onnxrt_backend.py``, ``packages/lumen-ocr/.../onnxrt_backend.py``) — plus
+everything torch.onnx emits for the golden-test models.
+
+Execution model: values flowing through the graph are either *static*
+(numpy arrays — shapes, axes, constants folded at trace time) or *traced*
+(jax arrays). Shape-carrying subgraphs (Shape -> Gather -> Concat ->
+Reshape ...) must stay static for XLA, so element-wise/indexing ops run in
+numpy whenever every input is static. Dense compute always lowers to jax.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .proto import Node
+
+OP_REGISTRY: dict = {}
+
+
+def register(name: str):
+    def deco(fn):
+        OP_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def _static(*vals) -> bool:
+    return all(not isinstance(v, jax.Array) for v in vals if v is not None)
+
+
+def _xp(*vals):
+    """numpy for all-static inputs, jnp otherwise."""
+    return np if _static(*vals) else jnp
+
+
+def _int_list(v) -> list[int]:
+    return [int(x) for x in np.asarray(v).reshape(-1)]
+
+
+# -- elementwise -------------------------------------------------------------
+
+_UNARY = {
+    "Relu": lambda xp, x: xp.maximum(x, 0),
+    "Sigmoid": lambda xp, x: 1.0 / (1.0 + xp.exp(-x)),
+    "Tanh": lambda xp, x: xp.tanh(x),
+    "Exp": lambda xp, x: xp.exp(x),
+    "Log": lambda xp, x: xp.log(x),
+    "Sqrt": lambda xp, x: xp.sqrt(x),
+    "Neg": lambda xp, x: -x,
+    "Abs": lambda xp, x: xp.abs(x),
+    "Floor": lambda xp, x: xp.floor(x),
+    "Ceil": lambda xp, x: xp.ceil(x),
+    "Round": lambda xp, x: xp.round(x),
+    "Reciprocal": lambda xp, x: 1.0 / x,
+    "Not": lambda xp, x: ~x,
+    "Erf": lambda xp, x: jax.scipy.special.erf(x) if xp is jnp else _np_erf(x),
+    "Softplus": lambda xp, x: xp.logaddexp(x, 0.0),
+    "Identity": lambda xp, x: x,
+}
+
+
+def _np_erf(x):
+    from math import erf
+
+    return np.vectorize(erf)(np.asarray(x, np.float64)).astype(np.asarray(x).dtype)
+
+
+for _name, _fn in _UNARY.items():
+
+    def _make(fn):
+        def op(node: Node, vals, ctx):
+            return [fn(_xp(vals[0]), vals[0])]
+
+        return op
+
+    OP_REGISTRY[_name] = _make(_fn)
+
+
+_BINARY = {
+    "Add": lambda xp, a, b: a + b,
+    "Sub": lambda xp, a, b: a - b,
+    "Mul": lambda xp, a, b: a * b,
+    "Div": lambda xp, a, b: a / b if np.issubdtype(np.asarray(a).dtype if xp is np else a.dtype, np.floating) or np.issubdtype(np.asarray(b).dtype if xp is np else b.dtype, np.floating) else a // b,
+    "Pow": lambda xp, a, b: xp.power(a, b),
+    "Min": lambda xp, a, b: xp.minimum(a, b),
+    "Max": lambda xp, a, b: xp.maximum(a, b),
+    "Equal": lambda xp, a, b: a == b,
+    "Greater": lambda xp, a, b: a > b,
+    "GreaterOrEqual": lambda xp, a, b: a >= b,
+    "Less": lambda xp, a, b: a < b,
+    "LessOrEqual": lambda xp, a, b: a <= b,
+    "And": lambda xp, a, b: a & b,
+    "Or": lambda xp, a, b: a | b,
+    "Mod": lambda xp, a, b: a % b,
+}
+
+for _name, _fn in _BINARY.items():
+
+    def _make2(fn):
+        def op(node: Node, vals, ctx):
+            a, b = vals[0], vals[1]
+            xp = _xp(a, b)
+            if len(vals) > 2:  # Min/Max are variadic
+                out = fn(xp, a, b)
+                for v in vals[2:]:
+                    out = fn(xp, out, v)
+                return [out]
+            return [fn(xp, a, b)]
+
+        return op
+
+    OP_REGISTRY[_name] = _make2(_fn)
+
+
+@register("Sum")
+def op_sum(node, vals, ctx):
+    out = vals[0]
+    for v in vals[1:]:
+        out = out + v
+    return [out]
+
+
+@register("LeakyRelu")
+def op_leaky(node, vals, ctx):
+    alpha = node.attr("alpha", 0.01)
+    x = vals[0]
+    xp = _xp(x)
+    return [xp.where(x >= 0, x, alpha * x)]
+
+
+@register("PRelu")
+def op_prelu(node, vals, ctx):
+    x, slope = vals
+    xp = _xp(x, slope)
+    s = xp.asarray(slope)
+    # ONNX slope broadcasts per channel: [C] / [C,1,1] against NCHW input.
+    if s.ndim and s.ndim < np.ndim(x):
+        s = s.reshape((1, -1) + (1,) * (np.ndim(x) - 2))
+    return [xp.where(x >= 0, x, s * x)]
+
+
+@register("HardSigmoid")
+def op_hardsigmoid(node, vals, ctx):
+    alpha = node.attr("alpha", 0.2)
+    beta = node.attr("beta", 0.5)
+    x = vals[0]
+    xp = _xp(x)
+    return [xp.clip(alpha * x + beta, 0.0, 1.0)]
+
+
+@register("HardSwish")
+def op_hardswish(node, vals, ctx):
+    x = vals[0]
+    xp = _xp(x)
+    return [x * xp.clip(x / 6.0 + 0.5, 0.0, 1.0)]
+
+
+@register("Mish")
+def op_mish(node, vals, ctx):
+    x = vals[0]
+    xp = _xp(x)
+    return [x * xp.tanh(xp.logaddexp(x, 0.0))]
+
+
+@register("Gelu")
+def op_gelu(node, vals, ctx):
+    x = vals[0]
+    if node.attr("approximate", "none") == "tanh":
+        return [jax.nn.gelu(x, approximate=True)]
+    return [jax.nn.gelu(x, approximate=False)]
+
+
+@register("Clip")
+def op_clip(node, vals, ctx):
+    x = vals[0]
+    lo = vals[1] if len(vals) > 1 and vals[1] is not None else node.attr("min")
+    hi = vals[2] if len(vals) > 2 and vals[2] is not None else node.attr("max")
+    xp = _xp(x)
+    if lo is not None:
+        x = xp.maximum(x, xp.asarray(lo, dtype=np.asarray(x).dtype if xp is np else x.dtype))
+    if hi is not None:
+        x = xp.minimum(x, xp.asarray(hi, dtype=np.asarray(x).dtype if xp is np else x.dtype))
+    return [x]
+
+
+@register("Where")
+def op_where(node, vals, ctx):
+    c, a, b = vals
+    return [_xp(c, a, b).where(c, a, b)]
+
+
+@register("Cast")
+def op_cast(node, vals, ctx):
+    from .proto import TENSOR_DTYPES
+
+    to = node.attr("to")
+    np_dtype = TENSOR_DTYPES.get(to, np.float32)
+    x = vals[0]
+    if _static(x):
+        return [np.asarray(x).astype(np_dtype)]
+    if np_dtype == np.int64:
+        np_dtype = np.int32  # x64 disabled under jit
+    elif np_dtype == np.float64:
+        np_dtype = np.float32
+    return [x.astype(np_dtype)]
+
+
+# -- normalization -----------------------------------------------------------
+
+
+@register("BatchNormalization")
+def op_batchnorm(node, vals, ctx):
+    x, scale, bias, mean, var = vals[:5]
+    eps = node.attr("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = 1.0 / np.sqrt(np.asarray(var, np.float32) + eps) if _static(var) else jax.lax.rsqrt(var + eps)
+    return [(x - mean.reshape(shape)) * (inv * scale).reshape(shape) + bias.reshape(shape)]
+
+
+@register("LayerNormalization")
+def op_layernorm(node, vals, ctx):
+    x = vals[0]
+    scale = vals[1]
+    bias = vals[2] if len(vals) > 2 else None
+    axis = node.attr("axis", -1)
+    eps = node.attr("epsilon", 1e-5)
+    axes = tuple(range(axis if axis >= 0 else x.ndim + axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps) * scale
+    if bias is not None:
+        out = out + bias
+    return [out]
+
+
+@register("InstanceNormalization")
+def op_instancenorm(node, vals, ctx):
+    x, scale, bias = vals
+    eps = node.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return [(x - mean) * jax.lax.rsqrt(var + eps) * scale.reshape(shape) + bias.reshape(shape)]
+
+
+@register("Dropout")
+def op_dropout(node, vals, ctx):
+    return [vals[0]]  # inference
+
+
+@register("Softmax")
+def op_softmax(node, vals, ctx):
+    x = vals[0]
+    axis = node.attr("axis", -1 if ctx.opset >= 13 else 1)
+    if ctx.opset >= 13:
+        return [jax.nn.softmax(x, axis=axis)]
+    # legacy semantics: flatten from axis, softmax, reshape back
+    shape = x.shape
+    flat = x.reshape(int(np.prod(shape[:axis])) if axis else 1, -1)
+    return [jax.nn.softmax(flat, axis=-1).reshape(shape)]
+
+
+@register("LogSoftmax")
+def op_logsoftmax(node, vals, ctx):
+    return [jax.nn.log_softmax(vals[0], axis=node.attr("axis", -1))]
+
+
+# -- conv / pool -------------------------------------------------------------
+
+
+def _conv_pads(node, spatial: int, x_shape, k_shape, strides, dilations):
+    auto_pad = node.attr("auto_pad", "NOTSET")
+    if isinstance(auto_pad, bytes):
+        auto_pad = auto_pad.decode()
+    pads = node.attr("pads")
+    if auto_pad in ("NOTSET", "", None):
+        if pads is None:
+            pads = [0] * (2 * spatial)
+        return [(pads[i], pads[i + spatial]) for i in range(spatial)]
+    if auto_pad == "VALID":
+        return [(0, 0)] * spatial
+    # SAME_UPPER / SAME_LOWER
+    out = []
+    for i in range(spatial):
+        in_dim = x_shape[2 + i]
+        eff_k = (k_shape[i] - 1) * dilations[i] + 1
+        out_dim = -(-in_dim // strides[i])
+        total = max(0, (out_dim - 1) * strides[i] + eff_k - in_dim)
+        lo = total // 2 if auto_pad == "SAME_UPPER" else total - total // 2
+        out.append((lo, total - lo))
+    return out
+
+
+@register("Conv")
+def op_conv(node, vals, ctx):
+    x, w = vals[0], vals[1]
+    b = vals[2] if len(vals) > 2 else None
+    spatial = x.ndim - 2
+    strides = node.attr("strides", [1] * spatial)
+    dilations = node.attr("dilations", [1] * spatial)
+    group = node.attr("group", 1)
+    k_shape = node.attr("kernel_shape", list(w.shape[2:]))
+    pads = _conv_pads(node, spatial, x.shape, k_shape, strides, dilations)
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW")
+    out = lax.conv_general_dilated(
+        jnp.asarray(x),
+        jnp.asarray(w),
+        window_strides=strides,
+        padding=pads,
+        rhs_dilation=dilations,
+        feature_group_count=group,
+        dimension_numbers=dn,
+    )
+    if b is not None:
+        out = out + jnp.asarray(b).reshape((1, -1) + (1,) * spatial)
+    return [out]
+
+
+@register("ConvTranspose")
+def op_conv_transpose(node, vals, ctx):
+    x, w = vals[0], vals[1]
+    b = vals[2] if len(vals) > 2 else None
+    spatial = x.ndim - 2
+    strides = node.attr("strides", [1] * spatial)
+    dilations = node.attr("dilations", [1] * spatial)
+    group = node.attr("group", 1)
+    pads_attr = node.attr("pads", [0] * (2 * spatial))
+    out_pad = node.attr("output_padding", [0] * spatial)
+    if node.attr("output_shape") is not None:
+        raise NotImplementedError("ConvTranspose with explicit output_shape")
+    # ONNX weight layout [C_in, C_out/group, kH, kW]; the fractionally-
+    # strided equivalent convolves the lhs-dilated input with the flipped
+    # kernel in [O, I, kH, kW] layout.
+    w = jnp.asarray(w)
+    if group != 1:
+        ci, co_g = w.shape[0], w.shape[1]
+        w = w.reshape(group, ci // group, co_g, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(group * co_g, ci // group, *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + spatial)))
+    pads = []
+    for i in range(spatial):
+        k_eff = (w.shape[2 + i] - 1) * dilations[i] + 1
+        lo = k_eff - 1 - pads_attr[i]
+        hi = k_eff - 1 - pads_attr[spatial + i] + out_pad[i]
+        pads.append((lo, hi))
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCW", "OIW", "NCW")
+    out = lax.conv_general_dilated(
+        jnp.asarray(x),
+        w,
+        window_strides=[1] * spatial,
+        padding=pads,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        feature_group_count=group,
+        dimension_numbers=dn,
+    )
+    if b is not None:
+        out = out + jnp.asarray(b).reshape((1, -1) + (1,) * spatial)
+    return [out]
+
+
+def _pool(node, x, reducer, init, is_avg=False):
+    spatial = x.ndim - 2
+    k = node.attr("kernel_shape")
+    strides = node.attr("strides", [1] * spatial)
+    dilations = node.attr("dilations", [1] * spatial)
+    pads = _conv_pads(node, spatial, x.shape, k, strides, dilations)
+    if node.attr("ceil_mode", 0):
+        # extend high padding so the last (partial) window is included
+        new_pads = []
+        for i in range(spatial):
+            in_dim = x.shape[2 + i] + pads[i][0] + pads[i][1]
+            eff_k = (k[i] - 1) * dilations[i] + 1
+            rem = (in_dim - eff_k) % strides[i]
+            extra = (strides[i] - rem) % strides[i] if rem else 0
+            new_pads.append((pads[i][0], pads[i][1] + extra))
+        pads = new_pads
+    window = (1, 1) + tuple(k)
+    ws = (1, 1) + tuple(strides)
+    wd = (1, 1) + tuple(dilations)
+    pad_full = [(0, 0), (0, 0)] + pads
+    x = jnp.asarray(x)
+    out = lax.reduce_window(x, init, reducer, window, ws, pad_full, window_dilation=wd)
+    if is_avg:
+        if node.attr("count_include_pad", 0):
+            out = out / float(np.prod(k))
+        else:
+            ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, ws, pad_full, window_dilation=wd)
+            out = out / counts
+    return out
+
+
+@register("MaxPool")
+def op_maxpool(node, vals, ctx):
+    if len(node.outputs) > 1:
+        raise NotImplementedError("MaxPool with indices output")
+    return [_pool(node, vals[0], lax.max, -jnp.inf)]
+
+
+@register("AveragePool")
+def op_avgpool(node, vals, ctx):
+    return [_pool(node, vals[0], lax.add, 0.0, is_avg=True)]
+
+
+@register("GlobalAveragePool")
+def op_gap(node, vals, ctx):
+    x = vals[0]
+    return [jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)]
+
+
+@register("GlobalMaxPool")
+def op_gmp(node, vals, ctx):
+    x = vals[0]
+    return [jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)]
+
+
+# -- matmul ------------------------------------------------------------------
+
+
+@register("MatMul")
+def op_matmul(node, vals, ctx):
+    return [jnp.matmul(jnp.asarray(vals[0]), jnp.asarray(vals[1]))]
+
+
+@register("Gemm")
+def op_gemm(node, vals, ctx):
+    a, b = jnp.asarray(vals[0]), jnp.asarray(vals[1])
+    c = vals[2] if len(vals) > 2 else None
+    if node.attr("transA", 0):
+        a = a.T
+    if node.attr("transB", 0):
+        b = b.T
+    out = node.attr("alpha", 1.0) * (a @ b)
+    if c is not None:
+        out = out + node.attr("beta", 1.0) * c
+    return [out]
+
+
+@register("Einsum")
+def op_einsum(node, vals, ctx):
+    return [jnp.einsum(node.attr("equation"), *[jnp.asarray(v) for v in vals])]
+
+
+# -- shape / indexing --------------------------------------------------------
+
+
+@register("Shape")
+def op_shape(node, vals, ctx):
+    shape = np.asarray(np.shape(vals[0]), np.int64)
+    start = node.attr("start", 0)
+    end = node.attr("end")
+    return [shape[start:end]]
+
+
+@register("Size")
+def op_size(node, vals, ctx):
+    return [np.asarray(np.size(vals[0]), np.int64)]
+
+
+@register("Reshape")
+def op_reshape(node, vals, ctx):
+    x, shape = vals
+    if isinstance(shape, jax.Array):
+        raise NotImplementedError(
+            f"dynamic Reshape target at node {node.name!r} (shape must be static)"
+        )
+    target = _int_list(shape)
+    x_shape = np.shape(x)
+    # ONNX: 0 copies the input dim (unless allowzero), -1 infers.
+    if not node.attr("allowzero", 0):
+        target = [x_shape[i] if t == 0 else t for i, t in enumerate(target)]
+    return [_xp(x).reshape(x, tuple(target))]
+
+
+@register("Transpose")
+def op_transpose(node, vals, ctx):
+    x = vals[0]
+    perm = node.attr("perm")
+    if perm is None:
+        perm = list(range(np.ndim(x)))[::-1]
+    return [_xp(x).transpose(x, perm)]
+
+
+@register("Flatten")
+def op_flatten(node, vals, ctx):
+    x = vals[0]
+    axis = node.attr("axis", 1)
+    shape = np.shape(x)
+    lead = int(np.prod(shape[:axis])) if axis else 1
+    return [_xp(x).reshape(x, (lead, -1))]
+
+
+@register("Squeeze")
+def op_squeeze(node, vals, ctx):
+    x = vals[0]
+    axes = _int_list(vals[1]) if len(vals) > 1 and vals[1] is not None else node.attr("axes")
+    xp = _xp(x)
+    if axes is None:
+        return [xp.squeeze(x)]
+    return [xp.squeeze(x, axis=tuple(int(a) for a in axes))]
+
+
+@register("Unsqueeze")
+def op_unsqueeze(node, vals, ctx):
+    x = vals[0]
+    axes = _int_list(vals[1]) if len(vals) > 1 and vals[1] is not None else node.attr("axes")
+    xp = _xp(x)
+    out = x
+    for a in sorted(int(a) for a in axes):
+        out = xp.expand_dims(out, a if a >= 0 else a + np.ndim(out) + 1)
+    return [out]
+
+
+@register("Concat")
+def op_concat(node, vals, ctx):
+    axis = node.attr("axis")
+    xp = _xp(*vals)
+    return [xp.concatenate([xp.asarray(v) for v in vals], axis=axis)]
+
+
+@register("Gather")
+def op_gather(node, vals, ctx):
+    x, idx = vals
+    axis = node.attr("axis", 0)
+    xp = _xp(x, idx)
+    return [xp.take(x, np.asarray(idx, np.int64) if xp is np else idx, axis=axis)]
+
+
+@register("GatherElements")
+def op_gather_elements(node, vals, ctx):
+    x, idx = jnp.asarray(vals[0]), jnp.asarray(vals[1])
+    axis = node.attr("axis", 0)
+    return [jnp.take_along_axis(x, idx, axis=axis)]
+
+
+@register("Slice")
+def op_slice(node, vals, ctx):
+    x = vals[0]
+    if len(vals) > 1:  # opset >= 10: starts/ends/axes/steps are inputs
+        starts = _int_list(vals[1])
+        ends = _int_list(vals[2])
+        axes = _int_list(vals[3]) if len(vals) > 3 and vals[3] is not None else list(range(len(starts)))
+        steps = _int_list(vals[4]) if len(vals) > 4 and vals[4] is not None else [1] * len(starts)
+    else:
+        starts = node.attr("starts")
+        ends = node.attr("ends")
+        axes = node.attr("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    index = [slice(None)] * np.ndim(x)
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        # ONNX encodes "to the end" as INT64_MAX; clamp for python slices.
+        lim = np.shape(x)[ax]
+        st = max(min(st, lim), -lim) if st >= 0 else st
+        en = min(en, lim) if en >= 0 else max(en, -lim - 1)
+        index[ax] = slice(st, en, sp)
+    return [x[tuple(index)]]
+
+
+@register("Split")
+def op_split(node, vals, ctx):
+    x = vals[0]
+    axis = node.attr("axis", 0)
+    split = (
+        _int_list(vals[1])
+        if len(vals) > 1 and vals[1] is not None
+        else node.attr("split")
+    )
+    xp = _xp(x)
+    if split is None:
+        n = len(node.outputs)
+        return list(xp.split(x, n, axis=axis))
+    idx = np.cumsum(split[:-1]).tolist()
+    return list(xp.split(x, idx, axis=axis))
+
+
+@register("Expand")
+def op_expand(node, vals, ctx):
+    x, shape = vals
+    target = _int_list(shape)
+    x_shape = list(np.shape(x))
+    # ONNX Expand is bidirectional broadcast; result dim = max(x, target)
+    ndim = max(len(target), len(x_shape))
+    x_shape = [1] * (ndim - len(x_shape)) + x_shape
+    target = [1] * (ndim - len(target)) + target
+    out_shape = tuple(max(a, b) for a, b in zip(x_shape, target))
+    xp = _xp(x)
+    return [xp.broadcast_to(xp.reshape(x, tuple(x_shape)), out_shape)]
+
+
+@register("Tile")
+def op_tile(node, vals, ctx):
+    x, reps = vals
+    return [_xp(x).tile(x, tuple(_int_list(reps)))]
+
+
+@register("Pad")
+def op_pad(node, vals, ctx):
+    x = vals[0]
+    if len(vals) > 1 and vals[1] is not None:
+        pads = _int_list(vals[1])
+        cval = vals[2] if len(vals) > 2 and vals[2] is not None else 0.0
+    else:
+        pads = node.attr("pads")
+        cval = node.attr("value", 0.0)
+    mode = node.attr("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    n = np.ndim(x)
+    widths = [(pads[i], pads[i + n]) for i in range(n)]
+    xp = _xp(x)
+    if mode == "constant":
+        return [xp.pad(x, widths, mode="constant", constant_values=float(np.asarray(cval)))]
+    return [xp.pad(x, widths, mode={"reflect": "reflect", "edge": "edge"}[mode])]
+
+
+@register("Constant")
+def op_constant(node, vals, ctx):
+    t = node.attr("value")
+    if t is not None:
+        return [t.array]
+    for key in ("value_float", "value_int"):
+        v = node.attr(key)
+        if v is not None:
+            return [np.asarray(v)]
+    v = node.attr("value_floats")
+    if v:
+        return [np.asarray(v, np.float32)]
+    v = node.attr("value_ints")
+    if v:
+        return [np.asarray(v, np.int64)]
+    raise NotImplementedError(f"Constant node {node.name!r} without value")
+
+
+@register("ConstantOfShape")
+def op_constant_of_shape(node, vals, ctx):
+    shape = tuple(_int_list(vals[0]))
+    t = node.attr("value")
+    if t is None:
+        return [np.zeros(shape, np.float32)]
+    return [np.full(shape, t.array.reshape(-1)[0], t.array.dtype)]
+
+
+@register("Range")
+def op_range(node, vals, ctx):
+    start, limit, delta = [np.asarray(v).item() if _static(v) else v for v in vals]
+    if _static(*vals):
+        return [np.arange(start, limit, delta)]
+    return [jnp.arange(start, limit, delta)]
+
+
+@register("ArgMax")
+def op_argmax(node, vals, ctx):
+    x = vals[0]
+    axis = node.attr("axis", 0)
+    keepdims = node.attr("keepdims", 1)
+    xp = _xp(x)
+    out = xp.argmax(x, axis=axis)
+    if keepdims:
+        out = xp.expand_dims(out, axis)
+    return [out.astype(np.int64) if xp is np else out.astype(jnp.int32)]
+
+
+@register("ArgMin")
+def op_argmin(node, vals, ctx):
+    x = vals[0]
+    axis = node.attr("axis", 0)
+    keepdims = node.attr("keepdims", 1)
+    xp = _xp(x)
+    out = xp.argmin(x, axis=axis)
+    if keepdims:
+        out = xp.expand_dims(out, axis)
+    return [out.astype(np.int64) if xp is np else out.astype(jnp.int32)]
+
+
+@register("TopK")
+def op_topk(node, vals, ctx):
+    x = jnp.asarray(vals[0])
+    k = int(np.asarray(vals[1]).item())
+    axis = node.attr("axis", -1)
+    if node.attr("largest", 1) == 0:
+        raise NotImplementedError("TopK smallest")
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    v, i = jax.lax.top_k(x, k)
+    if axis not in (-1, x.ndim - 1):
+        v = jnp.moveaxis(v, -1, axis)
+        i = jnp.moveaxis(i, -1, axis)
+    return [v, i.astype(jnp.int32)]
+
+
+def _reduce(node, vals, ctx, fn_np, fn_jnp):
+    x = vals[0]
+    if len(vals) > 1 and vals[1] is not None:
+        axes = _int_list(vals[1])
+    else:
+        axes = node.attr("axes")
+    keepdims = bool(node.attr("keepdims", 1))
+    axes_t = tuple(int(a) for a in axes) if axes else None
+    if axes_t is None and node.attr("noop_with_empty_axes", 0):
+        return [x]
+    if _static(x):
+        return [fn_np(np.asarray(x), axis=axes_t, keepdims=keepdims)]
+    return [fn_jnp(x, axis=axes_t, keepdims=keepdims)]
+
+
+for _name, _np_fn, _jnp_fn in [
+    ("ReduceMean", np.mean, jnp.mean),
+    ("ReduceSum", np.sum, jnp.sum),
+    ("ReduceMax", np.max, jnp.max),
+    ("ReduceMin", np.min, jnp.min),
+    ("ReduceProd", np.prod, jnp.prod),
+]:
+
+    def _maker(fnp, fjnp):
+        def op(node, vals, ctx):
+            return _reduce(node, vals, ctx, fnp, fjnp)
+
+        return op
+
+    OP_REGISTRY[_name] = _maker(_np_fn, _jnp_fn)
+
+
+@register("ReduceL2")
+def op_reduce_l2(node, vals, ctx):
+    x = vals[0]
+    axes = (
+        tuple(_int_list(vals[1]))
+        if len(vals) > 1 and vals[1] is not None
+        else (tuple(node.attr("axes")) if node.attr("axes") else None)
+    )
+    keepdims = bool(node.attr("keepdims", 1))
+    return [jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(x)), axis=axes, keepdims=keepdims))]
+
+
+# -- resize ------------------------------------------------------------------
+
+
+def _resize_coords(out_size, in_size, scale, mode):
+    """Output-pixel -> input-coordinate per ONNX coordinate_transformation_mode."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if mode == "align_corners":
+        if out_size == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * (in_size - 1) / (out_size - 1)
+    if mode == "asymmetric":
+        return i / scale
+    if mode == "pytorch_half_pixel":
+        return (i + 0.5) / scale - 0.5 if out_size > 1 else jnp.zeros((1,), jnp.float32)
+    # default: half_pixel
+    return (i + 0.5) / scale - 0.5
+
+
+@register("Resize")
+def op_resize(node, vals, ctx):
+    x = jnp.asarray(vals[0])
+    scales = vals[2] if len(vals) > 2 and vals[2] is not None and np.size(vals[2]) else None
+    sizes = vals[3] if len(vals) > 3 and vals[3] is not None and np.size(vals[3]) else None
+    mode = node.attr("mode", "nearest")
+    coord_mode = node.attr("coordinate_transformation_mode", "half_pixel")
+    nearest_mode = node.attr("nearest_mode", "round_prefer_floor")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if isinstance(coord_mode, bytes):
+        coord_mode = coord_mode.decode()
+    if isinstance(nearest_mode, bytes):
+        nearest_mode = nearest_mode.decode()
+
+    in_shape = x.shape
+    if sizes is not None:
+        out_shape = tuple(_int_list(sizes))
+        eff_scales = [o / i for o, i in zip(out_shape, in_shape)]
+    else:
+        eff_scales = [float(s) for s in np.asarray(scales).reshape(-1)]
+        out_shape = tuple(
+            int(math.floor(i * s)) for i, s in zip(in_shape, eff_scales)
+        )
+    out = x
+    for axis in range(x.ndim):
+        if out_shape[axis] == in_shape[axis]:
+            continue
+        coords = _resize_coords(out_shape[axis], in_shape[axis], eff_scales[axis], coord_mode)
+        if mode == "nearest":
+            if nearest_mode == "floor":
+                idx = jnp.floor(coords)
+            elif nearest_mode == "ceil":
+                idx = jnp.ceil(coords)
+            elif nearest_mode == "round_prefer_ceil":
+                idx = jnp.floor(coords + 0.5)
+            else:  # round_prefer_floor
+                idx = jnp.ceil(coords - 0.5)
+            idx = jnp.clip(idx, 0, in_shape[axis] - 1).astype(jnp.int32)
+            out = jnp.take(out, idx, axis=axis)
+        elif mode == "linear":
+            c = jnp.clip(coords, 0.0, in_shape[axis] - 1)
+            lo = jnp.floor(c).astype(jnp.int32)
+            hi = jnp.clip(lo + 1, 0, in_shape[axis] - 1)
+            w = (c - lo).astype(x.dtype)
+            shape = [1] * out.ndim
+            shape[axis] = -1
+            w = w.reshape(shape)
+            out = jnp.take(out, lo, axis=axis) * (1 - w) + jnp.take(out, hi, axis=axis) * w
+        else:
+            raise NotImplementedError(f"Resize mode {mode!r}")
+    return [out]
+
+
+@register("Upsample")
+def op_upsample(node, vals, ctx):
+    # Legacy (opset<10) alias of Resize with scales input/attr, asymmetric.
+    scales = vals[1] if len(vals) > 1 else np.asarray(node.attr("scales"), np.float32)
+    fake = Node(
+        op_type="Resize",
+        name=node.name,
+        inputs=node.inputs,
+        outputs=node.outputs,
+        attrs={},
+    )
+    fake.attrs = dict(node.attrs)
+    from .proto import Attribute
+
+    fake.attrs["coordinate_transformation_mode"] = Attribute(
+        name="coordinate_transformation_mode", type=3, s=b"asymmetric"
+    )
+    fake.attrs["nearest_mode"] = Attribute(name="nearest_mode", type=3, s=b"floor")
+    return op_resize(fake, [vals[0], None, scales], ctx)
+
+
+@register("DepthToSpace")
+def op_depth_to_space(node, vals, ctx):
+    x = jnp.asarray(vals[0])
+    bs = node.attr("blocksize")
+    b, c, h, w = x.shape
+    if node.attr("mode", "DCR") == "DCR":
+        x = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+        x = x.transpose(0, 3, 4, 1, 5, 2)
+    else:  # CRD
+        x = x.reshape(b, c // (bs * bs), bs, bs, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+    return [x.reshape(b, c // (bs * bs), h * bs, w * bs)]
+
+
+@register("SpaceToDepth")
+def op_space_to_depth(node, vals, ctx):
+    x = jnp.asarray(vals[0])
+    bs = node.attr("blocksize")
+    b, c, h, w = x.shape
+    x = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return [x.reshape(b, c * bs * bs, h // bs, w // bs)]
