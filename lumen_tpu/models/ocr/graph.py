@@ -1,0 +1,108 @@
+"""Graph-backed OCR models: real PP-OCR ONNX exports on TPU.
+
+The reference serves PP-OCRv4/v5 det+rec ``.onnx`` files through onnxruntime
+(``packages/lumen-ocr/src/lumen_ocr/backends/onnxrt_backend.py:43-633``).
+Here the same files load through ``lumen_tpu.onnx_bridge`` into jittable XLA
+programs, so ``ocr`` produces the same answers as the reference with the
+same weights. File discovery follows the reference naming convention
+(``_find_model_file``, ``onnxrt_backend.py:210-233``):
+``detection.{precision}.onnx`` / ``recognition.{precision}.onnx`` with a
+bare ``detection.onnx`` fallback, plus the stock PaddleOCR export names
+(``det*.onnx`` / ``rec*.onnx``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+from ...onnx_bridge import OnnxModule
+
+logger = logging.getLogger(__name__)
+
+_PRECISION_ORDER = ("fp32", "fp16")
+
+
+def find_onnx_models(model_dir: str, precision: str | None = None) -> dict[str, str]:
+    """Locate det/rec ``.onnx`` files in a model dir. Returns a dict with
+    any of the keys ``detection`` / ``recognition``."""
+    names = sorted(os.listdir(model_dir)) if os.path.isdir(model_dir) else []
+    # Also look inside an ``onnx/`` runtime subdir (reference layout keeps
+    # onnx files under the runtime directory, ``resources/loader.py:164``).
+    sub = os.path.join(model_dir, "onnx")
+    if os.path.isdir(sub):
+        names += [os.path.join("onnx", n) for n in sorted(os.listdir(sub))]
+
+    found: dict[str, str] = {}
+    order = [precision] if precision else []
+    order += [p for p in _PRECISION_ORDER if p not in order]
+    for kind, prefix in (("detection", "det"), ("recognition", "rec")):
+        candidates = [n for n in names if n.endswith(".onnx") and os.path.basename(n).startswith(prefix)]
+        if not candidates:
+            continue
+
+        def rank(name: str) -> tuple:
+            base = os.path.basename(name)
+            for i, prec in enumerate(order):
+                if f".{prec}." in base:
+                    return (i, base)
+            return (len(order), base)  # bare detection.onnx etc.
+
+        found[kind] = os.path.join(model_dir, sorted(candidates, key=rank)[0])
+    return found
+
+
+def _ends_in_softmax(module: OnnxModule, output_name: str) -> bool:
+    """True when the graph output is produced by a Softmax node (PP-OCR rec
+    exports emit probabilities; torch CTC heads emit logits)."""
+    for node in module.graph.nodes:
+        if output_name in node.outputs:
+            return node.op_type in ("Softmax", "LogSoftmax")
+    return False
+
+
+@dataclass
+class DBNetGraph:
+    """Detection graph: [B,3,H,W] normalized floats -> [B,H,W] prob map.
+
+    PP-OCR det exports return a [B,1,H,W] sigmoid probability map; the
+    adapter squeezes the channel to match the native Flax DBNet contract
+    (``modeling.py:82``).
+    """
+
+    module: OnnxModule
+
+    @classmethod
+    def from_path(cls, path: str) -> "DBNetGraph":
+        return cls(module=OnnxModule.from_path(path))
+
+    def __call__(self, params: dict, x_nchw):
+        import jax.numpy as jnp
+
+        out = jnp.asarray(self.module(params, {self.module.input_names[0]: x_nchw})[0])
+        if out.ndim == 4:  # [B,1,H,W] or rarely [B,H,W,1]
+            out = out[:, 0] if out.shape[1] == 1 else out[..., 0]
+        return out.astype(jnp.float32)
+
+
+@dataclass
+class RecGraph:
+    """Recognition graph: [B,3,H,W] normalized crops -> [B,T,V] CTC frames
+    plus whether they are already softmax probabilities."""
+
+    module: OnnxModule
+    outputs_probs: bool
+
+    @classmethod
+    def from_path(cls, path: str) -> "RecGraph":
+        module = OnnxModule.from_path(path)
+        return cls(
+            module=module,
+            outputs_probs=_ends_in_softmax(module, module.output_names[0]),
+        )
+
+    def __call__(self, params: dict, x_nchw):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.module(params, {self.module.input_names[0]: x_nchw})[0])

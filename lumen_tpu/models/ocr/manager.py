@@ -103,6 +103,7 @@ class OcrManager:
         det_cfg: DBNetConfig | None = None,
         rec_cfg: SVTRConfig | None = None,
         warmup: bool = False,
+        allow_random_init: bool = False,
     ):
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
@@ -116,6 +117,7 @@ class OcrManager:
         self.rec_cfg = rec_cfg or self._rec_cfg_from_info()
         self.detector = DBNet(self.det_cfg)
         self.recognizer = SVTRRecognizer(self.rec_cfg)
+        self.allow_random_init = allow_random_init
         self._initialized = False
 
     def _load_vocab(self) -> list[str]:
@@ -143,13 +145,20 @@ class OcrManager:
 
     # -- init -------------------------------------------------------------
 
-    def _load_variables(self, filename: str, module, example_shape: tuple):
+    def _load_variables(self, filename: str, module, example_shape: tuple, kind: str):
         path = os.path.join(self.model_dir, filename)
         if os.path.exists(path):
             variables = convert_ocr_checkpoint(load_safetensors(path))
-        else:
-            logger.warning("%s missing in %s; using random init (tests only)", filename, self.model_dir)
+        elif self.allow_random_init:
+            logger.warning("%s missing in %s; RANDOM INIT (allow_random_init=True, tests only)", filename, self.model_dir)
             variables = dict(module.init(jax.random.PRNGKey(0), jnp.zeros(example_shape, jnp.float32)))
+        else:
+            # A missing checkpoint must hard-fail: serving random weights
+            # returns confident garbage with HTTP 200s (round-1 verdict).
+            raise FileNotFoundError(
+                f"no {kind} weights in {self.model_dir}: expected {filename} "
+                f"or a {kind} .onnx graph; pass allow_random_init=True only in tests"
+            )
         variables["params"] = self.policy.cast_params(variables["params"])
         if "batch_stats" in variables:
             variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
@@ -159,35 +168,82 @@ class OcrManager:
         if self._initialized:
             return
         s = self.spec
-        self.det_vars = self._load_variables(
-            "detection.safetensors", self.detector, (1, s.det_buckets[0], s.det_buckets[0], 3)
-        )
-        self.rec_vars = self._load_variables(
-            "recognition.safetensors",
-            self.recognizer,
-            (1, self.rec_cfg.height, s.rec_width_buckets[0], 3),
-        )
         compute = self.policy.compute_dtype
         det_mean, det_std = jnp.asarray(s.det_mean), jnp.asarray(s.det_std)
         rec_mean, rec_std = jnp.asarray(s.rec_mean), jnp.asarray(s.rec_std)
 
-        @jax.jit
-        def run_detector(variables, images_u8):
-            x = (images_u8.astype(jnp.float32) / 255.0 - det_mean) / det_std
-            return self.detector.apply(variables, x.astype(compute))
+        from .graph import DBNetGraph, RecGraph, find_onnx_models
 
-        @jax.jit
-        def run_recognizer(variables, crops_u8, widths):
-            x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
-            logits = self.recognizer.apply(variables, x.astype(compute))
-            ids, conf = ctc_greedy_device(logits)
+        onnx_models = find_onnx_models(self.model_dir)
+
+        if "detection" in onnx_models:
+            # Real PP-OCR det export: run the actual DBNet graph via the
+            # ONNX->JAX bridge (reference runs the same file through
+            # onnxruntime, ``onnxrt_backend.py:122-126``).
+            graph_det = DBNetGraph.from_path(onnx_models["detection"])
+            self.det_vars = jax.device_put(dict(graph_det.module.params))
+            logger.info("ocr detector: DBNet graph %s (%d MB params)",
+                        onnx_models["detection"], graph_det.module.param_bytes() >> 20)
+
+            @jax.jit
+            def run_detector(variables, images_u8):
+                x = (images_u8.astype(jnp.float32) / 255.0 - det_mean) / det_std
+                return graph_det(variables, x.transpose(0, 3, 1, 2))
+
+        else:
+            self.det_vars = self._load_variables(
+                "detection.safetensors",
+                self.detector,
+                (1, s.det_buckets[0], s.det_buckets[0], 3),
+                "detection",
+            )
+
+            @jax.jit
+            def run_detector(variables, images_u8):
+                x = (images_u8.astype(jnp.float32) / 255.0 - det_mean) / det_std
+                return self.detector.apply(variables, x.astype(compute))
+
+        def _mask_padding(ids, conf, crop_w: int, t: int, widths):
             # Mask timesteps past each crop's true width (padding region):
             # force blank id 0 / confidence 1 so collapse ignores them.
-            t = logits.shape[1]
-            downsample = crops_u8.shape[2] // t
+            downsample = max(crop_w // t, 1)
             steps = jnp.arange(t)[None, :] * downsample
             valid = steps < widths[:, None]
             return jnp.where(valid, ids, 0), jnp.where(valid, conf, 1.0)
+
+        if "recognition" in onnx_models:
+            graph_rec = RecGraph.from_path(onnx_models["recognition"])
+            self.rec_vars = jax.device_put(dict(graph_rec.module.params))
+            logger.info("ocr recognizer: graph %s (softmax output: %s)",
+                        onnx_models["recognition"], graph_rec.outputs_probs)
+
+            @jax.jit
+            def run_recognizer(variables, crops_u8, widths):
+                x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
+                frames = graph_rec(variables, x.transpose(0, 3, 1, 2))
+                if graph_rec.outputs_probs:
+                    # Graph already ends in Softmax — re-softmaxing would
+                    # flatten confidences (argmax unchanged, conf wrong).
+                    probs = frames.astype(jnp.float32)
+                    ids, conf = jnp.argmax(probs, -1), jnp.max(probs, -1)
+                else:
+                    ids, conf = ctc_greedy_device(frames)
+                return _mask_padding(ids, conf, crops_u8.shape[2], frames.shape[1], widths)
+
+        else:
+            self.rec_vars = self._load_variables(
+                "recognition.safetensors",
+                self.recognizer,
+                (1, self.rec_cfg.height, s.rec_width_buckets[0], 3),
+                "recognition",
+            )
+
+            @jax.jit
+            def run_recognizer(variables, crops_u8, widths):
+                x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
+                logits = self.recognizer.apply(variables, x.astype(compute))
+                ids, conf = ctc_greedy_device(logits)
+                return _mask_padding(ids, conf, crops_u8.shape[2], logits.shape[1], widths)
 
         self._run_detector = run_detector
         self._run_recognizer = run_recognizer

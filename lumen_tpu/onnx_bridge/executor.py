@@ -126,6 +126,15 @@ class OnnxModule:
         def fn(p, *arrays):
             if len(arrays) != len(names):
                 raise ValueError(f"expected inputs {names}, got {len(arrays)} arrays")
+            if dtype is not None:
+                # keep float inputs in the params dtype so mixed-precision
+                # serving doesn't trip dtype-strict primitives (conv)
+                arrays = tuple(
+                    jnp.asarray(a, dtype)
+                    if np.issubdtype(np.asarray(a).dtype if not isinstance(a, jax.Array) else a.dtype, np.floating)
+                    else a
+                    for a in arrays
+                )
             outs = self(p, dict(zip(names, arrays)))
             return tuple(jnp.asarray(o) for o in outs)
 

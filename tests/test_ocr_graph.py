@@ -1,0 +1,220 @@
+"""End-to-end test of the PP-OCR ONNX graph path.
+
+Builds a model dir holding torch-exported ``detection.onnx`` /
+``recognition.onnx`` files with hand-crafted weights whose behavior is
+predictable (detector: brightness -> probability; recognizer: per-column
+brightness -> character class), then runs the full ``OcrManager`` pipeline
+through the ONNX bridge — exactly how a real PP-OCRv4 export would be
+served (reference path ``packages/lumen-ocr/src/lumen_ocr/backends/
+onnxrt_backend.py:122-128``).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from tests.test_onnx_bridge import export_onnx  # noqa: E402
+
+VOCAB_CHARS = "ab"  # blank + 'a' + 'b' + space
+
+
+class BrightnessDet(nn.Module):
+    """[B,3,H,W] (ImageNet-normalized) -> [B,1,H,W] prob: high where the
+    pixel is bright. Mimics a DBNet det export's output contract."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 1, 1)
+        with torch.no_grad():
+            # undo normalization roughly: mean of normalized channels is
+            # positive for bright pixels, negative for dark ones
+            self.conv.weight[:] = 1.0 / 3.0
+            self.conv.bias[:] = -0.2
+
+    def forward(self, x):
+        return torch.sigmoid(20.0 * self.conv(x))
+
+
+class BrightnessRec(nn.Module):
+    """[B,3,48,W] -> [B, W//8, V] softmax frames: bright columns -> class 1
+    ('a'), dark columns -> blank. Mimics a PP-OCR rec export (trailing
+    Softmax, CTC frame layout)."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.conv = nn.Conv2d(3, vocab_size, kernel_size=(48, 8), stride=(48, 8))
+        with torch.no_grad():
+            self.conv.weight[:] = 0.0
+            self.conv.bias[:] = 0.0
+            # class 1 ('a') fires on mostly-bright columns; blank (0) wins
+            # on dark ones. Column logit for mean brightness m in [-1, 1]
+            # is 20*m, so a bias of -6 puts the decision at m = -0.3 —
+            # tolerant of the dark unclip margins around a detected band.
+            self.conv.weight[1] = 10.0 / (3 * 48 * 8)
+            self.conv.bias[:] = -10.0  # all other classes below blank
+            self.conv.bias[0] = -6.0
+            self.conv.bias[1] = 0.0
+        self.conv.weight.requires_grad_(False)
+
+    def forward(self, x):
+        f = self.conv(x * 2.0)  # [B,V,1,T]
+        f = f.squeeze(2).permute(0, 2, 1)  # [B,T,V]
+        return torch.softmax(20.0 * f, dim=-1)
+
+
+def make_graph_ocr_model_dir(tmp_path):
+    model_dir = tmp_path / "models" / "GraphOCR"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    vocab_size = 1 + len(VOCAB_CHARS) + 1
+    export_onnx(
+        BrightnessDet(),
+        (torch.randn(1, 3, 64, 64),),
+        str(model_dir / "detection.fp32.onnx"),
+        input_names=["x"],
+        dynamic_axes={"x": {0: "b", 2: "h", 3: "w"}},
+    )
+    export_onnx(
+        BrightnessRec(vocab_size),
+        (torch.randn(1, 3, 48, 80),),
+        str(model_dir / "recognition.fp32.onnx"),
+        input_names=["x"],
+        dynamic_axes={"x": {0: "b", 3: "w"}},
+    )
+    (model_dir / "ppocr_keys_v1.txt").write_text("\n".join(VOCAB_CHARS) + "\n")
+    info = {
+        "name": "GraphOCR",
+        "version": "1.0.0",
+        "description": "graph-backed test ocr pack",
+        "model_type": "ocr",
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/GraphOCR"},
+        "runtimes": {
+            "onnx": {"available": True, "files": ["detection.fp32.onnx", "recognition.fp32.onnx"]}
+        },
+        "extra_metadata": {
+            "ocr": {
+                "det_buckets": [320],
+                "det_threshold": 0.5,
+                "box_threshold": 0.5,
+                "rec_threshold": 0.2,
+                "min_size": 2.0,
+            }
+        },
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+@pytest.fixture(scope="module")
+def graph_ocr_mgr(tmp_path_factory):
+    from lumen_tpu.models.ocr import OcrManager
+
+    model_dir = make_graph_ocr_model_dir(tmp_path_factory.mktemp("gocr"))
+    mgr = OcrManager(model_dir, dtype="float32")
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+class TestFindOnnxModels:
+    def test_precision_ranking(self, tmp_path):
+        from lumen_tpu.models.ocr.graph import find_onnx_models
+
+        d = tmp_path / "m"
+        d.mkdir()
+        for n in ("detection.fp16.onnx", "detection.fp32.onnx", "rec_svtr.onnx"):
+            (d / n).write_bytes(b"")
+        found = find_onnx_models(str(d))
+        assert found["detection"].endswith("detection.fp32.onnx")
+        assert found["recognition"].endswith("rec_svtr.onnx")
+        found = find_onnx_models(str(d), precision="fp16")
+        assert found["detection"].endswith("detection.fp16.onnx")
+
+    def test_onnx_subdir(self, tmp_path):
+        from lumen_tpu.models.ocr.graph import find_onnx_models
+
+        d = tmp_path / "m" / "onnx"
+        d.mkdir(parents=True)
+        (d / "detection.onnx").write_bytes(b"")
+        found = find_onnx_models(str(tmp_path / "m"))
+        assert found["detection"].endswith("onnx/detection.onnx")
+
+    def test_empty_dir(self, tmp_path):
+        from lumen_tpu.models.ocr.graph import find_onnx_models
+
+        assert find_onnx_models(str(tmp_path)) == {}
+
+
+class TestMissingWeightsHardFail:
+    def test_hard_fail_without_checkpoints(self, tmp_path):
+        """Round-1 verdict: a misconfigured deployment must not silently
+        serve random weights."""
+        from lumen_tpu.models.ocr import OcrManager
+        from tests.test_ocr import make_ocr_model_dir
+
+        model_dir = make_ocr_model_dir(tmp_path)
+        import os
+
+        os.remove(os.path.join(model_dir, "detection.safetensors"))
+        mgr = OcrManager(model_dir, dtype="float32")
+        with pytest.raises(FileNotFoundError, match="detection"):
+            mgr.initialize()
+
+    def test_random_init_optin(self, tmp_path):
+        from lumen_tpu.models.ocr import OcrManager
+        from tests.test_ocr import make_ocr_model_dir
+
+        model_dir = make_ocr_model_dir(tmp_path)
+        import os
+
+        os.remove(os.path.join(model_dir, "recognition.safetensors"))
+        mgr = OcrManager(model_dir, dtype="float32", allow_random_init=True)
+        mgr.initialize()  # no raise
+
+
+class TestGraphPipeline:
+    def test_graph_path_selected(self, graph_ocr_mgr):
+        # graph params have flat ONNX initializer names, not Flax trees
+        assert not isinstance(graph_ocr_mgr.det_vars.get("params"), dict)
+
+    def test_detects_bright_band(self, graph_ocr_mgr):
+        img = np.zeros((240, 320, 3), np.uint8)
+        img[100:140, 40:280] = 255
+        boxes = graph_ocr_mgr.detect(img)
+        assert len(boxes) == 1
+        quad, score = boxes[0]
+        assert score > 0.8
+        xs, ys = quad[:, 0], quad[:, 1]
+        # The unclip-dilated quad contains the band (reference applies the
+        # same unclip expansion before rescale, ``onnxrt_backend.py:470-476``)
+        assert xs.min() < 60 and xs.max() > 260
+        assert 50 < ys.min() < 110 and 130 < ys.max() < 190
+
+    def test_recognize_bright_crop(self, graph_ocr_mgr):
+        crop = np.full((48, 160, 3), 255, np.uint8)
+        [(text, conf)] = graph_ocr_mgr.recognize_crops([crop])
+        # every frame says 'a'; CTC collapses repeats to a single 'a'
+        assert text == "a"
+        assert conf > 0.9
+
+    def test_dark_crop_is_blank(self, graph_ocr_mgr):
+        crop = np.zeros((48, 160, 3), np.uint8)
+        [(text, _)] = graph_ocr_mgr.recognize_crops([crop])
+        assert text == ""
+
+    def test_full_predict_end_to_end(self, graph_ocr_mgr):
+        import cv2
+
+        img = np.zeros((240, 320, 3), np.uint8)
+        img[100:140, 40:280] = 255
+        ok, enc = cv2.imencode(".png", img[..., ::-1])
+        assert ok
+        results = graph_ocr_mgr.predict(enc.tobytes())
+        assert len(results) == 1
+        assert "a" in results[0].text
+        assert results[0].confidence > 0.5
