@@ -1,0 +1,306 @@
+"""End-to-end + golden-parity tests for the InsightFace ONNX graph path.
+
+Builds a model dir holding torch-exported ``det_10g.onnx`` (SCRFD output
+contract: per-stride [B,M,1]/[B,M,4]/[B,M,10] tensors grouped by TYPE,
+post-sigmoid scores, stride-unit distances — reference
+``packages/lumen-face/src/lumen_face/backends/insightface_specs.py`` and
+``onnxrt_backend.py:882-1154``) and ``w600k_r50.onnx`` (ArcFace contract:
+[B,3,112,112] -> [B,512]), then:
+
+1. runs the full ``FaceManager`` pipeline through the ONNX bridge, and
+2. asserts golden parity of the device-side decode (anchors,
+   distance2bbox/kps, NMS, letterbox unmap) against an INDEPENDENT numpy
+   reimplementation of the reference's decode semantics, run on the same
+   raw graph outputs (IoU > 0.95 per matched box, same scores).
+
+The detector's weights are crafted so score = brightness of the anchor
+cell: bright blobs become stable, well-separated detections — decode
+parity is then insensitive to fp noise between torch and XLA convs.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from tests.test_onnx_bridge import export_onnx  # noqa: E402
+
+DET_SIZE = 128
+STRIDES = (8, 16, 32)
+NUM_ANCHORS = 2
+
+
+class BrightnessSCRFD(nn.Module):
+    """SCRFD-contract detector: scores fire on bright cells; bbox/kps
+    distances are constant (in stride units), so each firing anchor yields
+    a box of side ``4*stride`` centered on its cell."""
+
+    def __init__(self):
+        super().__init__()
+        self.pools = nn.ModuleList([nn.AvgPool2d(s, s) for s in STRIDES])
+        # zero-weight convs with constant bias: bbox distances 2.0 (stride
+        # units -> boxes of side 4*stride), kps offsets 1.0
+        self.bbox = nn.ModuleList([nn.Conv2d(3, 4 * NUM_ANCHORS, 1) for _ in STRIDES])
+        self.kps = nn.ModuleList([nn.Conv2d(3, 10 * NUM_ANCHORS, 1) for _ in STRIDES])
+        with torch.no_grad():
+            for conv in [*self.bbox, *self.kps]:
+                conv.weight[:] = 0.0
+            for conv in self.bbox:
+                conv.bias[:] = 2.0
+            for conv in self.kps:
+                conv.bias[:] = 1.0
+
+    def forward(self, x):
+        b = x.shape[0]
+        outs_s, outs_b, outs_k = [], [], []
+        # x is (pixel - 127.5) / 128: bright ~ +1, dark ~ -1
+        for pool, bconv, kconv in zip(self.pools, self.bbox, self.kps):
+            g = pool(x)  # [B,3,h,w]
+            f = g.mean(1, keepdim=True)  # mean brightness per cell
+            score = torch.sigmoid(10.0 * f)  # bright cell -> ~1, dark -> ~0
+            score2 = torch.cat([score, score * 0.9], 1)  # 2 anchors per cell
+            outs_s.append(score2.permute(0, 2, 3, 1).reshape(b, -1, 1))
+            outs_b.append(bconv(g).permute(0, 2, 3, 1).reshape(b, -1, 4))
+            outs_k.append(kconv(g).permute(0, 2, 3, 1).reshape(b, -1, 10))
+        return tuple(outs_s) + tuple(outs_b) + tuple(outs_k)
+
+
+class TinyArcFace(nn.Module):
+    """[B,3,112,112] -> [B,512] (unnormalized; manager L2-normalizes)."""
+
+    def __init__(self):
+        super().__init__()
+        self.net = nn.Sequential(
+            nn.Conv2d(3, 8, 7, 4, 3),
+            nn.ReLU(),
+            nn.Conv2d(8, 16, 3, 2, 1),
+            nn.ReLU(),
+            nn.AdaptiveAvgPool2d(7),
+            nn.Flatten(),
+            nn.Linear(16 * 49, 512),
+        )
+
+    def forward(self, x):
+        return self.net(x)
+
+
+def make_graph_face_model_dir(tmp_path):
+    model_dir = tmp_path / "models" / "GraphFace"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    torch.manual_seed(0)
+    export_onnx(
+        BrightnessSCRFD(),
+        (torch.randn(1, 3, DET_SIZE, DET_SIZE),),
+        str(model_dir / "det_10g.onnx"),
+        input_names=["input"],
+        dynamic_axes={"input": {0: "b"}},
+    )
+    global _REC_MODEL
+    _REC_MODEL = TinyArcFace()
+    export_onnx(
+        _REC_MODEL,
+        (torch.randn(1, 3, 112, 112),),
+        str(model_dir / "w600k_r50.onnx"),
+        input_names=["input"],
+        dynamic_axes={"input": {0: "b"}},
+    )
+    torch.save(_REC_MODEL.state_dict(), str(model_dir / "rec_state.pt"))
+    info = {
+        "name": "GraphFace",
+        "version": "1.0.0",
+        "description": "graph-backed test face pack",
+        "model_type": "face",
+        "embedding_dim": 512,
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/GraphFace"},
+        "runtimes": {"onnx": {"available": True, "files": ["det_10g.onnx", "w600k_r50.onnx"]}},
+        "extra_metadata": {
+            "insightface": {
+                "det_size": DET_SIZE,
+                "score_threshold": 0.6,
+                "nms_threshold": 0.4,
+                # keep every anchor: parity check covers the full candidate set
+                "max_detections": 672,
+            },
+            "detector": {"input_size": DET_SIZE, "num_anchors": NUM_ANCHORS},
+        },
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+# -- independent numpy reimplementation of the reference decode ---------------
+
+
+def numpy_scrfd_decode(raw_outputs, input_size, score_thr, nms_thr):
+    """Reference decode semantics (``onnxrt_backend.py:425-483,882-1154``):
+    per-stride anchor centers (2 anchors/cell, cell-major), stride-scaled
+    distance2bbox/kps, score threshold, then greedy IoU NMS across strides.
+    Pure numpy, written against the reference's published algorithm — NOT
+    the repo implementation."""
+    fmc = len(STRIDES)
+    cands = []
+    for i, stride in enumerate(STRIDES):
+        scores = np.asarray(raw_outputs[i], np.float32).reshape(-1)
+        bbox = np.asarray(raw_outputs[fmc + i], np.float32).reshape(-1, 4) * stride
+        kps = np.asarray(raw_outputs[2 * fmc + i], np.float32).reshape(-1, 10) * stride
+        n = input_size // stride
+        grid_y, grid_x = np.mgrid[:n, :n]
+        centers = np.stack([grid_x, grid_y], -1).reshape(-1, 2).astype(np.float32) * stride
+        centers = np.repeat(centers, NUM_ANCHORS, axis=0)
+        mask = scores >= score_thr
+        x1 = centers[mask, 0] - bbox[mask, 0]
+        y1 = centers[mask, 1] - bbox[mask, 1]
+        x2 = centers[mask, 0] + bbox[mask, 2]
+        y2 = centers[mask, 1] + bbox[mask, 3]
+        kp = kps[mask].reshape(-1, 5, 2) + centers[mask][:, None, :]
+        cands.append((np.stack([x1, y1, x2, y2], -1), kp, scores[mask]))
+    boxes = np.concatenate([c[0] for c in cands])
+    kps = np.concatenate([c[1] for c in cands])
+    scores = np.concatenate([c[2] for c in cands])
+    # stable: ties broken by candidate index, like the reference's argsort
+    order = np.argsort(-scores, kind="stable")
+    boxes, kps, scores = boxes[order], kps[order], scores[order]
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    areas = (boxes[:, 2] - boxes[:, 0]).clip(0) * (boxes[:, 3] - boxes[:, 1]).clip(0)
+    for i in range(len(boxes)):
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[i + 1 :, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[i + 1 :, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[i + 1 :, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[i + 1 :, 3])
+        inter = (xx2 - xx1).clip(0) * (yy2 - yy1).clip(0)
+        iou = inter / np.maximum(areas[i] + areas[i + 1 :] - inter, 1e-9)
+        suppressed[i + 1 :] |= iou > nms_thr
+    return boxes[keep], kps[keep], scores[keep]
+
+
+def iou(a, b):
+    inter = max(0.0, min(a[2], b[2]) - max(a[0], b[0])) * max(
+        0.0, min(a[3], b[3]) - max(a[1], b[1])
+    )
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-9)
+
+
+@pytest.fixture(scope="module")
+def graph_face_mgr(tmp_path_factory):
+    from lumen_tpu.models.face import FaceManager
+
+    model_dir = make_graph_face_model_dir(tmp_path_factory.mktemp("gface"))
+    mgr = FaceManager(model_dir, dtype="float32", batch_size=2)
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+def _two_blob_image():
+    """128x128, two bright blobs far apart."""
+    img = np.zeros((DET_SIZE, DET_SIZE, 3), np.uint8)
+    img[24:40, 24:40] = 255
+    img[88:104, 80:96] = 255
+    return img
+
+
+class TestGraphFacePipeline:
+    def test_graph_path_selected(self, graph_face_mgr):
+        assert not isinstance(graph_face_mgr.det_vars.get("params"), dict)
+
+    def test_detects_bright_blobs(self, graph_face_mgr):
+        faces = graph_face_mgr.detect_faces(_two_blob_image())
+        assert len(faces) >= 2
+        centers = np.array([(f.bbox[:2] + f.bbox[2:]) / 2 for f in faces])
+        # one detection near each blob center
+        assert min(np.linalg.norm(centers - np.array([32, 32]), axis=1)) < 12
+        assert min(np.linalg.norm(centers - np.array([88, 96]), axis=1)) < 12
+        for f in faces:
+            assert f.landmarks is not None and f.landmarks.shape == (5, 2)
+
+    def test_decode_golden_parity_vs_numpy_reference(self, graph_face_mgr):
+        """Same raw graph outputs -> our on-device decode must match the
+        numpy reference-semantics decode: same box set (IoU>0.95), same
+        scores (reference bar from the round-1 verdict)."""
+        from lumen_tpu.models.face.graph import ScrfdGraph, find_onnx_models
+
+        img = _two_blob_image()
+        mgr = graph_face_mgr
+        faces = mgr.detect_faces(img)  # square image: scale=1, no pad
+
+        onnx_models = find_onnx_models(mgr.model_dir)
+        graph = ScrfdGraph.from_path(onnx_models["detection"], num_anchors=NUM_ANCHORS)
+        x = (img[None].astype(np.float32) - mgr.spec.det_mean) / mgr.spec.det_std
+        raw = graph.module(graph.module.params, {graph.module.input_names[0]: x.transpose(0, 3, 1, 2)})
+        g_boxes, g_kps, g_scores = numpy_scrfd_decode(
+            raw, DET_SIZE, mgr.spec.score_threshold, mgr.spec.nms_threshold
+        )
+
+        assert len(faces) == len(g_boxes)
+        matched = set()
+        for f in faces:
+            best, best_iou = None, 0.0
+            for j in range(len(g_boxes)):
+                if j in matched:
+                    continue
+                v = iou(f.bbox, g_boxes[j])
+                if v > best_iou:
+                    best, best_iou = j, v
+            assert best is not None and best_iou > 0.95, (f.bbox, g_boxes, best_iou)
+            matched.add(best)
+            assert abs(f.confidence - g_scores[best]) < 1e-3
+            np.testing.assert_allclose(f.landmarks, g_kps[best], atol=0.5)
+
+    def test_embedding_parity_vs_torch(self, graph_face_mgr):
+        """Bridge-executed ArcFace graph matches the torch forward."""
+        rng = np.random.RandomState(0)
+        crop = rng.randint(0, 256, (112, 112, 3)).astype(np.uint8)
+        emb = graph_face_mgr.extract_embedding(crop)
+        assert emb.shape == (512,)
+        np.testing.assert_allclose(np.linalg.norm(emb), 1.0, atol=1e-5)
+
+        import os
+
+        model = TinyArcFace()
+        model.load_state_dict(
+            torch.load(os.path.join(graph_face_mgr.model_dir, "rec_state.pt"))
+        )
+        model.eval()
+        x = (crop.astype(np.float32) - 127.5) / 127.5
+        with torch.no_grad():
+            want = model(torch.from_numpy(x.transpose(2, 0, 1)[None])).numpy()[0]
+        want /= np.linalg.norm(want)
+        cos = float(np.dot(emb, want))
+        assert cos > 0.999, cos
+
+    def test_detect_and_extract_end_to_end(self, graph_face_mgr):
+        import cv2
+
+        img = _two_blob_image()
+        ok, enc = cv2.imencode(".png", img[..., ::-1])
+        assert ok
+        faces = graph_face_mgr.detect_and_extract(enc.tobytes(), max_faces=2)
+        assert len(faces) == 2
+        for f in faces:
+            assert f.embedding is not None and abs(np.linalg.norm(f.embedding) - 1.0) < 1e-5
+
+
+class TestFaceHardFail:
+    def test_missing_weights_hard_fail(self, tmp_path):
+        from lumen_tpu.models.face import FaceManager
+        from tests.test_face import make_face_model_dir
+
+        import os
+
+        model_dir, det_cfg, rec_cfg = make_face_model_dir(tmp_path)
+        os.remove(os.path.join(model_dir, "detection.safetensors"))
+        mgr = FaceManager(
+            model_dir, dtype="float32", detector_cfg=det_cfg, embedder_cfg=rec_cfg
+        )
+        with pytest.raises(FileNotFoundError, match="detection"):
+            mgr.initialize()
