@@ -22,6 +22,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ops.sampling import apply_repetition_penalty, sample
 from .modeling import VLMConfig, VLMModel, init_kv_cache
@@ -109,8 +110,8 @@ class Generator:
         lengths,  # [B] live token count
         prompt_ids,  # [B, S_text] original text ids (for repetition penalty)
         rng,
-        max_new_tokens,  # traced scalar <= max_new_cap
-        temperature,
+        max_new_tokens,  # traced scalar or per-sample [B], <= max_new_cap
+        temperature,  # sampling params: traced scalars or per-sample [B]
         top_p,
         do_sample,
         repetition_penalty,
@@ -123,6 +124,7 @@ class Generator:
         tok0 = self._sample_next(
             sub, last_logits, seen, temperature, top_p, do_sample, repetition_penalty
         ).astype(jnp.int32)
+        max_new = jnp.broadcast_to(jnp.asarray(max_new_tokens, jnp.int32), (b,))
 
         buf = jnp.full((b, self.max_new_cap), cfg.pad_token_id, jnp.int32)
         state = dict(
@@ -131,14 +133,17 @@ class Generator:
             cur_len=lengths.astype(jnp.int32),  # cache slots filled so far
             t=jnp.zeros((), jnp.int32),
             rng=rng,
-            done=jnp.zeros((b,), bool),
+            # A zero-budget row must emit nothing even when batched with
+            # live rows (solo, cond already short-circuits).
+            done=max_new <= 0,
+            eos=jnp.zeros((b,), bool),
             buf=buf,
             seen=seen,
             n_gen=jnp.zeros((b,), jnp.int32),
         )
 
         def cond(s):
-            return (s["t"] < max_new_tokens) & ~jnp.all(s["done"])
+            return (s["t"] < jnp.max(max_new)) & ~jnp.all(s["done"])
 
         def body(s):
             active = ~s["done"]
@@ -146,7 +151,9 @@ class Generator:
             buf = s["buf"].at[:, s["t"]].set(tok)
             n_gen = s["n_gen"] + active.astype(jnp.int32)
             seen = s["seen"].at[jnp.arange(b), s["cur_tok"]].max(active)
-            done = s["done"] | (s["cur_tok"] == cfg.eos_token_id)
+            eos = s["eos"] | (active & (s["cur_tok"] == cfg.eos_token_id))
+            # A sample stops at its own cap (batched requests mix budgets).
+            done = s["done"] | eos | (n_gen >= max_new)
 
             # Next-token forward (skipped work when everyone is done: the
             # while_loop cond stops the whole program instead).
@@ -170,13 +177,14 @@ class Generator:
                 t=s["t"] + 1,
                 rng=rng,
                 done=done,
+                eos=eos,
                 buf=buf,
                 seen=seen,
                 n_gen=n_gen,
             )
 
         state = jax.lax.while_loop(cond, body, state)
-        return state["buf"], state["n_gen"], state["done"]
+        return state["buf"], state["n_gen"], state["eos"]
 
     def generate(
         self,
@@ -186,14 +194,18 @@ class Generator:
         lengths,
         prompt_ids,
         rng,
-        max_new_tokens: int = 256,
-        temperature: float = 0.0,
-        top_p: float = 1.0,
-        do_sample: bool = False,
-        repetition_penalty: float = 1.0,
+        max_new_tokens=256,
+        temperature=0.0,
+        top_p=1.0,
+        do_sample=False,
+        repetition_penalty=1.0,
     ) -> GenerateOutput:
-        cap = min(int(max_new_tokens), self.max_new_cap)
-        buf, n_gen, done = self._generate(
+        """Each generation param may be a python scalar (shared by the whole
+        batch) or a length-B sequence (batched serving with mixed request
+        configs — the capability the reference's one-request-at-a-time
+        backend lacks, ``onnxrt_backend.py:298-356``)."""
+        cap = np.minimum(np.asarray(max_new_tokens, np.int32), self.max_new_cap)
+        buf, n_gen, eos = self._generate(
             params,
             embeds,
             positions,
@@ -206,7 +218,7 @@ class Generator:
             jnp.asarray(do_sample, bool),
             jnp.asarray(repetition_penalty, jnp.float32),
         )
-        return GenerateOutput(tokens=buf, n_generated=n_gen, stopped_eos=done)
+        return GenerateOutput(tokens=buf, n_generated=n_gen, stopped_eos=eos)
 
     # -- streaming path (host loop, one compiled call per step) -------------
 

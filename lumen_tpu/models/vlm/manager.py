@@ -54,6 +54,114 @@ class GenerationChunk:
     metadata: dict[str, Any] = field(default_factory=dict)
 
 
+@dataclass
+class _PendingGen:
+    """One queued generation request inside the batcher."""
+
+    embeds: Any  # [1, L, H]
+    positions: Any  # [1, L]
+    length: Any  # [1]
+    prompt_ids: Any  # [1, S]
+    max_new: int
+    temperature: float
+    top_p: float
+    do_sample: bool
+    repetition_penalty: float
+    future: Any = None
+
+    @property
+    def key(self) -> tuple:
+        # Only identically-shaped requests share one compiled program.
+        return (self.embeds.shape[1], self.prompt_ids.shape[1])
+
+
+class _GenBatcher:
+    """Batched decode scheduler: collects concurrent ``generate`` requests
+    with the same prompt-bucket shape and decodes them as one [B>1]
+    program. Replaces the round-1 single-flight lock — the decoder's
+    per-sample cache offsets (``modeling.py``) already support mixed
+    positions, and per-sample sampling params (``ops/sampling.py``) support
+    mixed request configs, so aggregate tokens/sec scales with batch.
+    """
+
+    def __init__(self, runner, max_batch: int = 4, max_latency_ms: float = 6.0):
+        from concurrent.futures import Future
+
+        self._Future = Future
+        self._runner = runner
+        self.max_batch = max_batch
+        self.max_latency_s = max_latency_ms / 1e3
+        self.batches_run = 0  # observability: how often we actually batched
+        self.rows_run = 0
+        self._queue: list[_PendingGen] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, name="vlm-gen-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, item: _PendingGen):
+        item.future = self._Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("generation batcher is closed")
+            self._queue.append(item)
+            self._cond.notify()
+        return item.future
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=5)
+        with self._cond:
+            pending, self._queue = self._queue, []
+        for item in pending:
+            item.future.set_exception(RuntimeError("generation batcher closed"))
+
+    def _take_batch(self) -> list[_PendingGen]:
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return []
+            head = self._queue.pop(0)
+        batch = [head]
+        deadline = time.perf_counter() + self.max_latency_s
+        while len(batch) < self.max_batch:
+            with self._cond:
+                take = [i for i, it in enumerate(self._queue) if it.key == head.key]
+                for offset, i in enumerate(take[: self.max_batch - len(batch)]):
+                    batch.append(self._queue.pop(i - offset))
+            if len(batch) >= self.max_batch:
+                break
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            with self._cond:
+                self._cond.wait(timeout=remaining)
+                if self._closed:
+                    break
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._closed:
+                    return
+                continue
+            # Count before the futures resolve so a caller that joins its
+            # threads and immediately reads the counters sees this batch.
+            self.batches_run += 1
+            self.rows_run += len(batch)
+            try:
+                self._runner(batch)
+            except Exception as e:  # noqa: BLE001 - fan the failure out
+                for item in batch:
+                    if not item.future.done():
+                        item.future.set_exception(e)
+
+
 class VLMManager:
     def __init__(
         self,
@@ -63,6 +171,8 @@ class VLMManager:
         max_new_cap: int = 512,
         prefill_buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
         warmup: bool = False,
+        gen_batch_size: int = 4,
+        gen_batch_latency_ms: float = 6.0,
     ):
         self.model_dir = model_dir
         self.policy = get_policy(dtype)
@@ -70,13 +180,20 @@ class VLMManager:
         self.max_seq = max_seq
         self.max_new_cap = max_new_cap
         self.prefill_buckets = sorted(prefill_buckets)
+        self.gen_batch_size = gen_batch_size
+        self.gen_batch_latency_ms = gen_batch_latency_ms
         self.info: ModelInfo = load_model_info(model_dir)
         self.cfg = self._build_config(model_dir)
         self.model = VLMModel(self.cfg)
         self.model_id = self.info.name
         self._initialized = False
-        self._lock = threading.Lock()  # one generation stream at a time
+        self._seed_lock = threading.Lock()
         self._seed = 0
+        # Each live stream holds a full [1, max_seq] KV cache in device
+        # memory; without a bound, N concurrent streams allocate N caches
+        # and can exhaust HBM (batched generate() is already bounded by
+        # the single batcher thread).
+        self._stream_slots = threading.Semaphore(max(1, gen_batch_size))
 
     def _build_config(self, model_dir: str) -> VLMConfig:
         cfg_path = os.path.join(model_dir, "config.json")
@@ -181,6 +298,11 @@ class VLMManager:
 
         self._prepare = prepare
         self._prepare_text = prepare_text
+        self._batcher = _GenBatcher(
+            self._run_gen_batch,
+            max_batch=self.gen_batch_size,
+            max_latency_ms=self.gen_batch_latency_ms,
+        )
         self._initialized = True
         if self.warmup:
             # Compile the dominant path up front (smallest prompt bucket:
@@ -198,6 +320,8 @@ class VLMManager:
         )
 
     def close(self) -> None:
+        if self._initialized:
+            self._batcher.close()
         self._initialized = False
 
     # -- prompt prep -------------------------------------------------------
@@ -248,8 +372,54 @@ class VLMManager:
         return embeds, positions, lengths, jnp.asarray(padded), n
 
     def _next_rng(self) -> jax.Array:
-        self._seed += 1
-        return jax.random.PRNGKey(self._seed)
+        with self._seed_lock:
+            self._seed += 1
+            seed = self._seed
+        return jax.random.PRNGKey(seed)
+
+    # -- batched decode ----------------------------------------------------
+
+    def _run_gen_batch(self, items: list) -> None:
+        """Decode a same-shape group of requests as one [B] program and
+        fan the per-row results back out (runs on the batcher thread).
+
+        The batch dim is padded up to a power-of-two bucket (1,2,4,...)
+        so distinct compiled programs per prompt bucket stay bounded at
+        log2(max_batch)+1 instead of one per observed batch size — a
+        serving-time compile on the sole batcher thread stalls every
+        queued request. Padding rows replay row 0 with a zero budget, so
+        they exit the decode loop immediately."""
+        b = len(items)
+        bucket = 1
+        while bucket < b:
+            bucket *= 2
+        pad = bucket - b
+
+        def stack(rows, pad_row):
+            return jnp.concatenate(list(rows) + [pad_row] * pad, axis=0)
+
+        embeds = stack((it.embeds for it in items), items[0].embeds)
+        positions = stack((it.positions for it in items), items[0].positions)
+        lengths = stack((it.length for it in items), items[0].length)
+        prompt_ids = stack((it.prompt_ids for it in items), items[0].prompt_ids)
+        out = self.generator.generate(
+            self.params,
+            embeds,
+            positions,
+            lengths,
+            prompt_ids,
+            self._next_rng(),
+            max_new_tokens=[it.max_new for it in items] + [0] * pad,
+            temperature=[it.temperature for it in items] + [0.0] * pad,
+            top_p=[it.top_p for it in items] + [1.0] * pad,
+            do_sample=[it.do_sample for it in items] + [False] * pad,
+            repetition_penalty=[it.repetition_penalty for it in items] + [1.0] * pad,
+        )
+        tokens = np.asarray(out.tokens)
+        n_gen = np.asarray(out.n_generated)
+        eos = np.asarray(out.stopped_eos)
+        for i, item in enumerate(items):
+            item.future.set_result((tokens[i], int(n_gen[i]), bool(eos[i])))
 
     # -- generation --------------------------------------------------------
 
@@ -266,27 +436,26 @@ class VLMManager:
     ) -> GenerationResult:
         self._ensure_ready()
         t0 = time.perf_counter()
-        with self._lock:
-            embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
-                messages, image_bytes
+        embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
+            messages, image_bytes
+        )
+        future = self._batcher.submit(
+            _PendingGen(
+                embeds=embeds,
+                positions=positions,
+                length=lengths,
+                prompt_ids=prompt_ids,
+                max_new=min(int(max_new_tokens), self.max_new_cap),
+                temperature=float(temperature),
+                top_p=float(top_p),
+                do_sample=bool(do_sample),
+                repetition_penalty=float(repetition_penalty),
             )
-            out = self.generator.generate(
-                self.params,
-                embeds,
-                positions,
-                lengths,
-                prompt_ids,
-                self._next_rng(),
-                max_new_tokens=max_new_tokens,
-                temperature=temperature,
-                top_p=top_p,
-                do_sample=do_sample,
-                repetition_penalty=repetition_penalty,
-            )
-        n_gen = int(out.n_generated[0])
-        tokens = [int(t) for t in np.asarray(out.tokens[0][:n_gen])]
+        )
+        row_tokens, n_gen, stopped_eos = future.result()
+        tokens = [int(t) for t in row_tokens[:n_gen]]
         text = self.tokenizer.decode(tokens)
-        finish = "eos_token" if bool(out.stopped_eos[0]) else "length"
+        finish = "eos_token" if stopped_eos else "length"
         text, hit = _truncate_on_stop(text, stop_sequences)
         if hit:
             finish = "stop_sequence"
@@ -325,56 +494,72 @@ class VLMManager:
         # Hold back enough text that a stop sequence straddling a chunk
         # boundary can still be cut before emission.
         holdback = max((len(s) for s in stop_sequences), default=1) - 1 if stop_sequences else 0
-        with self._lock:
-            embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
-                messages, image_bytes
+        # No global lock: the generator's prefill/step programs carry all
+        # state explicitly (caches are per-call values), so concurrent
+        # streams and batched generates interleave safely. The semaphore
+        # only bounds how many stream KV caches are live at once.
+        self._stream_slots.acquire()
+        try:
+            yield from self._stream_locked(
+                messages, image_bytes, max_new_tokens, temperature, top_p,
+                do_sample, repetition_penalty, stop_sequences, holdback, t0,
             )
-            tokens: list[int] = []
-            emitted = ""
-            finish = "length"
-            final_text: str | None = None
-            for tok in self.generator.stream(
-                self.params,
-                embeds,
-                positions,
-                lengths,
-                prompt_ids,
-                self._next_rng(),
-                max_new_tokens=max_new_tokens,
-                temperature=temperature,
-                top_p=top_p,
-                do_sample=do_sample,
-                repetition_penalty=repetition_penalty,
-            ):
-                tokens.append(tok)
-                if tok == self.cfg.eos_token_id:
-                    finish = "eos_token"
+        finally:
+            self._stream_slots.release()
+
+    def _stream_locked(
+        self, messages, image_bytes, max_new_tokens, temperature, top_p,
+        do_sample, repetition_penalty, stop_sequences, holdback, t0,
+    ) -> Iterator[GenerationChunk]:
+        embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
+            messages, image_bytes
+        )
+        tokens: list[int] = []
+        emitted = ""
+        finish = "length"
+        final_text: str | None = None
+        for tok in self.generator.stream(
+            self.params,
+            embeds,
+            positions,
+            lengths,
+            prompt_ids,
+            self._next_rng(),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            do_sample=do_sample,
+            repetition_penalty=repetition_penalty,
+        ):
+            tokens.append(tok)
+            if tok == self.cfg.eos_token_id:
+                finish = "eos_token"
+                break
+            text = self.tokenizer.decode(tokens)
+            # Byte-level BPE can split a multi-byte character across
+            # tokens: the partial decode ends in U+FFFD and is not a
+            # prefix of the next decode. Emit only stable prefixes.
+            if text.endswith("�"):
+                continue
+            if stop_sequences:
+                truncated, hit = _truncate_on_stop(text, stop_sequences)
+                if hit:
+                    finish = "stop_sequence"
+                    final_text = truncated
                     break
-                text = self.tokenizer.decode(tokens)
-                # Byte-level BPE can split a multi-byte character across
-                # tokens: the partial decode ends in U+FFFD and is not a
-                # prefix of the next decode. Emit only stable prefixes.
-                if text.endswith("�"):
-                    continue
-                if stop_sequences:
-                    truncated, hit = _truncate_on_stop(text, stop_sequences)
-                    if hit:
-                        finish = "stop_sequence"
-                        final_text = truncated
-                        break
-                if not text.startswith(emitted):
-                    continue  # transient divergence; wait for re-extension
-                delta = text[len(emitted) : max(len(text) - holdback, len(emitted))]
-                if delta:
-                    emitted += delta
-                    yield GenerationChunk(text=delta, tokens=[tok])
-            if final_text is None:
-                final_text = self.tokenizer.decode(tokens)
-            # Flush the held-back tail so the stream equals generate().
-            if final_text.startswith(emitted) and len(final_text) > len(emitted):
-                tail = final_text[len(emitted) :]
-                emitted = final_text
-                yield GenerationChunk(text=tail, tokens=[])
+            if not text.startswith(emitted):
+                continue  # transient divergence; wait for re-extension
+            delta = text[len(emitted) : max(len(text) - holdback, len(emitted))]
+            if delta:
+                emitted += delta
+                yield GenerationChunk(text=delta, tokens=[tok])
+        if final_text is None:
+            final_text = self.tokenizer.decode(tokens)
+        # Flush the held-back tail so the stream equals generate().
+        if final_text.startswith(emitted) and len(final_text) > len(emitted):
+            tail = final_text[len(emitted) :]
+            emitted = final_text
+            yield GenerationChunk(text=tail, tokens=[])
         dt_ms = (time.perf_counter() - t0) * 1e3
         yield GenerationChunk(
             text="",

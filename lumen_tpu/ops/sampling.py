@@ -17,11 +17,22 @@ def greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1)
 
 
+def _per_sample(value, logits: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a scalar or per-sample [...] param against [..., V] logits."""
+    v = jnp.asarray(value, jnp.float32)
+    if v.ndim == logits.ndim - 1 and v.ndim > 0:
+        v = v[..., None]
+    return v
+
+
 def apply_repetition_penalty(
-    logits: jnp.ndarray, token_mask: jnp.ndarray, penalty: float
+    logits: jnp.ndarray, token_mask: jnp.ndarray, penalty
 ) -> jnp.ndarray:
     """CTRL-style penalty over tokens already generated (``token_mask``:
-    [..., V] bool). Positive logits are divided, negative multiplied."""
+    [..., V] bool). Positive logits are divided, negative multiplied.
+    ``penalty`` may be a scalar or per-sample [B] (batched serving mixes
+    request configs in one program)."""
+    penalty = _per_sample(penalty, logits)
     penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
     return jnp.where(token_mask, penalized, logits)
 
@@ -34,7 +45,7 @@ def top_p_filter(logits: jnp.ndarray, top_p: jnp.ndarray | float) -> jnp.ndarray
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
     # Position k is kept if the cumulative mass BEFORE it is < top_p; the
     # top-1 token is always kept (top_p=0 must mean greedy, not empty set).
-    keep_sorted = (cumulative - sorted_probs) < top_p
+    keep_sorted = (cumulative - sorted_probs) < _per_sample(top_p, logits)
     keep_sorted = keep_sorted.at[..., 0].set(True)
     # Threshold logit = smallest kept logit.
     threshold = jnp.min(
@@ -52,11 +63,13 @@ def sample(
 ) -> jnp.ndarray:
     """Temperature + top-p categorical sampling; falls back to greedy when
     ``do_sample`` is False or temperature ~ 0. All args may be traced values
+    (scalars, or per-sample [B] vectors for batched mixed-config serving)
     so one compiled program serves every generation config."""
     greedy_ids = greedy(logits)
-    safe_temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
-    scaled = logits.astype(jnp.float32) / safe_temp
+    scaled = logits.astype(jnp.float32) / jnp.maximum(_per_sample(temperature, logits), 1e-6)
     filtered = top_p_filter(scaled, top_p)
     sampled_ids = jax.random.categorical(rng, filtered, axis=-1)
-    use_sample = jnp.asarray(do_sample) & (jnp.asarray(temperature, jnp.float32) > 1e-6)
+    # [B]-or-scalar shaped, matching the ids
+    hot = jnp.asarray(temperature, jnp.float32) > 1e-6
+    use_sample = jnp.asarray(do_sample) & hot
     return jnp.where(use_sample, sampled_ids, greedy_ids)

@@ -100,16 +100,15 @@ def make_graph_face_model_dir(tmp_path):
         input_names=["input"],
         dynamic_axes={"input": {0: "b"}},
     )
-    global _REC_MODEL
-    _REC_MODEL = TinyArcFace()
+    rec_model = TinyArcFace()
     export_onnx(
-        _REC_MODEL,
+        rec_model,
         (torch.randn(1, 3, 112, 112),),
         str(model_dir / "w600k_r50.onnx"),
         input_names=["input"],
         dynamic_axes={"input": {0: "b"}},
     )
-    torch.save(_REC_MODEL.state_dict(), str(model_dir / "rec_state.pt"))
+    torch.save(rec_model.state_dict(), str(model_dir / "rec_state.pt"))
     info = {
         "name": "GraphFace",
         "version": "1.0.0",
