@@ -1,0 +1,77 @@
+"""Graph-backed VLM vision tower: FastVLM ``vision.onnx`` on TPU.
+
+The reference serves FastVLM as three onnxruntime sessions — vision.onnx,
+embed.onnx, decoder.onnx (``packages/lumen-vlm/src/lumen_vlm/backends/
+onnxrt_backend.py:107-140``). The autoregressive decoder runs best as our
+native Flax Qwen2 (fused while_loop decode, golden-tested against HF in
+``tests/test_vlm_golden.py``), but the vision tower is a single static-
+shape forward per image — exactly what the ONNX->JAX bridge serves well.
+Running ``vision.onnx`` through the bridge means FastViTHD-style hybrid
+conv/attention towers work with the exporter's own weights, no per-
+architecture conversion rules (the round-1 gap: "real FastVLM vision
+towers will not convert").
+
+Contract (reference ``_run_vision_encoder:661-729``): input [B,3,S,S]
+normalized pixels, output [B, N, H_decoder] projector-space embeddings
+ready to splice at the image-token position.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+from ...onnx_bridge import OnnxModule
+
+logger = logging.getLogger(__name__)
+
+
+def find_vision_onnx(model_dir: str) -> str | None:
+    """Locate a ``vision*.onnx`` export (bare dir or ``onnx/`` subdir)."""
+    dirs = [model_dir, os.path.join(model_dir, "onnx")]
+    for d in dirs:
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.startswith("vision") and name.endswith(".onnx"):
+                return os.path.join(d, name)
+    return None
+
+
+@dataclass
+class VisionGraph:
+    """[B,3,S,S] normalized floats -> [B,N,H] splice-ready embeddings."""
+
+    module: OnnxModule
+
+    @classmethod
+    def from_path(cls, path: str) -> "VisionGraph":
+        return cls(module=OnnxModule.from_path(path))
+
+    def __call__(self, params: dict, x_nchw):
+        import jax.numpy as jnp
+
+        out = jnp.asarray(self.module(params, {self.module.input_names[0]: x_nchw})[0])
+        if out.ndim != 3:
+            raise ValueError(
+                f"vision graph output must be [B, N, H], got shape {out.shape}"
+            )
+        return out
+
+    def probe(self, image_size: int, hidden_size: int) -> int:
+        """Run once on zeros to learn the token count and validate the
+        embedding width against the decoder's hidden size."""
+        import numpy as np
+
+        out = self(
+            self.module.params,
+            np.zeros((1, 3, image_size, image_size), np.float32),
+        )
+        n, h = int(out.shape[1]), int(out.shape[2])
+        if h != hidden_size:
+            raise ValueError(
+                f"vision graph emits width {h}, decoder hidden is {hidden_size}: "
+                "the export must include the multimodal projector"
+            )
+        return n

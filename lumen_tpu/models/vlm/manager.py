@@ -144,22 +144,35 @@ class _GenBatcher:
         return batch
 
     def _loop(self) -> None:
-        while True:
-            batch = self._take_batch()
-            if not batch:
-                if self._closed:
-                    return
-                continue
-            # Count before the futures resolve so a caller that joins its
-            # threads and immediately reads the counters sees this batch.
-            self.batches_run += 1
-            self.rows_run += len(batch)
-            try:
-                self._runner(batch)
-            except Exception as e:  # noqa: BLE001 - fan the failure out
-                for item in batch:
-                    if not item.future.done():
-                        item.future.set_exception(e)
+        try:
+            while True:
+                batch = self._take_batch()
+                if not batch:
+                    if self._closed:
+                        return
+                    continue
+                # Count before the futures resolve so a caller that joins
+                # its threads and immediately reads the counters sees this
+                # batch.
+                self.batches_run += 1
+                self.rows_run += len(batch)
+                try:
+                    self._runner(batch)
+                except Exception as e:  # noqa: BLE001 - fan the failure out
+                    for item in batch:
+                        if not item.future.done():
+                            item.future.set_exception(e)
+        finally:
+            # Worker death for ANY reason (incl. BaseException like
+            # KeyboardInterrupt) must not strand callers blocked on
+            # futures: close the queue and fail everything pending.
+            with self._cond:
+                self._closed = True
+                pending, self._queue = self._queue, []
+            err = RuntimeError("generation batcher worker exited")
+            for item in pending:
+                if item.future is not None and not item.future.done():
+                    item.future.set_exception(err)
 
 
 class VLMManager:
@@ -187,6 +200,8 @@ class VLMManager:
         self.model = VLMModel(self.cfg)
         self.model_id = self.info.name
         self._initialized = False
+        # Overridden at initialize() when a vision.onnx graph is probed.
+        self.vision_tokens = self.cfg.vision.num_tokens
         self._seed_lock = threading.Lock()
         self._seed = 0
         # Each live stream holds a full [1, max_seq] KV cache in device
@@ -242,6 +257,8 @@ class VLMManager:
     def initialize(self) -> None:
         if self._initialized:
             return
+        from .graph import VisionGraph, find_vision_onnx
+
         logger.info("loading VLM weights from %s", self.model_dir)
         state = load_state_dict(self.model_dir)
         init = jax.eval_shape(
@@ -253,15 +270,59 @@ class VLMManager:
                 ),
             )["params"]
         )
-        params = convert_vlm_checkpoint(
-            state, init, tie_word_embeddings=self.cfg.decoder.tie_word_embeddings
+        from ...runtime.weights import assert_tree_shapes
+
+        # Vision backend selection. ``auto`` (default): prefer converted
+        # Flax vision weights when the checkpoint ships a complete tower —
+        # an auxiliary vision*.onnx (e.g. an optimum export without the
+        # projector) must not break a previously-working model dir — and
+        # fall back to the ONNX graph otherwise (FastVLM-style repos whose
+        # FastViTHD tower has no conversion rules). ``graph``/``native``
+        # in model_info extra_metadata force one path.
+        backend = str((self.info.extra_metadata or {}).get("vision_backend", "auto"))
+        converted = convert_vlm_checkpoint(
+            state, None, tie_word_embeddings=self.cfg.decoder.tie_word_embeddings
         )
+        has_native_vision = _subtree_matches(converted.get("vision"), init["vision"])
+        vision_onnx = find_vision_onnx(self.model_dir) if backend != "native" else None
+        vision_graph: VisionGraph | None = None
+        if vision_onnx is not None and (backend == "graph" or not has_native_vision):
+            vision_graph = VisionGraph.from_path(vision_onnx)
+            params = converted
+            # The Flax vision subtree is never executed on this path; keep
+            # the shape gate on the decoder half only and don't burn HBM
+            # on a dead tower.
+            params.pop("vision", None)
+            gate = {k: v for k, v in init.items() if k != "vision"}
+            assert_tree_shapes(params, gate)
+        else:
+            if vision_onnx is None and backend == "graph":
+                raise FileNotFoundError(
+                    f"vision_backend=graph but no vision*.onnx in {self.model_dir}"
+                )
+            params = converted
+            assert_tree_shapes(params, init)
         params = self.policy.cast_params(params)
         self.params = jax.device_put(params)
         self.tokenizer = VlmTokenizer.from_model_dir(self.model_dir)
+        if vision_graph is not None:
+            self.vision_tokens = vision_graph.probe(
+                self.cfg.vision.image_size, self.cfg.decoder.hidden_size
+            )
+            self._vision_params = jax.device_put(dict(vision_graph.module.params))
+            logger.info(
+                "vlm vision tower: graph %s (%d MB params, %d tokens)",
+                vision_onnx,
+                vision_graph.module.param_bytes() >> 20,
+                self.vision_tokens,
+            )
+            # The host fp32 copy is duplicated on device now; the compiled
+            # program receives weights via the vparams argument, so free
+            # the originals instead of pinning them in the closure.
+            vision_graph.module.params.clear()
         # A prompt bucket is usable only if prompt + vision tokens + the
         # decode budget fit in the KV buffer.
-        v = self.cfg.vision.num_tokens
+        v = self.vision_tokens
         self.prefill_buckets = [
             b for b in self.prefill_buckets if b - 1 + v + self.max_new_cap + 1 <= self.max_seq
         ]
@@ -279,15 +340,32 @@ class VLMManager:
         mean = jnp.asarray(vis_cfg.mean)
         std = jnp.asarray(vis_cfg.std)
 
-        @jax.jit
-        def prepare(params, pixels_u8, ids, length):
-            x = pixels_u8.astype(jnp.float32) / 255.0
-            x = ((x - mean) / std).astype(compute)
-            vis = self.model.apply({"params": params}, x, method=VLMModel.encode_vision)
-            text = self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
-            return merge_image_embeddings(
-                text.astype(compute), vis, ids, self.cfg.image_token_id, length
-            )
+        if vision_graph is not None:
+
+            @jax.jit
+            def prepare_graph(params, vparams, pixels_u8, ids, length):
+                x = pixels_u8.astype(jnp.float32) / 255.0
+                x = (x - mean) / std
+                vis = vision_graph(vparams, x.transpose(0, 3, 1, 2)).astype(compute)
+                text = self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
+                return merge_image_embeddings(
+                    text.astype(compute), vis, ids, self.cfg.image_token_id, length
+                )
+
+            def prepare(params, pixels_u8, ids, length):
+                return prepare_graph(params, self._vision_params, pixels_u8, ids, length)
+
+        else:
+
+            @jax.jit
+            def prepare(params, pixels_u8, ids, length):
+                x = pixels_u8.astype(jnp.float32) / 255.0
+                x = ((x - mean) / std).astype(compute)
+                vis = self.model.apply({"params": params}, x, method=VLMModel.encode_vision)
+                text = self.model.apply({"params": params}, ids, method=VLMModel.embed_tokens)
+                return merge_image_embeddings(
+                    text.astype(compute), vis, ids, self.cfg.image_token_id, length
+                )
 
         @jax.jit
         def prepare_text(params, ids, length):
@@ -316,7 +394,7 @@ class VLMManager:
             self.model_id,
             self.cfg.decoder.layers,
             self.cfg.decoder.hidden_size,
-            vis_cfg.num_tokens,
+            self.vision_tokens,
         )
 
     def close(self) -> None:
@@ -578,6 +656,24 @@ class VLMManager:
     def _ensure_ready(self) -> None:
         if not self._initialized:
             raise RuntimeError("VLMManager.initialize() not called")
+
+
+def _flat_shapes(tree, prefix=""):
+    out = {}
+    for k, v in (tree or {}).items():
+        if isinstance(v, dict):
+            out.update(_flat_shapes(v, f"{prefix}{k}/"))
+        else:
+            out[f"{prefix}{k}"] = tuple(v.shape)
+    return out
+
+
+def _subtree_matches(sub, ref) -> bool:
+    """True when ``sub`` carries exactly the leaves/shapes of ``ref`` — the
+    checkpoint genuinely ships this subtree (not a partial or absent one)."""
+    if not isinstance(sub, dict) or not sub:
+        return False
+    return _flat_shapes(sub) == _flat_shapes(ref)
 
 
 def _truncate_on_stop(text: str, stop_sequences: Sequence[str] | None) -> tuple[str, bool]:

@@ -56,7 +56,27 @@ class VlmService(BaseService):
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
-        manager = VLMManager(model_dir, dtype=bs.dtype, warmup=bs.warmup)
+        kw = {}
+        if bs.batch_buckets:
+            kw["prefill_buckets"] = tuple(bs.batch_buckets)
+        # batch_size here is the decode batch (requests coalesced per
+        # program) and the stream-cache bound — NOT a CLIP-style image
+        # batch. Configs written before per-family sizing may carry the
+        # headline batch (e.g. 256); clamp to a sane decode width instead
+        # of allocating hundreds of KV caches.
+        gen_batch = max(1, min(bs.batch_size, 16))
+        if gen_batch != bs.batch_size:
+            logger.warning(
+                "vlm batch_size %d clamped to %d (decode batch)", bs.batch_size, gen_batch
+            )
+        manager = VLMManager(
+            model_dir,
+            dtype=bs.dtype,
+            warmup=bs.warmup,
+            gen_batch_size=gen_batch,
+            gen_batch_latency_ms=bs.max_batch_latency_ms,
+            **kw,
+        )
         manager.initialize()
         return cls(manager)
 
@@ -69,7 +89,7 @@ class VlmService(BaseService):
             extra={
                 "max_new_cap": str(self.manager.max_new_cap),
                 "max_seq": str(self.manager.max_seq),
-                "vision_tokens": str(self.manager.cfg.vision.num_tokens),
+                "vision_tokens": str(self.manager.vision_tokens),
                 "vocab_size": str(self.manager.cfg.decoder.vocab_size),
             },
         )

@@ -35,7 +35,12 @@ def _install_onnx_shim():
     model whose graph iterates empty satisfies it."""
     if "onnx" in sys.modules:
         return
+    import importlib.machinery
+
     shim = types.ModuleType("onnx")
+    # a real ModuleSpec so later importlib.util.find_spec("onnx") probes
+    # (e.g. transformers' availability checks) don't explode
+    shim.__spec__ = importlib.machinery.ModuleSpec("onnx", loader=None)
 
     class _Graph:
         node = ()

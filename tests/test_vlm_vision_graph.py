@@ -1,0 +1,182 @@
+"""FastVLM-style serving: a torch-exported ``vision.onnx`` tower (hybrid
+conv/SE/attention, FastViT-flavored) runs through the ONNX bridge while the
+decoder runs as native Flax — the split that serves real FastVLM repos
+(reference three-session layout, ``packages/lumen-vlm/src/lumen_vlm/
+backends/onnxrt_backend.py:107-140``; round-1 gap: FastViTHD towers had no
+conversion path)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from lumen_tpu.models.vlm import ChatMessage, VLMManager  # noqa: E402
+from tests.test_onnx_bridge import export_onnx  # noqa: E402
+from tests.test_vlm import make_vlm_model_dir, png_bytes  # noqa: E402
+
+HIDDEN = 32  # TinyVLM decoder hidden size
+IMG = 32  # TinyVLM vision image size
+
+
+class FastVitStyleTower(nn.Module):
+    """Conv stem + SE + self-attention mixer + projector: the hybrid op mix
+    of FastViTHD, shrunk. [B,3,32,32] -> [B,16,32] splice-ready tokens."""
+
+    def __init__(self):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 16, 3, 2, 1),
+            nn.BatchNorm2d(16),
+            nn.GELU(),
+            nn.Conv2d(16, 16, 3, 1, 1, groups=16),  # depthwise
+            nn.Conv2d(16, 24, 1),
+            nn.GELU(),
+            nn.AvgPool2d(2),
+        )
+        self.se_fc1 = nn.Conv2d(24, 8, 1)
+        self.se_fc2 = nn.Conv2d(24 // 3, 24, 1) if False else nn.Conv2d(8, 24, 1)
+        self.pool = nn.AvgPool2d(2)  # -> 4x4 = 16 tokens
+        self.qkv = nn.Linear(24, 3 * 24)
+        self.proj = nn.Linear(24, HIDDEN)
+
+    def forward(self, x):
+        f = self.stem(x)  # [B,24,8,8]
+        s = torch.sigmoid(self.se_fc2(torch.relu(self.se_fc1(f.mean((2, 3), keepdim=True)))))
+        f = self.pool(f * s)  # [B,24,4,4]
+        b = f.shape[0]
+        t = f.flatten(2).transpose(1, 2)  # [B,16,24]
+        qkv = self.qkv(t).reshape(b, 16, 3, 4, 6).permute(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        att = torch.softmax(q @ k.transpose(-2, -1) / 6**0.5, dim=-1)
+        t = t + (att @ v).transpose(1, 2).reshape(b, 16, 24)
+        return self.proj(t)
+
+
+def make_fastvlm_style_dir(tmp_path, backend="graph"):
+    import json
+
+    model_dir = make_vlm_model_dir(tmp_path)  # expects a pathlib.Path
+    torch.manual_seed(3)
+    tower = FastVitStyleTower()
+    export_onnx(
+        tower,
+        (torch.randn(1, 3, IMG, IMG),),
+        model_dir + "/vision.onnx",
+        input_names=["pixel_values"],
+        dynamic_axes={"pixel_values": {0: "b"}},
+    )
+    torch.save(tower.state_dict(), model_dir + "/vision_state.pt")
+    # The TinyVLM fixture ships a complete native vision tower too; a real
+    # FastVLM repo would not, so its manifest pins the graph backend.
+    info_path = model_dir + "/model_info.json"
+    info = json.loads(open(info_path).read())
+    if backend is not None:
+        info["extra_metadata"] = {**info.get("extra_metadata", {}), "vision_backend": backend}
+        open(info_path, "w").write(json.dumps(info))
+    return model_dir
+
+
+@pytest.fixture(scope="module")
+def graph_vlm(tmp_path_factory):
+    model_dir = make_fastvlm_style_dir(tmp_path_factory.mktemp("gvlm"))
+    mgr = VLMManager(
+        model_dir, dtype="float32", max_seq=128, max_new_cap=16, prefill_buckets=(16, 32)
+    )
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+class TestVisionGraphServing:
+    def test_probe_found_graph_tokens(self, graph_vlm):
+        # graph emits 16 tokens, not the Flax tower's (32/16)^2 = 4
+        assert graph_vlm.vision_tokens == 16
+
+    def test_generate_with_image(self, graph_vlm):
+        out = graph_vlm.generate(
+            [ChatMessage(role="user", content="describe <image>")],
+            image_bytes=png_bytes(IMG),
+            max_new_tokens=4,
+        )
+        assert len(out.tokens) == 4
+        assert out.finish_reason in ("length", "eos_token")
+
+    def test_image_changes_generation(self, graph_vlm):
+        """The graph tower's output actually conditions the decode."""
+        text_only = graph_vlm.generate(
+            [ChatMessage(role="user", content="describe")], max_new_tokens=6
+        )
+        with_img = graph_vlm.generate(
+            [ChatMessage(role="user", content="describe")],
+            image_bytes=png_bytes(IMG, seed=1),
+            max_new_tokens=6,
+        )
+        assert text_only.tokens != with_img.tokens
+
+    def test_vision_embeddings_match_torch(self, graph_vlm):
+        """Spliced image-position embeddings == torch tower forward."""
+        import cv2
+
+        rng = np.random.RandomState(5)
+        img = rng.randint(0, 256, (IMG, IMG, 3)).astype(np.uint8)
+        ok, enc = cv2.imencode(".png", img[..., ::-1])
+        assert ok
+
+        msgs = [ChatMessage(role="user", content="hi <image>")]
+        ids = graph_vlm._encode_prompt(msgs, has_image=True)
+        pos = ids.index(graph_vlm.cfg.image_token_id)
+        embeds, _, _, _, _ = graph_vlm._prepare_inputs(msgs, enc.tobytes())
+        got = np.asarray(embeds[0, pos : pos + 16], np.float32)
+
+        tower = FastVitStyleTower()
+        tower.load_state_dict(torch.load(graph_vlm.model_dir + "/vision_state.pt"))
+        tower.eval()
+        mean = np.asarray(graph_vlm.cfg.vision.mean, np.float32)
+        std = np.asarray(graph_vlm.cfg.vision.std, np.float32)
+        x = (img.astype(np.float32) / 255.0 - mean) / std
+        with torch.no_grad():
+            want = tower(torch.from_numpy(x.transpose(2, 0, 1)[None])).numpy()[0]
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+    def test_auto_prefers_complete_native_vision(self, tmp_path):
+        """An auxiliary vision.onnx must not hijack a model dir whose
+        checkpoint ships a complete converted vision tower (review
+        finding: no-fallback startup failures)."""
+        model_dir = make_fastvlm_style_dir(tmp_path, backend=None)  # auto
+        mgr = VLMManager(
+            model_dir, dtype="float32", max_seq=128, max_new_cap=16, prefill_buckets=(16, 32)
+        )
+        mgr.initialize()
+        try:
+            # native Flax tower: (32/16)^2 = 4 tokens, not the graph's 16
+            assert mgr.vision_tokens == 4
+        finally:
+            mgr.close()
+
+    def test_bad_width_rejected(self, tmp_path):
+        """A vision export missing the projector (wrong width) fails loudly
+        at initialize, not with silent garbage at serve time."""
+
+        import json
+
+        class NoProjector(nn.Module):
+            def forward(self, x):
+                b = x.shape[0]
+                return x.flatten(2).transpose(1, 2)[:, :4, :24]
+
+        model_dir = make_vlm_model_dir(tmp_path)
+        export_onnx(
+            NoProjector(),
+            (torch.randn(1, 3, IMG, IMG),),
+            model_dir + "/vision.onnx",
+        )
+        info_path = model_dir + "/model_info.json"
+        info = json.loads(open(info_path).read())
+        info["extra_metadata"] = {"vision_backend": "graph"}
+        open(info_path, "w").write(json.dumps(info))
+        mgr = VLMManager(model_dir, dtype="float32", max_seq=128, max_new_cap=16, prefill_buckets=(16,))
+        with pytest.raises(ValueError, match="projector|width"):
+            mgr.initialize()
