@@ -152,10 +152,15 @@ def build_app(state: AppState | None = None) -> web.Application:
                     name: {
                         "description": p.description,
                         "platform": p.platform,
+                        "generation": p.generation,
                         "chips": p.chips,
                         "mesh_axes": p.mesh_axes,
                         "dtype": p.dtype,
                         "batch_size": p.batch_size,
+                        "face_batch": p.face_batch,
+                        "ocr_batch": p.ocr_batch,
+                        "vlm_gen_batch": p.vlm_gen_batch,
+                        "max_batch_latency_ms": p.max_batch_latency_ms,
                         "max_tier": p.max_tier,
                     }
                     for name, p in PRESETS.items()

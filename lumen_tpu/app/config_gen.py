@@ -38,26 +38,37 @@ TIER_SERVICES = {
 
 
 def _service_block(family: str, preset: DevicePreset, region: str) -> dict[str, Any]:
+    """Per-family backend settings sized to the preset's chip generation
+    (the reference carries one batch size per device preset,
+    ``config.py:41-279``; TPU presets size each family separately because
+    their device programs differ in arithmetic intensity)."""
     models: dict[str, Any]
+    settings: dict[str, Any] = {
+        "dtype": preset.dtype,
+        "mesh": {"axes": dict(preset.mesh_axes)},
+        "max_batch_latency_ms": preset.max_batch_latency_ms,
+    }
     if family == "clip":
         models = {"clip": {"model": CLIP_MODELS[region], "runtime": "jax"}}
+        settings["batch_size"] = preset.batch_size
     elif family == "face":
         models = {"face": {"model": FACE_MODEL, "runtime": "jax"}}
+        settings["batch_size"] = preset.face_batch
     elif family == "ocr":
         models = {"ocr": {"model": OCR_MODEL, "runtime": "jax"}}
+        settings["batch_size"] = preset.ocr_batch
+        settings["batch_buckets"] = list(preset.ocr_det_buckets)
     elif family == "vlm":
         models = {"vlm": {"model": VLM_MODEL, "runtime": "jax"}}
+        settings["batch_size"] = preset.vlm_gen_batch
+        settings["batch_buckets"] = list(preset.vlm_prefill_buckets)
     else:
         raise ValueError(f"unknown service family {family!r}")
     return {
         "enabled": True,
         "package": f"lumen_tpu.serving.services.{family}_service",
         "import_info": {"registry_class": SERVICE_REGISTRY_CLASSES[family]},
-        "backend_settings": {
-            "batch_size": preset.batch_size,
-            "dtype": preset.dtype,
-            "mesh": {"axes": dict(preset.mesh_axes)},
-        },
+        "backend_settings": settings,
         "models": models,
     }
 

@@ -16,7 +16,12 @@ import subprocess
 import sys
 from dataclasses import dataclass, field
 
-from lumen_tpu.app.presets import detect_preset, supported_presets
+from lumen_tpu.app.presets import (
+    chip_spec,
+    detect_preset,
+    parse_generation,
+    supported_presets,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -114,10 +119,22 @@ def hardware_report(hw: HardwareInfo | None = None) -> dict:
     """Detection + the preset recommendation the wizard shows."""
     hw = hw or detect_hardware()
     plat = "tpu" if hw.platform == "tpu" else "cpu"
-    best = detect_preset(plat, hw.device_count)
-    supported = supported_presets(plat, hw.device_count)
+    best = detect_preset(plat, hw.device_count, hw.device_kind)
+    supported = supported_presets(plat, hw.device_count, hw.device_kind)
+    generation = parse_generation(hw.device_kind)
+    spec = chip_spec(generation) if generation else None
     return {
         "hardware": hw.as_dict(),
+        "generation": generation,
+        "chip": (
+            {
+                "hbm_gb": spec.hbm_gb,
+                "bf16_tflops": spec.bf16_tflops,
+                "slice_bf16_tflops": spec.bf16_tflops * max(hw.device_count, 1),
+            }
+            if spec
+            else None
+        ),
         "recommended_preset": best.name,
         "supported_presets": [p.name for p in supported],
     }

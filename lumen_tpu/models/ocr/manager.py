@@ -104,11 +104,14 @@ class OcrManager:
         rec_cfg: SVTRConfig | None = None,
         warmup: bool = False,
         allow_random_init: bool = False,
+        det_buckets: tuple[int, ...] | None = None,
     ):
         self.model_dir = model_dir
         self.info = load_model_info(model_dir)
         self.model_id = self.info.name
         self.spec = OcrSpec.from_extra(self.info.extra("ocr"))
+        if det_buckets:  # deployment preset overrides the manifest default
+            self.spec.det_buckets = tuple(sorted(det_buckets))
         self.policy = get_policy(dtype)
         self.warmup = warmup
         self.batch_size = batch_size

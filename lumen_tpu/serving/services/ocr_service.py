@@ -42,7 +42,11 @@ class OcrService(BaseService):
         alias, mc = next(iter(service_config.models.items()))
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
         manager = OcrManager(
-            model_dir, dtype=bs.dtype, batch_size=bs.batch_size, warmup=bs.warmup
+            model_dir,
+            dtype=bs.dtype,
+            batch_size=bs.batch_size,
+            warmup=bs.warmup,
+            det_buckets=tuple(bs.batch_buckets) if bs.batch_buckets else None,
         )
         manager.initialize()
         return cls(manager)

@@ -45,11 +45,46 @@ def with_client(fn):
 
 
 class TestPresets:
-    def test_detect_tpu(self):
-        assert detect_preset("tpu", 8).name in ("tpu_v5e_8", "tpu_v6e_8")
-        assert detect_preset("tpu", 16).name == "tpu_v5e_16_dp_tp"
-        assert detect_preset("tpu", 1).name == "tpu_v5e_1"
+    def test_detect_tpu_generation_aware(self):
+        # The jax device_kind string pins the generation.
+        assert detect_preset("tpu", 8, "TPU v5 lite").name == "tpu_v5e_8"
+        assert detect_preset("tpu", 16, "TPU v5 lite").name == "tpu_v5e_16_dp_tp"
+        assert detect_preset("tpu", 1, "TPU v5 lite").name == "tpu_v5e_1"
+        assert detect_preset("tpu", 8, "TPU v6 lite").name == "tpu_v6e_8"
+        assert detect_preset("tpu", 8, "TPU v4").name == "tpu_v4_8"
+        assert detect_preset("tpu", 8, "TPU v3").name == "tpu_v3_8"
+        assert detect_preset("tpu", 8, "TPU v5p").name == "tpu_v5p_8"
         assert detect_preset("cpu", 0).name == "cpu"
+
+    def test_known_generation_without_size_match_keeps_tpu(self):
+        """v4-4 / v5p-1 etc. must still get a TPU preset (review finding:
+        no regression to the float32 cpu tier)."""
+        p = detect_preset("tpu", 4, "TPU v4")
+        assert p.platform == "tpu" and p.chips <= 4
+        p = detect_preset("tpu", 1, "TPU v5p")
+        assert p.platform == "tpu" and p.chips == 1
+
+    def test_detect_unknown_kind_falls_back_to_any(self):
+        # Unknown kind string: any-TPU matching, most capable first.
+        assert detect_preset("tpu", 1).platform == "tpu"
+        assert detect_preset("tpu", 16).chips <= 16
+
+    def test_generation_parsing(self):
+        from lumen_tpu.app.presets import parse_generation
+
+        assert parse_generation("TPU v5 lite") == "v5e"
+        assert parse_generation("TPU v6 lite") == "v6e"
+        assert parse_generation("TPU v5p") == "v5p"
+        assert parse_generation("TPU v5") == "v5p"
+        assert parse_generation("TPU v4") == "v4"
+        assert parse_generation("TPU v2") == "v2"
+        assert parse_generation("") is None
+        assert parse_generation("NVIDIA H100") is None
+
+    def test_supported_filters_generation(self):
+        names = [p.name for p in supported_presets("tpu", 16, "TPU v5 lite")]
+        assert "tpu_v5e_16_dp_tp" in names
+        assert all("v6e" not in n for n in names if n != "cpu")
 
     def test_supported_contains_cpu_always(self):
         for plat, n in [("tpu", 4), ("cpu", 0)]:
@@ -59,6 +94,21 @@ class TestPresets:
     def test_presets_have_valid_mesh(self):
         for p in PRESETS.values():
             assert sum(1 for v in p.mesh_axes.values() if v == -1) <= 1
+
+    def test_batch_scales_with_slice(self):
+        assert PRESETS["tpu_v5e_8"].batch_size > PRESETS["tpu_v5e_1"].batch_size
+        # tp=2 halves the data-parallel width on the 16-chip preset
+        assert (
+            PRESETS["tpu_v5e_16_dp_tp"].batch_size
+            == PRESETS["tpu_v5e_1"].batch_size * 8
+        )
+
+    def test_chip_specs_cover_all_tpu_presets(self):
+        from lumen_tpu.app.presets import chip_spec
+
+        for p in PRESETS.values():
+            if p.platform == "tpu":
+                assert chip_spec(p.generation) is not None, p.name
 
 
 class TestConfigApi:
