@@ -119,8 +119,8 @@ def hardware_report(hw: HardwareInfo | None = None) -> dict:
     """Detection + the preset recommendation the wizard shows."""
     hw = hw or detect_hardware()
     plat = "tpu" if hw.platform == "tpu" else "cpu"
-    best = detect_preset(plat, hw.device_count, hw.device_kind)
     supported = supported_presets(plat, hw.device_count, hw.device_kind)
+    best = supported[0] if supported else detect_preset(plat, hw.device_count)
     generation = parse_generation(hw.device_kind)
     spec = chip_spec(generation) if generation else None
     return {

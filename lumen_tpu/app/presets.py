@@ -105,8 +105,12 @@ def _tpu_preset(
         face_batch=max(8, spec.base_batch // 2) * dp,
         ocr_batch=max(4, spec.base_batch // 4),
         vlm_gen_batch=8 if spec.hbm_gb >= 32 else 4,
-        # Small-HBM chips trade one prompt bucket for KV headroom.
-        vlm_prefill_buckets=(64, 128, 256, 512) if spec.hbm_gb >= 32 else (64, 128, 256),
+        # Small-HBM chips trade the longest prompt bucket for KV headroom;
+        # the manager additionally drops any bucket that cannot fit its
+        # max_seq KV buffer (vlm/manager.py bucket filter).
+        vlm_prefill_buckets=(
+            (64, 128, 256, 512, 1024) if spec.hbm_gb >= 32 else (64, 128, 256, 512)
+        ),
         max_batch_latency_ms=3.0 if spec.bf16_tflops >= 400 else 5.0,
         max_tier=tier,
     )
@@ -155,18 +159,20 @@ PRESETS: dict[str, DevicePreset] = {
     ]
 }
 
-# Order presets are tried during auto-detection (most capable first).
+# Order presets are tried during auto-detection: larger slices strictly
+# before smaller ones (a 4-chip slice must never auto-pick a single-chip
+# preset and idle 3 chips), newer generations first within a size.
 DETECTION_ORDER = [
     "tpu_v6e_16_dp_tp",
-    "tpu_v6e_8",
     "tpu_v5e_16_dp_tp",
+    "tpu_v6e_8",
     "tpu_v5p_8",
     "tpu_v4_8",
     "tpu_v5e_8",
-    "tpu_v6e_1",
-    "tpu_v5e_4",
     "tpu_v3_8",
     "tpu_v2_8",
+    "tpu_v5e_4",
+    "tpu_v6e_1",
     "tpu_v5e_1",
     "cpu",
 ]

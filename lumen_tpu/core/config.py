@@ -158,6 +158,10 @@ class BackendSettings(BaseModel):
     dtype: Literal["bfloat16", "float32", "float16"] = "bfloat16"
     mesh: MeshConfig | None = None
     max_batch_latency_ms: float = Field(5.0, ge=0)
+    # Static-shape bucket ladder. The unit is family-specific: request
+    # batch sizes for CLIP/face, detection side-lengths (px) for OCR,
+    # prompt lengths (tokens) for the VLM — each service's from_config
+    # documents its interpretation.
     batch_buckets: list[int] | None = None
     # Compile every batch bucket at startup instead of on first request.
     warmup: bool = False

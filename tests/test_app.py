@@ -60,7 +60,7 @@ class TestPresets:
         """v4-4 / v5p-1 etc. must still get a TPU preset (review finding:
         no regression to the float32 cpu tier)."""
         p = detect_preset("tpu", 4, "TPU v4")
-        assert p.platform == "tpu" and p.chips <= 4
+        assert p.platform == "tpu" and p.chips == 4  # all 4 chips used
         p = detect_preset("tpu", 1, "TPU v5p")
         assert p.platform == "tpu" and p.chips == 1
 
@@ -68,6 +68,29 @@ class TestPresets:
         # Unknown kind string: any-TPU matching, most capable first.
         assert detect_preset("tpu", 1).platform == "tpu"
         assert detect_preset("tpu", 16).chips <= 16
+
+    def test_detection_never_idles_chips(self):
+        """Within any slice size, the detected preset uses every chip that
+        some preset of that size could use (review finding: a 4-chip slice
+        must not pick a 1-chip preset)."""
+        from lumen_tpu.app.presets import parse_generation
+
+        for kind in ("", "TPU v4", "TPU v5p", "TPU v5 lite", "TPU v6 lite"):
+            gen = parse_generation(kind)
+            for count in (1, 4, 8, 16):
+                best = detect_preset("tpu", count, kind)
+                same_gen = [
+                    p.chips
+                    for p in PRESETS.values()
+                    if p.platform == "tpu" and 0 < p.chips <= count and p.generation == gen
+                ]
+                any_gen = [
+                    p.chips
+                    for p in PRESETS.values()
+                    if p.platform == "tpu" and 0 < p.chips <= count
+                ]
+                want = max(same_gen) if same_gen else max(any_gen)
+                assert best.chips == want, (kind, count, best.name)
 
     def test_generation_parsing(self):
         from lumen_tpu.app.presets import parse_generation

@@ -1,0 +1,162 @@
+"""Multi-host smoke test: two ``jax.distributed`` processes, one logical
+deployment (round-1 verdict item 10 — ``parallel/distributed.py`` had no
+multi-process test).
+
+Each worker process:
+
+1. joins the process group through ``lumen_tpu.parallel.distributed``
+   (coordinator over DCN-equivalent loopback, 4 simulated CPU devices per
+   process -> 8 global devices),
+2. participates in a global-mesh computation built from process-local
+   shards (the cross-host collective path every pjit program rides), and
+3. runs a per-host gRPC frontend (hub router + echo service) and drives a
+   client round-trip against it — the per-host-frontend serving layout of
+   SURVEY.md §7 step 10.
+
+The parent asserts both workers saw the same global topology and the same
+all-host reduction result.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import json, os, sys
+
+port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["LUMEN_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["LUMEN_NUM_PROCESSES"] = "2"
+os.environ["LUMEN_PROCESS_ID"] = str(pid)
+sys.path.insert(0, %(root)r)
+
+# Site hooks may import jax at interpreter start (latching a TPU platform
+# before this script's env is read); re-point the config like
+# tests/conftest.py does.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from lumen_tpu.parallel import distributed
+
+multi = distributed.initialize()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devices = np.asarray(jax.devices())
+mesh = Mesh(devices, ("data",))
+
+# Global batch assembled from process-local shards: each host contributes
+# rows [4*local_start, ...) so the reduction checks cross-host data really
+# met on the mesh.
+local = (
+    np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+    + 1000.0 * jax.process_index()
+)
+sharding = NamedSharding(mesh, P("data"))
+garr = jax.make_array_from_process_local_data(sharding, local, (8, 3))
+
+total = float(jax.jit(lambda x: jnp.sum(x * 2.0))(garr))
+
+# Per-host gRPC frontend: every process serves, every process's client
+# round-trips through its own frontend.
+import grpc
+from concurrent import futures
+from lumen_tpu.serving.echo import EchoService
+from lumen_tpu.serving.router import HubRouter
+from lumen_tpu.serving.proto import ml_service_pb2_grpc
+from lumen_tpu.serving.proto.ml_service_pb2 import InferRequest
+
+server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+ml_service_pb2_grpc.add_InferenceServicer_to_server(
+    HubRouter({"echo": EchoService()}), server
+)
+grpc_port = server.add_insecure_port("127.0.0.1:0")
+server.start()
+stub = ml_service_pb2_grpc.InferenceStub(grpc.insecure_channel(f"127.0.0.1:{grpc_port}"))
+payload = f"host-{jax.process_index()}".encode()
+resps = list(stub.Infer(iter([InferRequest(correlation_id="c", task="echo", payload=payload, seq=0, total=1)])))
+echo_ok = resps[-1].result == payload
+server.stop(0)
+
+# All hosts reach the end before teardown (DCN barrier).
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("smoke-done")
+
+json.dump(
+    {
+        "multi": bool(multi),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "total": total,
+        "primary": distributed.is_primary(),
+        "echo_ok": bool(echo_ok),
+    },
+    open(out_path, "w"),
+)
+""" % {"root": _ROOT}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_group_serves_and_reduces(tmp_path):
+    port = _free_port()
+    outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
+    procs = []
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    for pid in range(2):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER, str(port), str(pid), outs[pid]],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for pid, p in enumerate(procs):
+        try:
+            stdout, stderr = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {pid} timed out")
+        assert p.returncode == 0, f"worker {pid} failed:\n{stderr[-3000:]}"
+        with open(outs[pid]) as f:
+            results.append(json.load(f))
+
+    for pid, r in enumerate(results):
+        assert r["multi"] is True
+        assert r["process_index"] == pid
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 8
+        assert r["local_devices"] == 4
+        assert r["echo_ok"] is True
+    assert results[0]["primary"] is True
+    assert results[1]["primary"] is False
+    # Both hosts computed the same global reduction over each other's rows:
+    # sum(2x) over host0 rows (0..11) + host1 rows (+1000 each)
+    base = sum(range(12)) * 2
+    want = float(base + base + 2 * 1000.0 * 12)
+    assert results[0]["total"] == results[1]["total"] == want
