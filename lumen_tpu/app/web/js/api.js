@@ -1,0 +1,129 @@
+// API client for the lumen-tpu control plane (role of the reference's
+// typed web-ui/src/lib/api.ts). One function per endpoint of
+// lumen_tpu/app/api.py; errors normalize to Error(message).
+
+const V1 = "/api/v1";
+
+async function request(method, path, body) {
+  const opts = { method, headers: {} };
+  if (body !== undefined) {
+    opts.headers["Content-Type"] = "application/json";
+    opts.body = JSON.stringify(body);
+  }
+  let res;
+  try {
+    res = await fetch(path, opts);
+  } catch (e) {
+    throw new Error(`control plane unreachable: ${e.message}`);
+  }
+  const text = await res.text();
+  let data = null;
+  try {
+    data = text ? JSON.parse(text) : null;
+  } catch {
+    data = { raw: text };
+  }
+  if (!res.ok) {
+    throw new Error((data && data.error) || `${method} ${path} -> HTTP ${res.status}`);
+  }
+  return data;
+}
+
+export const api = {
+  health: () => request("GET", "/health"),
+
+  // hardware
+  hardwareInfo: () => request("GET", `${V1}/hardware/info`),
+  hardwareDetect: () => request("GET", `${V1}/hardware/detect`),
+
+  // config
+  presets: () => request("GET", `${V1}/config/presets`),
+  generateConfig: (opts) => request("POST", `${V1}/config/generate`, opts),
+  currentConfig: () => request("GET", `${V1}/config/current`),
+  validateConfig: (cfg) => request("POST", `${V1}/config/validate`, { config: cfg }),
+  saveConfig: (path) => request("POST", `${V1}/config/save`, { path }),
+  configYaml: async () => {
+    const res = await fetch(`${V1}/config/yaml`);
+    if (!res.ok) throw new Error(`no config yet (HTTP ${res.status})`);
+    return res.text();
+  },
+
+  // install
+  installSetup: (opts) => request("POST", `${V1}/install/setup`, opts),
+  installTasks: () => request("GET", `${V1}/install/tasks`),
+  installStatus: (id) => request("GET", `${V1}/install/status/${id}`),
+  installCancel: (id) => request("POST", `${V1}/install/cancel/${id}`),
+
+  // server
+  serverStatus: () => request("GET", `${V1}/server/status`),
+  serverStart: (opts) => request("POST", `${V1}/server/start`, opts || {}),
+  serverStop: () => request("POST", `${V1}/server/stop`),
+  serverRestart: () => request("POST", `${V1}/server/restart`),
+  metrics: async () => {
+    const res = await fetch(`${V1}/metrics`);
+    return res.text();
+  },
+};
+
+// Live log stream over /ws/logs (frames {type: connected|log|heartbeat}).
+// Auto-reconnects with backoff; hands every log line to the subscribers.
+export class LogStream {
+  constructor() {
+    this.subscribers = new Set();
+    this.statusSubscribers = new Set();
+    this.ws = null;
+    this.backoff = 500;
+    this.closed = false;
+  }
+
+  connect() {
+    if (this.closed || (this.ws && this.ws.readyState <= 1)) return;
+    const proto = location.protocol === "https:" ? "wss" : "ws";
+    this.ws = new WebSocket(`${proto}://${location.host}/ws/logs`);
+    this.ws.onopen = () => {
+      this.backoff = 500;
+      this._status(true);
+    };
+    this.ws.onmessage = (ev) => {
+      let frame;
+      try {
+        frame = JSON.parse(ev.data);
+      } catch {
+        return;
+      }
+      if (frame.type === "log") {
+        for (const fn of this.subscribers) fn(frame);
+      }
+    };
+    this.ws.onclose = () => {
+      this._status(false);
+      if (!this.closed) {
+        setTimeout(() => this.connect(), this.backoff);
+        this.backoff = Math.min(this.backoff * 2, 8000);
+      }
+    };
+    this.ws.onerror = () => this.ws && this.ws.close();
+  }
+
+  subscribe(fn) {
+    this.subscribers.add(fn);
+    this.connect();
+    return () => this.subscribers.delete(fn);
+  }
+
+  onStatus(fn) {
+    this.statusSubscribers.add(fn);
+    return () => this.statusSubscribers.delete(fn);
+  }
+
+  _status(up) {
+    for (const fn of this.statusSubscribers) fn(up);
+  }
+
+  close() {
+    this.closed = true;
+    if (this.ws) this.ws.close();
+  }
+}
+
+export const logStream = new LogStream();

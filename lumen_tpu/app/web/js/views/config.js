@@ -1,0 +1,163 @@
+// Config view (reference: web-ui/src/views/Config): tier/region/port form
+// -> generated YAML preview -> validate -> save to disk.
+
+import { api } from "../api.js";
+import { wizard } from "../wizard.js";
+import { el, toast } from "../ui.js";
+
+const TIER_LABELS = {
+  minimal: "Minimal — OCR only",
+  light_weight: "Light — OCR + CLIP + face",
+  full: "Full — OCR + CLIP + face + VLM",
+};
+
+export function renderConfig(root) {
+  const s = wizard.state;
+  root.append(
+    el("h2", { class: "view-title" }, "Configuration"),
+    el("p", { class: "view-sub" }, [
+      "Generate the deployment YAML for preset ",
+      el("span", { class: "badge accent" }, s.preset || "—"),
+      ". Per-service batch and bucket sizes come from the preset's chip generation.",
+    ]),
+    el("div", { class: "grid2" }, [
+      el("div", { class: "card" }, [
+        el("h3", {}, "Deployment options"),
+        field("Service tier", tierSelect()),
+        field(
+          "Model hub region",
+          seg("region", [
+            ["other", "International (HuggingFace)"],
+            ["cn", "China (ModelScope)"],
+          ])
+        ),
+        field("Cache directory", input("cacheDir", "text")),
+        field("gRPC port", input("port", "number")),
+        el("div", { class: "checkrow" }, [
+          checkbox("mdns"),
+          "advertise on the LAN via mDNS (_lumen._tcp)",
+        ]),
+        el("div", { class: "row" }, [
+          el("button", { class: "btn primary", id: "cfg-generate" }, "Generate config"),
+          el("span", { class: "muted", id: "cfg-status" }, s.configGenerated ? "config generated" : ""),
+        ]),
+      ]),
+      el("div", { class: "card" }, [
+        el("h3", {}, "Save & validate"),
+        field("Config file path", el("input", { type: "text", id: "cfg-path", value: s.configPath || "lumen-config.yaml" })),
+        el("div", { class: "row" }, [
+          el("button", { class: "btn", id: "cfg-save", disabled: s.configGenerated ? undefined : "1" }, "Save YAML"),
+          el("button", { class: "btn", id: "cfg-validate", disabled: s.configGenerated ? undefined : "1" }, "Validate"),
+          el("span", { class: "muted", id: "cfg-save-status" }, s.configPath ? `saved: ${s.configPath}` : ""),
+        ]),
+        el("p", { class: "muted" }, "The server step launches the gRPC hub from this saved file."),
+      ]),
+    ]),
+    el("div", { class: "card" }, [
+      el("h3", {}, "Generated YAML"),
+      el("pre", { class: "code", id: "cfg-yaml" }, s.configGenerated ? "loading…" : "— generate first —"),
+    ])
+  );
+
+  if (s.configGenerated) loadYaml(root);
+
+  root.querySelector("#cfg-generate").onclick = async () => {
+    const btn = root.querySelector("#cfg-generate");
+    btn.disabled = true;
+    try {
+      await api.generateConfig({
+        preset: wizard.state.preset,
+        tier: wizard.state.tier,
+        region: wizard.state.region,
+        cache_dir: wizard.state.cacheDir,
+        port: Number(wizard.state.port),
+        mdns: wizard.state.mdns,
+      });
+      wizard.update({ configGenerated: true, configPath: null });
+      root.querySelector("#cfg-status").textContent = "config generated";
+      root.querySelector("#cfg-save").disabled = false;
+      root.querySelector("#cfg-validate").disabled = false;
+      await loadYaml(root);
+      toast("config generated");
+    } catch (e) {
+      toast(e.message, true);
+    } finally {
+      btn.disabled = false;
+    }
+  };
+
+  root.querySelector("#cfg-save").onclick = async () => {
+    try {
+      const { path } = await api.saveConfig(root.querySelector("#cfg-path").value);
+      wizard.update({ configPath: path });
+      root.querySelector("#cfg-save-status").textContent = `saved: ${path}`;
+      toast(`saved ${path}`);
+    } catch (e) {
+      toast(e.message, true);
+    }
+  };
+
+  root.querySelector("#cfg-validate").onclick = async () => {
+    try {
+      const cfg = await api.currentConfig();
+      const v = await api.validateConfig(cfg);
+      if (v.valid) toast(`valid — services: ${v.services.join(", ")}`);
+      else toast(`invalid: ${v.error}`, true);
+    } catch (e) {
+      toast(e.message, true);
+    }
+  };
+}
+
+async function loadYaml(root) {
+  try {
+    root.querySelector("#cfg-yaml").textContent = await api.configYaml();
+  } catch (e) {
+    root.querySelector("#cfg-yaml").textContent = `(${e.message})`;
+  }
+}
+
+function field(labelText, control) {
+  return el("label", { class: "field" }, [el("span", {}, labelText), control]);
+}
+
+function input(key, type) {
+  const node = el("input", { type, value: wizard.state[key] });
+  node.onchange = () => wizard.update({ [key]: node.value, configGenerated: false });
+  return node;
+}
+
+function checkbox(key) {
+  const node = el("input", { type: "checkbox" });
+  node.checked = Boolean(wizard.state[key]);
+  node.onchange = () => wizard.update({ [key]: node.checked, configGenerated: false });
+  return node;
+}
+
+function tierSelect() {
+  const node = el(
+    "select",
+    {},
+    Object.entries(TIER_LABELS).map(([value, label]) => {
+      const opt = el("option", { value }, label);
+      if (wizard.state.tier === value) opt.selected = true;
+      return opt;
+    })
+  );
+  node.onchange = () => wizard.update({ tier: node.value, configGenerated: false });
+  return node;
+}
+
+function seg(key, options) {
+  const wrap = el("div", { class: "seg" });
+  for (const [value, label] of options) {
+    const btn = el("button", { type: "button" }, label);
+    if (wizard.state[key] === value) btn.classList.add("active");
+    btn.onclick = () => {
+      wizard.update({ [key]: value, configGenerated: false });
+      for (const b of wrap.children) b.classList.toggle("active", b === btn);
+    };
+    wrap.append(btn);
+  }
+  return wrap;
+}

@@ -1,0 +1,132 @@
+// Install view (reference: web-ui/src/views/Install): start the install
+// task (env verify + model downloads), poll step progress, stream logs.
+
+import { api, logStream } from "../api.js";
+import { wizard } from "../wizard.js";
+import { el, toast, attachLogPane } from "../ui.js";
+
+const STEP_ICONS = {
+  pending: "○",
+  running: "◌",
+  completed: "●",
+  skipped: "◌",
+  failed: "✕",
+  cancelled: "✕",
+};
+
+let pollTimer = null;
+
+export function renderInstall(root, onLeave) {
+  const s = wizard.state;
+  root.append(
+    el("h2", { class: "view-title" }, "Install"),
+    el("p", { class: "view-sub" },
+      "Verifies the runtime environment and downloads the model weights the config needs into the cache."),
+    el("div", { class: "card" }, [
+      el("div", { class: "checkrow" }, [
+        el("input", { type: "checkbox", id: "inst-download", checked: "1" }),
+        "download model weights for the saved config",
+      ]),
+      el("div", { class: "row" }, [
+        el("button", { class: "btn primary", id: "inst-start" }, s.installDone ? "Re-run install" : "Start install"),
+        el("button", { class: "btn danger", id: "inst-cancel", disabled: "1" }, "Cancel"),
+        el("span", { class: "muted", id: "inst-status" }, s.installDone ? "install completed" : ""),
+      ]),
+      el("div", { class: "progress" }, el("div", { id: "inst-bar", style: "width:0%" })),
+      el("ul", { class: "steplist", id: "inst-steps" }),
+      el("p", { class: "muted", id: "inst-error" }),
+    ]),
+    el("div", { class: "card" }, [
+      el("h3", {}, "Live logs"),
+      el("div", { class: "logpane", id: "inst-logs" }),
+    ])
+  );
+
+  const unsubLogs = attachLogPane(root.querySelector("#inst-logs"), logStream);
+  onLeave(() => {
+    unsubLogs();
+    clearTimeout(pollTimer);
+  });
+
+  // resume a task in flight (reload mid-install)
+  if (s.installTaskId && !s.installDone) poll(root, s.installTaskId);
+
+  root.querySelector("#inst-start").onclick = async () => {
+    const btn = root.querySelector("#inst-start");
+    btn.disabled = true;
+    try {
+      const download = root.querySelector("#inst-download").checked;
+      if (download && !wizard.state.configPath) {
+        // The server silently skips downloads without a config path; make
+        // the operator save first instead of "completing" a no-op install.
+        toast("save the config YAML first (Config step) so the install knows which models to download", true);
+        btn.disabled = false;
+        return;
+      }
+      const task = await api.installSetup({
+        download,
+        config_path: download ? wizard.state.configPath : null,
+        cache_dir: wizard.state.cacheDir,
+      });
+      wizard.update({ installTaskId: task.task_id, installDone: false });
+      root.querySelector("#inst-cancel").disabled = false;
+      poll(root, task.task_id);
+    } catch (e) {
+      toast(e.message, true);
+      btn.disabled = false;
+    }
+  };
+
+  root.querySelector("#inst-cancel").onclick = async () => {
+    if (!wizard.state.installTaskId) return;
+    try {
+      await api.installCancel(wizard.state.installTaskId);
+      toast("cancelling…");
+    } catch (e) {
+      toast(e.message, true);
+    }
+  };
+}
+
+async function poll(root, taskId) {
+  if (!root.isConnected) return; // view switched away
+  let task;
+  try {
+    task = await api.installStatus(taskId);
+  } catch (e) {
+    // Transient control-plane hiccups must not freeze a running install's
+    // progress display — keep polling.
+    root.querySelector("#inst-status").textContent = `${e.message} (retrying…)`;
+    pollTimer = setTimeout(() => poll(root, taskId), 2000);
+    return;
+  }
+  if (!root.isConnected) return;
+
+  root.querySelector("#inst-bar").style.width = `${Math.round((task.progress || 0) * 100)}%`;
+  const list = root.querySelector("#inst-steps");
+  list.replaceChildren(
+    ...task.steps.map((step) =>
+      el("li", { class: step.status }, [
+        el("span", { class: "step-ico" }, STEP_ICONS[step.status] || "○"),
+        step.name,
+        el("span", { class: "step-detail" }, step.detail || ""),
+      ])
+    )
+  );
+  root.querySelector("#inst-status").textContent = `status: ${task.status}`;
+  root.querySelector("#inst-error").textContent = task.error || "";
+
+  if (task.status === "running" || task.status === "pending") {
+    root.querySelector("#inst-cancel").disabled = false;
+    pollTimer = setTimeout(() => poll(root, taskId), 900);
+  } else {
+    root.querySelector("#inst-start").disabled = false;
+    root.querySelector("#inst-cancel").disabled = true;
+    if (task.status === "completed") {
+      wizard.update({ installDone: true });
+      toast("install complete");
+    } else if (task.status === "failed") {
+      toast(`install failed: ${task.error || "see logs"}`, true);
+    }
+  }
+}

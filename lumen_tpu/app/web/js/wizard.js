@@ -1,0 +1,123 @@
+// Wizard state machine (role of the reference's WizardProvider context,
+// web-ui/src/context/). Holds cross-view state, persists to localStorage
+// so a reload resumes where the operator left off, and gates forward
+// navigation on per-step completion.
+
+const STORAGE_KEY = "lumen-tpu-wizard";
+
+export const STEPS = [
+  { id: "welcome", title: "Welcome" },
+  { id: "hardware", title: "Hardware" },
+  { id: "config", title: "Config" },
+  { id: "install", title: "Install" },
+  { id: "server", title: "Server" },
+];
+
+const DEFAULT_STATE = {
+  step: "welcome",
+  // hardware
+  hardware: null, // /hardware/detect report (not persisted stale: re-fetched)
+  preset: null,
+  // config
+  tier: "light_weight",
+  region: "other",
+  cacheDir: "~/.lumen-tpu",
+  port: 50051,
+  mdns: true,
+  configGenerated: false,
+  configPath: null,
+  // install
+  installTaskId: null,
+  installDone: false,
+};
+
+function load() {
+  try {
+    const raw = localStorage.getItem(STORAGE_KEY);
+    if (!raw) return { ...DEFAULT_STATE };
+    const saved = JSON.parse(raw);
+    return { ...DEFAULT_STATE, ...saved, hardware: null };
+  } catch {
+    return { ...DEFAULT_STATE };
+  }
+}
+
+class Wizard {
+  constructor() {
+    this.state = load();
+    this.listeners = new Set();
+  }
+
+  get step() {
+    return this.state.step;
+  }
+
+  update(patch) {
+    Object.assign(this.state, patch);
+    const { hardware, ...persist } = this.state;
+    try {
+      localStorage.setItem(STORAGE_KEY, JSON.stringify(persist));
+    } catch {
+      /* private mode etc. — state just won't survive reload */
+    }
+    for (const fn of this.listeners) fn(this.state);
+  }
+
+  subscribe(fn) {
+    this.listeners.add(fn);
+    return () => this.listeners.delete(fn);
+  }
+
+  reset() {
+    // rev forces a full re-render even though step stays "welcome"
+    this.state = { ...DEFAULT_STATE, rev: (this.state.rev || 0) + 1 };
+    localStorage.removeItem(STORAGE_KEY);
+    for (const fn of this.listeners) fn(this.state);
+  }
+
+  stepIndex(id = this.state.step) {
+    return STEPS.findIndex((s) => s.id === id);
+  }
+
+  // A step is reachable when every prior step is complete.
+  complete(id) {
+    switch (id) {
+      case "welcome":
+        return true;
+      case "hardware":
+        return this.state.preset !== null;
+      case "config":
+        return this.state.configGenerated;
+      case "install":
+        return this.state.installDone;
+      case "server":
+        return false;
+      default:
+        return false;
+    }
+  }
+
+  canEnter(id) {
+    const idx = this.stepIndex(id);
+    for (let i = 0; i < idx; i++) {
+      if (!this.complete(STEPS[i].id)) return false;
+    }
+    return true;
+  }
+
+  goto(id) {
+    if (this.canEnter(id)) this.update({ step: id });
+  }
+
+  next() {
+    const idx = this.stepIndex();
+    if (idx < STEPS.length - 1) this.goto(STEPS[idx + 1].id);
+  }
+
+  back() {
+    const idx = this.stepIndex();
+    if (idx > 0) this.update({ step: STEPS[idx - 1].id });
+  }
+}
+
+export const wizard = new Wizard();

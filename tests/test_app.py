@@ -436,50 +436,6 @@ class TestServerManagerApi:
         assert with_client(fn)
 
 
-class TestWebUi:
-    """Contract tests binding the static wizard to the API it calls (role of
-    the reference's import-contract AST test, SURVEY.md §4)."""
-
-    def test_index_served_with_all_views(self):
-        async def fn(client):
-            r = await client.get("/")
-            assert r.status == 200
-            html = await r.text()
-            for view in ("welcome", "hardware", "config", "install", "server"):
-                assert f'data-view="{view}"' in html
-            assert "/ws/logs" in html
-            return True
-
-        assert with_client(fn)
-
-    def test_every_api_path_in_ui_is_routed(self):
-        import re
-
-        html_path = os.path.join(
-            os.path.dirname(__file__), "..", "lumen_tpu", "app", "web", "index.html"
-        )
-        with open(html_path, encoding="utf-8") as f:
-            html = f.read()
-        # api("/x/y", ...) JS calls -> /api/v1/x/y
-        called = set(re.findall(r'api\("(/[a-z_/]+)"', html))
-        assert called, "expected api() calls in the wizard"
-        app = build_app()
-        routed = set()
-        for resource in app.router.resources():
-            info = resource.get_info()
-            path = info.get("path") or info.get("formatter") or ""
-            routed.add(path)
-        for path in called:
-            full = "/api/v1" + path
-            if full.endswith("/"):
-                # dynamic tail built in JS ("/server/" + action, "/install/
-                # status/" + id): any routed path under the prefix satisfies
-                ok = any(r.startswith(full) for r in routed)
-            else:
-                ok = full in routed
-            assert ok, f"wizard calls {full} but no route matches (routes: {sorted(routed)})"
-
-
 class TestWsLogs:
     def test_connected_log_heartbeat_frames(self):
         async def fn(client):

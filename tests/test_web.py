@@ -1,0 +1,158 @@
+"""Wizard SPA static checks (no JS runtime exists in CI, so the UI is
+validated at the contract level): every asset serves over the control
+plane's static route, every ES-module import resolves to a shipped file,
+and every API path the client calls is a route the aiohttp app actually
+registers — the same glue guarantee the reference gets from its
+OpenAPI-generated ``types/schema.d.ts`` client."""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from tests.test_app import run_async
+
+WEB = os.path.join(os.path.dirname(__file__), "..", "lumen_tpu", "app", "web")
+
+
+def _js_files():
+    out = []
+    for base, _dirs, names in os.walk(WEB):
+        for name in names:
+            if name.endswith(".js"):
+                out.append(os.path.join(base, name))
+    return sorted(out)
+
+
+def _client():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from lumen_tpu.app.api import build_app
+
+    return TestClient(TestServer(build_app()))
+
+
+class TestStaticAssets:
+    def test_all_assets_serve(self):
+        async def fn():
+            client = _client()
+            await client.start_server()
+            try:
+                r = await client.get("/")
+                assert r.status == 200
+                html = await r.text()
+                # every /ui/ reference in the shell resolves
+                for ref in re.findall(r'(?:src|href)="(/ui/[^"]+)"', html):
+                    rr = await client.get(ref)
+                    assert rr.status == 200, ref
+                # and every shipped file is reachable at its /ui/ path
+                for base, _dirs, names in os.walk(WEB):
+                    for name in names:
+                        rel = os.path.relpath(os.path.join(base, name), WEB)
+                        rr = await client.get(f"/ui/{rel}")
+                        assert rr.status == 200, rel
+            finally:
+                await client.close()
+
+        run_async(fn())
+
+    def test_js_modules_are_declared_as_modules(self):
+        with open(os.path.join(WEB, "index.html")) as f:
+            html = f.read()
+        assert 'type="module"' in html
+
+
+class TestModuleImports:
+    def test_every_import_resolves(self):
+        """Each `import ... from "./x.js"` points at a shipped file (a typo
+        here is a blank page at runtime with only a console error)."""
+        for path in _js_files():
+            with open(path) as f:
+                src = f.read()
+            for spec in re.findall(r'from\s+"([^"]+)"', src):
+                assert spec.endswith(".js"), (path, spec)
+                target = os.path.normpath(os.path.join(os.path.dirname(path), spec))
+                assert os.path.exists(target), f"{path} imports missing {spec}"
+
+    def test_no_unbalanced_braces(self):
+        """Cheap corruption guard: balanced (), {}, [] per file (string
+        contents stripped) — catches truncated edits without a JS parser."""
+        pairs = {"(": ")", "{": "}", "[": "]"}
+        for path in _js_files():
+            with open(path) as f:
+                src = f.read()
+            # Strip order matters: comments go before single-quoted strings
+            # so prose apostrophes ("the reference's ...") don't read as
+            # string openers.
+            src = re.sub(r'`(?:[^`\\]|\\.)*`', "``", src, flags=re.S)
+            src = re.sub(r'"(?:[^"\\]|\\.)*"', '""', src)
+            src = re.sub(r"/\*.*?\*/", "", src, flags=re.S)
+            src = re.sub(r"//[^\n]*", "", src)
+            src = re.sub(r"'(?:[^'\\]|\\.)*'", "''", src)
+            stack = []
+            for ch in src:
+                if ch in pairs:
+                    stack.append(pairs[ch])
+                elif ch in pairs.values():
+                    assert stack and stack.pop() == ch, f"unbalanced {ch!r} in {path}"
+            assert not stack, f"unclosed {stack} in {path}"
+
+
+class TestApiContract:
+    def test_client_paths_match_registered_routes(self):
+        """Every endpoint api.js calls exists on the server with the same
+        method."""
+        with open(os.path.join(WEB, "js", "api.js")) as f:
+            src = f.read()
+        calls = re.findall(r'request\("(\w+)",\s*(?:`\$\{V1\}(/[^`]+)`|"(/[^"]+)")', src)
+        raw_fetches = re.findall(r'fetch\(`\$\{V1\}(/[^`]+)`\)', src)
+        wanted = []
+        for method, v1path, abspath in calls:
+            path = f"/api/v1{v1path}" if v1path else abspath
+            wanted.append((method, re.sub(r"\$\{[^}]+\}", "{param}", path)))
+        for p in raw_fetches:
+            wanted.append(("GET", f"/api/v1{p}"))
+        assert len(wanted) >= 15  # the client actually covers the surface
+
+        from lumen_tpu.app.api import build_app
+
+        app = build_app()
+        routes = set()
+        for route in app.router.routes():
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter") or ""
+            routes.add((route.method, re.sub(r"\{[^}]+\}", "{param}", path)))
+
+        for method, path in wanted:
+            assert (method, path) in routes, f"client calls unregistered {method} {path}"
+
+    def test_ws_logs_route_used_by_client(self):
+        with open(os.path.join(WEB, "js", "api.js")) as f:
+            src = f.read()
+        assert "/ws/logs" in src
+
+
+class TestViewDomContract:
+    def test_view_ids_are_defined_before_use(self):
+        """Every id queried with querySelector('#x') inside a view module is
+        also created in that module (views build their own DOM)."""
+        views_dir = os.path.join(WEB, "js", "views")
+        for name in sorted(os.listdir(views_dir)):
+            path = os.path.join(views_dir, name)
+            with open(path) as f:
+                src = f.read()
+            created = set(re.findall(r'id:\s*"([\w-]+)"', src))
+            created |= set(re.findall(r'id="([\w-]+)"', src))
+            queried = set(re.findall(r'querySelector\("#([\w-]+)"\)', src))
+            missing = queried - created
+            assert not missing, f"{name}: queried but never created: {missing}"
+
+    def test_shell_ids_exist(self):
+        with open(os.path.join(WEB, "index.html")) as f:
+            html = f.read()
+        with open(os.path.join(WEB, "js", "app.js")) as f:
+            app_src = f.read()
+        for node_id in re.findall(r'getElementById\("([\w-]+)"\)', app_src):
+            assert f'id="{node_id}"' in html, node_id
