@@ -24,7 +24,9 @@ async function request(method, path, body) {
     data = { raw: text };
   }
   if (!res.ok) {
-    throw new Error((data && data.error) || `${method} ${path} -> HTTP ${res.status}`);
+    const err = new Error((data && data.error) || `${method} ${path} -> HTTP ${res.status}`);
+    err.status = res.status;
+    throw err;
   }
   return data;
 }

@@ -3,6 +3,10 @@
 export function el(tag, attrs = {}, children = []) {
   const node = document.createElement(tag);
   for (const [k, v] of Object.entries(attrs)) {
+    // undefined/null/false mean "attribute absent" — setAttribute would
+    // stringify them, and boolean attributes like disabled activate on
+    // ANY value.
+    if (v === undefined || v === null || v === false) continue;
     if (k === "class") node.className = v;
     else if (k.startsWith("on") && typeof v === "function") node[k] = v;
     else node.setAttribute(k, v);

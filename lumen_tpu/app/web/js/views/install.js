@@ -90,10 +90,20 @@ export function renderInstall(root, onLeave) {
 
 async function poll(root, taskId) {
   if (!root.isConnected) return; // view switched away
+  clearTimeout(pollTimer); // a Start-triggered poll replaces a stale chain
   let task;
   try {
     task = await api.installStatus(taskId);
   } catch (e) {
+    if (e.status === 404) {
+      // Install tasks live in the control plane's memory; after a restart
+      // a persisted id is gone for good — stop polling, forget it.
+      wizard.update({ installTaskId: null });
+      root.querySelector("#inst-status").textContent = "previous install task no longer exists";
+      root.querySelector("#inst-start").disabled = false;
+      root.querySelector("#inst-cancel").disabled = true;
+      return;
+    }
     // Transient control-plane hiccups must not freeze a running install's
     // progress display — keep polling.
     root.querySelector("#inst-status").textContent = `${e.message} (retrying…)`;

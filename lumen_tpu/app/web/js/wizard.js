@@ -36,7 +36,11 @@ function load() {
     const raw = localStorage.getItem(STORAGE_KEY);
     if (!raw) return { ...DEFAULT_STATE };
     const saved = JSON.parse(raw);
-    return { ...DEFAULT_STATE, ...saved, hardware: null };
+    const state = { ...DEFAULT_STATE, ...saved, hardware: null };
+    // A step id from another version (or corruption) must not crash the
+    // boot render — fall back to the first step.
+    if (!STEPS.some((s) => s.id === state.step)) state.step = "welcome";
+    return state;
   } catch {
     return { ...DEFAULT_STATE };
   }

@@ -47,19 +47,24 @@ from .tokenizer import ClipTokenizer
 
 logger = logging.getLogger(__name__)
 
-# Generic scene buckets for the scene-classify task (role of the reference's
-# hardcoded scene prompt list, clip_model.py:90-99; wording is ours).
+# Scene-classify contract: the reference's 8 hardcoded prompts and its
+# label derivation (prompt minus "a photo of " minus "an ") are part of the
+# observable output (``clip_model.py:90-99`` builds them, ``:355-357``
+# derives the label), so clients see identical scene buckets on both
+# stacks. These short strings are wire-contract constants, like proto
+# field names.
+SCENE_PROMPTS = [
+    "a photo of a person",
+    "a photo of an animal",
+    "a photo of a vehicle",
+    "a photo of food",
+    "a photo of a building",
+    "a photo of nature",
+    "a photo of an object",
+    "a photo of a landscape",
+]
 SCENE_LABELS = [
-    "indoor room",
-    "city street",
-    "natural landscape",
-    "beach or coastline",
-    "mountains",
-    "forest",
-    "food on a table",
-    "document or screenshot",
-    "people at an event",
-    "animal close-up",
+    p.replace("a photo of ", "").replace("an ", "") for p in SCENE_PROMPTS
 ]
 DEFAULT_PROMPT_TEMPLATE = "a photo of a {}"
 
@@ -327,14 +332,26 @@ class CLIPManager:
     def classify_scene(self, image_bytes: bytes, top_k: int = 3) -> ClassifyResult:
         self._ensure_ready()
         if not hasattr(self, "_scene_matrix"):
-            mat = self._compute_label_embeddings(SCENE_LABELS)
+            # The full prompts embed verbatim (template already baked in);
+            # labels are their reference-derived short forms.
+            mat = self._compute_label_embeddings(SCENE_PROMPTS, template="{}")
             mat = mat / np.maximum(np.linalg.norm(mat, axis=-1, keepdims=True), 1e-12)
             self._scene_matrix = jnp.asarray(mat)
         vec = self.encode_image(image_bytes)
-        return self._classify_vector(vec, SCENE_LABELS, self._scene_matrix, top_k)
+        # Reference scene scoring is a plain softmax over raw cosine
+        # similarities (``clip_model.py:344-350``) — no logit-scale
+        # temperature, unlike classify_image.
+        return self._classify_vector(
+            vec, SCENE_LABELS, self._scene_matrix, top_k, temperature=1.0
+        )
 
     def _classify_vector(
-        self, vec: np.ndarray, names: list[str], matrix: jax.Array, top_k: int
+        self,
+        vec: np.ndarray,
+        names: list[str],
+        matrix: jax.Array,
+        top_k: int,
+        temperature: float | None = None,
     ) -> ClassifyResult:
         sims = np.asarray(matrix @ jnp.asarray(vec))  # cosine: both unit-norm
         top_k = min(top_k, len(names))
@@ -345,9 +362,13 @@ class CLIPManager:
             scores = sims[idx]
         else:
             # Temperature-scaled stable softmax over ALL labels
-            # (reference: clip_model.py:232-317; temperature = logit scale).
-            temp = float(np.exp(np.asarray(self.params["logit_scale"], np.float32)))
-            logits = sims * temp
+            # (reference: clip_model.py:232-317; temperature = logit scale
+            # unless the caller pins one, e.g. the scene path's 1.0).
+            if temperature is None:
+                temperature = float(
+                    np.exp(np.asarray(self.params["logit_scale"], np.float32))
+                )
+            logits = sims * temperature
             logits -= logits.max()
             probs = np.exp(logits)
             probs /= probs.sum()

@@ -363,9 +363,24 @@ class FaceManager:
 
     def align_crop(self, img: np.ndarray, landmarks: np.ndarray) -> np.ndarray:
         """5-point similarity-transform alignment to the canonical ArcFace
-        112x112 template (reference ``_align_face_5points``)."""
+        112x112 template (reference ``_align_face_5points``). 68-point
+        (iBUG) landmark sets reduce to the canonical 5 first — the
+        reference contract accepts 68 but silently skips alignment for
+        them (``onnxrt_backend.py:1327-1332``); deriving the 5 keeps the
+        embedding aligned either way."""
         import cv2
 
+        landmarks = np.asarray(landmarks, np.float32)
+        if landmarks.shape == (68, 2):
+            landmarks = np.stack(
+                [
+                    landmarks[36:42].mean(0),  # left eye center
+                    landmarks[42:48].mean(0),  # right eye center
+                    landmarks[30],  # nose tip
+                    landmarks[48],  # left mouth corner
+                    landmarks[54],  # right mouth corner
+                ]
+            )
         template = np.asarray(ARCFACE_TEMPLATE, np.float32) * (self.rec_cfg.input_size / 112.0)
         matrix, _ = cv2.estimateAffinePartial2D(
             np.asarray(landmarks, np.float32), template, method=cv2.LMEDS

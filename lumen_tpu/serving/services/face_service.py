@@ -120,8 +120,11 @@ class FaceService(BaseService):
         if "landmarks" in meta:
             try:
                 landmarks = np.asarray(json.loads(meta["landmarks"]), np.float32)
-                if landmarks.shape != (5, 2):
-                    raise ValueError(f"expected [5,2], got {landmarks.shape}")
+                # Contract allows 5-point OR 68-point landmarks (reference
+                # ``backends/base.py:91-103``); 68-point sets reduce to the
+                # canonical 5 in the manager.
+                if landmarks.shape not in ((5, 2), (68, 2)):
+                    raise ValueError(f"expected [5,2] or [68,2], got {landmarks.shape}")
             except (ValueError, json.JSONDecodeError) as e:
                 raise InvalidArgument(f"invalid landmarks meta: {e}") from e
         emb = self._call(lambda: self.manager.extract_embedding(payload, landmarks))

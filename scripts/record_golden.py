@@ -1,0 +1,188 @@
+"""Record golden fixtures into tests/golden/ (SURVEY.md §4 commitment).
+
+Captures input/output pairs through the numerically-sensitive host/device
+math layers — SCRFD decode+NMS, DB postprocess, CTC collapse, CLIP
+classify scoring, VLM image-token splice — so a future refactor cannot
+silently change them. Weight-dependent behavior is covered separately by
+the live-parity suites (HF transformers / torch at test time); these
+fixtures pin the layers that have no external oracle.
+
+Regenerating (only when a change is INTENTIONAL):
+    python scripts/record_golden.py
+then review the diff in the paired test expectations before committing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Site hooks can import jax before this script runs; re-point the config
+# so fixtures are recorded on CPU — the same platform the tests replay on.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "..", "tests", "golden")
+
+
+def record_face_decode() -> None:
+    """SCRFD-contract raw outputs -> decoded boxes/kps/scores + NMS keep."""
+    import jax
+
+    from lumen_tpu.models.face.modeling import decode_detections
+    from lumen_tpu.ops.nms import nms_jax
+
+    rng = np.random.RandomState(0)
+    input_size, num_anchors = 128, 2
+    raw = {}
+    outputs = {}
+    for stride in (8, 16, 32):
+        n = input_size // stride
+        m = n * n * num_anchors
+        scores = rng.uniform(0, 1, (1, m)).astype(np.float32)
+        bbox = rng.uniform(0.5, 3.0, (1, m, 4)).astype(np.float32)
+        kps = rng.uniform(-2.0, 2.0, (1, m, 10)).astype(np.float32)
+        raw[f"scores_{stride}"] = scores
+        raw[f"bbox_{stride}"] = bbox
+        raw[f"kps_{stride}"] = kps
+        outputs[stride] = {"scores": scores, "bbox": bbox, "kps": kps}
+
+    boxes, kps, scores = decode_detections(
+        outputs, input_size, num_anchors, max_detections=672, scores_are_logits=False
+    )
+    keep = jax.vmap(lambda b, s: nms_jax(b, s, 0.4))(boxes, scores)
+    np.savez_compressed(
+        os.path.join(GOLDEN, "face_decode.npz"),
+        input_size=np.int32(input_size),
+        num_anchors=np.int32(num_anchors),
+        **raw,
+        boxes=np.asarray(boxes, np.float32),
+        kps=np.asarray(kps, np.float32),
+        scores=np.asarray(scores, np.float32),
+        keep=np.asarray(keep),
+    )
+
+
+def record_ocr_postprocess() -> None:
+    """Synthetic DB probability map -> quads+scores; CTC rows -> strings."""
+    from lumen_tpu.models.ocr.postprocess import boxes_from_prob_map
+    from lumen_tpu.ops.ctc import ctc_collapse_rows
+
+    prob = np.zeros((160, 240), np.float32)
+    prob[30:50, 20:140] = 0.9  # wide band
+    prob[90:130, 60:100] = 0.8  # square block
+    prob[10:14, 200:204] = 0.7  # tiny blob (min_size filtered)
+    found = boxes_from_prob_map(
+        prob,
+        det_threshold=0.3,
+        box_threshold=0.5,
+        unclip_ratio=1.5,
+        max_candidates=100,
+        min_size=5.0,
+        dest_hw=(320, 480),
+        scale=0.5,
+        pad_top=0,
+        pad_left=0,
+    )
+    quads = np.stack([q for q, _ in found]).astype(np.float32)
+    scores = np.asarray([s for _, s in found], np.float32)
+
+    ids = np.array(
+        [
+            [0, 1, 1, 0, 2, 2, 2, 0, 3],  # collapse -> chars 1,2,3
+            [4, 4, 4, 4, 0, 0, 0, 0, 4],  # collapse -> 4, 4
+            [0, 0, 0, 0, 0, 0, 0, 0, 0],  # all blank
+        ],
+        np.int64,
+    )
+    confs = np.full(ids.shape, 0.9, np.float32)
+    vocab = ["<blank>", "a", "b", "c", "d"]
+    collapsed = ctc_collapse_rows(ids, confs, vocab)
+    np.savez_compressed(
+        os.path.join(GOLDEN, "ocr_postprocess.npz"),
+        prob=prob,
+        quads=quads,
+        quad_scores=scores,
+        ctc_ids=ids,
+        ctc_confs=confs,
+        ctc_texts=np.asarray([t for t, _ in collapsed]),
+        ctc_text_confs=np.asarray([c for _, c in collapsed], np.float32),
+    )
+
+
+def record_clip_classify() -> None:
+    """Cosine scoring + temperature softmax + top-k, reference semantics
+    (clip_model.py:232-317)."""
+    rng = np.random.RandomState(1)
+    vec = rng.randn(64).astype(np.float32)
+    vec /= np.linalg.norm(vec)
+    matrix = rng.randn(20, 64).astype(np.float32)
+    matrix /= np.linalg.norm(matrix, axis=1, keepdims=True)
+    temp = 100.0
+    sims = matrix @ vec
+    logits = sims * temp
+    logits -= logits.max()
+    probs = np.exp(logits)
+    probs /= probs.sum()
+    idx = np.argsort(-sims)[:5]
+    np.savez_compressed(
+        os.path.join(GOLDEN, "clip_classify.npz"),
+        vec=vec,
+        matrix=matrix,
+        temperature=np.float32(temp),
+        top_idx=idx.astype(np.int64),
+        top_probs=probs[idx].astype(np.float32),
+        cosine=sims.astype(np.float32),
+    )
+
+
+def record_vlm_splice() -> None:
+    """Image-token splice layout (merge_image_embeddings) — the LLaVA-style
+    merge the reference does in numpy (onnxrt_backend.py:240-296)."""
+    import jax.numpy as jnp
+
+    from lumen_tpu.models.vlm.modeling import merge_image_embeddings
+
+    rng = np.random.RandomState(2)
+    b, s, v, h = 2, 7, 3, 8
+    text = rng.randn(b, s, h).astype(np.float32)
+    vis = rng.randn(b, v, h).astype(np.float32)
+    image_token = 99
+    ids = np.full((b, s), 5, np.int32)
+    ids[0, 2] = image_token
+    ids[1, 0] = image_token
+    lengths = np.asarray([6, 7], np.int32)
+    merged, positions, out_len = merge_image_embeddings(
+        jnp.asarray(text), jnp.asarray(vis), jnp.asarray(ids), image_token, jnp.asarray(lengths)
+    )
+    np.savez_compressed(
+        os.path.join(GOLDEN, "vlm_splice.npz"),
+        text=text,
+        vis=vis,
+        ids=ids,
+        lengths=lengths,
+        image_token=np.int32(image_token),
+        merged=np.asarray(merged, np.float32),
+        positions=np.asarray(positions),
+        out_lengths=np.asarray(out_len),
+    )
+
+
+def main() -> None:
+    os.makedirs(GOLDEN, exist_ok=True)
+    record_face_decode()
+    record_ocr_postprocess()
+    record_clip_classify()
+    record_vlm_splice()
+    for name in sorted(os.listdir(GOLDEN)):
+        path = os.path.join(GOLDEN, name)
+        print(f"{name}: {os.path.getsize(path)} bytes")
+
+
+if __name__ == "__main__":
+    main()

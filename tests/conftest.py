@@ -8,10 +8,14 @@ TPU hardware, mirroring the strategy described in SURVEY.md §4.
 import os
 import sys
 
-# Must be set before jax initializes a backend.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Must be set before jax initializes a backend. LUMEN_TPU_TESTS=1 opts out
+# of the CPU override so the @pytest.mark.tpu subset runs on the real chip
+# (e.g. `LUMEN_TPU_TESTS=1 pytest -m tpu tests/test_ops.py`).
+_ON_CHIP = os.environ.get("LUMEN_TPU_TESTS") == "1"
+if not _ON_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _ON_CHIP and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -22,7 +26,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # initialized lazily, so this sticks as long as no devices were touched yet.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_CHIP:
+    jax.config.update("jax_platforms", "cpu")
 
 # Repo root on sys.path so `import lumen_tpu` works without installation.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

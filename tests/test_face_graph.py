@@ -289,6 +289,43 @@ class TestGraphFacePipeline:
             assert f.embedding is not None and abs(np.linalg.norm(f.embedding) - 1.0) < 1e-5
 
 
+class Test68PointLandmarks:
+    def test_68_point_landmarks_align(self, graph_face_mgr):
+        """The contract accepts 68-point (iBUG) landmark sets; the canonical
+        5 are derived for alignment (reference allows 5|68,
+        ``backends/base.py:91-103``)."""
+        rng = np.random.RandomState(2)
+        crop = rng.randint(0, 256, (140, 140, 3)).astype(np.uint8)
+        five = np.array(
+            [[50, 60], [90, 60], [70, 80], [55, 105], [85, 105]], np.float32
+        )
+        # Build a 68-point set whose derived canonical 5 equals `five`.
+        lm68 = np.zeros((68, 2), np.float32)
+        lm68[36:42] = five[0]
+        lm68[42:48] = five[1]
+        lm68[30] = five[2]
+        lm68[48] = five[3]
+        lm68[54] = five[4]
+        e68 = graph_face_mgr.extract_embedding(crop, lm68)
+        e5 = graph_face_mgr.extract_embedding(crop, five)
+        np.testing.assert_allclose(e68, e5, atol=1e-5)
+
+    def test_bad_landmark_shape_rejected_at_service(self, graph_face_mgr):
+        import json as _json
+
+        from lumen_tpu.serving.base_service import InvalidArgument
+        from lumen_tpu.serving.services.face_service import FaceService
+
+        svc = FaceService(graph_face_mgr)
+        handler = svc.registry.get("face_embed").handler
+        crop = np.zeros((64, 64, 3), np.uint8)
+        import cv2
+
+        ok, enc = cv2.imencode(".png", crop)
+        with pytest.raises(InvalidArgument):
+            handler(enc.tobytes(), "image/png", {"landmarks": _json.dumps([[1, 2]] * 7)})
+
+
 class TestFaceHardFail:
     def test_missing_weights_hard_fail(self, tmp_path):
         from lumen_tpu.models.face import FaceManager
