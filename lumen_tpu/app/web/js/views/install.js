@@ -15,6 +15,10 @@ const STEP_ICONS = {
 };
 
 let pollTimer = null;
+// Poll chains can be parked in a timer OR awaiting installStatus; a timer
+// clear can't cancel the latter, so each chain carries a generation and a
+// stale chain drops its response instead of clobbering a newer task's state.
+let pollGen = 0;
 
 export function renderInstall(root, onLeave) {
   const s = wizard.state;
@@ -49,7 +53,7 @@ export function renderInstall(root, onLeave) {
   });
 
   // resume a task in flight (reload mid-install)
-  if (s.installTaskId && !s.installDone) poll(root, s.installTaskId);
+  if (s.installTaskId && !s.installDone) poll(root, s.installTaskId, ++pollGen);
 
   root.querySelector("#inst-start").onclick = async () => {
     const btn = root.querySelector("#inst-start");
@@ -70,7 +74,7 @@ export function renderInstall(root, onLeave) {
       });
       wizard.update({ installTaskId: task.task_id, installDone: false });
       root.querySelector("#inst-cancel").disabled = false;
-      poll(root, task.task_id);
+      poll(root, task.task_id, ++pollGen);
     } catch (e) {
       toast(e.message, true);
       btn.disabled = false;
@@ -88,13 +92,14 @@ export function renderInstall(root, onLeave) {
   };
 }
 
-async function poll(root, taskId) {
-  if (!root.isConnected) return; // view switched away
+async function poll(root, taskId, gen) {
+  if (!root.isConnected || gen !== pollGen) return; // view switched / superseded
   clearTimeout(pollTimer); // a Start-triggered poll replaces a stale chain
   let task;
   try {
     task = await api.installStatus(taskId);
   } catch (e) {
+    if (gen !== pollGen) return; // superseded while awaiting
     if (e.status === 404) {
       // Install tasks live in the control plane's memory; after a restart
       // a persisted id is gone for good — stop polling, forget it.
@@ -107,10 +112,10 @@ async function poll(root, taskId) {
     // Transient control-plane hiccups must not freeze a running install's
     // progress display — keep polling.
     root.querySelector("#inst-status").textContent = `${e.message} (retrying…)`;
-    pollTimer = setTimeout(() => poll(root, taskId), 2000);
+    pollTimer = setTimeout(() => poll(root, taskId, gen), 2000);
     return;
   }
-  if (!root.isConnected) return;
+  if (!root.isConnected || gen !== pollGen) return;
 
   root.querySelector("#inst-bar").style.width = `${Math.round((task.progress || 0) * 100)}%`;
   const list = root.querySelector("#inst-steps");
@@ -128,7 +133,7 @@ async function poll(root, taskId) {
 
   if (task.status === "running" || task.status === "pending") {
     root.querySelector("#inst-cancel").disabled = false;
-    pollTimer = setTimeout(() => poll(root, taskId), 900);
+    pollTimer = setTimeout(() => poll(root, taskId, gen), 900);
   } else {
     root.querySelector("#inst-start").disabled = false;
     root.querySelector("#inst-cancel").disabled = true;

@@ -357,8 +357,10 @@ class CLIPManager:
         top_k = min(top_k, len(names))
         idx = np.argpartition(-sims, top_k - 1)[:top_k]
         idx = idx[np.argsort(-sims[idx])]
-        if self.classify_mode == "cosine":
-            # Raw similarity scores (BioCLIP large-taxonomy behavior).
+        if self.classify_mode == "cosine" and temperature is None:
+            # Raw similarity scores (BioCLIP large-taxonomy behavior). An
+            # explicitly pinned temperature (the scene path's 1.0) always
+            # means softmax — even on a cosine-mode manager.
             scores = sims[idx]
         else:
             # Temperature-scaled stable softmax over ALL labels

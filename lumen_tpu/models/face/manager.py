@@ -382,9 +382,7 @@ class FaceManager:
                 ]
             )
         template = np.asarray(ARCFACE_TEMPLATE, np.float32) * (self.rec_cfg.input_size / 112.0)
-        matrix, _ = cv2.estimateAffinePartial2D(
-            np.asarray(landmarks, np.float32), template, method=cv2.LMEDS
-        )
+        matrix, _ = cv2.estimateAffinePartial2D(landmarks, template, method=cv2.LMEDS)
         if matrix is None:
             return self._center_crop(img)
         return cv2.warpAffine(img, matrix, (self.rec_cfg.input_size, self.rec_cfg.input_size))

@@ -29,6 +29,14 @@ jax.config.update("jax_platforms", "cpu")
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "..", "tests", "golden")
 
+from tests.golden_params import (  # noqa: E402 — needs the repo root on sys.path
+    CLIP_TOP_K,
+    CTC_VOCAB,
+    DB_POSTPROCESS,
+    FACE_MAX_DETECTIONS,
+    FACE_NMS_THRESHOLD,
+)
+
 
 def record_face_decode() -> None:
     """SCRFD-contract raw outputs -> decoded boxes/kps/scores + NMS keep."""
@@ -53,9 +61,10 @@ def record_face_decode() -> None:
         outputs[stride] = {"scores": scores, "bbox": bbox, "kps": kps}
 
     boxes, kps, scores = decode_detections(
-        outputs, input_size, num_anchors, max_detections=672, scores_are_logits=False
+        outputs, input_size, num_anchors,
+        max_detections=FACE_MAX_DETECTIONS, scores_are_logits=False,
     )
-    keep = jax.vmap(lambda b, s: nms_jax(b, s, 0.4))(boxes, scores)
+    keep = jax.vmap(lambda b, s: nms_jax(b, s, FACE_NMS_THRESHOLD))(boxes, scores)
     np.savez_compressed(
         os.path.join(GOLDEN, "face_decode.npz"),
         input_size=np.int32(input_size),
@@ -77,18 +86,7 @@ def record_ocr_postprocess() -> None:
     prob[30:50, 20:140] = 0.9  # wide band
     prob[90:130, 60:100] = 0.8  # square block
     prob[10:14, 200:204] = 0.7  # tiny blob (min_size filtered)
-    found = boxes_from_prob_map(
-        prob,
-        det_threshold=0.3,
-        box_threshold=0.5,
-        unclip_ratio=1.5,
-        max_candidates=100,
-        min_size=5.0,
-        dest_hw=(320, 480),
-        scale=0.5,
-        pad_top=0,
-        pad_left=0,
-    )
+    found = boxes_from_prob_map(prob, **DB_POSTPROCESS)
     quads = np.stack([q for q, _ in found]).astype(np.float32)
     scores = np.asarray([s for _, s in found], np.float32)
 
@@ -101,8 +99,7 @@ def record_ocr_postprocess() -> None:
         np.int64,
     )
     confs = np.full(ids.shape, 0.9, np.float32)
-    vocab = ["<blank>", "a", "b", "c", "d"]
-    collapsed = ctc_collapse_rows(ids, confs, vocab)
+    collapsed = ctc_collapse_rows(ids, confs, CTC_VOCAB)
     np.savez_compressed(
         os.path.join(GOLDEN, "ocr_postprocess.npz"),
         prob=prob,
@@ -129,7 +126,7 @@ def record_clip_classify() -> None:
     logits -= logits.max()
     probs = np.exp(logits)
     probs /= probs.sum()
-    idx = np.argsort(-sims)[:5]
+    idx = np.argsort(-sims)[:CLIP_TOP_K]
     np.savez_compressed(
         os.path.join(GOLDEN, "clip_classify.npz"),
         vec=vec,

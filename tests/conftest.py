@@ -33,3 +33,18 @@ if not _ON_CHIP:
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+
+def pytest_collection_modifyitems(config, items):
+    """On-chip sessions run ONLY the @pytest.mark.tpu subset: everything
+    else was recorded/toleranced for CPU numerics (golden fixtures, exact
+    NMS masks) and would fail spuriously on TPU matmul precision — skip it
+    rather than let `LUMEN_TPU_TESTS=1 pytest tests/` look like regressions."""
+    if not _ON_CHIP:
+        return
+    import pytest
+
+    skip = pytest.mark.skip(reason="LUMEN_TPU_TESTS=1 runs only -m tpu tests")
+    for item in items:
+        if "tpu" not in item.keywords:
+            item.add_marker(skip)

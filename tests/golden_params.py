@@ -1,0 +1,27 @@
+"""Parameters shared by the golden-fixture recorder and its replay tests.
+
+Single source of truth so ``scripts/record_golden.py`` and
+``tests/test_golden.py`` cannot drift apart: a parameter tweak in one
+place is automatically the other's, and a golden mismatch then always
+means a genuine behavior change (array-valued inputs/outputs live in the
+``.npz`` fixtures themselves).
+"""
+
+FACE_NMS_THRESHOLD = 0.4
+FACE_MAX_DETECTIONS = 672  # keep every anchor: parity covers the full set
+
+DB_POSTPROCESS = dict(
+    det_threshold=0.3,
+    box_threshold=0.5,
+    unclip_ratio=1.5,
+    max_candidates=100,
+    min_size=5.0,
+    dest_hw=(320, 480),
+    scale=0.5,
+    pad_top=0,
+    pad_left=0,
+)
+
+CTC_VOCAB = ["<blank>", "a", "b", "c", "d"]
+
+CLIP_TOP_K = 5

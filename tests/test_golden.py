@@ -13,6 +13,14 @@ import os
 import numpy as np
 import pytest
 
+from tests.golden_params import (
+    CLIP_TOP_K,
+    CTC_VOCAB,
+    DB_POSTPROCESS,
+    FACE_MAX_DETECTIONS,
+    FACE_NMS_THRESHOLD,
+)
+
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
 
 
@@ -43,10 +51,10 @@ class TestFaceDecodeGolden:
             outputs,
             int(fx["input_size"]),
             int(fx["num_anchors"]),
-            max_detections=672,
+            max_detections=FACE_MAX_DETECTIONS,
             scores_are_logits=False,
         )
-        keep = jax.vmap(lambda b, s: nms_jax(b, s, 0.4))(boxes, scores)
+        keep = jax.vmap(lambda b, s: nms_jax(b, s, FACE_NMS_THRESHOLD))(boxes, scores)
         np.testing.assert_allclose(np.asarray(boxes), fx["boxes"], atol=1e-4, rtol=1e-4)
         np.testing.assert_allclose(np.asarray(kps), fx["kps"], atol=1e-4, rtol=1e-4)
         np.testing.assert_allclose(np.asarray(scores), fx["scores"], atol=1e-5, rtol=1e-5)
@@ -58,18 +66,7 @@ class TestOcrPostprocessGolden:
         from lumen_tpu.models.ocr.postprocess import boxes_from_prob_map
 
         fx = load("ocr_postprocess.npz")
-        found = boxes_from_prob_map(
-            fx["prob"],
-            det_threshold=0.3,
-            box_threshold=0.5,
-            unclip_ratio=1.5,
-            max_candidates=100,
-            min_size=5.0,
-            dest_hw=(320, 480),
-            scale=0.5,
-            pad_top=0,
-            pad_left=0,
-        )
+        found = boxes_from_prob_map(fx["prob"], **DB_POSTPROCESS)
         quads = np.stack([q for q, _ in found]).astype(np.float32)
         scores = np.asarray([s for _, s in found], np.float32)
         assert quads.shape == fx["quads"].shape
@@ -80,8 +77,7 @@ class TestOcrPostprocessGolden:
         from lumen_tpu.ops.ctc import ctc_collapse_rows
 
         fx = load("ocr_postprocess.npz")
-        vocab = ["<blank>", "a", "b", "c", "d"]
-        collapsed = ctc_collapse_rows(fx["ctc_ids"], fx["ctc_confs"], vocab)
+        collapsed = ctc_collapse_rows(fx["ctc_ids"], fx["ctc_confs"], CTC_VOCAB)
         assert [t for t, _ in collapsed] == list(fx["ctc_texts"])
         np.testing.assert_allclose(
             [c for _, c in collapsed], fx["ctc_text_confs"], atol=1e-6
@@ -107,7 +103,7 @@ class TestClipClassifyGolden:
             fx["vec"],
             names,
             jnp.asarray(fx["matrix"]),
-            top_k=5,
+            top_k=CLIP_TOP_K,
             temperature=float(fx["temperature"]),
         )
         got_idx = [names.index(label) for label, _ in res.labels]
