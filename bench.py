@@ -299,6 +299,124 @@ def phase_ingest(n_images: int = 256) -> dict:
     }
 
 
+def phase_face(batch: int = 32, iters: int = 10) -> dict:
+    """SCRFD-shaped detect (forward + device decode + NMS) images/sec —
+    the reference's per-image CPU loop (``packages/lumen-face/src/
+    lumen_face/backends/onnxrt_backend.py:701-1290``) recast as one
+    batched XLA program. Random weights: perf depends only on shapes."""
+    _apply_platform_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lumen_tpu.models.face.modeling import DetectorConfig, FaceDetector, decode_detections
+    from lumen_tpu.ops.nms import nms_jax
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        batch, iters = 2, 2
+    dcfg = DetectorConfig.tiny() if cpu else DetectorConfig()  # 640
+    det = FaceDetector(dcfg)
+    dvars = det.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, dcfg.input_size, dcfg.input_size, 3), jnp.bfloat16)
+    )
+
+    @jax.jit
+    def detect(variables, pixels_u8):
+        x = (pixels_u8.astype(jnp.float32) - 127.5) / 128.0
+        out = det.apply(variables, x.astype(jnp.bfloat16))
+        boxes, kps, scores = decode_detections(
+            out, dcfg.input_size, dcfg.num_anchors, max_detections=128
+        )
+        keep = jax.vmap(lambda b, s: nms_jax(b, s, 0.4))(boxes, scores)
+        return boxes, kps, scores, keep
+
+    inputs = [
+        jax.device_put(
+            np.random.default_rng(i).integers(
+                0, 255, (batch, dcfg.input_size, dcfg.input_size, 3), np.uint8
+            )
+        )
+        for i in range(2)
+    ]
+    np.asarray(detect(dvars, inputs[0])[0])  # compile + settle
+    t0 = time.perf_counter()
+    out = None
+    for i in range(iters):
+        out = detect(dvars, inputs[i % len(inputs)])
+    np.asarray(out[0])
+    dt = time.perf_counter() - t0
+    return {
+        "images_per_sec": round(batch * iters / dt, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def phase_ocr(det_batch: int = 8, rec_batch: int = 64, iters: int = 10) -> dict:
+    """DBNet detect (640²) images/sec + SVTR/CTC recognize (48×320 crops)
+    crops/sec — the reference's PP-OCR pipeline stages (``packages/
+    lumen-ocr/src/lumen_ocr/backends/onnxrt_backend.py:43-633``) as
+    batched XLA programs with on-device CTC argmax."""
+    _apply_platform_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lumen_tpu.models.ocr.modeling import (
+        DBNet,
+        DBNetConfig,
+        SVTRConfig,
+        SVTRRecognizer,
+    )
+    from lumen_tpu.ops.ctc import ctc_greedy_device
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        det_batch, rec_batch, iters = 1, 2, 2
+        det_size, rec_w = 64, 64
+        dcfg, rcfg = DBNetConfig.tiny(), SVTRConfig.tiny()
+    else:
+        det_size, rec_w = 640, 320
+        dcfg, rcfg = DBNetConfig(), SVTRConfig()
+    det = DBNet(dcfg)
+    dvars = det.init(jax.random.PRNGKey(0), jnp.zeros((1, det_size, det_size, 3), jnp.bfloat16))
+    rec = SVTRRecognizer(rcfg)
+    rvars = rec.init(jax.random.PRNGKey(1), jnp.zeros((1, rcfg.height, rec_w, 3), jnp.bfloat16))
+
+    @jax.jit
+    def detect(variables, pixels_u8):
+        x = (pixels_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5
+        return det.apply(variables, x.astype(jnp.bfloat16))
+
+    @jax.jit
+    def recognize(variables, crops_u8):
+        x = (crops_u8.astype(jnp.float32) / 255.0 - 0.5) / 0.5
+        logits = rec.apply(variables, x.astype(jnp.bfloat16))
+        return ctc_greedy_device(logits)
+
+    rng = np.random.default_rng(0)
+    det_in = jax.device_put(rng.integers(0, 255, (det_batch, det_size, det_size, 3), np.uint8))
+    rec_in = jax.device_put(rng.integers(0, 255, (rec_batch, rcfg.height, rec_w, 3), np.uint8))
+    np.asarray(detect(dvars, det_in))  # compile + settle
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = detect(dvars, det_in)
+    np.asarray(out)
+    det_dt = time.perf_counter() - t0
+    np.asarray(recognize(rvars, rec_in)[0])  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = recognize(rvars, rec_in)
+    np.asarray(out[0])
+    rec_dt = time.perf_counter() - t0
+    return {
+        "det_images_per_sec": round(det_batch * iters / det_dt, 1),
+        "rec_crops_per_sec": round(rec_batch * iters / rec_dt, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def phase_baseline_torch(iters: int = 8) -> dict:
     """Reference execution model: per-request (batch 1) CPU forward of the
     same ViT-B/32 vision tower."""
@@ -346,6 +464,8 @@ PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
     "vlm": phase_vlm,
+    "face": phase_face,
+    "ocr": phase_ocr,
     "ingest": phase_ingest,
     "baseline": phase_baseline_torch,
 }
@@ -462,7 +582,7 @@ def main(args) -> None:
     # Secondary metrics are opt-in (--full) or env-enabled so the default
     # driver invocation stays well inside its time budget.
     full = args.full or os.environ.get("BENCH_FULL") == "1"
-    names = ["probe", "clip"] + (["vlm", "ingest"] if full else [])
+    names = ["probe", "clip"] + (["vlm", "face", "ocr", "ingest"] if full else [])
     # BENCH_TIMEOUT is per heavyweight phase (probe is trivial); the group
     # shares one budget so slow-but-working later phases aren't killed by
     # a single-phase allowance. CPU fallbacks shrink their own workloads,
@@ -483,6 +603,15 @@ def main(args) -> None:
         extras["vlm_decode_tokens_per_sec"] = vlm.get("tokens_per_sec")
         extras["vlm_batch"] = vlm.get("batch")
         extras["vlm_platform"] = vlm.get("platform")
+    face = results.get("face")
+    if face:
+        extras["face_detect_images_per_sec"] = face.get("images_per_sec")
+        extras["face_platform"] = face.get("platform")
+    ocr = results.get("ocr")
+    if ocr:
+        extras["ocr_det_images_per_sec"] = ocr.get("det_images_per_sec")
+        extras["ocr_rec_crops_per_sec"] = ocr.get("rec_crops_per_sec")
+        extras["ocr_platform"] = ocr.get("platform")
     ingest = results.get("ingest")
     if ingest:
         extras["ingest_images_per_sec"] = ingest.get("images_per_sec")

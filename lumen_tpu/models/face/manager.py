@@ -292,6 +292,7 @@ class FaceManager:
         size_min: float | None = None,
         size_max: float | None = None,
         max_faces: int | None = None,
+        nms_threshold: float | None = None,
     ) -> list[FaceDetection]:
         self._ensure_ready()
         img = (
@@ -306,7 +307,7 @@ class FaceManager:
             boxes, kps, scores, keep,
             scale=scale, pad_top=pad_top, pad_left=pad_left, image_hw=(h, w),
             conf_threshold=conf_threshold, size_min=size_min, size_max=size_max,
-            max_faces=max_faces,
+            max_faces=max_faces, nms_threshold=nms_threshold,
         )
 
     def detections_from_outputs(
@@ -324,11 +325,33 @@ class FaceManager:
         size_min: float | None = None,
         size_max: float | None = None,
         max_faces: int | None = None,
+        nms_threshold: float | None = None,
     ) -> list[FaceDetection]:
         """Host half of detection: score/keep filtering + letterbox unmap.
         Shared by the per-request path above and the batch-ingest pipeline
         (``lumen_tpu/pipeline/photo.py``), so threshold semantics can't drift."""
         h, w = image_hw
+        if nms_threshold is not None and nms_threshold != self.spec.nms_threshold:
+            # The device program bakes the pack's NMS threshold into its
+            # compiled keep-mask; a per-request override (reference meta
+            # ``nms_threshold``, ``face_service.py:441``) re-suppresses the
+            # full decoded candidate set host-side instead of recompiling
+            # per distinct value.
+            from ...ops.nms import nms_numpy
+
+            finite = np.where(np.isfinite(scores))[0]
+            keep = np.zeros(np.shape(scores), bool)
+            if finite.size:
+                kept = finite[
+                    np.asarray(
+                        nms_numpy(
+                            np.asarray(boxes)[finite].astype(np.float32),
+                            np.asarray(scores)[finite].astype(np.float32),
+                            float(nms_threshold),
+                        )
+                    )
+                ]
+                keep[kept] = True
         conf = self.spec.score_threshold if conf_threshold is None else conf_threshold
         # Size gate defaults come from the pack spec (min_face/max_face);
         # explicit request values still win.

@@ -404,8 +404,10 @@ class VLMManager:
 
     # -- prompt prep -------------------------------------------------------
 
-    def _encode_prompt(self, messages: Sequence[ChatMessage], has_image: bool) -> list[int]:
-        prompt = self.tokenizer.render(messages, add_generation_prompt=True)
+    def _encode_prompt(
+        self, messages: Sequence[ChatMessage], has_image: bool, add_generation_prompt: bool = True
+    ) -> list[int]:
+        prompt = self.tokenizer.render(messages, add_generation_prompt=add_generation_prompt)
         ids = self.tokenizer.encode(prompt)
         if has_image and self.cfg.image_token_id not in ids:
             # Template without an <image> slot: splice the placeholder up
@@ -420,11 +422,11 @@ class VLMManager:
                 return b
         raise ValueError(f"prompt of {n} tokens exceeds the largest bucket {self.prefill_buckets[-1]}")
 
-    def _prepare_inputs(self, messages, image_bytes):
+    def _prepare_inputs(self, messages, image_bytes, add_generation_prompt: bool = True):
         import cv2
 
         has_image = bool(image_bytes)
-        ids = self._encode_prompt(messages, has_image)
+        ids = self._encode_prompt(messages, has_image, add_generation_prompt)
         n = len(ids)
         bucket = self._bucket_len(n)
         padded = np.full((1, bucket), self.cfg.pad_token_id, np.int32)
@@ -511,11 +513,12 @@ class VLMManager:
         do_sample: bool = False,
         repetition_penalty: float = 1.0,
         stop_sequences: Sequence[str] | None = None,
+        add_generation_prompt: bool = True,
     ) -> GenerationResult:
         self._ensure_ready()
         t0 = time.perf_counter()
         embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
-            messages, image_bytes
+            messages, image_bytes, add_generation_prompt
         )
         future = self._batcher.submit(
             _PendingGen(
@@ -563,6 +566,7 @@ class VLMManager:
         do_sample: bool = False,
         repetition_penalty: float = 1.0,
         stop_sequences: Sequence[str] | None = None,
+        add_generation_prompt: bool = True,
     ) -> Iterator[GenerationChunk]:
         """Incremental generation: yields text deltas as tokens arrive
         (true streaming — the reference collects all chunks into one
@@ -581,6 +585,7 @@ class VLMManager:
             yield from self._stream_locked(
                 messages, image_bytes, max_new_tokens, temperature, top_p,
                 do_sample, repetition_penalty, stop_sequences, holdback, t0,
+                add_generation_prompt,
             )
         finally:
             self._stream_slots.release()
@@ -588,9 +593,10 @@ class VLMManager:
     def _stream_locked(
         self, messages, image_bytes, max_new_tokens, temperature, top_p,
         do_sample, repetition_penalty, stop_sequences, holdback, t0,
+        add_generation_prompt=True,
     ) -> Iterator[GenerationChunk]:
         embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
-            messages, image_bytes
+            messages, image_bytes, add_generation_prompt
         )
         tokens: list[int] = []
         emitted = ""

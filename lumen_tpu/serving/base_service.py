@@ -53,6 +53,17 @@ class Unavailable(ServiceError):
         super().__init__(pb.ERROR_CODE_UNAVAILABLE, message, detail)
 
 
+def first_meta_key(meta: dict[str, str], *keys: str) -> str | None:
+    """First present key among ``keys`` — shared alias resolution so every
+    service treats reference-client meta names (e.g. the face service's
+    ``detection_confidence_threshold`` for our ``conf_threshold``) with the
+    same precedence rule: our name first, then the reference aliases."""
+    for key in keys:
+        if key in meta:
+            return key
+    return None
+
+
 @dataclass
 class _Assembly:
     task: str = ""

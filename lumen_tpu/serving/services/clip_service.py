@@ -23,7 +23,7 @@ import os
 from ...core.config import ServiceConfig
 from ...core.result_schemas import EmbeddingV1, LabelsV1, LabelItem
 from ...models.clip import CLIPManager
-from ..base_service import BaseService, InvalidArgument, Unavailable
+from ..base_service import BaseService, InvalidArgument, Unavailable, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
 logger = logging.getLogger(__name__)
@@ -152,7 +152,7 @@ class ClipService(BaseService):
         return self._embedding_result(mgr, vec)
 
     def _classify(self, mgr: CLIPManager, payload: bytes, meta: dict[str, str]):
-        top_k = _int_meta(meta, "top_k", 5)
+        top_k = _top_k(meta, 5)
         try:
             result = mgr.classify_image(payload, top_k=top_k)
         except RuntimeError as e:
@@ -163,7 +163,7 @@ class ClipService(BaseService):
 
     def _scene(self, mgr: CLIPManager, payload: bytes, meta: dict[str, str]):
         try:
-            result = mgr.classify_scene(payload, top_k=_int_meta(meta, "top_k", 3))
+            result = mgr.classify_scene(payload, top_k=_top_k(meta, 3))
         except ValueError as e:
             raise InvalidArgument(f"cannot process image: {e}") from e
         return self._labels_result(mgr, result)
@@ -173,7 +173,7 @@ class ClipService(BaseService):
         if ns != "bioatlas":
             raise InvalidArgument(f"unsupported namespace {ns!r} (expected 'bioatlas')")
         mgr = self.managers["bioclip"]
-        top_k = _int_meta(meta, "top_k", 5)
+        top_k = _top_k(meta, 5)
         try:
             result = mgr.classify_image(payload, top_k=top_k)
         except ValueError as e:
@@ -207,6 +207,13 @@ def _int_meta(meta: dict[str, str], key: str, default: int) -> int:
         return int(meta.get(key, default))
     except ValueError as e:
         raise InvalidArgument(f"meta {key!r} must be an integer") from e
+
+
+def _top_k(meta: dict[str, str], default: int) -> int:
+    """Accept our ``top_k`` and the reference client's ``topk``
+    (``clip_service.py:317``) so drop-in clients keep their knob."""
+    key = first_meta_key(meta, "top_k", "topk")
+    return _int_meta(meta, key, default) if key else default
 
 
 def _backend_name() -> str:

@@ -19,7 +19,7 @@ import numpy as np
 from ...core.config import ServiceConfig
 from ...core.result_schemas import FaceItem, FaceV1
 from ...models.face import FaceManager
-from ..base_service import BaseService, InvalidArgument
+from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
 logger = logging.getLogger(__name__)
@@ -98,12 +98,18 @@ class FaceService(BaseService):
 
     def _det_kwargs(self, meta: dict[str, str]) -> dict:
         kw = {}
-        if "conf_threshold" in meta:
-            kw["conf_threshold"] = _float_meta(meta, "conf_threshold")
-        if "size_min" in meta:
-            kw["size_min"] = _float_meta(meta, "size_min")
-        if "size_max" in meta:
-            kw["size_max"] = _float_meta(meta, "size_max")
+        # First alias per arg is ours; the rest are the reference client's
+        # exact key names (``general_face/face_service.py:439-443``) so a
+        # drop-in client's knobs aren't silently ignored.
+        for arg, aliases in (
+            ("conf_threshold", ("conf_threshold", "detection_confidence_threshold")),
+            ("size_min", ("size_min", "face_size_min")),
+            ("size_max", ("size_max", "face_size_max")),
+            ("nms_threshold", ("nms_threshold",)),
+        ):
+            meta_key = first_meta_key(meta, *aliases)
+            if meta_key is not None:
+                kw[arg] = _float_meta(meta, meta_key)
         if "max_faces" in meta:
             try:
                 kw["max_faces"] = int(meta["max_faces"])

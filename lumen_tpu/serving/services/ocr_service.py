@@ -13,7 +13,7 @@ import os
 from ...core.config import ServiceConfig
 from ...core.result_schemas import OcrItem, OCRV1
 from ...models.ocr import OcrManager
-from ..base_service import BaseService, InvalidArgument
+from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
 logger = logging.getLogger(__name__)
@@ -74,13 +74,17 @@ class OcrService(BaseService):
 
     def _ocr(self, payload: bytes, mime: str, meta: dict[str, str]):
         kw = {}
-        for meta_key, arg in (
-            ("det_thresh", "det_threshold"),
-            ("rec_thresh", "rec_threshold"),
-            ("box_thresh", "box_threshold"),
-            ("unclip_ratio", "unclip_ratio"),
+        # First alias per arg is ours; the rest are the reference client's
+        # exact key names (``general_ocr/ocr_service.py:244-250``) so a
+        # drop-in client's knobs aren't silently ignored.
+        for arg, aliases in (
+            ("det_threshold", ("det_thresh", "detection_threshold")),
+            ("rec_threshold", ("rec_thresh", "recognition_threshold")),
+            ("box_threshold", ("box_thresh", "ocr.box_thresh")),
+            ("unclip_ratio", ("unclip_ratio", "ocr.unclip_ratio")),
         ):
-            if meta_key in meta:
+            meta_key = first_meta_key(meta, *aliases)
+            if meta_key is not None:
                 try:
                     kw[arg] = float(meta[meta_key])
                 except ValueError as e:

@@ -132,6 +132,10 @@ class VlmService(BaseService):
                     raise InvalidArgument(f"meta {key!r} must be a {cast.__name__}") from e
         if "do_sample" in meta:
             kw["do_sample"] = meta["do_sample"].lower() in ("1", "true", "yes")
+        if "add_generation_prompt" in meta:
+            # Reference knob (``fastvlm_service.py:398``): render the chat
+            # template without the trailing assistant turn when false.
+            kw["add_generation_prompt"] = meta["add_generation_prompt"].lower() in ("1", "true", "yes")
         if "stop_sequences" in meta:
             try:
                 stops = json.loads(meta["stop_sequences"])

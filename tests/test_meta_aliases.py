@@ -1,0 +1,138 @@
+"""Reference clients' request-meta key names must keep working.
+
+The reference services parse specific meta keys (face
+``general_face/face_service.py:439-443``, ocr
+``general_ocr/ocr_service.py:244-250``, clip ``clip_service.py:317``, vlm
+``fastvlm_service.py:392-398``); a drop-in client switching stacks sends
+exactly those, so each service accepts them as aliases of our names.
+"""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+import pytest
+
+
+class TestFaceMetaAliases:
+    def _kwargs(self, meta):
+        from lumen_tpu.serving.services.face_service import FaceService
+
+        return FaceService._det_kwargs(object.__new__(FaceService), meta)
+
+    def test_reference_keys_accepted(self):
+        kw = self._kwargs(
+            {
+                "detection_confidence_threshold": "0.7",
+                "face_size_min": "50",
+                "face_size_max": "1000",
+                "nms_threshold": "0.3",
+                "max_faces": "2",
+            }
+        )
+        assert kw == {
+            "conf_threshold": 0.7,
+            "size_min": 50.0,
+            "size_max": 1000.0,
+            "nms_threshold": 0.3,
+            "max_faces": 2,
+        }
+
+    def test_our_keys_win_over_aliases(self):
+        kw = self._kwargs({"conf_threshold": "0.5", "detection_confidence_threshold": "0.9"})
+        assert kw["conf_threshold"] == 0.5
+
+
+class TestOcrMetaAliases:
+    def _kwargs(self, meta):
+        """Run the parse half of ``_ocr`` via a manager stub that records
+        the kwargs it was called with."""
+        from lumen_tpu.serving.services.ocr_service import OcrService
+
+        captured = {}
+
+        class _Mgr:
+            model_id = "m"
+
+            def predict(self, payload, **kw):
+                captured.update(kw)
+                return []
+
+        svc = object.__new__(OcrService)
+        svc.manager = _Mgr()
+        svc._ocr(b"x", "image/png", meta)
+        return captured
+
+    def test_reference_keys_accepted(self):
+        kw = self._kwargs(
+            {
+                "detection_threshold": "0.25",
+                "recognition_threshold": "0.6",
+                "ocr.box_thresh": "0.55",
+                "ocr.unclip_ratio": "1.8",
+            }
+        )
+        assert kw == {
+            "det_threshold": 0.25,
+            "rec_threshold": 0.6,
+            "box_threshold": 0.55,
+            "unclip_ratio": 1.8,
+        }
+
+    def test_our_keys_win_over_aliases(self):
+        kw = self._kwargs({"det_thresh": "0.3", "detection_threshold": "0.9"})
+        assert kw["det_threshold"] == 0.3
+
+
+class TestClipTopkAlias:
+    def test_topk_alias(self):
+        from lumen_tpu.serving.services.clip_service import _top_k
+
+        assert _top_k({"topk": "7"}, 5) == 7
+        assert _top_k({"top_k": "3", "topk": "9"}, 5) == 3
+        assert _top_k({}, 5) == 5
+
+
+class TestVlmAddGenerationPrompt:
+    def test_meta_parsed(self):
+        from lumen_tpu.serving.services.vlm_service import VlmService
+
+        svc = object.__new__(VlmService)
+        _msgs, _img, kw = svc._parse_request(
+            b"", {"messages": '[{"role":"user","content":"hi"}]', "add_generation_prompt": "false"}
+        )
+        assert kw["add_generation_prompt"] is False
+
+
+class TestFaceNmsOverride:
+    def test_host_side_renms(self):
+        """A per-request nms_threshold re-suppresses the decoded candidate
+        set host-side (the device keep mask bakes in the pack default)."""
+        from lumen_tpu.models.face.manager import FaceManager
+
+        fake = types.SimpleNamespace(
+            spec=types.SimpleNamespace(
+                nms_threshold=0.4, score_threshold=0.1, min_face=0.0, max_face=1e9
+            )
+        )
+        # Two heavily-overlapping boxes + one far away. Device keep (at
+        # 0.4) suppressed box 1; a permissive request threshold (0.95)
+        # must bring it back, and a strict one (0.01) must keep it out.
+        boxes = np.array(
+            [[0, 0, 100, 100], [5, 5, 105, 105], [300, 300, 400, 400]], np.float32
+        )
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        kps = np.zeros((3, 5, 2), np.float32)
+        keep_dev = np.array([True, False, True])
+
+        def run(nms):
+            return FaceManager.detections_from_outputs(
+                fake, boxes, kps, scores, keep_dev,
+                scale=1.0, pad_top=0, pad_left=0, image_hw=(500, 500),
+                nms_threshold=nms,
+            )
+
+        assert len(run(None)) == 2  # device mask respected
+        assert len(run(0.95)) == 3  # permissive: overlap allowed again
+        assert len(run(0.01)) == 2  # strict: overlapping box suppressed
