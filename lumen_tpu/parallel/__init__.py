@@ -1,13 +1,15 @@
-"""Parallelism: sharding rules, ring attention, multi-host runtime."""
+"""Parallelism: sharding rules, ring + all-to-all sequence parallelism, multi-host runtime."""
 
 from .distributed import initialize, is_primary
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 from .sharding import TRANSFORMER_TP_RULES, replicate, shard_params, spec_for
 
 __all__ = [
     "initialize",
     "is_primary",
     "ring_attention",
+    "ulysses_attention",
     "shard_params",
     "replicate",
     "spec_for",

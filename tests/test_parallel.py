@@ -118,3 +118,61 @@ class TestLogitScaleClamp:
         step = trainer.make_train_step()
         params, _, metrics = step(params, opt_state, make_batch(8, cfg))
         assert float(params["logit_scale"]) <= float(jnp.log(100.0)) + 1e-6
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        from lumen_tpu.parallel import ulysses_attention
+
+        mesh = build_mesh({"seq": -1})
+        n = mesh.shape["seq"]
+        assert n == 8
+        rng = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(rng, 3)
+        b, h, s, d = 1, 8, 8 * 16, 32  # heads divisible by the axis
+        q = jax.random.normal(kq, (b, h, s, d))
+        k = jax.random.normal(kk, (b, h, s, d))
+        v = jax.random.normal(kv, (b, h, s, d))
+        ref = attention_reference(q, k, v, causal=causal)
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_matches_ring(self):
+        """Both SP strategies compute the same exact attention."""
+        from lumen_tpu.parallel import ulysses_attention
+
+        mesh = build_mesh({"seq": -1})
+        rng = jax.random.PRNGKey(2)
+        kq, kk, kv = jax.random.split(rng, 3)
+        b, h, s, d = 2, 8, 8 * 8, 16
+        q = jax.random.normal(kq, (b, h, s, d))
+        k = jax.random.normal(kk, (b, h, s, d))
+        v = jax.random.normal(kv, (b, h, s, d))
+        a = ulysses_attention(q, k, v, mesh, causal=True)
+        r = ring_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=2e-5, rtol=2e-5)
+
+    def test_jit_under_mesh(self):
+        from lumen_tpu.parallel import ulysses_attention
+
+        mesh = build_mesh({"seq": -1})
+        x = jnp.ones((1, 8, 8 * 8, 16))
+        f = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True))
+        assert f(x, x, x).shape == x.shape
+
+    def test_indivisible_heads_raise(self):
+        from lumen_tpu.parallel import ulysses_attention
+
+        mesh = build_mesh({"seq": -1})
+        x = jnp.ones((1, 2, 8 * 8, 16))  # 2 heads on an 8-way axis
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(x, x, x, mesh)
+
+    def test_missing_axis_raises(self):
+        from lumen_tpu.parallel import ulysses_attention
+
+        mesh = build_mesh({"data": -1})
+        x = jnp.ones((1, 8, 8, 4))
+        with pytest.raises(ValueError, match="axis"):
+            ulysses_attention(x, x, x, mesh)
