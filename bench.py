@@ -54,12 +54,17 @@ VITB32_FLOPS_PER_IMG = 8.7e9  # ~2 * 87M vision params * 50 tokens
 def _apply_platform_env() -> None:
     """Honor JAX_PLATFORMS even though the axon sitecustomize overrides it
     with ``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter
-    start (config beats env, so the env var alone is a no-op)."""
+    start (config beats env, so the env var alone is a no-op). Also enable
+    the persistent compile cache so repeat bench runs (and the CPU
+    fallbacks re-running a phase) skip recompilation."""
     env = os.environ.get("JAX_PLATFORMS")
     if env and env != "axon":
         import jax
 
         jax.config.update("jax_platforms", env)
+    from lumen_tpu.runtime import enable_persistent_cache
+
+    enable_persistent_cache()
 
 
 def phase_clip(batch: int = 256, iters: int = 30) -> dict:

@@ -4,6 +4,7 @@ The execution layer that replaces the reference's ONNX-Runtime/libtorch
 backends (`SURVEY.md` §2 "native compute" note).
 """
 
+from .compile_cache import enable_persistent_cache
 from .batcher import MicroBatcher, bucket_for, default_buckets
 from .mesh import (
     DATA_AXIS,
@@ -28,6 +29,7 @@ from .weights import (
 )
 
 __all__ = [
+    "enable_persistent_cache",
     "MicroBatcher",
     "bucket_for",
     "default_buckets",

@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache.
+
+The reference pays "model load time" once per process (onnxruntime session
+build, ``onnxrt_backend.py:228``); our equivalent startup cost is XLA
+compilation — tens of seconds per shape bucket on TPU, worse through a
+remote-compile tunnel. JAX can persist compiled executables to disk keyed
+by (HLO, backend, flags); enabling it turns every warm restart, bench
+subprocess, and supervised-server respawn into a cache hit instead of a
+recompile.
+
+Opt-out via ``LUMEN_COMPILE_CACHE=0``; cache location override via
+``LUMEN_COMPILE_CACHE_DIR`` (default ``~/.cache/lumen_tpu/xla``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "lumen_tpu", "xla"
+)
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Point JAX's compilation cache at a persistent directory.
+
+    Idempotent; safe to call before or after backend init (the cache is
+    consulted per compile). Returns the cache dir, or None when disabled.
+    """
+    if os.environ.get("LUMEN_COMPILE_CACHE") == "0":
+        return None
+    import jax
+
+    cache_dir = path or os.environ.get("LUMEN_COMPILE_CACHE_DIR") or _DEFAULT_DIR
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # JAX's own gating (min compile time 1s by default) keeps ms-scale
+        # programs out of the cache; every real model bucket qualifies.
+    except Exception as e:  # noqa: BLE001 - cache is an optimization, never fatal
+        logger.warning("persistent compile cache unavailable: %s", e)
+        return None
+    logger.info("persistent XLA compile cache at %s", cache_dir)
+    return cache_dir

@@ -94,6 +94,9 @@ def serve(
     skip_download: bool = False,
     metrics_port: int | None = None,
 ) -> ServerHandle:
+    from ..runtime import enable_persistent_cache
+
+    enable_persistent_cache()  # warm restarts hit compiled buckets on disk
     if not skip_download:
         ensure_models(config)
     services = build_services(config)

@@ -63,8 +63,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from lumen_tpu.core.config import load_config
     from lumen_tpu.pipeline import PhotoIngestPipeline
+    from lumen_tpu.runtime import enable_persistent_cache
     from lumen_tpu.runtime.mesh import build_mesh
     from lumen_tpu.serving.server import build_services
+
+    enable_persistent_cache()  # repeat ingest runs skip bucket recompiles
 
     config = load_config(args.config)
     services = build_services(config)

@@ -1,5 +1,6 @@
 """Runtime core tests: mesh resolution, batcher semantics, weight loading."""
 
+import os
 import threading
 import time
 
@@ -260,3 +261,43 @@ class TestMeshBatching:
         out = wrapped(np.zeros((8, 4), np.float32), 8)
         assert seen["spec"][0] == "data"
         assert out.shape == (8, 4)
+
+
+class TestCompileCache:
+    def test_enable_points_jax_at_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from lumen_tpu.runtime import enable_persistent_cache
+
+        monkeypatch.delenv("LUMEN_COMPILE_CACHE", raising=False)
+        monkeypatch.delenv("LUMEN_COMPILE_CACHE_DIR", raising=False)
+        target = str(tmp_path / "xla")
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            got = enable_persistent_cache(target)
+            assert got == target
+            assert os.path.isdir(target)
+            assert jax.config.jax_compilation_cache_dir == target
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_env_opt_out(self, tmp_path, monkeypatch):
+        from lumen_tpu.runtime import enable_persistent_cache
+
+        monkeypatch.setenv("LUMEN_COMPILE_CACHE", "0")
+        assert enable_persistent_cache(str(tmp_path / "x")) is None
+        assert not os.path.exists(str(tmp_path / "x"))
+
+    def test_env_dir_override(self, tmp_path, monkeypatch):
+        import jax
+
+        from lumen_tpu.runtime import enable_persistent_cache
+
+        monkeypatch.delenv("LUMEN_COMPILE_CACHE", raising=False)
+        target = str(tmp_path / "envdir")
+        prev = jax.config.jax_compilation_cache_dir
+        monkeypatch.setenv("LUMEN_COMPILE_CACHE_DIR", target)
+        try:
+            assert enable_persistent_cache() == target
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
