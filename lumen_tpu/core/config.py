@@ -165,6 +165,11 @@ class BackendSettings(BaseModel):
     batch_buckets: list[int] | None = None
     # Compile every batch bucket at startup instead of on first request.
     warmup: bool = False
+    # VLM decode scheduling: "coalesce" groups same-shape concurrent
+    # requests into one fused-loop program (lowest dispatch overhead);
+    # "continuous" runs a slot pool that admits arrivals mid-decode
+    # (no queueing behind long generations). Other services ignore this.
+    scheduler: Literal["coalesce", "continuous"] = "coalesce"
 
 
 class ServiceConfig(BaseModel):

@@ -1,0 +1,263 @@
+"""Continuous batching scheduler for VLM generation.
+
+The coalescing batcher (``manager._GenBatcher``) groups only requests that
+arrive within one small latency window AND share a prompt bucket; once a
+fused generation program launches, everything behind it queues until the
+longest row finishes. This scheduler removes that cliff:
+
+- a fixed pool of ``slots`` decode rows advances together in ``block``-step
+  compiled programs (``Generator._step_block_impl``);
+- new requests prefill at batch 1 and are ADMITTED into free slots between
+  blocks — they start decoding immediately next block, regardless of what
+  the other slots are doing or which prompt bucket they used;
+- rows retire on EOS / per-request cap without stopping the others.
+
+This is the slot half of TPU continuous batching (the "ragged batch" of
+paged attention with contiguous per-slot KV regions instead of pages).
+Trade-off vs the fused ``lax.while_loop`` path: one host dispatch per
+``block`` tokens instead of one per generation — pick ``block`` to
+amortize dispatch overhead, and prefer the coalescing batcher when traffic
+arrives in same-shaped bursts.
+
+The reference serves one request at a time per process
+(``packages/lumen-vlm/src/lumen_vlm/backends/onnxrt_backend.py:298-356``);
+neither strategy has an upstream equivalent.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from .manager import _PendingGen
+
+logger = logging.getLogger(__name__)
+
+_STREAM_END = object()
+
+
+@dataclass
+class _Request(_PendingGen):
+    """One continuous-batching request: the batcher's fields plus a
+    per-request rng, an optional stream queue, and a cancel flag (set when
+    a stream consumer goes away so the slot stops decoding)."""
+
+    rng: object = None
+    future: Future = field(default_factory=Future)
+    stream_q: "queue_mod.SimpleQueue | None" = None
+    cancelled: bool = False
+
+
+@dataclass
+class _Slot:
+    request: _Request
+    tokens: list = field(default_factory=list)
+    delivered: int = 0
+
+
+class ContinuousScheduler:
+    """Slot-pool decode loop on a dedicated thread.
+
+    ``submit`` returns a Future resolving to ``(tokens_np, n_gen, eos)`` —
+    the same contract as the coalescing batcher — and optionally streams
+    token ids into ``stream_q`` as blocks complete (``_STREAM_END``
+    sentinel on retirement, exposed via :meth:`submit_stream`).
+    """
+
+    def __init__(self, generator, params, slots: int = 8, block: int = 8):
+        self.gen = generator
+        self.params = params
+        self.n_slots = slots
+        self.block = block
+        self.pool = generator.init_pool(slots)
+        # Decode sampling draws from one scheduler-level stream (sample()
+        # takes a single key per batched step); entropy-seeded so sampled
+        # continuations differ across processes. Per-request keys seed each
+        # request's prefill sample.
+        self._rng = jax.random.PRNGKey(int.from_bytes(__import__("os").urandom(4), "big"))
+        self._slots: dict[int, _Slot] = {}  # slot idx -> live request
+        self._pending: list[_Request] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self.blocks_run = 0  # observability
+        self.admitted = 0
+        self._thread = threading.Thread(target=self._loop, name="vlm-continuous", daemon=True)
+        self._thread.start()
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, req: _Request) -> Future:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("continuous scheduler is closed")
+            self._pending.append(req)
+            self._cond.notify()
+        return req.future
+
+    def submit_stream(self, req: _Request):
+        """Submit and iterate generated token ids as they decode."""
+        req.stream_q = queue_mod.SimpleQueue()
+        self.submit(req)
+
+        def tokens():
+            try:
+                while True:
+                    item = req.stream_q.get()
+                    if item is _STREAM_END:
+                        err = req.future.exception()
+                        if err is not None:
+                            raise err
+                        return
+                    yield item
+            finally:
+                # Consumer gone (stop sequence hit, client disconnect, or
+                # normal end): tell the scheduler to free the slot instead
+                # of decoding to the cap into an unread queue.
+                req.cancelled = True
+
+        return tokens()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=10)
+        with self._cond:
+            pending, self._pending = self._pending, []
+            live, self._slots = list(self._slots.values()), {}
+        err = RuntimeError("continuous scheduler closed")
+        for req in pending + [s.request for s in live]:
+            if not req.future.done():
+                req.future.set_exception(err)
+            if req.stream_q is not None:
+                req.stream_q.put(_STREAM_END)
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _take_work(self) -> list[_Request]:
+        """Block until there is something to do; drain admissible requests."""
+        with self._cond:
+            while not self._closed and not self._pending and not self._slots:
+                self._cond.wait()
+            if self._closed:
+                return []
+            free = self.n_slots - len(self._slots)
+            take, self._pending = self._pending[:free], self._pending[free:]
+            return take
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                admit = self._take_work()
+                with self._cond:
+                    closed = self._closed
+                if closed:
+                    # close() raced us after _take_work popped these off
+                    # _pending — its sweep can no longer see them, so fail
+                    # them here instead of stranding their callers.
+                    err = RuntimeError("continuous scheduler closed")
+                    for req in admit:
+                        if not req.future.done():
+                            req.future.set_exception(err)
+                        if req.stream_q is not None:
+                            req.stream_q.put(_STREAM_END)
+                    return
+                for req in admit:
+                    try:
+                        self._admit(req)
+                    except Exception as e:  # noqa: BLE001 - fail ONE request
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                        if req.stream_q is not None:
+                            req.stream_q.put(_STREAM_END)
+                if self._slots:
+                    self._run_block()
+        except BaseException as e:  # noqa: BLE001 - never strand callers
+            logger.exception("continuous scheduler loop died")
+            with self._cond:
+                self._closed = True
+                pending, self._pending = self._pending, []
+                live, self._slots = list(self._slots.values()), {}
+            for req in pending + [s.request for s in live]:
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError(f"continuous scheduler died: {e!r}")
+                    )
+                if req.stream_q is not None:
+                    req.stream_q.put(_STREAM_END)
+
+    def _free_slot(self) -> int:
+        for i in range(self.n_slots):
+            if i not in self._slots:
+                return i
+        raise RuntimeError("no free slot (scheduler bug: admission overran pool)")
+
+    def _admit(self, req: _Request) -> None:
+        import jax.numpy as jnp
+
+        slot = self._free_slot()
+        sub = jax.random.fold_in(req.rng, 0)
+        caches1, tok0, seen1 = self.gen._prefill(
+            self.params, req.embeds, req.positions, req.length, req.prompt_ids, sub,
+            jnp.float32(req.temperature), jnp.float32(req.top_p),
+            jnp.asarray(req.do_sample), jnp.float32(req.repetition_penalty),
+        )
+        self.pool = self.gen._admit(
+            self.pool, slot, caches1, tok0, seen1, req.length,
+            req.max_new, req.temperature, req.top_p, req.do_sample,
+            req.repetition_penalty,
+        )
+        self._slots[slot] = _Slot(request=req)
+        self.admitted += 1
+
+    def _run_block(self) -> None:
+        cancelled = [
+            i for i, slot in self._slots.items() if slot.request.cancelled
+        ]
+        if cancelled:
+            import jax.numpy as jnp
+
+            idx = jnp.asarray(cancelled, jnp.int32)
+            self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
+            for i in cancelled:
+                slot = self._slots.pop(i)
+                req = slot.request
+                if not req.future.done():
+                    req.future.set_result(
+                        (np.asarray(slot.tokens, np.int64), len(slot.tokens), False)
+                    )
+            if not self._slots:
+                return
+        self.pool, self._rng, toks = self.gen._step_block(
+            self.params, self.pool, self._rng, block=self.block
+        )
+        self.blocks_run += 1
+        toks_np = np.asarray(toks)
+        n_gen = np.asarray(self.pool["n_gen"])
+        done = np.asarray(self.pool["done"])
+        eos = np.asarray(self.pool["eos"])
+        for idx in list(self._slots):
+            slot = self._slots[idx]
+            new = int(n_gen[idx]) - len(slot.tokens)
+            if new > 0:
+                slot.tokens.extend(int(t) for t in toks_np[idx, :new])
+                if slot.request.stream_q is not None:
+                    for t in slot.tokens[slot.delivered :]:
+                        slot.request.stream_q.put(t)
+                    slot.delivered = len(slot.tokens)
+            if done[idx]:
+                with self._cond:
+                    del self._slots[idx]
+                req = slot.request
+                if not req.future.done():
+                    req.future.set_result(
+                        (np.asarray(slot.tokens, np.int64), len(slot.tokens), bool(eos[idx]))
+                    )
+                if req.stream_q is not None:
+                    req.stream_q.put(_STREAM_END)

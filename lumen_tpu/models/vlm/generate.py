@@ -60,6 +60,13 @@ class Generator:
         self._generate = jax.jit(self._generate_impl)
         self._prefill = jax.jit(self._prefill_impl)
         self._step = jax.jit(self._step_impl)
+        # The continuous pool (slots x max_seq KV) is the dominant buffer;
+        # donating it lets XLA update in place instead of holding two
+        # copies across every admit/block dispatch.
+        self._admit = jax.jit(self._admit_impl, donate_argnames=("pool",))
+        self._step_block = jax.jit(
+            self._step_block_impl, static_argnames=("block",), donate_argnames=("pool",)
+        )
 
     # -- shared pieces ------------------------------------------------------
 
@@ -247,6 +254,102 @@ class Generator:
             rng, logits[:, 0], seen, temperature, top_p, do_sample, repetition_penalty
         ).astype(jnp.int32)
         return caches, nxt, seen
+
+    # -- continuous-batching pool programs ----------------------------------
+    #
+    # A fixed pool of B decode slots advances together in k-step blocks;
+    # requests are admitted into free slots between blocks (prefill at
+    # batch 1) and retired on EOS/cap without stopping the others. This is
+    # the slot half of TPU continuous batching (paged attention minus the
+    # paging — the per-slot KV region is contiguous): arrivals no longer
+    # wait for the longest running generation to finish.
+
+    def init_pool(self, slots: int) -> dict:
+        """Fresh all-slots-free pool state (host-callable, device arrays)."""
+        cfg = self.cfg
+        return dict(
+            caches=init_kv_cache(cfg, slots, self.max_seq, self.cache_dtype),
+            cur_tok=jnp.zeros((slots,), jnp.int32),
+            cur_len=jnp.zeros((slots,), jnp.int32),
+            seen=jnp.zeros((slots, cfg.decoder.vocab_size), bool),
+            n_gen=jnp.zeros((slots,), jnp.int32),
+            eos=jnp.zeros((slots,), bool),
+            done=jnp.ones((slots,), bool),  # free slot == done
+            max_new=jnp.zeros((slots,), jnp.int32),
+            temperature=jnp.zeros((slots,), jnp.float32),
+            top_p=jnp.ones((slots,), jnp.float32),
+            do_sample=jnp.zeros((slots,), bool),
+            rep=jnp.ones((slots,), jnp.float32),
+        )
+
+    def _admit_impl(
+        self, pool, slot, caches1, tok0, seen1, length,
+        max_new, temperature, top_p, do_sample, rep,
+    ):
+        """Write one prefetched request (batch-1 prefill results) into slot."""
+        z = jnp.zeros((), jnp.int32)
+        s = jnp.asarray(slot, jnp.int32)
+        caches = jax.tree.map(
+            lambda p, o: jax.lax.dynamic_update_slice(p, o.astype(p.dtype), (s, z, z, z)),
+            pool["caches"],
+            caches1,
+        )
+        return dict(
+            caches=caches,
+            cur_tok=pool["cur_tok"].at[s].set(tok0[0]),
+            cur_len=pool["cur_len"].at[s].set(length[0].astype(jnp.int32)),
+            seen=jax.lax.dynamic_update_slice(pool["seen"], seen1, (s, z)),
+            n_gen=pool["n_gen"].at[s].set(0),
+            eos=pool["eos"].at[s].set(False),
+            done=pool["done"].at[s].set(max_new <= 0),
+            max_new=pool["max_new"].at[s].set(jnp.asarray(max_new, jnp.int32)),
+            temperature=pool["temperature"].at[s].set(jnp.asarray(temperature, jnp.float32)),
+            top_p=pool["top_p"].at[s].set(jnp.asarray(top_p, jnp.float32)),
+            do_sample=pool["do_sample"].at[s].set(jnp.asarray(do_sample, bool)),
+            rep=pool["rep"].at[s].set(jnp.asarray(rep, jnp.float32)),
+        )
+
+    def _step_block_impl(self, params, pool, rng, *, block: int):
+        """Advance every live slot ``block`` tokens; emission semantics are
+        identical to ``_generate_impl``'s while-loop body (per-slot budgets,
+        EOS, repetition penalty), with free/finished slots masked out."""
+        cfg = self.cfg
+        b = pool["cur_tok"].shape[0]
+
+        def body(carry, _):
+            pool, rng = carry
+            active = ~pool["done"]
+            tok = jnp.where(active, pool["cur_tok"], cfg.pad_token_id)
+            n_gen = pool["n_gen"] + active.astype(jnp.int32)
+            seen = pool["seen"].at[jnp.arange(b), pool["cur_tok"]].max(active)
+            eos = pool["eos"] | (active & (pool["cur_tok"] == cfg.eos_token_id))
+            done = pool["done"] | eos | (n_gen >= pool["max_new"])
+            tok_embed = self._embed(params, pool["cur_tok"][:, None]).astype(self.cache_dtype)
+            # Free slots hold cur_len=0 and done rows stop advancing, so the
+            # clamp only guards a full slot writing past its buffer.
+            pos = jnp.minimum(pool["cur_len"], self.max_seq - 1)
+            logits, caches = self._decode(
+                params, tok_embed, pos[:, None], pool["caches"], pos, pos + 1
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = self._sample_next(
+                sub, logits[:, 0], seen,
+                pool["temperature"], pool["top_p"], pool["do_sample"], pool["rep"],
+            ).astype(jnp.int32)
+            new_pool = dict(
+                pool,
+                caches=caches,
+                cur_tok=nxt,
+                cur_len=pool["cur_len"] + active.astype(jnp.int32),
+                seen=seen,
+                n_gen=n_gen,
+                eos=eos,
+                done=done,
+            )
+            return (new_pool, rng), tok
+
+        (pool, rng), toks = jax.lax.scan(body, (pool, rng), None, length=block)
+        return pool, rng, toks.T  # [B, block]
 
     def stream(
         self,

@@ -186,6 +186,9 @@ class VLMManager:
         warmup: bool = False,
         gen_batch_size: int = 4,
         gen_batch_latency_ms: float = 6.0,
+        scheduler: str = "coalesce",  # or "continuous"
+        gen_slots: int = 8,
+        gen_block: int = 8,
     ):
         self.model_dir = model_dir
         self.policy = get_policy(dtype)
@@ -195,6 +198,11 @@ class VLMManager:
         self.prefill_buckets = sorted(prefill_buckets)
         self.gen_batch_size = gen_batch_size
         self.gen_batch_latency_ms = gen_batch_latency_ms
+        if scheduler not in ("coalesce", "continuous"):
+            raise ValueError(f"scheduler must be 'coalesce' or 'continuous', got {scheduler!r}")
+        self.scheduler = scheduler
+        self.gen_slots = gen_slots
+        self.gen_block = gen_block
         self.info: ModelInfo = load_model_info(model_dir)
         self.cfg = self._build_config(model_dir)
         self.model = VLMModel(self.cfg)
@@ -376,11 +384,20 @@ class VLMManager:
 
         self._prepare = prepare
         self._prepare_text = prepare_text
-        self._batcher = _GenBatcher(
-            self._run_gen_batch,
-            max_batch=self.gen_batch_size,
-            max_latency_ms=self.gen_batch_latency_ms,
-        )
+        self._batcher = None
+        self._continuous = None
+        if self.scheduler == "continuous":
+            from .continuous import ContinuousScheduler
+
+            self._continuous = ContinuousScheduler(
+                self.generator, self.params, slots=self.gen_slots, block=self.gen_block
+            )
+        else:
+            self._batcher = _GenBatcher(
+                self._run_gen_batch,
+                max_batch=self.gen_batch_size,
+                max_latency_ms=self.gen_batch_latency_ms,
+            )
         self._initialized = True
         if self.warmup:
             # Compile the dominant path up front (smallest prompt bucket:
@@ -399,7 +416,10 @@ class VLMManager:
 
     def close(self) -> None:
         if self._initialized:
-            self._batcher.close()
+            if self._batcher is not None:
+                self._batcher.close()
+            if self._continuous is not None:
+                self._continuous.close()
         self._initialized = False
 
     # -- prompt prep -------------------------------------------------------
@@ -450,6 +470,29 @@ class VLMManager:
                 self.params, jnp.asarray(padded), length
             )
         return embeds, positions, lengths, jnp.asarray(padded), n
+
+    def _make_gen_request(
+        self, embeds, positions, lengths, prompt_ids,
+        max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
+    ):
+        """One construction site for both schedulers' request objects —
+        adding a generation parameter means touching exactly here."""
+        common = dict(
+            embeds=embeds,
+            positions=positions,
+            length=lengths,
+            prompt_ids=prompt_ids,
+            max_new=min(int(max_new_tokens), self.max_new_cap),
+            temperature=float(temperature),
+            top_p=float(top_p),
+            do_sample=bool(do_sample),
+            repetition_penalty=float(repetition_penalty),
+        )
+        if self._continuous is not None:
+            from .continuous import _Request
+
+            return _Request(rng=self._next_rng(), **common)
+        return _PendingGen(**common)
 
     def _next_rng(self) -> jax.Array:
         with self._seed_lock:
@@ -520,19 +563,14 @@ class VLMManager:
         embeds, positions, lengths, prompt_ids, n_input = self._prepare_inputs(
             messages, image_bytes, add_generation_prompt
         )
-        future = self._batcher.submit(
-            _PendingGen(
-                embeds=embeds,
-                positions=positions,
-                length=lengths,
-                prompt_ids=prompt_ids,
-                max_new=min(int(max_new_tokens), self.max_new_cap),
-                temperature=float(temperature),
-                top_p=float(top_p),
-                do_sample=bool(do_sample),
-                repetition_penalty=float(repetition_penalty),
-            )
+        req = self._make_gen_request(
+            embeds, positions, lengths, prompt_ids,
+            max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
         )
+        if self._continuous is not None:
+            future = self._continuous.submit(req)
+        else:
+            future = self._batcher.submit(req)
         row_tokens, n_gen, stopped_eos = future.result()
         tokens = [int(t) for t in row_tokens[:n_gen]]
         text = self.tokenizer.decode(tokens)
@@ -579,7 +617,16 @@ class VLMManager:
         # No global lock: the generator's prefill/step programs carry all
         # state explicitly (caches are per-call values), so concurrent
         # streams and batched generates interleave safely. The semaphore
-        # only bounds how many stream KV caches are live at once.
+        # only bounds how many per-stream KV caches are live at once; the
+        # continuous scheduler's memory is the fixed slot pool instead, so
+        # its streams need no such bound.
+        if self._continuous is not None:
+            yield from self._stream_locked(
+                messages, image_bytes, max_new_tokens, temperature, top_p,
+                do_sample, repetition_penalty, stop_sequences, holdback, t0,
+                add_generation_prompt,
+            )
+            return
         self._stream_slots.acquire()
         try:
             yield from self._stream_locked(
@@ -602,19 +649,28 @@ class VLMManager:
         emitted = ""
         finish = "length"
         final_text: str | None = None
-        for tok in self.generator.stream(
-            self.params,
-            embeds,
-            positions,
-            lengths,
-            prompt_ids,
-            self._next_rng(),
-            max_new_tokens=max_new_tokens,
-            temperature=temperature,
-            top_p=top_p,
-            do_sample=do_sample,
-            repetition_penalty=repetition_penalty,
-        ):
+        if self._continuous is not None:
+            token_iter = self._continuous.submit_stream(
+                self._make_gen_request(
+                    embeds, positions, lengths, prompt_ids,
+                    max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
+                )
+            )
+        else:
+            token_iter = self.generator.stream(
+                self.params,
+                embeds,
+                positions,
+                lengths,
+                prompt_ids,
+                self._next_rng(),
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                do_sample=do_sample,
+                repetition_penalty=repetition_penalty,
+            )
+        for tok in token_iter:
             tokens.append(tok)
             if tok == self.cfg.eos_token_id:
                 finish = "eos_token"

@@ -75,6 +75,8 @@ class VlmService(BaseService):
             warmup=bs.warmup,
             gen_batch_size=gen_batch,
             gen_batch_latency_ms=bs.max_batch_latency_ms,
+            scheduler=bs.scheduler,
+            gen_slots=gen_batch,  # pool width = configured decode batch
             **kw,
         )
         manager.initialize()
