@@ -170,6 +170,10 @@ class BackendSettings(BaseModel):
     # "continuous" runs a slot pool that admits arrivals mid-decode
     # (no queueing behind long generations). Other services ignore this.
     scheduler: Literal["coalesce", "continuous"] = "coalesce"
+    # Continuous scheduler only: decode steps per compiled block (one host
+    # dispatch per block; larger amortizes dispatch, smaller admits and
+    # retires rows sooner). Ignored by "coalesce".
+    decode_block: int = Field(8, ge=1)
 
 
 class ServiceConfig(BaseModel):

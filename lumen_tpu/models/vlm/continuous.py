@@ -27,6 +27,7 @@ neither strategy has an upstream equivalent.
 from __future__ import annotations
 
 import logging
+import os
 import queue as queue_mod
 import threading
 from concurrent.futures import Future
@@ -40,6 +41,25 @@ from .manager import _PendingGen
 logger = logging.getLogger(__name__)
 
 _STREAM_END = object()
+
+
+def _fail(req: "_Request", err: BaseException) -> None:
+    """Retire a request with an error: resolve its future (if a result
+    hasn't already won) and unblock any stream consumer. Every retirement
+    path MUST go through here or :func:`_retire` — a missed
+    ``_STREAM_END`` strands the consumer on ``stream_q.get()`` forever."""
+    if not req.future.done():
+        req.future.set_exception(err)
+    if req.stream_q is not None:
+        req.stream_q.put(_STREAM_END)
+
+
+def _retire(req: "_Request", tokens: list, eos: bool) -> None:
+    """Retire a request successfully with whatever tokens it produced."""
+    if not req.future.done():
+        req.future.set_result((np.asarray(tokens, np.int64), len(tokens), eos))
+    if req.stream_q is not None:
+        req.stream_q.put(_STREAM_END)
 
 
 @dataclass
@@ -80,7 +100,7 @@ class ContinuousScheduler:
         # takes a single key per batched step); entropy-seeded so sampled
         # continuations differ across processes. Per-request keys seed each
         # request's prefill sample.
-        self._rng = jax.random.PRNGKey(int.from_bytes(__import__("os").urandom(4), "big"))
+        self._rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
         self._slots: dict[int, _Slot] = {}  # slot idx -> live request
         self._pending: list[_Request] = []
         self._cond = threading.Condition()
@@ -133,10 +153,7 @@ class ContinuousScheduler:
             live, self._slots = list(self._slots.values()), {}
         err = RuntimeError("continuous scheduler closed")
         for req in pending + [s.request for s in live]:
-            if not req.future.done():
-                req.future.set_exception(err)
-            if req.stream_q is not None:
-                req.stream_q.put(_STREAM_END)
+            _fail(req, err)
 
     # -- scheduler loop ----------------------------------------------------
 
@@ -163,19 +180,27 @@ class ContinuousScheduler:
                     # them here instead of stranding their callers.
                     err = RuntimeError("continuous scheduler closed")
                     for req in admit:
-                        if not req.future.done():
-                            req.future.set_exception(err)
-                        if req.stream_q is not None:
-                            req.stream_q.put(_STREAM_END)
+                        _fail(req, err)
                     return
                 for req in admit:
+                    if req.cancelled:
+                        # Stream consumer disconnected while queued: retire
+                        # without wasting a prefill dispatch on a dead row.
+                        _retire(req, [], eos=False)
+                        continue
                     try:
                         self._admit(req)
                     except Exception as e:  # noqa: BLE001 - fail ONE request
-                        if not req.future.done():
-                            req.future.set_exception(e)
-                        if req.stream_q is not None:
-                            req.stream_q.put(_STREAM_END)
+                        _fail(req, e)
+                        if self._pool_invalid():
+                            # The failure hit the donation-based _admit call
+                            # after self.pool's buffers were consumed: the
+                            # other slots' KV state is gone, so "fail one
+                            # request" is impossible — escalate to the
+                            # fail-everything handler below.
+                            raise RuntimeError(
+                                "slot pool invalidated by failed admission"
+                            ) from e
                 if self._slots:
                     self._run_block()
         except BaseException as e:  # noqa: BLE001 - never strand callers
@@ -185,12 +210,16 @@ class ContinuousScheduler:
                 pending, self._pending = self._pending, []
                 live, self._slots = list(self._slots.values()), {}
             for req in pending + [s.request for s in live]:
-                if not req.future.done():
-                    req.future.set_exception(
-                        RuntimeError(f"continuous scheduler died: {e!r}")
-                    )
-                if req.stream_q is not None:
-                    req.stream_q.put(_STREAM_END)
+                _fail(req, RuntimeError(f"continuous scheduler died: {e!r}"))
+
+    def _pool_invalid(self) -> bool:
+        """True when the slot pool's buffers were deleted by a donation
+        whose computation then failed (see ``Generator._admit``'s
+        ``donate_argnames``)."""
+        return any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(self.pool)
+        )
 
     def _free_slot(self) -> int:
         for i in range(self.n_slots):
@@ -227,21 +256,19 @@ class ContinuousScheduler:
             self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
             for i in cancelled:
                 slot = self._slots.pop(i)
-                req = slot.request
-                if not req.future.done():
-                    req.future.set_result(
-                        (np.asarray(slot.tokens, np.int64), len(slot.tokens), False)
-                    )
+                _retire(slot.request, slot.tokens, eos=False)
             if not self._slots:
                 return
         self.pool, self._rng, toks = self.gen._step_block(
             self.params, self.pool, self._rng, block=self.block
         )
         self.blocks_run += 1
-        toks_np = np.asarray(toks)
-        n_gen = np.asarray(self.pool["n_gen"])
-        done = np.asarray(self.pool["done"])
-        eos = np.asarray(self.pool["eos"])
+        # One fused device->host transfer for everything the bookkeeping
+        # below needs (four separate np.asarray calls = four round trips
+        # on the per-block hot path).
+        toks_np, n_gen, done, eos = jax.device_get(
+            (toks, self.pool["n_gen"], self.pool["done"], self.pool["eos"])
+        )
         for idx in list(self._slots):
             slot = self._slots[idx]
             new = int(n_gen[idx]) - len(slot.tokens)
@@ -254,10 +281,4 @@ class ContinuousScheduler:
             if done[idx]:
                 with self._cond:
                     del self._slots[idx]
-                req = slot.request
-                if not req.future.done():
-                    req.future.set_result(
-                        (np.asarray(slot.tokens, np.int64), len(slot.tokens), bool(eos[idx]))
-                    )
-                if req.stream_q is not None:
-                    req.stream_q.put(_STREAM_END)
+                _retire(slot.request, slot.tokens, bool(eos[idx]))

@@ -1,6 +1,9 @@
-"""Parallelism: sharding rules, ring + all-to-all sequence parallelism, multi-host runtime."""
+"""Parallelism: sharding rules, ring + all-to-all sequence parallelism,
+pipeline + expert parallelism, multi-host runtime."""
 
 from .distributed import initialize, is_primary
+from .moe import MoEParams, init_moe_params, moe_ffn, moe_sharding
+from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .sharding import TRANSFORMER_TP_RULES, replicate, shard_params, spec_for
@@ -10,6 +13,13 @@ __all__ = [
     "is_primary",
     "ring_attention",
     "ulysses_attention",
+    "pipeline_apply",
+    "stack_stage_params",
+    "stage_sharding",
+    "MoEParams",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_sharding",
     "shard_params",
     "replicate",
     "spec_for",

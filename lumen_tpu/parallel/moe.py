@@ -1,0 +1,192 @@
+"""Expert parallelism: a top-k routed mixture-of-experts FFN with GShard
+all-to-all dispatch over an ``expert`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.8 lists EP as N/A there); built
+here because the driver contract treats EP as a first-class sharding and
+because the obvious growth path for the VLM family is an MoE decoder
+(Qwen/Mixtral-style). TPU-native shape:
+
+- tokens arrive sharded over the ``expert`` axis (the axis doubles as the
+  data axis for the MoE block — the standard TPU layout, so the dispatch
+  rides the same ICI ring in both directions);
+- routing is capacity-based: each expert processes at most ``C`` tokens
+  per shard, overflow drops (GShard semantics) — this keeps every shape
+  static for XLA, no data-dependent gather sizes;
+- dispatch/combine are einsums against a one-hot dispatch mask plus ONE
+  ``all_to_all`` each way; expert FFNs run as a batched einsum over the
+  device's local expert slice (dense, MXU-friendly).
+
+Everything is differentiable; ``jax.grad`` transposes the all-to-alls
+automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..runtime.mesh import EXPERT_AXIS
+
+
+class MoEParams(NamedTuple):
+    """Weights for a routed SwiGLU expert bank.
+
+    ``router``: [D, E] — token -> expert logits (kept fp32 for stable
+    softmax, as every production MoE does).
+    ``w_gate``/``w_up``: [E, D, F]; ``w_down``: [E, F, D].
+    """
+
+    router: jax.Array
+    w_gate: jax.Array
+    w_up: jax.Array
+    w_down: jax.Array
+
+
+def init_moe_params(
+    key: jax.Array, d_model: int, d_ff: int, n_experts: int, dtype=jnp.float32
+) -> MoEParams:
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    return MoEParams(
+        router=(jax.random.normal(kr, (d_model, n_experts)) * scale_in).astype(
+            jnp.float32
+        ),
+        w_gate=(jax.random.normal(kg, (n_experts, d_model, d_ff)) * scale_in).astype(dtype),
+        w_up=(jax.random.normal(ku, (n_experts, d_model, d_ff)) * scale_in).astype(dtype),
+        w_down=(jax.random.normal(kd, (n_experts, d_ff, d_model)) * scale_out).astype(dtype),
+    )
+
+
+def moe_sharding(mesh: Mesh, axis_name: str = EXPERT_AXIS) -> MoEParams:
+    """Shardings matching :func:`moe_ffn`: expert banks split their leading
+    (expert) dim over the axis; the router is replicated."""
+    ex = NamedSharding(mesh, P(axis_name))
+    return MoEParams(
+        router=NamedSharding(mesh, P()), w_gate=ex, w_up=ex, w_down=ex
+    )
+
+
+def _route(
+    x: jnp.ndarray, router: jnp.ndarray, n_experts: int, k: int, capacity: int
+):
+    """Top-k capacity-limited routing for ``x: [T, D]``.
+
+    Returns ``dispatch: [T, E, C]`` one-hot (token t occupies slot c of
+    expert e) and ``combine: [T, E, C]`` (same support, scaled by the
+    renormalized router probability).
+    """
+    probs = jax.nn.softmax(x.astype(jnp.float32) @ router, axis=-1)  # [T, E]
+    gate_vals, gate_idx = lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # Slot assignment: all rank-0 choices across tokens claim slots before
+    # any rank-1 choice (primary routes never lose capacity to secondaries).
+    sel = jax.nn.one_hot(gate_idx, n_experts, dtype=jnp.float32)  # [T, k, E]
+    flat = sel.transpose(1, 0, 2).reshape(k * x.shape[0], n_experts)
+    pos = jnp.cumsum(flat, axis=0) - 1.0  # slot index per (choice, expert)
+    pos = pos.reshape(k, x.shape[0], n_experts).transpose(1, 0, 2)  # [T, k, E]
+    slot = (pos * sel).sum(-1)  # [T, k] slot within the chosen expert
+    fits = (slot < capacity) & (sel.sum(-1) > 0)
+
+    slot_oh = jax.nn.one_hot(slot.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T, k, C]
+    choice = sel * fits[..., None]  # [T, k, E]
+    dispatch = jnp.einsum("tke,tkc->tec", choice, slot_oh)
+    combine = jnp.einsum("tke,tkc,tk->tec", choice, slot_oh, gate_vals)
+    return dispatch, combine
+
+
+def _expert_ffn(params: MoEParams, xs: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU over a local expert bank: ``xs: [E_local, N, D]``."""
+    gate = jnp.einsum("end,edf->enf", xs, params.w_gate)
+    up = jnp.einsum("end,edf->enf", xs, params.w_up)
+    act = jax.nn.silu(gate) * up
+    return jnp.einsum("enf,efd->end", act, params.w_down)
+
+
+def _moe_local(
+    params: MoEParams,
+    x: jnp.ndarray,
+    *,
+    n_experts: int,
+    k: int,
+    capacity: int,
+    n_shards: int,
+    axis_name: str | None,
+) -> jnp.ndarray:
+    t = x.shape[0]
+    dispatch, combine = _route(x, params.router, n_experts, k, capacity)
+    buf = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)  # [E, C, D]
+    buf = buf.astype(params.w_gate.dtype)
+
+    if axis_name is not None:
+        # [E, C, D] -> every device holds its E/n local experts with the
+        # slots from ALL n shards: [E/n, n*C, D].
+        e_local = n_experts // n_shards
+        buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        buf = buf.reshape(n_shards, e_local, capacity, buf.shape[-1])
+        buf = buf.transpose(1, 0, 2, 3).reshape(e_local, n_shards * capacity, -1)
+        out = _expert_ffn(params, buf)
+        out = out.reshape(e_local, n_shards, capacity, -1).transpose(1, 0, 2, 3)
+        out = out.reshape(n_experts, capacity, -1)
+        out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    else:
+        out = _expert_ffn(params, buf)
+
+    y = jnp.einsum("ecd,tec->td", out.astype(jnp.float32), combine)
+    return y.astype(x.dtype).reshape(t, -1)
+
+
+def moe_ffn(
+    params: MoEParams,
+    x: jax.Array,
+    mesh: Mesh | None = None,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    axis_name: str = EXPERT_AXIS,
+) -> jax.Array:
+    """Apply the routed expert FFN to ``x: [T, D]`` (flatten [B, S, D]
+    upstream).
+
+    With a mesh, tokens and expert banks are sharded over ``axis_name``
+    (``T`` and ``E`` must divide by its size) and dispatch runs via
+    all-to-all; without one, the same math runs single-device (the unit
+    test oracle and the 1-chip serving path).
+    """
+    n_experts = params.w_gate.shape[0]
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        t = x.shape[0]
+        capacity = max(1, int(capacity_factor * k * t / n_experts))
+        return _moe_local(
+            params, x, n_experts=n_experts, k=k, capacity=capacity,
+            n_shards=1, axis_name=None,
+        )
+    n = mesh.shape[axis_name]
+    if x.shape[0] % n or n_experts % n:
+        raise ValueError(
+            f"tokens ({x.shape[0]}) and experts ({n_experts}) must divide by "
+            f"mesh axis {axis_name!r} size {n}"
+        )
+    t_local = x.shape[0] // n
+    capacity = max(1, int(capacity_factor * k * t_local / n_experts))
+    inner = functools.partial(
+        _moe_local, n_experts=n_experts, k=k, capacity=capacity,
+        n_shards=n, axis_name=axis_name,
+    )
+    param_specs = MoEParams(
+        router=P(), w_gate=P(axis_name), w_up=P(axis_name), w_down=P(axis_name)
+    )
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(param_specs, P(axis_name)),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )(params, x)

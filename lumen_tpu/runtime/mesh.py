@@ -3,9 +3,11 @@
 Framework-wide logical axis names (used by every sharded model and the
 batch-ingest scheduler):
 
-- ``data``  — batch/data parallelism (throughput scaling),
-- ``model`` — tensor parallelism (attention heads / MLP shards),
-- ``seq``   — sequence/context parallelism (ring attention).
+- ``data``   — batch/data parallelism (throughput scaling),
+- ``model``  — tensor parallelism (attention heads / MLP shards),
+- ``seq``    — sequence/context parallelism (ring attention / Ulysses),
+- ``stage``  — pipeline parallelism (GPipe microbatch schedule),
+- ``expert`` — expert parallelism (MoE all-to-all dispatch).
 
 The reference has no device mesh at all (its concurrency is a gRPC thread
 pool over single-model ONNX sessions, ``src/lumen/server.py:232-235``);
@@ -29,6 +31,8 @@ logger = logging.getLogger(__name__)
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
 
 
 def resolve_axes(axes: dict[str, int], n_devices: int) -> dict[str, int]:

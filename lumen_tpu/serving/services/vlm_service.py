@@ -77,6 +77,7 @@ class VlmService(BaseService):
             gen_batch_latency_ms=bs.max_batch_latency_ms,
             scheduler=bs.scheduler,
             gen_slots=gen_batch,  # pool width = configured decode batch
+            gen_block=bs.decode_block,
             **kw,
         )
         manager.initialize()
