@@ -182,7 +182,7 @@ class ContinuousScheduler:
                     for req in admit:
                         _fail(req, err)
                     return
-                for req in admit:
+                for pos, req in enumerate(admit):
                     if req.cancelled:
                         # Stream consumer disconnected while queued: retire
                         # without wasting a prefill dispatch on a dead row.
@@ -197,7 +197,12 @@ class ContinuousScheduler:
                             # after self.pool's buffers were consumed: the
                             # other slots' KV state is gone, so "fail one
                             # request" is impossible — escalate to the
-                            # fail-everything handler below.
+                            # fail-everything handler below. That handler
+                            # sweeps only _pending + _slots and this batch
+                            # is already off _pending, so fail its
+                            # unprocessed tail here first.
+                            for later in admit[pos + 1 :]:
+                                _fail(later, e)
                             raise RuntimeError(
                                 "slot pool invalidated by failed admission"
                             ) from e

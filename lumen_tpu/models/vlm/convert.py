@@ -35,6 +35,16 @@ DECODER_RULES = [
     (r"model\.layers\.(\d+)\.mlp\.gate_proj\.weight", r"decoder/layers_\1/mlp/gate_proj/kernel", linear_kernel),
     (r"model\.layers\.(\d+)\.mlp\.up_proj\.weight", r"decoder/layers_\1/mlp/up_proj/kernel", linear_kernel),
     (r"model\.layers\.(\d+)\.mlp\.down_proj\.weight", r"decoder/layers_\1/mlp/down_proj/kernel", linear_kernel),
+    # Qwen2-MoE sparse layers: router + per-expert SwiGLU (stacked into
+    # [E, ...] banks by _stack_experts below) + sigmoid-gated shared expert.
+    (r"model\.layers\.(\d+)\.mlp\.gate\.weight", r"decoder/layers_\1/mlp/router", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\.gate_proj\.weight", r"decoder/layers_\1/mlp/__expert_gate__/\2", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\.up_proj\.weight", r"decoder/layers_\1/mlp/__expert_up__/\2", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.experts\.(\d+)\.down_proj\.weight", r"decoder/layers_\1/mlp/__expert_down__/\2", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert\.gate_proj\.weight", r"decoder/layers_\1/mlp/shared/gate_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert\.up_proj\.weight", r"decoder/layers_\1/mlp/shared/up_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert\.down_proj\.weight", r"decoder/layers_\1/mlp/shared/down_proj/kernel", linear_kernel),
+    (r"model\.layers\.(\d+)\.mlp\.shared_expert_gate\.weight", r"decoder/layers_\1/mlp/shared_gate/kernel", linear_kernel),
     (r"model\.layers\.(\d+)\.input_layernorm\.weight", r"decoder/layers_\1/input_norm/scale", None),
     (r"model\.layers\.(\d+)\.post_attention_layernorm\.weight", r"decoder/layers_\1/post_attn_norm/scale", None),
     (r"model\.norm\.weight", r"decoder/final_norm/scale", None),
@@ -92,6 +102,39 @@ DROP = [
 ]
 
 
+_EXPERT_BANKS = {
+    "__expert_gate__": "w_gate",
+    "__expert_up__": "w_up",
+    "__expert_down__": "w_down",
+}
+
+
+def _stack_experts(flat: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Collapse ``.../mlp/__expert_gate__/<i>`` leaves into one stacked
+    ``.../mlp/w_gate`` bank per layer (``[E, ...]``, expert index 0..E-1
+    on the leading dim — the layout ``MoEFFN`` and the ``expert``-axis
+    sharding rules expect)."""
+    groups: dict[tuple[str, str], dict[int, np.ndarray]] = {}
+    out: dict[str, np.ndarray] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        if len(parts) >= 2 and parts[-2] in _EXPERT_BANKS:
+            prefix = "/".join(parts[:-2])
+            groups.setdefault((prefix, parts[-2]), {})[int(parts[-1])] = val
+        else:
+            out[key] = val
+    for (prefix, marker), members in groups.items():
+        n = len(members)
+        if sorted(members) != list(range(n)):
+            raise ValueError(
+                f"{prefix}/{marker}: non-contiguous expert indices {sorted(members)}"
+            )
+        out[f"{prefix}/{_EXPERT_BANKS[marker]}"] = np.stack(
+            [members[i] for i in range(n)], axis=0
+        )
+    return out
+
+
 def convert_vlm_checkpoint(
     state: dict[str, np.ndarray],
     init_params: dict | None = None,
@@ -114,7 +157,7 @@ def convert_vlm_checkpoint(
     if tie_word_embeddings:
         drop.append(r"^lm_head\.weight$")
     flat = apply_rules(normalized, DECODER_RULES + VISION_RULES, drop=drop)
-    params = unflatten(flat)
+    params = unflatten(_stack_experts(flat))
     if init_params is not None:
         assert_tree_shapes(params, init_params)
     return params

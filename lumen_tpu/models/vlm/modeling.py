@@ -46,10 +46,29 @@ class DecoderConfig:
     rms_norm_eps: float = 1e-6
     max_position_embeddings: int = 32768
     tie_word_embeddings: bool = True
+    # --- Mixture-of-experts decoder (Qwen2-MoE layout; 0 experts = dense).
+    # MoE layers replace the SwiGLU MLP with a top-k routed expert bank;
+    # layer i is sparse iff (i+1) % moe_every == 0 (HF decoder_sparse_step
+    # semantics). Routing uses exact capacity (no token drops) so outputs
+    # match dense-gather reference implementations token-for-token.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_intermediate_size: int | None = None  # None -> intermediate_size
+    moe_shared_intermediate: int = 0  # >0 adds Qwen2-MoE's shared expert
+    moe_every: int = 1
+    moe_norm_topk: bool = True
+    moe_dense_layers: tuple[int, ...] = ()  # HF mlp_only_layers: force-dense
 
     @property
     def dim_per_head(self) -> int:
         return self.head_dim or self.hidden_size // self.heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return (
+            self.moe_experts > 0
+            and i not in self.moe_dense_layers
+            and (i + 1) % self.moe_every == 0
+        )
 
 
 @dataclass(frozen=True)
@@ -117,6 +136,15 @@ class VLMConfig:
             rms_norm_eps=text.get("rms_norm_eps", 1e-6),
             max_position_embeddings=text.get("max_position_embeddings", 32768),
             tie_word_embeddings=text.get("tie_word_embeddings", cfg.get("tie_word_embeddings", True)),
+            # Qwen2-MoE config keys (absent on dense checkpoints).
+            moe_experts=text.get("num_experts", 0),
+            moe_top_k=text.get("num_experts_per_tok", 2),
+            moe_intermediate_size=text.get("moe_intermediate_size"),
+            moe_shared_intermediate=text.get("shared_expert_intermediate_size", 0),
+            moe_every=text.get("decoder_sparse_step", 1),
+            # HF Qwen2MoeConfig defaults norm_topk_prob to False.
+            moe_norm_topk=text.get("norm_topk_prob", not text.get("num_experts", 0)),
+            moe_dense_layers=tuple(text.get("mlp_only_layers", ())),
         )
         vision = VisionTowerConfig(
             image_size=vis.get("image_size", 1024),
@@ -255,19 +283,68 @@ class DecoderAttention(nn.Module):
 
 class SwiGLU(nn.Module):
     cfg: DecoderConfig
+    intermediate: int | None = None  # override cfg.intermediate_size
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         c = self.cfg
-        gate = nn.Dense(c.intermediate_size, use_bias=False, name="gate_proj", dtype=x.dtype)(x)
-        up = nn.Dense(c.intermediate_size, use_bias=False, name="up_proj", dtype=x.dtype)(x)
+        inter = self.intermediate or c.intermediate_size
+        gate = nn.Dense(inter, use_bias=False, name="gate_proj", dtype=x.dtype)(x)
+        up = nn.Dense(inter, use_bias=False, name="up_proj", dtype=x.dtype)(x)
         return nn.Dense(c.hidden_size, use_bias=False, name="down_proj", dtype=x.dtype)(
             nn.silu(gate) * up
         )
 
 
+class MoEFFN(nn.Module):
+    """Qwen2-MoE sparse MLP: softmax router -> top-k routed SwiGLU expert
+    bank (+ optional sigmoid-gated shared expert). The routed compute is
+    :func:`lumen_tpu.parallel.moe.moe_ffn` with EXACT capacity, so outputs
+    match HF's dense-gather reference (``Qwen2MoeSparseMoeBlock``)
+    token-for-token; at pod scale the stacked ``w_*`` banks shard their
+    leading dim over the ``expert`` mesh axis (``parallel.sharding``
+    MOE_EP_RULES) or run through ``moe_ffn(mesh=...)`` explicitly."""
+
+    cfg: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from ...parallel.moe import MoEParams, moe_ffn
+
+        c = self.cfg
+        e = c.moe_experts
+        d = c.hidden_size
+        f = c.moe_intermediate_size or c.intermediate_size
+        init = nn.initializers.normal(0.02)
+        router = self.param("router", init, (d, e), jnp.float32)
+        w_gate = self.param("w_gate", init, (e, d, f), jnp.float32)
+        w_up = self.param("w_up", init, (e, d, f), jnp.float32)
+        w_down = self.param("w_down", init, (e, f, d), jnp.float32)
+        b, s, _ = x.shape
+        tokens = x.reshape(b * s, d)
+        y = moe_ffn(
+            MoEParams(
+                router=router,
+                w_gate=w_gate.astype(x.dtype),
+                w_up=w_up.astype(x.dtype),
+                w_down=w_down.astype(x.dtype),
+            ),
+            tokens,
+            mesh=None,
+            k=c.moe_top_k,
+            capacity_factor=None,  # exact: no token drops at inference
+            norm_topk=c.moe_norm_topk,
+        ).reshape(b, s, d)
+        if c.moe_shared_intermediate:
+            shared = SwiGLU(c, intermediate=c.moe_shared_intermediate, name="shared")(x)
+            gate = nn.Dense(1, use_bias=False, name="shared_gate", dtype=x.dtype)(x)
+            y = y + jax.nn.sigmoid(gate) * shared
+        return y
+
+
 class DecoderLayer(nn.Module):
     cfg: DecoderConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, positions, cache, cache_offset, kv_valid_len):
@@ -279,7 +356,8 @@ class DecoderLayer(nn.Module):
             kv_valid_len,
         )
         x = x + h
-        x = x + SwiGLU(self.cfg, name="mlp")(
+        mlp_cls = MoEFFN if self.cfg.is_moe_layer(self.layer_idx) else SwiGLU
+        x = x + mlp_cls(self.cfg, name="mlp")(
             RMSNorm(self.cfg.rms_norm_eps, name="post_attn_norm")(x)
         )
         return x, cache
@@ -295,7 +373,9 @@ class Decoder(nn.Module):
     def setup(self):
         c = self.cfg
         self.embed_tokens = nn.Embed(c.vocab_size, c.hidden_size, name="embed_tokens")
-        self.blocks = [DecoderLayer(c, name=f"layers_{i}") for i in range(c.layers)]
+        self.blocks = [
+            DecoderLayer(c, layer_idx=i, name=f"layers_{i}") for i in range(c.layers)
+        ]
         self.final_norm = RMSNorm(c.rms_norm_eps, name="final_norm")
         if not c.tie_word_embeddings:
             self.lm_head = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")

@@ -6,7 +6,13 @@ from .moe import MoEParams, init_moe_params, moe_ffn, moe_sharding
 from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
-from .sharding import TRANSFORMER_TP_RULES, replicate, shard_params, spec_for
+from .sharding import (
+    MOE_EP_RULES,
+    TRANSFORMER_TP_RULES,
+    replicate,
+    shard_params,
+    spec_for,
+)
 
 __all__ = [
     "initialize",
@@ -24,4 +30,5 @@ __all__ = [
     "replicate",
     "spec_for",
     "TRANSFORMER_TP_RULES",
+    "MOE_EP_RULES",
 ]

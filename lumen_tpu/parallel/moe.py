@@ -73,18 +73,57 @@ def moe_sharding(mesh: Mesh, axis_name: str = EXPERT_AXIS) -> MoEParams:
     )
 
 
+def _topk_gates(x: jnp.ndarray, router: jnp.ndarray, k: int, norm_topk: bool):
+    """Softmax-then-top-k routing: ``[T, k]`` gate values + expert ids."""
+    probs = jax.nn.softmax(x.astype(jnp.float32) @ router, axis=-1)  # [T, E]
+    gate_vals, gate_idx = lax.top_k(probs, k)  # [T, k]
+    if norm_topk:
+        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    return gate_vals, gate_idx
+
+
+def _moe_exact_local(
+    params: MoEParams, x: jnp.ndarray, *, n_experts: int, k: int, norm_topk: bool
+) -> jnp.ndarray:
+    """Exact (zero-drop) single-device MoE via grouped GEMM.
+
+    Sorts the ``T*k`` (token, choice) assignments by expert and runs the
+    expert bank as three ``lax.ragged_dot`` calls — O(T*k) dispatch work
+    and O(T*k*D*F) FLOPs, vs the capacity formulation whose exact variant
+    needs an ``[E, T, D]`` buffer and O(T^2*E*D) one-hot einsums. This is
+    the inference path that reproduces dense-gather references (HF MoE)
+    token-for-token.
+    """
+    t, d = x.shape
+    gate_vals, gate_idx = _topk_gates(x, params.router, k, norm_topk)
+    e_flat = gate_idx.reshape(-1)  # [N], N = T*k; index t*k+j = (token t, choice j)
+    order = jnp.argsort(e_flat, stable=True)
+    inv = jnp.argsort(order)
+    xs = jnp.repeat(x, k, axis=0)[order].astype(params.w_gate.dtype)  # [N, D]
+    group_sizes = jnp.bincount(e_flat, length=n_experts).astype(jnp.int32)
+    hg = lax.ragged_dot(xs, params.w_gate, group_sizes)
+    hu = lax.ragged_dot(xs, params.w_up, group_sizes)
+    ys = lax.ragged_dot(jax.nn.silu(hg) * hu, params.w_down, group_sizes)  # [N, D]
+    ys = ys[inv].reshape(t, k, d).astype(jnp.float32)
+    return (ys * gate_vals[..., None]).sum(axis=1).astype(x.dtype)
+
+
 def _route(
-    x: jnp.ndarray, router: jnp.ndarray, n_experts: int, k: int, capacity: int
+    x: jnp.ndarray,
+    router: jnp.ndarray,
+    n_experts: int,
+    k: int,
+    capacity: int,
+    norm_topk: bool = True,
 ):
     """Top-k capacity-limited routing for ``x: [T, D]``.
 
     Returns ``dispatch: [T, E, C]`` one-hot (token t occupies slot c of
     expert e) and ``combine: [T, E, C]`` (same support, scaled by the
-    renormalized router probability).
+    router probability — renormalized over the top-k iff ``norm_topk``,
+    matching HF's ``norm_topk_prob``).
     """
-    probs = jax.nn.softmax(x.astype(jnp.float32) @ router, axis=-1)  # [T, E]
-    gate_vals, gate_idx = lax.top_k(probs, k)  # [T, k]
-    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    gate_vals, gate_idx = _topk_gates(x, router, k, norm_topk)
 
     # Slot assignment: all rank-0 choices across tokens claim slots before
     # any rank-1 choice (primary routes never lose capacity to secondaries).
@@ -119,9 +158,10 @@ def _moe_local(
     capacity: int,
     n_shards: int,
     axis_name: str | None,
+    norm_topk: bool = True,
 ) -> jnp.ndarray:
     t = x.shape[0]
-    dispatch, combine = _route(x, params.router, n_experts, k, capacity)
+    dispatch, combine = _route(x, params.router, n_experts, k, capacity, norm_topk)
     buf = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)  # [E, C, D]
     buf = buf.astype(params.w_gate.dtype)
 
@@ -149,8 +189,9 @@ def moe_ffn(
     mesh: Mesh | None = None,
     *,
     k: int = 2,
-    capacity_factor: float = 1.25,
+    capacity_factor: float | None = 1.25,
     axis_name: str = EXPERT_AXIS,
+    norm_topk: bool = True,
 ) -> jax.Array:
     """Apply the routed expert FFN to ``x: [T, D]`` (flatten [B, S, D]
     upstream).
@@ -159,14 +200,26 @@ def moe_ffn(
     (``T`` and ``E`` must divide by its size) and dispatch runs via
     all-to-all; without one, the same math runs single-device (the unit
     test oracle and the 1-chip serving path).
+
+    ``capacity_factor=None`` means EXACT routing (nothing drops) for
+    parity with dense-gather implementations (HF). Single-device this
+    runs the grouped-GEMM path (``lax.ragged_dot`` over expert-sorted
+    assignments, O(T*k) dispatch); sharded it sets per-shard capacity to
+    the local token count — the worst per-expert load, since a token's
+    top-k choices are distinct experts — at an ``[E, T_local, D]`` buffer
+    memory cost, so prefer a finite factor at scale.
     """
     n_experts = params.w_gate.shape[0]
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        if capacity_factor is None:
+            return _moe_exact_local(
+                params, x, n_experts=n_experts, k=k, norm_topk=norm_topk
+            )
         t = x.shape[0]
         capacity = max(1, int(capacity_factor * k * t / n_experts))
         return _moe_local(
             params, x, n_experts=n_experts, k=k, capacity=capacity,
-            n_shards=1, axis_name=None,
+            n_shards=1, axis_name=None, norm_topk=norm_topk,
         )
     n = mesh.shape[axis_name]
     if x.shape[0] % n or n_experts % n:
@@ -175,10 +228,12 @@ def moe_ffn(
             f"mesh axis {axis_name!r} size {n}"
         )
     t_local = x.shape[0] // n
-    capacity = max(1, int(capacity_factor * k * t_local / n_experts))
+    capacity = t_local if capacity_factor is None else max(
+        1, int(capacity_factor * k * t_local / n_experts)
+    )
     inner = functools.partial(
         _moe_local, n_experts=n_experts, k=k, capacity=capacity,
-        n_shards=n, axis_name=axis_name,
+        n_shards=n, axis_name=axis_name, norm_topk=norm_topk,
     )
     param_specs = MoEParams(
         router=P(), w_gate=P(axis_name), w_up=P(axis_name), w_down=P(axis_name)

@@ -32,6 +32,15 @@ TRANSFORMER_TP_RULES: list[ShardingRule] = [
     (r".*embedding$", P(None, "model")),
 ]
 
+# Expert parallelism for MoE decoder layers (``models/vlm/modeling.MoEFFN``):
+# stacked expert banks [E, ...] split their leading dim over ``expert``; the
+# router stays replicated (it's tiny and every token needs it). Prepend to
+# TP rules when the mesh carries both axes.
+MOE_EP_RULES: list[ShardingRule] = [
+    (r".*mlp/(w_gate|w_up|w_down)$", P("expert")),
+    (r".*mlp/router$", P()),
+]
+
 
 def spec_for(path: str, rules: Iterable[ShardingRule]) -> P:
     for pattern, spec in rules:
