@@ -12,6 +12,7 @@ Routes (same surface as the reference, ``main.py:64-68``):
 - ``GET  /api/v1/config/presets``
 - ``GET  /api/v1/hardware/info``
 - ``GET  /api/v1/hardware/detect``
+- ``GET  /api/v1/hardware/check``      ?cache_dir=...
 - ``POST /api/v1/install/setup``        {venv_path?, packages?, config_path?, download?}
 - ``GET  /api/v1/install/tasks``
 - ``GET  /api/v1/install/status/{task_id}``
@@ -179,6 +180,16 @@ def build_app(state: AppState | None = None) -> web.Application:
         report = await asyncio.to_thread(hardware_report)
         return web.json_response(report)
 
+    async def hardware_check(request: web.Request) -> web.Response:
+        """Environment readiness (reference ``/api/v1/hardware/check``:
+        driver/env probes, ``api/hardware.py:115-196``) — TPU-flavored:
+        jax stack versions, libtpu/PJRT, device nodes, cache-dir disk."""
+        from lumen_tpu.app.env_check import environment_report
+
+        cache_dir = request.query.get("cache_dir", "~/.lumen-tpu")
+        report = await asyncio.to_thread(environment_report, cache_dir)
+        return web.json_response(report)
+
     # -- install ----------------------------------------------------------
 
     async def install_setup(request: web.Request) -> web.Response:
@@ -188,6 +199,7 @@ def build_app(state: AppState | None = None) -> web.Application:
             packages=list(body.get("packages", [])),
             config_path=body.get("config_path") if body.get("download") else None,
             cache_dir=body.get("cache_dir"),
+            region=body.get("region", "other"),
         )
         try:
             task = orchestrator.create_task(options)
@@ -302,6 +314,7 @@ def build_app(state: AppState | None = None) -> web.Application:
     app.router.add_get(f"{v1}/config/presets", config_presets)
     app.router.add_get(f"{v1}/hardware/info", hardware_info)
     app.router.add_get(f"{v1}/hardware/detect", hardware_detect)
+    app.router.add_get(f"{v1}/hardware/check", hardware_check)
     app.router.add_post(f"{v1}/install/setup", install_setup)
     app.router.add_get(f"{v1}/install/tasks", install_tasks)
     app.router.add_get(f"{v1}/install/status/{{task_id}}", install_status)

@@ -1,0 +1,149 @@
+"""TPU environment checker + pip mirror selection for the control plane.
+
+Reference equivalents: the per-accelerator driver/environment probes
+(``lumen-app/src/lumen_app/utils/env_checker.py:27-826`` — nvidia-smi, NPU,
+OpenVINO, CoreML checks) and the CN-aware ``MirrorSelector``
+(``lumen-app/src/lumen_app/utils/package_resolver.py:19-321``). On a TPU VM
+the questions change: is the jax/libtpu stack importable and
+version-coherent, are the TPU device nodes present, is there disk for the
+model cache — answered from metadata and the filesystem WITHOUT
+initializing a JAX backend (that would claim the chip away from the server
+this control plane exists to spawn; see ``app/hardware.py`` for the
+subprocess device probe).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import sys
+from dataclasses import dataclass
+from importlib import metadata
+
+#: TPU device nodes by driver flavor: older gen expose /dev/accel*, newer
+#: VMs attach chips through VFIO.
+_DEVICE_GLOBS = ("/dev/accel*", "/dev/vfio/*")
+
+#: PyPI indexes by region (reference MirrorSelector picks CN mirrors for
+#: wheel installs when the deployment region is cn).
+PIP_INDEXES = {
+    "cn": "https://pypi.tuna.tsinghua.edu.cn/simple",
+    "other": None,  # default index
+}
+
+
+@dataclass
+class Check:
+    name: str
+    ok: bool
+    detail: str
+    required: bool = True
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "detail": self.detail,
+            "required": self.required,
+        }
+
+
+def _version_of(*dists: str) -> str | None:
+    for dist in dists:
+        try:
+            return f"{dist} {metadata.version(dist)}"
+        except metadata.PackageNotFoundError:
+            continue
+    return None
+
+
+def check_python() -> Check:
+    v = sys.version_info
+    detail = f"python {v.major}.{v.minor}.{v.micro}"
+    return Check("python", (v.major, v.minor) >= (3, 11), detail)
+
+
+def check_jax_stack() -> list[Check]:
+    """Importability/version of the compute stack, from dist metadata (no
+    imports: importing jax in the control plane is harmless, but keeping
+    this metadata-only makes it safe to call from ANY process)."""
+    out = []
+    for name, dists, required in (
+        ("jax", ("jax",), True),
+        ("jaxlib", ("jaxlib",), True),
+        ("flax", ("flax",), True),
+        ("optax", ("optax",), True),
+        ("orbax-checkpoint", ("orbax-checkpoint", "orbax"), False),
+        ("grpcio", ("grpcio",), True),
+        ("safetensors", ("safetensors",), True),
+    ):
+        ver = _version_of(*dists)
+        out.append(Check(name, ver is not None, ver or "not installed", required))
+    return out
+
+
+def check_libtpu() -> Check:
+    """TPU runtime library: a libtpu dist, an explicit TPU_LIBRARY_PATH, or
+    a tunneled/virtual platform (PJRT plugin) all count."""
+    ver = _version_of("libtpu", "libtpu-nightly")
+    if ver:
+        return Check("libtpu", True, ver, required=False)
+    path = os.environ.get("TPU_LIBRARY_PATH")
+    if path and os.path.exists(path):
+        return Check("libtpu", True, f"TPU_LIBRARY_PATH={path}", required=False)
+    plugins = [ep.name for ep in metadata.entry_points(group="jax_plugins")]
+    if plugins:
+        return Check("libtpu", True, f"PJRT plugin(s): {', '.join(plugins)}", required=False)
+    return Check("libtpu", False, "no libtpu dist / TPU_LIBRARY_PATH / PJRT plugin", required=False)
+
+
+def check_tpu_devices() -> Check:
+    nodes = [n for pat in _DEVICE_GLOBS for n in sorted(glob.glob(pat))]
+    if nodes:
+        return Check("tpu_devices", True, ", ".join(nodes[:8]), required=False)
+    return Check(
+        "tpu_devices",
+        False,
+        "no /dev/accel* or /dev/vfio nodes (ok for remote/tunneled TPU or CPU dev)",
+        required=False,
+    )
+
+
+def check_disk(cache_dir: str, need_gb: float = 10.0) -> Check:
+    """Model cache needs room: the reference's full tier pulls several GB
+    of weights (``lumen_resources/downloader.py``)."""
+    path = os.path.expanduser(cache_dir)
+    probe = path
+    while probe and not os.path.exists(probe):
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    try:
+        free_gb = shutil.disk_usage(probe or "/").free / 1e9
+    except OSError as e:
+        return Check("disk_space", False, f"cannot stat {probe!r}: {e}")
+    return Check(
+        "disk_space",
+        free_gb >= need_gb,
+        f"{free_gb:.1f} GB free at {probe} (need ~{need_gb:.0f} GB)",
+    )
+
+
+def environment_report(cache_dir: str = "~/.lumen-tpu", need_gb: float = 10.0) -> dict:
+    """Aggregate check report for ``GET /api/v1/hardware/check``. ``ok``
+    requires every *required* check; optional ones (device nodes, libtpu)
+    inform the wizard without blocking a CPU/remote-TPU setup."""
+    checks: list[Check] = [check_python(), *check_jax_stack(), check_libtpu(),
+                           check_tpu_devices(), check_disk(cache_dir, need_gb)]
+    return {
+        "ok": all(c.ok for c in checks if c.required),
+        "checks": [c.as_dict() for c in checks],
+    }
+
+
+def pip_index_url(region: str) -> str | None:
+    """Region -> PyPI index (None = default). Unknown regions use the
+    default rather than failing: mirror choice is an optimization."""
+    return PIP_INDEXES.get(region)

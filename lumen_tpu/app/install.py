@@ -56,6 +56,9 @@ class InstallOptions:
     config_path: str | None = None  # when set, download models for it
     cache_dir: str | None = None  # wiped on cancellation (reference parity)
     verify_imports: list[str] = field(default_factory=lambda: list(VERIFY_IMPORTS))
+    #: deployment region; cn selects a PyPI mirror for the pip step
+    #: (reference MirrorSelector, ``utils/package_resolver.py:19-321``)
+    region: str = "other"
 
 
 @dataclass
@@ -175,8 +178,14 @@ class InstallOrchestrator:
         step.detail = path
 
     async def _step_install_packages(self, task: InstallTask, step: InstallStep) -> None:
+        from lumen_tpu.app.env_check import pip_index_url
+
         python = self._env_python(task)
-        rc, out = await self._exec(task, python, "-m", "pip", "install", *task.options.packages)
+        mirror = pip_index_url(task.options.region)
+        extra = ("--index-url", mirror) if mirror else ()
+        rc, out = await self._exec(
+            task, python, "-m", "pip", "install", *extra, *task.options.packages
+        )
         if rc != 0:
             raise RuntimeError(f"pip install failed: {out[-500:]}")
         step.detail = ", ".join(task.options.packages)

@@ -37,6 +37,9 @@ export const api = {
   // hardware
   hardwareInfo: () => request("GET", `${V1}/hardware/info`),
   hardwareDetect: () => request("GET", `${V1}/hardware/detect`),
+  hardwareCheck: (cacheDir) =>
+    // no client-side default: an absent param uses the server's default
+    request("GET", `${V1}/hardware/check` + (cacheDir ? `?cache_dir=${encodeURIComponent(cacheDir)}` : "")),
 
   // config
   presets: () => request("GET", `${V1}/config/presets`),

@@ -16,6 +16,10 @@ export function renderHardware(root) {
       ]),
     ]),
     el("div", { class: "card" }, [
+      el("h3", {}, "Environment"),
+      el("div", { class: "muted", id: "env-card" }, "checking runtime stack…"),
+    ]),
+    el("div", { class: "card" }, [
       el("h3", {}, "Topology preset"),
       el("div", { class: "muted", id: "preset-hint" }, "Presets load after detection."),
       el("div", { class: "preset-grid", id: "preset-grid" }),
@@ -23,6 +27,36 @@ export function renderHardware(root) {
   );
 
   detect(root);
+  envCheck(root);
+}
+
+async function envCheck(root) {
+  const card = root.querySelector("#env-card");
+  try {
+    const report = await api.hardwareCheck(wizard.state.cacheDir);
+    card.classList.remove("muted");
+    card.replaceChildren(
+      el("div", {}, [
+        report.ok
+          ? el("span", { class: "badge ok" }, "environment ready")
+          : el("span", { class: "badge err" }, "missing requirements"),
+      ]),
+      el(
+        "dl",
+        { class: "kv" },
+        report.checks
+          .map((c) =>
+            kv(
+              (c.ok ? "✓ " : c.required ? "✗ " : "– ") + c.name,
+              c.detail
+            )
+          )
+          .flat()
+      )
+    );
+  } catch (e) {
+    card.textContent = `environment check failed: ${e.message}`;
+  }
 }
 
 async function detect(root) {

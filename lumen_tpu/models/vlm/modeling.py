@@ -138,7 +138,8 @@ class VLMConfig:
             tie_word_embeddings=text.get("tie_word_embeddings", cfg.get("tie_word_embeddings", True)),
             # Qwen2-MoE config keys (absent on dense checkpoints).
             moe_experts=text.get("num_experts", 0),
-            moe_top_k=text.get("num_experts_per_tok", 2),
+            # HF Qwen2MoeConfig defaults num_experts_per_tok to 4.
+            moe_top_k=text.get("num_experts_per_tok", 4 if text.get("num_experts", 0) else 2),
             moe_intermediate_size=text.get("moe_intermediate_size"),
             moe_shared_intermediate=text.get("shared_expert_intermediate_size", 0),
             moe_every=text.get("decoder_sparse_step", 1),

@@ -474,3 +474,78 @@ class TestWsLogs:
             return True
 
         assert with_client(fn)
+
+
+class TestEnvCheck:
+    def test_environment_report_on_this_image(self):
+        from lumen_tpu.app.env_check import environment_report
+
+        # need_gb tiny so the verdict doesn't depend on this host's free disk
+        report = environment_report(cache_dir="/tmp", need_gb=0.001)
+        names = {c["name"] for c in report["checks"]}
+        assert {"python", "jax", "flax", "disk_space"} <= names
+        by_name = {c["name"]: c for c in report["checks"]}
+        # This image ships the whole stack, so required checks all pass.
+        assert report["ok"] is True
+        assert by_name["jax"]["ok"] and "jax" in by_name["jax"]["detail"]
+        # Optional checks never gate ok.
+        assert by_name["tpu_devices"]["required"] is False
+        assert by_name["libtpu"]["required"] is False
+
+    def test_disk_check_walks_to_existing_parent(self):
+        from lumen_tpu.app.env_check import check_disk
+
+        c = check_disk("/tmp/does/not/exist/yet", need_gb=0.001)
+        assert c.ok and "/tmp" in c.detail
+
+    def test_pip_index_by_region(self):
+        from lumen_tpu.app.env_check import pip_index_url
+
+        assert pip_index_url("cn") and "tsinghua" in pip_index_url("cn")
+        assert pip_index_url("other") is None
+        assert pip_index_url("unknown-region") is None
+
+    def test_hardware_check_endpoint(self):
+        async def fn(client):
+            r = await client.get("/api/v1/hardware/check?cache_dir=/tmp")
+            assert r.status == 200
+            data = await r.json()
+            # ok depends on this host's free disk; assert the structure and
+            # the stack checks instead.
+            assert isinstance(data["ok"], bool)
+            for name in ("python", "jax", "flax", "grpcio"):
+                assert any(c["name"] == name and c["ok"] for c in data["checks"])
+            return True
+
+        assert with_client(fn)
+
+    def test_install_region_selects_mirror_flag(self):
+        """region=cn routes the pip step through the mirror index; the
+        default region does not (reference MirrorSelector semantics).
+        _exec is stubbed to capture argv — no real pip run."""
+        from lumen_tpu.app.install import InstallOptions, InstallStep, InstallTask
+
+        async def fn():
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
+            calls = []
+
+            async def fake_exec(task, *cmd):
+                calls.append(cmd)
+                return 0, ""
+
+            orch._exec = fake_exec
+            for region, expects_mirror in (("cn", True), ("other", False)):
+                task = InstallTask(
+                    task_id="t-" + region,
+                    options=InstallOptions(packages=["einops"], region=region),
+                    steps=[InstallStep("install_packages")],
+                )
+                await orch._step_install_packages(task, task.steps[0])
+                argv = calls[-1]
+                assert ("--index-url" in argv) == expects_mirror
+                assert argv[-1] == "einops"
+            return True
+
+        assert run_async(fn())

@@ -111,6 +111,7 @@ class TestApiContract:
         wanted = []
         for method, v1path, abspath in calls:
             path = f"/api/v1{v1path}" if v1path else abspath
+            path = path.split("?", 1)[0]  # query strings aren't routed
             wanted.append((method, re.sub(r"\$\{[^}]+\}", "{param}", path)))
         for p in raw_fetches:
             wanted.append(("GET", f"/api/v1{p}"))
