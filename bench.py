@@ -422,6 +422,53 @@ def phase_ocr(det_batch: int = 8, rec_batch: int = 64, iters: int = 10) -> dict:
     }
 
 
+def phase_flash_ab(iters: int = 20) -> dict:
+    """A/B: XLA reference attention vs the Pallas flash kernel on a
+    VLM-prefill-shaped causal problem (the workload SURVEY.md §7 step 7
+    targets). Reported so the kernel's win (or loss) is measured, not
+    assumed. CPU fallback runs tiny shapes with the kernel in interpret
+    mode — a correctness proof, not a perf claim."""
+    _apply_platform_env()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lumen_tpu.ops import attention_reference, flash_attention
+
+    cpu = jax.default_backend() == "cpu"
+    if cpu:
+        b, h, s, d, iters = 1, 2, 64, 32, 1
+    else:
+        b, h, s, d = 8, 14, 1024, 64  # Qwen2-0.5B-ish prefill block
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(key, (b, h, s, d), jnp.bfloat16) for key in ks
+    )
+    ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+    fla = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=cpu)
+    )
+
+    def time_fn(fn):
+        np.asarray(fn(q, k, v))  # compile + settle
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, k, v)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / iters * 1e3  # ms/iter
+
+    ref_ms = time_fn(ref)
+    flash_ms = time_fn(fla)
+    return {
+        "ref_ms": round(ref_ms, 3),
+        "flash_ms": round(flash_ms, 3),
+        "flash_speedup": round(ref_ms / flash_ms, 3) if flash_ms else None,
+        "shape": f"b{b} h{h} s{s} d{d} causal bf16",
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def phase_baseline_torch(iters: int = 8) -> dict:
     """Reference execution model: per-request (batch 1) CPU forward of the
     same ViT-B/32 vision tower."""
@@ -472,6 +519,7 @@ PHASES = {
     "face": phase_face,
     "ocr": phase_ocr,
     "ingest": phase_ingest,
+    "flash_ab": phase_flash_ab,
     "baseline": phase_baseline_torch,
 }
 
@@ -587,7 +635,9 @@ def main(args) -> None:
     # Secondary metrics are opt-in (--full) or env-enabled so the default
     # driver invocation stays well inside its time budget.
     full = args.full or os.environ.get("BENCH_FULL") == "1"
-    names = ["probe", "clip"] + (["vlm", "face", "ocr", "ingest"] if full else [])
+    names = ["probe", "clip"] + (
+        ["vlm", "face", "ocr", "ingest", "flash_ab"] if full else []
+    )
     # BENCH_TIMEOUT is per heavyweight phase (probe is trivial); the group
     # shares one budget so slow-but-working later phases aren't killed by
     # a single-phase allowance. CPU fallbacks shrink their own workloads,
@@ -621,6 +671,12 @@ def main(args) -> None:
     if ingest:
         extras["ingest_images_per_sec"] = ingest.get("images_per_sec")
         extras["ingest_platform"] = ingest.get("platform")
+    flash_ab = results.get("flash_ab")
+    if flash_ab:
+        extras["flash_ab_ref_ms"] = flash_ab.get("ref_ms")
+        extras["flash_ab_flash_ms"] = flash_ab.get("flash_ms")
+        extras["flash_ab_speedup"] = flash_ab.get("flash_speedup")
+        extras["flash_ab_platform"] = flash_ab.get("platform")
 
     value = clip.get("images_per_sec", 0.0) if clip else 0.0
     platform = clip.get("platform", "none") if clip else "none"

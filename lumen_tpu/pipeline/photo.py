@@ -256,16 +256,31 @@ class PhotoIngestPipeline:
         """Caption path: VLM generation is autoregressive (one lax.while_loop
         per image) and dominates cost, so it runs after the dense sweep."""
         records = list(self.run(items))
-        if self.caption and self.vlm is not None:
-            from lumen_tpu.models.vlm.chat import ChatMessage
+        return self.caption_records(records, items)
 
-            for rec, payload in zip(records, items):
+    def caption_records(
+        self, records: list[PhotoRecord], items: list[bytes]
+    ) -> list[PhotoRecord]:
+        """Caption already-swept records in place. Per-image fault
+        tolerance matches the decode contract: one VLM failure records an
+        error on that row instead of aborting a multi-hour bulk run."""
+        if not self.caption or self.vlm is None:
+            return records
+        from lumen_tpu.models.vlm.chat import ChatMessage
+
+        for rec, payload in zip(records, items):
+            if rec.error:  # undecodable image: nothing to caption
+                continue
+            try:
                 result = self.vlm.generate(
                     [ChatMessage(role="user", content=self.caption_prompt)],
                     image_bytes=payload,
                     max_new_tokens=self.caption_max_tokens,
                 )
                 rec.caption = result.text
+            except Exception as e:  # noqa: BLE001 - record, don't abort
+                logger.warning("caption failed for item %d: %s", rec.index, e)
+                rec.error = f"caption failed: {e}"
         return records
 
     @property

@@ -34,6 +34,12 @@ class TrainConfig:
     max_grad_norm: float = 1.0
     warmup_steps: int = 100
     total_steps: int = 10_000
+    #: Rematerialize the forward during backward (``jax.checkpoint``) —
+    #: trades ~1/3 more FLOPs for dropping activation HBM, the standard
+    #: TPU lever when the per-chip batch is memory-bound. Matmul outputs
+    #: without batch dims stay saved (XLA's recommended policy) so the MXU
+    #: work isn't naively doubled.
+    remat: bool = False
 
 
 def contrastive_loss(img_emb: jax.Array, txt_emb: jax.Array, logit_scale: jax.Array) -> jax.Array:
@@ -113,10 +119,17 @@ class ClipTrainer:
         optimizer = self.optimizer
         data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
 
-        def loss_fn(params, batch):
-            out = model.apply(
-                {"params": params}, batch["pixel_values"], batch["input_ids"]
+        def forward(params, pixel_values, input_ids):
+            return model.apply({"params": params}, pixel_values, input_ids)
+
+        if self.train_cfg.remat:
+            forward = jax.checkpoint(
+                forward,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
+
+        def loss_fn(params, batch):
+            out = forward(params, batch["pixel_values"], batch["input_ids"])
             return contrastive_loss(
                 out["image_embeds"], out["text_embeds"], params["logit_scale"]
             )

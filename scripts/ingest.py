@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Bulk photo indexing: run a directory through the data-parallel ingest
-pipeline (CLIP embed [+classify] + face detect/embed + OCR) and write one
-JSON record per image.
+pipeline (CLIP embed [+classify] + face detect/embed + OCR [+ VLM
+caption]) and write one JSON record per image.
 
 No reference equivalent — this is the SURVEY.md §6 north-star capability
 (full-library ingest) as a CLI.
@@ -9,7 +9,7 @@ No reference equivalent — this is the SURVEY.md §6 north-star capability
 Usage:
     python scripts/ingest.py --config lumen-config.yaml --input photos/ \
         --output index.jsonl [--batch-size 64] [--classify-top-k 5] \
-        [--families clip,face,ocr] [--limit N]
+        [--families clip,face,ocr,vlm] [--caption-prompt "..."] [--limit N]
 """
 
 from __future__ import annotations
@@ -47,8 +47,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--families",
         default="clip,face,ocr",
-        help="comma list from {clip,face,ocr} (families must be in the config)",
+        help="comma list from {clip,face,ocr,vlm} (families must be in the "
+        "config; vlm adds a caption per image)",
     )
+    parser.add_argument("--caption-prompt", default="Describe this photo in one sentence.")
+    parser.add_argument("--caption-max-tokens", type=int, default=32)
     parser.add_argument("--limit", type=int, default=None)
     parser.add_argument("--embed-encoding", choices=["list", "b64"], default="b64",
                         help="embedding serialization (b64 = little-endian fp32)")
@@ -94,6 +97,10 @@ def main(argv: list[str] | None = None) -> int:
         clip=managers.get("clip"),
         face=managers.get("face"),
         ocr=managers.get("ocr"),
+        vlm=managers.get("vlm"),
+        caption="vlm" in managers,
+        caption_prompt=args.caption_prompt,
+        caption_max_tokens=args.caption_max_tokens,
         batch_size=args.batch_size,
         classify_top_k=args.classify_top_k,
         # One corrupt file must not abort a multi-hour library index; bad
@@ -124,11 +131,52 @@ def main(argv: list[str] | None = None) -> int:
             except OSError:
                 yield b""  # undecodable -> recorded as an error row
 
+    chunk_stats: list[dict] = []
+
+    def chunks():
+        batch: list[bytes] = []
+        for payload in payloads():
+            batch.append(payload)
+            if len(batch) >= max(args.batch_size * 4, 64):
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    def records():
+        """Stream records; the caption path needs payload lists, so it runs
+        in bounded chunks (a 100k-image library never sits in RAM at once).
+        Chunk k+1's dense device sweep runs on a worker thread WHILE chunk
+        k's sequential captions generate, so the TPU never idles through a
+        caption phase."""
+        if "vlm" in managers:
+            from concurrent.futures import ThreadPoolExecutor
+
+            def dense(chunk):
+                recs = list(pipe.run(chunk))
+                chunk_stats.append(pipe.stats.as_dict())
+                return recs
+
+            with ThreadPoolExecutor(1) as ex:
+                prev = None  # (records, chunk) awaiting captioning
+                for chunk in chunks():
+                    fut = ex.submit(dense, chunk)
+                    if prev is not None:
+                        yield from pipe.caption_records(*prev)
+                    prev = (fut.result(), chunk)
+                if prev is not None:
+                    yield from pipe.caption_records(*prev)
+        else:
+            yield from pipe.run(payloads())
+            chunk_stats.append(pipe.stats.as_dict())
+
     t0 = time.perf_counter()
     n_errors = 0
+    offset = 0
     with open(args.output, "w", encoding="utf-8") as out:
-        for rec in pipe.run(payloads()):
-            row = {"path": paths[rec.index]}
+        for rec in records():
+            row = {"path": paths[offset]}
+            offset += 1
             if rec.error:
                 row["error"] = rec.error
                 n_errors += 1
@@ -145,6 +193,8 @@ def main(argv: list[str] | None = None) -> int:
                     }
                     for f in rec.faces
                 ]
+            if rec.caption is not None:
+                row["caption"] = rec.caption
             if rec.ocr:
                 row["ocr"] = [
                     {
@@ -160,7 +210,16 @@ def main(argv: list[str] | None = None) -> int:
         f"done: {len(paths)} images in {dt:.1f}s "
         f"({len(paths) / dt:.1f} images/sec, {n_errors} errors) -> {args.output}"
     )
-    print("stage stats:", json.dumps(pipe.stats.as_dict()))
+    # Each engine.run resets pipe.stats, so chunked (VLM) runs accumulate a
+    # dict per chunk; sum the numeric fields for true whole-run telemetry.
+    totals: dict[str, float] = {}
+    for st in chunk_stats:
+        for key, val in st.items():
+            if isinstance(val, (int, float)):
+                totals[key] = totals.get(key, 0) + val
+    if totals.get("wall_s"):
+        totals["items_per_sec"] = round(totals["items"] / totals["wall_s"], 2)
+    print("stage stats:", json.dumps(totals))
     return 0
 
 

@@ -200,3 +200,83 @@ class TestPhotoIngest:
         assert records[0].error is None and records[0].clip_embedding is not None
         assert records[1].error and records[1].clip_embedding is None
         assert records[2].error is None and records[2].clip_embedding is not None
+
+
+class TestPhotoCaptioning:
+    def test_run_with_captions_sets_caption_and_skips_error_rows(self, mesh, tmp_path_factory):
+        from lumen_tpu.models.clip import CLIPManager
+        from lumen_tpu.models.vlm import VLMManager
+        from tests.test_vlm import make_vlm_model_dir
+
+        clip_dir = make_clip_model_dir(tmp_path_factory.mktemp("capclip"))
+        clip_mgr = CLIPManager(clip_dir, dataset="Tiny", dtype="float32", batch_size=4)
+        clip_mgr.initialize()
+        vlm_dir = make_vlm_model_dir(tmp_path_factory.mktemp("capvlm"))
+        vlm_mgr = VLMManager(
+            vlm_dir, dtype="float32", max_seq=128, max_new_cap=8, prefill_buckets=(32,)
+        )
+        vlm_mgr.initialize()
+        try:
+            pipe = PhotoIngestPipeline(
+                mesh,
+                clip=clip_mgr,
+                vlm=vlm_mgr,
+                caption=True,
+                caption_max_tokens=4,
+                batch_size=8,
+                on_decode_error="record",
+            )
+            items = [png_bytes(seed=i) for i in range(3)] + [b"not an image"]
+            records = pipe.run_with_captions(items)
+            assert len(records) == 4
+            for rec in records[:3]:
+                assert isinstance(rec.caption, str) and rec.caption
+                assert rec.clip_embedding is not None
+            assert records[3].error and records[3].caption is None
+        finally:
+            clip_mgr.close()
+            vlm_mgr.close()
+
+    def test_caption_requires_vlm(self, mesh, tmp_path_factory):
+        from lumen_tpu.models.clip import CLIPManager
+
+        clip_dir = make_clip_model_dir(tmp_path_factory.mktemp("capclip2"))
+        mgr = CLIPManager(clip_dir, dataset="Tiny", dtype="float32", batch_size=4)
+        mgr.initialize()
+        try:
+            with pytest.raises(ValueError, match="vlm"):
+                PhotoIngestPipeline(mesh, clip=mgr, caption=True)
+        finally:
+            mgr.close()
+
+    def test_caption_failure_records_error_row(self, mesh, tmp_path_factory):
+        """One failing generate must not abort the run (reference decode
+        fault-tolerance contract extended to the caption stage)."""
+        from lumen_tpu.models.clip import CLIPManager
+
+        clip_dir = make_clip_model_dir(tmp_path_factory.mktemp("capclip3"))
+        clip_mgr = CLIPManager(clip_dir, dataset="Tiny", dtype="float32", batch_size=4)
+        clip_mgr.initialize()
+
+        class StubVlm:
+            mesh = None
+            calls = 0
+
+            def _ensure_ready(self):
+                pass
+
+            def generate(self, messages, image_bytes=None, max_new_tokens=0):
+                StubVlm.calls += 1
+                if StubVlm.calls == 2:
+                    raise RuntimeError("boom")
+                return type("R", (), {"text": "a photo"})()
+
+        try:
+            pipe = PhotoIngestPipeline(
+                mesh, clip=clip_mgr, vlm=StubVlm(), caption=True, batch_size=8
+            )
+            records = pipe.run_with_captions([png_bytes(seed=i) for i in range(3)])
+            assert [r.caption for r in records] == ["a photo", None, "a photo"]
+            assert records[1].error and "boom" in records[1].error
+        finally:
+            clip_mgr.close()

@@ -126,3 +126,48 @@ class TestCheckpoint:
         with pytest.raises(FileNotFoundError):
             ckpt.restore()
         ckpt.close()
+
+
+class TestRemat:
+    def test_remat_step_matches_plain(self):
+        """remat=True must change memory behavior only: same loss, same
+        updated params as the plain step for identical inputs."""
+        import numpy as np
+
+        from lumen_tpu.models.clip.modeling import CLIPConfig, TowerConfig
+        from lumen_tpu.runtime.mesh import build_mesh
+        from lumen_tpu.training import ClipTrainer, TrainConfig
+
+        cfg = CLIPConfig(
+            embed_dim=16,
+            image_size=32,
+            patch_size=16,
+            vision=TowerConfig(32, 2, 4),
+            text=TowerConfig(32, 2, 4),
+            vocab_size=64,
+            context_length=8,
+        )
+        mesh = build_mesh({"data": -1})
+        batch = {
+            "pixel_values": jnp.asarray(
+                np.random.RandomState(0).rand(8, 32, 32, 3), jnp.float32
+            ),
+            "input_ids": jnp.asarray(
+                np.random.RandomState(1).randint(0, 64, (8, 8)), jnp.int32
+            ),
+        }
+        results = []
+        for remat in (False, True):
+            tr = ClipTrainer(cfg, TrainConfig(total_steps=4, warmup_steps=1, remat=remat), mesh)
+            params, opt = tr.init_state(jax.random.PRNGKey(0))
+            step = tr.make_train_step()
+            params, opt, metrics = step(params, opt, batch)
+            results.append((float(metrics["loss"]), params))
+        assert results[0][0] == pytest.approx(results[1][0], rel=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-6
+            ),
+            results[0][1],
+            results[1][1],
+        )
