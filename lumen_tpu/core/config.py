@@ -174,6 +174,11 @@ class BackendSettings(BaseModel):
     # dispatch per block; larger amortizes dispatch, smaller admits and
     # retires rows sooner). Ignored by "coalesce".
     decode_block: int = Field(8, ge=1)
+    # VLM only: weight-only int8 for the decoder's attention + MLP
+    # projections (per-channel scales). Halves the dominant HBM traffic of
+    # bandwidth-bound decode; embeddings/norms/MoE banks stay full
+    # precision. Other services ignore this.
+    quantize: Literal["int8"] | None = None
 
 
 class ServiceConfig(BaseModel):

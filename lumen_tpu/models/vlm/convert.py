@@ -10,6 +10,7 @@ as the other families (``lumen_tpu/models/clip/convert.py``).
 from __future__ import annotations
 
 import logging
+import re
 
 import numpy as np
 
@@ -133,6 +134,40 @@ def _stack_experts(flat: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
             [members[i] for i in range(n)], axis=0
         )
     return out
+
+
+#: decoder projections QDense replaces when ``weight_quant="int8"`` — must
+#: stay in lockstep with modeling._dense call sites (attn q/k/v/o, SwiGLU
+#: gate/up/down incl. the MoE shared expert, untied lm_head). MoE expert
+#: banks (w_*), router, embeddings, and norms stay full precision.
+_QUANT_KERNEL = re.compile(
+    r"^decoder/.*(q_proj|k_proj|v_proj|o_proj|gate_proj|up_proj|down_proj|lm_head)/kernel$"
+)
+
+
+def quantize_decoder_int8(params: dict) -> dict:
+    """Weight-only int8: replace each matching ``.../kernel`` leaf with
+    ``.../q`` (int8, symmetric) + ``.../scale`` (fp32 per output channel).
+    Apply AFTER the dtype-policy cast so the quantization grid is computed
+    from the weights serving would otherwise use."""
+    from ...runtime.weights import flatten, unflatten
+
+    flat = flatten(params)
+    out: dict = {}
+    n_quant = 0
+    for path, leaf in flat.items():
+        if _QUANT_KERNEL.match(path):
+            w = np.asarray(leaf, np.float32)
+            scale = np.maximum(np.abs(w).max(axis=0) / 127.0, 1e-8)  # [out]
+            q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+            prefix = path[: -len("kernel")]
+            out[prefix + "q"] = q
+            out[prefix + "scale"] = scale.astype(np.float32)
+            n_quant += 1
+        else:
+            out[path] = leaf
+    logger.info("int8 weight-only quantization: %d decoder projections", n_quant)
+    return unflatten(out)
 
 
 def convert_vlm_checkpoint(

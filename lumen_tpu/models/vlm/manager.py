@@ -189,7 +189,11 @@ class VLMManager:
         scheduler: str = "coalesce",  # or "continuous"
         gen_slots: int = 8,
         gen_block: int = 8,
+        quantize: str | None = None,  # None | "int8" (weight-only decoder quant)
     ):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+        self.quantize = quantize
         self.model_dir = model_dir
         self.policy = get_policy(dtype)
         self.warmup = warmup
@@ -205,6 +209,13 @@ class VLMManager:
         self.gen_block = gen_block
         self.info: ModelInfo = load_model_info(model_dir)
         self.cfg = self._build_config(model_dir)
+        if self.quantize:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(
+                self.cfg,
+                decoder=dataclasses.replace(self.cfg.decoder, weight_quant=self.quantize),
+            )
         self.model = VLMModel(self.cfg)
         self.model_id = self.info.name
         self._initialized = False
@@ -291,6 +302,19 @@ class VLMManager:
         converted = convert_vlm_checkpoint(
             state, None, tie_word_embeddings=self.cfg.decoder.tie_word_embeddings
         )
+        if self.quantize == "int8":
+            from .convert import quantize_decoder_int8
+
+            # Cast first so the int8 grid is computed from the bf16 weights
+            # serving would otherwise stream; scales stay fp32 (the later
+            # blanket cast is skipped for quantized trees). The vision
+            # subtree sits out: it is never quantized, and casting it here
+            # would waste a host pass on a tower the ONNX-graph path is
+            # about to discard — it's cast below only if kept.
+            vision_sub = converted.pop("vision", None)
+            converted = quantize_decoder_int8(self.policy.cast_params(converted))
+            if vision_sub is not None:
+                converted["vision"] = vision_sub
         has_native_vision = _subtree_matches(converted.get("vision"), init["vision"])
         vision_onnx = find_vision_onnx(self.model_dir) if backend != "native" else None
         vision_graph: VisionGraph | None = None
@@ -310,7 +334,12 @@ class VLMManager:
                 )
             params = converted
             assert_tree_shapes(params, init)
-        params = self.policy.cast_params(params)
+        if not self.quantize:
+            params = self.policy.cast_params(params)
+        elif "vision" in params:
+            # Quantized decoder was cast pre-quantization; the kept native
+            # vision tower still needs its (ordinary) dtype cast.
+            params["vision"] = self.policy.cast_params(params["vision"])
         self.params = jax.device_put(params)
         self.tokenizer = VlmTokenizer.from_model_dir(self.model_dir)
         if vision_graph is not None:

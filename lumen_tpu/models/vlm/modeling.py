@@ -58,6 +58,14 @@ class DecoderConfig:
     moe_every: int = 1
     moe_norm_topk: bool = True
     moe_dense_layers: tuple[int, ...] = ()  # HF mlp_only_layers: force-dense
+    # --- Weight-only int8 quantization for the decoder's attention + MLP
+    # projections (per-output-channel scales). Decode at small batch is
+    # HBM-bandwidth-bound: the per-step cost is streaming the weights, so
+    # int8 halves the dominant traffic vs bf16. Embeddings (gather +
+    # tied lm_head), norms, and MoE expert banks stay full precision.
+    # Set by the serving layer (backend_settings.quantize), not by
+    # checkpoints — see ``quantize_decoder_int8`` in convert.py.
+    weight_quant: str | None = None  # None | "int8"
 
     @property
     def dim_per_head(self) -> int:
@@ -183,6 +191,37 @@ def init_kv_cache(cfg: VLMConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) 
 # -- modules ----------------------------------------------------------------
 
 
+class QDense(nn.Module):
+    """Weight-only int8 linear: ``y = (x @ q) * scale [+ bias]`` with
+    ``q: [in, out] int8`` and a per-output-channel fp32 ``scale``. XLA
+    fuses the int8->bf16 convert into the dot's operand read, so HBM
+    traffic for the weights is one byte per element — the point of the
+    exercise on a bandwidth-bound decode."""
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        q = self.param(
+            "q", lambda key, shape: jnp.zeros(shape, jnp.int8), (d, self.features)
+        )
+        scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
+        y = jnp.dot(x, q.astype(x.dtype)) * scale.astype(x.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+            y = y + bias.astype(x.dtype)
+        return y
+
+
+def _dense(cfg: DecoderConfig, features: int, name: str, use_bias: bool, dtype):
+    """Dense factory for decoder projections: honors ``weight_quant``."""
+    if cfg.weight_quant == "int8":
+        return QDense(features, use_bias=use_bias, name=name)
+    return nn.Dense(features, use_bias=use_bias, name=name, dtype=dtype)
+
+
 class RMSNorm(nn.Module):
     eps: float
 
@@ -225,9 +264,9 @@ class DecoderAttention(nn.Module):
         c = self.cfg
         b, s, _ = x.shape
         dh = c.dim_per_head
-        q = nn.Dense(c.heads * dh, name="q_proj", dtype=x.dtype)(x)
-        k = nn.Dense(c.kv_heads * dh, name="k_proj", dtype=x.dtype)(x)
-        v = nn.Dense(c.kv_heads * dh, name="v_proj", dtype=x.dtype)(x)
+        q = _dense(c, c.heads * dh, "q_proj", True, x.dtype)(x)
+        k = _dense(c, c.kv_heads * dh, "k_proj", True, x.dtype)(x)
+        v = _dense(c, c.kv_heads * dh, "v_proj", True, x.dtype)(x)
         q = q.reshape(b, s, c.heads, dh).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, c.kv_heads, dh).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, c.kv_heads, dh).transpose(0, 2, 1, 3)
@@ -279,7 +318,7 @@ class DecoderAttention(nn.Module):
             out = attention(q, repeat_kv(keys, n_rep), repeat_kv(values, n_rep), causal=True)
 
         out = out.transpose(0, 2, 1, 3).reshape(b, s, c.heads * dh)
-        return nn.Dense(c.hidden_size, use_bias=False, name="o_proj", dtype=x.dtype)(out), cache
+        return _dense(c, c.hidden_size, "o_proj", False, x.dtype)(out), cache
 
 
 class SwiGLU(nn.Module):
@@ -290,11 +329,9 @@ class SwiGLU(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         c = self.cfg
         inter = self.intermediate or c.intermediate_size
-        gate = nn.Dense(inter, use_bias=False, name="gate_proj", dtype=x.dtype)(x)
-        up = nn.Dense(inter, use_bias=False, name="up_proj", dtype=x.dtype)(x)
-        return nn.Dense(c.hidden_size, use_bias=False, name="down_proj", dtype=x.dtype)(
-            nn.silu(gate) * up
-        )
+        gate = _dense(c, inter, "gate_proj", False, x.dtype)(x)
+        up = _dense(c, inter, "up_proj", False, x.dtype)(x)
+        return _dense(c, c.hidden_size, "down_proj", False, x.dtype)(nn.silu(gate) * up)
 
 
 class MoEFFN(nn.Module):
@@ -379,7 +416,9 @@ class Decoder(nn.Module):
         ]
         self.final_norm = RMSNorm(c.rms_norm_eps, name="final_norm")
         if not c.tie_word_embeddings:
-            self.lm_head = nn.Dense(c.vocab_size, use_bias=False, name="lm_head")
+            # _dense so weight_quant="int8" applies to the untied lm_head
+            # (convert.quantize_decoder_int8 rewrites its kernel to q+scale).
+            self.lm_head = _dense(c, c.vocab_size, "lm_head", False, None)
 
     def embed(self, input_ids: jax.Array) -> jax.Array:
         return self.embed_tokens(input_ids)

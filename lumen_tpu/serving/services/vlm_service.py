@@ -78,6 +78,7 @@ class VlmService(BaseService):
             scheduler=bs.scheduler,
             gen_slots=gen_batch,  # pool width = configured decode batch
             gen_block=bs.decode_block,
+            quantize=bs.quantize,
             **kw,
         )
         manager.initialize()
