@@ -318,3 +318,34 @@ class TestMoE:
         mesh = build_mesh({"expert": -1})
         with pytest.raises(ValueError, match="divide"):
             moe_ffn(params, jnp.ones((30, 8)), mesh)
+
+
+class TestMoEModelSharding:
+    def test_moe_vlm_forward_with_ep_rules(self):
+        """MOE_EP_RULES + TP-style rules place a real MoE decoder's params
+        on an expert mesh and the jitted forward still runs (XLA inserts
+        the collectives for the declarative path)."""
+        import dataclasses
+
+        from lumen_tpu.models.vlm.modeling import VLMConfig, VLMModel
+        from lumen_tpu.parallel import MOE_EP_RULES, shard_params
+
+        base = VLMConfig.tiny()
+        cfg = dataclasses.replace(
+            base,
+            decoder=dataclasses.replace(
+                base.decoder, moe_experts=8, moe_top_k=2, moe_intermediate_size=32
+            ),
+        )
+        model = VLMModel(cfg)
+        ids = jnp.ones((2, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        mesh = build_mesh({"expert": -1})
+        placed = shard_params(params, mesh, MOE_EP_RULES)
+        bank = placed["decoder"]["layers_0"]["mlp"]["w_gate"]
+        assert bank.sharding.spec == P("expert")
+        router = placed["decoder"]["layers_0"]["mlp"]["router"]
+        assert router.sharding.spec == P()
+        logits = jax.jit(lambda p, i: model.apply({"params": p}, i, None))(placed, ids)
+        assert logits.shape == (2, 8, cfg.decoder.vocab_size)
+        assert bool(jnp.isfinite(logits).all())
