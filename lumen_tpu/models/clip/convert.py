@@ -76,6 +76,46 @@ def convert_hf_clip(state: dict[str, np.ndarray]) -> dict:
     return unflatten(flat)
 
 
+# -- ChineseCLIP (CN-CLIP) --------------------------------------------------
+# BERT text encoder ("encoder.layer.N.attention.self.query" naming) + the
+# standard HF CLIP vision tower. Reference loads these through the
+# ChineseCLIPModel torch path (``torch_backend.py:340-393``).
+
+_VISION_AND_SCALE_RULES = [r for r in HF_RULES if r[0].startswith(("vision", "visual", "logit"))]
+
+CNCLIP_RULES = [
+    (r"text_model\.embeddings\.word_embeddings\.weight", r"text/word_embeddings/embedding", None),
+    (r"text_model\.embeddings\.position_embeddings\.weight", r"text/position_embedding", None),
+    (r"text_model\.embeddings\.token_type_embeddings\.weight", r"text/token_type_embedding", None),
+    (r"text_model\.embeddings\.LayerNorm\.weight", r"text/embed_ln/scale", None),
+    (r"text_model\.embeddings\.LayerNorm\.bias", r"text/embed_ln/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.query\.weight", r"text/blocks_\1/attn/q_proj/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.query\.bias", r"text/blocks_\1/attn/q_proj/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.key\.weight", r"text/blocks_\1/attn/k_proj/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.key\.bias", r"text/blocks_\1/attn/k_proj/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.value\.weight", r"text/blocks_\1/attn/v_proj/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.self\.value\.bias", r"text/blocks_\1/attn/v_proj/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.output\.dense\.weight", r"text/blocks_\1/attn/out_proj/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.output\.dense\.bias", r"text/blocks_\1/attn/out_proj/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.weight", r"text/blocks_\1/ln1/scale", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.bias", r"text/blocks_\1/ln1/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.intermediate\.dense\.weight", r"text/blocks_\1/mlp/fc1/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.intermediate\.dense\.bias", r"text/blocks_\1/mlp/fc1/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.output\.dense\.weight", r"text/blocks_\1/mlp/fc2/kernel", linear_kernel),
+    (r"text_model\.encoder\.layer\.(\d+)\.output\.dense\.bias", r"text/blocks_\1/mlp/fc2/bias", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.output\.LayerNorm\.weight", r"text/blocks_\1/ln2/scale", None),
+    (r"text_model\.encoder\.layer\.(\d+)\.output\.LayerNorm\.bias", r"text/blocks_\1/ln2/bias", None),
+    (r"text_projection\.weight", r"text/projection/kernel", linear_kernel),
+] + _VISION_AND_SCALE_RULES
+
+CNCLIP_DROP = [r"position_ids$", r"text_model\.pooler\."]
+
+
+def convert_cnclip(state: dict[str, np.ndarray]) -> dict:
+    flat = apply_rules(state, CNCLIP_RULES, drop=CNCLIP_DROP)
+    return unflatten(flat)
+
+
 # -- OpenCLIP ---------------------------------------------------------------
 
 OPENCLIP_RULES = [
@@ -153,7 +193,10 @@ def convert_openclip(state: dict[str, np.ndarray]) -> dict:
 def convert_clip_checkpoint(state: dict[str, np.ndarray], init_params: dict | None = None) -> dict:
     """Sniff the checkpoint family, convert, and (optionally) gate against
     the module's initialized tree."""
-    if any(k.startswith(("text_model.", "vision_model.")) for k in state):
+    if any(k.startswith("text_model.encoder.layer.") for k in state):
+        # BERT-style text encoder ("layer", not "layers") = ChineseCLIP.
+        params = convert_cnclip(state)
+    elif any(k.startswith(("text_model.", "vision_model.")) for k in state):
         params = convert_hf_clip(state)
     elif any(k.startswith(("visual.", "transformer.")) for k in state):
         params = convert_openclip(state)

@@ -166,7 +166,7 @@ class CLIPManager:
         from ...parallel.sharding import replicate
 
         self.params = replicate(params, self.mesh)
-        self.tokenizer = ClipTokenizer.from_model_dir(self.model_dir, self.cfg.context_length)
+        self.tokenizer = ClipTokenizer.from_model_dir(self.model_dir, self.cfg.serving_text_length)
 
         mean, std = self.norm_stats
         compute_dtype = self.policy.compute_dtype
@@ -236,7 +236,7 @@ class CLIPManager:
         size = self.cfg.image_size
         warmup_batcher(self._image_batcher, lambda b: np.zeros((b, size, size, 3), np.uint8))
         warmup_batcher(
-            self._text_batcher, lambda b: np.zeros((b, self.cfg.context_length), np.int32)
+            self._text_batcher, lambda b: np.zeros((b, self.cfg.serving_text_length), np.int32)
         )
         logger.info("warmup: %d bucket(s) compiled in %.1fs", len(buckets), time.perf_counter() - t0)
 

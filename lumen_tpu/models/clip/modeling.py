@@ -44,6 +44,24 @@ class CLIPConfig:
     #: EOT/EOS token id for text pooling; None = argmax convention (OpenAI
     #: CLIP's EOT is the highest vocab id, so argmax finds it).
     eot_token_id: int | None = None
+    #: Text tower architecture: "clip" (causal pre-LN transformer, EOT
+    #: pooling) or "bert" (ChineseCLIP: bidirectional post-LN BERT with
+    #: padding mask, CLS pooling — reference loads these via the
+    #: ChineseCLIPModel torch path, ``torch_backend.py:340-393``).
+    text_arch: str = "clip"
+    text_hidden_act: str | None = None  # None -> hidden_act ("gelu" for bert)
+    text_layer_norm_eps: float | None = None  # None -> layer_norm_eps
+    pad_token_id: int = 0  # bert padding-mask id ([PAD]=0 for BERT vocabs)
+    #: Serving-side text length cap. BERT checkpoints carry a 512-row
+    #: position table (kept full size for checkpoint parity) but queries
+    #: are short — running every encode at 512 would pay ~100x the
+    #: attention FLOPs. The tower slices positions to the actual input
+    #: length, so the tokenizer/batcher pad to this instead.
+    text_serving_length: int | None = None
+
+    @property
+    def serving_text_length(self) -> int:
+        return min(self.text_serving_length or self.context_length, self.context_length)
 
     @classmethod
     def tiny(cls) -> "CLIPConfig":
@@ -60,8 +78,15 @@ class CLIPConfig:
 
     @classmethod
     def from_hf(cls, cfg: dict[str, Any]) -> "CLIPConfig":
-        """Build from an HF ``CLIPConfig``-style dict (``config.json``)."""
+        """Build from an HF ``CLIPConfig``-style dict (``config.json``).
+        ChineseCLIP (CN-CLIP) configs are recognized by their BERT-shaped
+        text_config and mapped to the ``bert`` text arch."""
         v, t = cfg["vision_config"], cfg["text_config"]
+        is_bert = (
+            cfg.get("model_type") == "chinese_clip"
+            or t.get("model_type") == "chinese_clip_text_model"
+            or "type_vocab_size" in t
+        )
         return cls(
             embed_dim=cfg.get("projection_dim", 512),
             image_size=v.get("image_size", 224),
@@ -72,22 +97,34 @@ class CLIPConfig:
                 v.get("num_attention_heads", 12),
             ),
             text=TowerConfig(
-                t.get("hidden_size", 512),
+                t.get("hidden_size", 768 if is_bert else 512),
                 t.get("num_hidden_layers", 12),
-                t.get("num_attention_heads", 8),
+                t.get("num_attention_heads", 12 if is_bert else 8),
             ),
-            vocab_size=t.get("vocab_size", 49408),
-            context_length=t.get("max_position_embeddings", 77),
+            vocab_size=t.get("vocab_size", 21128 if is_bert else 49408),
+            context_length=t.get("max_position_embeddings", 512 if is_bert else 77),
             eot_token_id=t.get("eos_token_id"),
             hidden_act=v.get("hidden_act", "quick_gelu"),
             layer_norm_eps=v.get("layer_norm_eps", 1e-5),
+            text_arch="bert" if is_bert else "clip",
+            # Only meaningful for the bert tower; left None for plain CLIP
+            # (TextTower uses the shared hidden_act/layer_norm_eps).
+            text_hidden_act=t.get("hidden_act", "gelu") if is_bert else None,
+            text_layer_norm_eps=t.get("layer_norm_eps", 1e-12) if is_bert else None,
+            pad_token_id=t.get("pad_token_id", 0),
+            # CN-CLIP's published context is 52 tokens; pad to that, not to
+            # the checkpoint's 512-row position table.
+            text_serving_length=52 if is_bert else None,
         )
 
 
 def _act(name: str):
     if name == "quick_gelu":
         return lambda x: x * jax.nn.sigmoid(1.702 * x)
-    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+    if name == "gelu":
+        # HF "gelu" is the exact erf form (BERT/ChineseCLIP text parity).
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    if name in ("gelu_new", "gelu_pytorch_tanh"):
         return lambda x: jax.nn.gelu(x, approximate=True)
     return getattr(jax.nn, name)
 
@@ -97,14 +134,16 @@ class Attention(nn.Module):
     heads: int
 
     @nn.compact
-    def __call__(self, x: jax.Array, causal: bool = False) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, causal: bool = False, mask: jax.Array | None = None
+    ) -> jax.Array:
         b, s, _ = x.shape
         head_dim = self.width // self.heads
         dense = lambda name: nn.Dense(self.width, name=name, dtype=x.dtype)
         q = dense("q_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         k = dense("k_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         v = dense("v_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
-        out = attention(q, k, v, causal=causal)
+        out = attention(q, k, v, causal=causal, mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, self.width)
         return nn.Dense(self.width, name="out_proj", dtype=x.dtype)(out)
 
@@ -136,6 +175,58 @@ class Block(nn.Module):
             nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x)
         )
         return x
+
+
+class BertBlock(nn.Module):
+    """Post-LN residual block (BERT layout, used by ChineseCLIP's text
+    encoder): LayerNorm AFTER each residual add, biased projections,
+    bidirectional attention with a padding mask."""
+
+    width: int
+    heads: int
+    hidden_act: str
+    eps: float
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        h = Attention(self.width, self.heads, name="attn")(x, mask=mask)
+        x = nn.LayerNorm(epsilon=self.eps, name="ln1", dtype=x.dtype)(x + h)
+        h = Mlp(self.width, self.hidden_act, name="mlp")(x)
+        return nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x + h)
+
+
+class BertTextTower(nn.Module):
+    """ChineseCLIP text tower: BERT encoder + CLS pooling + projection
+    (HF ``ChineseCLIPModel.get_text_features`` takes the last hidden
+    state's [CLS] through ``text_projection`` — the reference works around
+    the same model's pooler bug identically, ``torch_backend.py:340-393``)."""
+
+    cfg: CLIPConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        c = self.cfg
+        t = c.text
+        eps = c.text_layer_norm_eps or c.layer_norm_eps
+        act = c.text_hidden_act or "gelu"
+        s = input_ids.shape[1]
+        x = nn.Embed(c.vocab_size, t.width, name="word_embeddings")(input_ids)
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(0.02), (c.context_length, t.width)
+        )
+        # Single-segment inputs: token type 0 everywhere (the table is kept
+        # 2-row for checkpoint parity).
+        tt = self.param(
+            "token_type_embedding", nn.initializers.normal(0.02), (2, t.width)
+        )
+        x = x + pos[:s].astype(x.dtype) + tt[0].astype(x.dtype)
+        x = nn.LayerNorm(epsilon=eps, name="embed_ln", dtype=x.dtype)(x)
+        # Bidirectional with right-padding masked out: [B, 1, 1, S].
+        mask = (input_ids != c.pad_token_id)[:, None, None, :]
+        for i in range(t.layers):
+            x = BertBlock(t.width, t.heads, act, eps, name=f"blocks_{i}")(x, mask)
+        pooled = x[:, 0]  # [CLS]
+        return nn.Dense(c.embed_dim, use_bias=False, name="projection", dtype=x.dtype)(pooled)
 
 
 class VisionTower(nn.Module):
@@ -207,7 +298,8 @@ class CLIPModel(nn.Module):
 
     def setup(self):
         self.vision = VisionTower(self.cfg, name="vision")
-        self.text = TextTower(self.cfg, name="text")
+        text_cls = BertTextTower if self.cfg.text_arch == "bert" else TextTower
+        self.text = text_cls(self.cfg, name="text")
         self.logit_scale = self.param(
             "logit_scale", nn.initializers.constant(jnp.log(1 / 0.07)), ()
         )

@@ -23,15 +23,58 @@ class ClipTokenizer:
         from tokenizers import Tokenizer
 
         path = os.path.join(model_dir, "tokenizer.json")
-        if not os.path.exists(path):
-            raise FileNotFoundError(f"tokenizer.json not found in {model_dir}")
-        tok = Tokenizer.from_file(path)
+        vocab_txt = os.path.join(model_dir, "vocab.txt")
+        if os.path.exists(path):
+            tok = Tokenizer.from_file(path)
+        elif os.path.exists(vocab_txt):
+            # BERT wordpiece repos (CN-CLIP) ship vocab.txt instead of a
+            # fast-tokenizer JSON; same fallback chain as the reference
+            # (``onnxrt_backend.py:307-376`` tries AutoTokenizer last).
+            tok = cls._bert_from_vocab(model_dir, vocab_txt)
+        else:
+            raise FileNotFoundError(f"no tokenizer.json or vocab.txt in {model_dir}")
         pad_id = 0
         if tok.padding is not None and "pad_id" in tok.padding:
             pad_id = tok.padding["pad_id"]
         tok.no_padding()  # we pad ourselves to the static context length
         tok.enable_truncation(max_length=context_length)
         return cls(tok, context_length, pad_id)
+
+    @staticmethod
+    def _bert_from_vocab(model_dir: str, vocab_txt: str):
+        """Assemble a BERT wordpiece tokenizer from vocab.txt via the
+        public ``tokenizers`` components (the legacy BertWordPieceTokenizer
+        wrapper only exposes the assembled ``Tokenizer`` through a private
+        attribute). Casing honors the repo's ``tokenizer_config.json``
+        ``do_lower_case`` (default True, the BERT/CN-CLIP norm)."""
+        import json
+
+        from tokenizers import Tokenizer, decoders, normalizers, pre_tokenizers
+        from tokenizers.models import WordPiece
+        from tokenizers.processors import TemplateProcessing
+
+        lower = True
+        tc_path = os.path.join(model_dir, "tokenizer_config.json")
+        if os.path.exists(tc_path):
+            try:
+                with open(tc_path, "r", encoding="utf-8") as f:
+                    lower = bool(json.load(f).get("do_lower_case", True))
+            except (OSError, ValueError):
+                pass
+        tok = Tokenizer(WordPiece.from_file(vocab_txt, unk_token="[UNK]"))
+        tok.normalizer = normalizers.BertNormalizer(
+            lowercase=lower, strip_accents=lower
+        )
+        tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
+        tok.decoder = decoders.WordPiece(prefix="##")
+        vocab = tok.get_vocab()
+        cls_id, sep_id = vocab.get("[CLS]", 101), vocab.get("[SEP]", 102)
+        tok.post_processor = TemplateProcessing(
+            single="[CLS] $A [SEP]",
+            pair="[CLS] $A [SEP] $B [SEP]",
+            special_tokens=[("[CLS]", cls_id), ("[SEP]", sep_id)],
+        )
+        return tok
 
     def encode_batch(self, texts: list[str]) -> np.ndarray:
         """-> [B, context_length] int32, right-padded."""
