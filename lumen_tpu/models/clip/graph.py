@@ -1,0 +1,102 @@
+"""Graph-backed CLIP towers: exported ``vision.onnx`` / ``text.onnx`` on TPU.
+
+The reference's PRIMARY CLIP path is a dual-session onnxruntime backend
+over exactly these exports (``packages/lumen-clip/src/lumen_clip/backends/
+onnxrt_backend.py:72-745``: precision-aware ``{component}.{precision}.onnx``
+file pick, input-dtype autodetect, context length read from the text
+input's shape). Serving them through the ONNX->JAX bridge means model
+families whose towers have no conversion rules — MobileCLIP2's FastViT
+hybrid vision tower (the region=other config default), distilled/exported
+variants — run as XLA programs with the exporter's own weights.
+
+Contract (reference ``image_to_vector``/``text_to_vector``): vision takes
+``[B, 3, S, S]`` normalized pixels, text takes ``[B, L]`` token ids; both
+emit ``[B, D]`` embeddings which the manager L2-normalizes host-side
+(reference ``:486-489``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...onnx_bridge import OnnxModule
+
+logger = logging.getLogger(__name__)
+
+_PRECISION_ORDER = ["fp32", "fp16"]  # reference preference chain (:245-289)
+
+
+def find_clip_onnx(model_dir: str, precision: str | None = None) -> dict[str, str]:
+    """Locate ``vision*.onnx`` / ``text*.onnx`` (bare dir or ``onnx/``
+    subdir), preferring the requested precision then fp32 then fp16 —
+    the reference's file-pick chain."""
+    names = sorted(os.listdir(model_dir)) if os.path.isdir(model_dir) else []
+    sub = os.path.join(model_dir, "onnx")
+    if os.path.isdir(sub):
+        names += [os.path.join("onnx", n) for n in sorted(os.listdir(sub))]
+
+    order = [precision] if precision else []
+    order += [p for p in _PRECISION_ORDER if p not in order]
+    found: dict[str, str] = {}
+    for kind, prefix in (("vision", "vision"), ("text", "text")):
+        candidates = [
+            n for n in names
+            if n.endswith(".onnx") and os.path.basename(n).startswith(prefix)
+        ]
+        if not candidates:
+            continue
+
+        def rank(name: str) -> tuple:
+            base = os.path.basename(name)
+            for i, prec in enumerate(order):
+                if f".{prec}." in base:
+                    return (i, base)
+            return (len(order), base)  # bare vision.onnx / text.onnx
+
+        found[kind] = os.path.join(model_dir, sorted(candidates, key=rank)[0])
+    return found
+
+
+@dataclass
+class ClipTowerGraph:
+    """One exported tower as a jittable program."""
+
+    module: OnnxModule
+
+    @classmethod
+    def from_path(cls, path: str) -> "ClipTowerGraph":
+        mod = OnnxModule.from_path(path)
+        logger.info(
+            "clip tower graph %s: %d MB params, inputs %s",
+            os.path.basename(path), mod.param_bytes() >> 20, mod.input_shapes(),
+        )
+        return cls(module=mod)
+
+    def __call__(self, params: dict, x):
+        import jax.numpy as jnp
+
+        out = jnp.asarray(self.module(params, {self.module.input_names[0]: x})[0])
+        if out.ndim != 2:
+            raise ValueError(f"CLIP tower must emit [B, D], got {out.shape}")
+        return out
+
+    def context_length(self, default: int) -> int:
+        """Static text length from the export's input shape (reference
+        detects it the same way, ``onnxrt_backend.py:212-217``)."""
+        shape = next(iter(self.module.input_shapes().values()), ())
+        if len(shape) == 2 and isinstance(shape[1], int) and shape[1] > 0:
+            return int(shape[1])
+        return default
+
+    def probe_dim(self, example: np.ndarray) -> int:
+        """Output dim via shape-only tracing — no FLOPs, no compile."""
+        import jax
+
+        out = jax.eval_shape(
+            lambda p, x: self(p, x), self.module.params, np.asarray(example)
+        )
+        return int(out.shape[1])

@@ -98,6 +98,13 @@ class CLIPManager:
         self.warmup = warmup
         self.info: ModelInfo = load_model_info(model_dir)
         self.cfg = self._build_config(model_dir)
+        # Deployment override for the serving-side text pad length (e.g. a
+        # BERT-text model whose queries are known-short).
+        tsl = self.info.extra("text_serving_length")
+        if tsl:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(self.cfg, text_serving_length=int(tsl))
         self.model = CLIPModel(self.cfg)
         self.model_id = self.info.name
         self._initialized = False
@@ -109,6 +116,7 @@ class CLIPManager:
     # -- configuration ----------------------------------------------------
 
     def _build_config(self, model_dir: str) -> CLIPConfig:
+        self._graphs = None  # (vision, text) ClipTowerGraph when graph-served
         cfg_path = os.path.join(model_dir, "config.json")
         if os.path.exists(cfg_path):
             with open(cfg_path, "r", encoding="utf-8") as f:
@@ -133,7 +141,37 @@ class CLIPManager:
                 vocab_size=t.get("vocab_size", 49408),
                 context_length=t.get("context_length", 77),
             )
-        raise FileNotFoundError(f"no config.json / open_clip_config.json in {model_dir}")
+        # No tower config at all: an exported-ONNX repo (e.g. MobileCLIP2
+        # exports, the region=other default — reference serves these as its
+        # primary dual-session path, ``onnxrt_backend.py:72-745``). Derive
+        # the serving shapes from the graphs themselves.
+        graphs = self._load_graphs(model_dir)
+        if graphs is not None:
+            vision_graph, text_graph = graphs
+            vshape = next(iter(vision_graph.module.input_shapes().values()), ())
+            size = vshape[-1] if len(vshape) == 4 and isinstance(vshape[-1], int) and vshape[-1] > 0 else 224
+            return CLIPConfig(
+                embed_dim=int(self.info.embedding_dim or 512),
+                image_size=int(size),
+                context_length=text_graph.context_length(77),
+            )
+        raise FileNotFoundError(
+            f"no config.json / open_clip_config.json / onnx towers in {model_dir}"
+        )
+
+    def _load_graphs(self, model_dir: str):
+        """Probe for exported vision+text towers; memoized on self."""
+        if self._graphs is not None:
+            return self._graphs
+        from .graph import ClipTowerGraph, find_clip_onnx
+
+        found = find_clip_onnx(model_dir, precision=self.info.extra("precision"))
+        if "vision" in found and "text" in found:
+            self._graphs = (
+                ClipTowerGraph.from_path(found["vision"]),
+                ClipTowerGraph.from_path(found["text"]),
+            )
+        return self._graphs
 
     @property
     def norm_stats(self) -> tuple[tuple[float, ...], tuple[float, ...]]:
@@ -149,47 +187,118 @@ class CLIPManager:
     def initialize(self) -> None:
         if self._initialized:
             return
-        logger.info("loading CLIP weights from %s", self.model_dir)
-        state = load_state_dict(self.model_dir)
-        init = jax.eval_shape(
-            lambda: self.model.init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32),
-                jnp.zeros((1, self.cfg.context_length), jnp.int32),
-            )["params"]
-        )
-        params = convert_clip_checkpoint(state, init)
-        params = self.policy.cast_params(params)
-        # DP serving: params replicated over the mesh; micro-batches are
-        # data-sharded so one batched call spreads across every device
-        # (trivial placement on a 1-device mesh).
         from ...parallel.sharding import replicate
-
-        self.params = replicate(params, self.mesh)
-        self.tokenizer = ClipTokenizer.from_model_dir(self.model_dir, self.cfg.serving_text_length)
 
         mean, std = self.norm_stats
         compute_dtype = self.policy.compute_dtype
+        backend = str(self.info.extra("clip_backend", "auto") or "auto")
 
-        @jax.jit
-        def encode_images(params, pixels_u8):
-            # pixels_u8: [B, S, S, 3] uint8 (resized on host or device-resized
-            # upstream); normalize + cast on device.
-            x = pixels_u8.astype(jnp.float32) / 255.0
-            x = (x - jnp.asarray(mean)) / jnp.asarray(std)
-            z = self.model.apply(
-                {"params": params},
-                x.astype(compute_dtype),
-                method=lambda m, px: m.encode_image(px),
-            )
-            return z  # fp32 unit-norm
-
-        @jax.jit
-        def encode_texts(params, ids):
-            return self.model.apply(
-                {"params": params}, ids, method=lambda m, i: m.encode_text(i)
+        state = None
+        if backend != "graph" and (self._graphs is None or backend == "native"):
+            # clip_backend=native must reach for a real checkpoint even when
+            # _build_config already derived a graph config (export-only dir).
+            try:
+                logger.info("loading CLIP weights from %s", self.model_dir)
+                state = load_state_dict(self.model_dir)
+            except FileNotFoundError:
+                if backend == "native" or self._load_graphs(self.model_dir) is None:
+                    raise
+                logger.info("no native CLIP checkpoint; serving onnx towers")
+        if backend == "graph" and self._load_graphs(self.model_dir) is None:
+            raise FileNotFoundError(
+                f"clip_backend=graph but no vision/text onnx in {self.model_dir}"
             )
 
+        if state is not None:
+            init = jax.eval_shape(
+                lambda: self.model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32),
+                    jnp.zeros((1, self.cfg.context_length), jnp.int32),
+                )["params"]
+            )
+            params = convert_clip_checkpoint(state, init)
+            params = self.policy.cast_params(params)
+            # DP serving: params replicated over the mesh; micro-batches are
+            # data-sharded so one batched call spreads across every device
+            # (trivial placement on a 1-device mesh).
+            self.params = replicate(params, self.mesh)
+
+            @jax.jit
+            def encode_images(params, pixels_u8):
+                # pixels_u8: [B, S, S, 3] uint8 (resized on host or device-
+                # resized upstream); normalize + cast on device.
+                x = pixels_u8.astype(jnp.float32) / 255.0
+                x = (x - jnp.asarray(mean)) / jnp.asarray(std)
+                z = self.model.apply(
+                    {"params": params},
+                    x.astype(compute_dtype),
+                    method=lambda m, px: m.encode_image(px),
+                )
+                return z  # fp32 unit-norm
+
+            @jax.jit
+            def encode_texts(params, ids):
+                return self.model.apply(
+                    {"params": params}, ids, method=lambda m, i: m.encode_text(i)
+                )
+
+        else:
+            # Graph towers: the exporter's own weights as XLA programs; the
+            # manager normalizes outputs host-of-device-side exactly like
+            # the reference session path (``onnxrt_backend.py:486-489``).
+            import dataclasses
+
+            vision_graph, text_graph = self._graphs
+            # Reconcile serving shapes with the exports' STATIC shapes even
+            # when a config.json supplied the cfg (a text export built at
+            # 52 tokens cannot run 77-padded ids; the vision export's input
+            # side fixes the resize target).
+            vshape = next(iter(vision_graph.module.input_shapes().values()), ())
+            updates: dict = {}
+            if len(vshape) == 4 and isinstance(vshape[-1], int) and vshape[-1] > 0:
+                updates["image_size"] = int(vshape[-1])
+            ctx = text_graph.context_length(self.cfg.context_length)
+            if ctx != self.cfg.context_length:
+                updates["context_length"] = ctx
+                updates["text_serving_length"] = None
+            dim = vision_graph.probe_dim(
+                np.zeros(
+                    (1, 3, updates.get("image_size", self.cfg.image_size),
+                     updates.get("image_size", self.cfg.image_size)), np.float32
+                )
+            )
+            if dim != self.cfg.embed_dim:
+                logger.info("graph towers emit %d-d embeddings (config said %d)", dim, self.cfg.embed_dim)
+                updates["embed_dim"] = dim
+            if updates:
+                self.cfg = dataclasses.replace(self.cfg, **updates)
+            self.params = replicate(
+                {
+                    "vision": dict(vision_graph.module.params),
+                    "text": dict(text_graph.module.params),
+                },
+                self.mesh,
+            )
+            # The jitted closures only need the graph TOPOLOGY; drop the
+            # host-RAM weight copies now that the mesh holds them.
+            vision_graph.module.params = {}
+            text_graph.module.params = {}
+
+            @jax.jit
+            def encode_images(params, pixels_u8):
+                x = pixels_u8.astype(jnp.float32) / 255.0
+                x = (x - jnp.asarray(mean)) / jnp.asarray(std)
+                z = vision_graph(params["vision"], x.transpose(0, 3, 1, 2))
+                z = z.astype(jnp.float32)
+                return z / jnp.maximum(jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-12)
+
+            @jax.jit
+            def encode_texts(params, ids):
+                z = text_graph(params["text"], ids).astype(jnp.float32)
+                return z / jnp.maximum(jnp.linalg.norm(z, axis=-1, keepdims=True), 1e-12)
+
+        self.tokenizer = ClipTokenizer.from_model_dir(self.model_dir, self.cfg.serving_text_length)
         self._encode_images = encode_images
         self._encode_texts = encode_texts
 
@@ -367,9 +476,7 @@ class CLIPManager:
             # (reference: clip_model.py:232-317; temperature = logit scale
             # unless the caller pins one, e.g. the scene path's 1.0).
             if temperature is None:
-                temperature = float(
-                    np.exp(np.asarray(self.params["logit_scale"], np.float32))
-                )
+                temperature = self.temperature()
             logits = sims * temperature
             logits -= logits.max()
             probs = np.exp(logits)
@@ -394,4 +501,14 @@ class CLIPManager:
         return vec / n
 
     def temperature(self) -> float:
-        return float(np.exp(np.asarray(self.params["logit_scale"], np.float32)))
+        """Exported logit scale (exp'd). Graph-served towers carry no
+        logit_scale param — ONNX exports don't ship the temperature, same
+        as the reference's session path whose ``get_temperature`` is
+        optional (``base.py:254-270``) — so the fallback chain is
+        model_info ``extra.logit_scale`` then the CLIP-standard 100."""
+        if "logit_scale" in self.params:
+            return float(np.exp(np.asarray(self.params["logit_scale"], np.float32)))
+        extra = self.info.extra("logit_scale")
+        if extra is not None:
+            return float(np.exp(float(extra)))
+        return 100.0

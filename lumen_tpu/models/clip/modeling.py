@@ -82,11 +82,11 @@ class CLIPConfig:
         ChineseCLIP (CN-CLIP) configs are recognized by their BERT-shaped
         text_config and mapped to the ``bert`` text arch."""
         v, t = cfg["vision_config"], cfg["text_config"]
-        is_bert = (
+        is_cnclip = (
             cfg.get("model_type") == "chinese_clip"
             or t.get("model_type") == "chinese_clip_text_model"
-            or "type_vocab_size" in t
         )
+        is_bert = is_cnclip or "type_vocab_size" in t
         return cls(
             embed_dim=cfg.get("projection_dim", 512),
             image_size=v.get("image_size", 224),
@@ -113,8 +113,10 @@ class CLIPConfig:
             text_layer_norm_eps=t.get("layer_norm_eps", 1e-12) if is_bert else None,
             pad_token_id=t.get("pad_token_id", 0),
             # CN-CLIP's published context is 52 tokens; pad to that, not to
-            # the checkpoint's 512-row position table.
-            text_serving_length=52 if is_bert else None,
+            # the checkpoint's 512-row position table. Generic BERT-text
+            # CLIPs keep their full context (overridable via model_info
+            # extra.text_serving_length in the manager).
+            text_serving_length=52 if is_cnclip else None,
         )
 
 

@@ -1,0 +1,154 @@
+"""Graph-served CLIP: exported ``vision.onnx`` + ``text.onnx`` towers
+through the ONNX bridge — the reference's PRIMARY CLIP execution model
+(dual onnxruntime sessions, ``packages/lumen-clip/src/lumen_clip/backends/
+onnxrt_backend.py:72-745``). This is the weight path for model families
+with no conversion rules: MobileCLIP2's FastViT-hybrid vision tower (the
+region=other config default) and any distilled/exported variant.
+
+Parity oracle: the torch modules the ONNX was exported from.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from tests.clip_fixtures import png_bytes  # noqa: E402
+from tests.test_onnx_bridge import export_onnx  # noqa: E402
+
+EMBED = 24
+IMG = 32
+CTX = 12
+VOCAB = 128  # fixture tokenizer's <eot> id is 127
+
+
+class MobileStyleVisionTower(nn.Module):
+    """Conv-heavy hybrid (MobileCLIP flavor): not convertible by ViT rules,
+    must run through the bridge. [B,3,32,32] -> [B, EMBED]."""
+
+    def __init__(self):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, 16, 3, 2, 1), nn.BatchNorm2d(16), nn.GELU(),
+            nn.Conv2d(16, 16, 3, 1, 1, groups=16), nn.Conv2d(16, 32, 1), nn.GELU(),
+        )
+        self.head = nn.Linear(32, EMBED)
+
+    def forward(self, x):
+        f = self.stem(x).mean((2, 3))  # [B, 32]
+        return self.head(f)
+
+
+class TinyTextTower(nn.Module):
+    """[B, CTX] ids -> [B, EMBED] (embedding mean + linear)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(VOCAB, 32)
+        self.fc = nn.Linear(32, EMBED)
+
+    def forward(self, ids):
+        return self.fc(self.emb(ids).mean(1))
+
+
+def make_export_dir(tmp_path) -> tuple[str, nn.Module, nn.Module]:
+    torch.manual_seed(0)
+    vt, tt = MobileStyleVisionTower().eval(), TinyTextTower().eval()
+    d = pathlib.Path(tmp_path) / "models" / "TinyMobileCLIP"
+    d.mkdir(parents=True)
+    export_onnx(vt, torch.zeros(2, 3, IMG, IMG), str(d / "vision.fp32.onnx"))
+    export_onnx(tt, torch.zeros(2, CTX, dtype=torch.int64), str(d / "text.fp32.onnx"))
+    # No config.json on purpose: export-only repos derive shapes from the
+    # graphs. Tokenizer comes from a minimal tokenizer.json.
+    from tests.clip_fixtures import write_tiny_tokenizer
+
+    write_tiny_tokenizer(str(d / "tokenizer.json"))
+    (d / "model_info.json").write_text(json.dumps({
+        "name": "TinyMobileCLIP", "version": "1.0.0",
+        "description": "exported towers", "model_type": "clip",
+        "embedding_dim": EMBED,
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/TinyMobileCLIP"},
+        "runtimes": {"onnx": {"available": True, "files": ["vision.fp32.onnx", "text.fp32.onnx"]}},
+    }))
+    return str(d), vt, tt
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    from lumen_tpu.models.clip import CLIPManager
+
+    d, vt, tt = make_export_dir(tmp_path_factory.mktemp("clipgraph"))
+    mgr = CLIPManager(d, dtype="float32", batch_size=2)
+    mgr.initialize()
+    yield mgr, vt, tt
+    mgr.close()
+
+
+class TestClipGraphServing:
+    def test_config_derived_from_graphs(self, served):
+        mgr, _, _ = served
+        assert mgr.cfg.image_size == IMG
+        assert mgr.cfg.context_length == CTX
+        assert mgr.cfg.embed_dim == EMBED
+
+    def test_image_embed_matches_torch(self, served):
+        mgr, vt, _ = served
+        img = png_bytes(size=40, seed=1)
+        vec = mgr.encode_image(img)
+        assert vec.shape == (EMBED,)
+        # Same preprocessing host-side, through the torch oracle.
+        import cv2
+
+        arr = cv2.imdecode(np.frombuffer(img, np.uint8), cv2.IMREAD_COLOR)[:, :, ::-1]
+        resized = cv2.resize(arr, (IMG, IMG), interpolation=cv2.INTER_LINEAR)
+        mean, std = mgr.norm_stats
+        x = (resized.astype(np.float32) / 255.0 - np.asarray(mean)) / np.asarray(std)
+        with torch.no_grad():
+            want = vt(torch.from_numpy(x.transpose(2, 0, 1)[None].astype(np.float32))).numpy()[0]
+        want = want / np.linalg.norm(want)
+        np.testing.assert_allclose(vec, want, atol=1e-4, rtol=1e-3)
+
+    def test_text_embed_matches_torch(self, served):
+        mgr, _, tt = served
+        vec = mgr.encode_text("a photo")
+        assert abs(float(np.linalg.norm(vec)) - 1.0) < 1e-5
+        ids = mgr.tokenizer.encode_batch(["a photo"])
+        with torch.no_grad():
+            want = tt(torch.from_numpy(ids.astype(np.int64))).numpy()[0]
+        want = want / np.linalg.norm(want)
+        np.testing.assert_allclose(vec, want, atol=1e-4, rtol=1e-3)
+
+    def test_graph_backend_forced_without_onnx_raises(self, tmp_path):
+        from lumen_tpu.models.clip import CLIPManager
+
+        d = pathlib.Path(tmp_path) / "models" / "Empty"
+        d.mkdir(parents=True)
+        (d / "model_info.json").write_text(json.dumps({
+            "name": "Empty", "version": "1.0.0", "description": "x",
+            "model_type": "clip",
+            "source": {"format": "custom", "repo_id": "LumilioPhotos/Empty"},
+            "runtimes": {"jax": {"available": True, "files": []}},
+            "extra_metadata": {"clip_backend": "graph"},
+        }))
+        with pytest.raises(FileNotFoundError):
+            CLIPManager(str(d), dtype="float32")
+
+    def test_classify_without_logit_scale_uses_fallback_temperature(self, served):
+        """Graph towers ship no logit_scale param; classify must fall back
+        (review finding: KeyError on the softmax path)."""
+        import jax.numpy as jnp
+
+        mgr, _, _ = served
+        assert mgr.temperature() == 100.0  # CLIP-standard fallback
+        labels = ["cat", "dog"]
+        mat = jnp.stack([jnp.asarray(mgr.encode_text(f"a photo {l}")) for l in labels])
+        vec = mgr.encode_text("a photo cat")
+        res = mgr._classify_vector(vec, labels, mat, top_k=2)
+        assert len(res.labels) == 2
+        assert abs(sum(s for _, s in res.labels) - 1.0) < 1e-5  # softmax'd
