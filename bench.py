@@ -129,10 +129,15 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     }
 
 
-def phase_vlm(batch: int = 8, new_tokens: int = 64) -> dict:
+def phase_vlm(batch: int = 8, new_tokens: int = 64, quantize: bool = False) -> dict:
     """Fused-decode tokens/sec on a Qwen2-0.5B-shaped decoder (the realistic
-    small-VLM size; random weights — perf only depends on shapes)."""
+    small-VLM size; random weights — perf only depends on shapes). With
+    ``quantize``, the decoder's projections run weight-only int8
+    (``quantize_decoder_int8``) — decode is weight-streaming-bound, so this
+    measures the bandwidth win directly."""
     _apply_platform_env()
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -173,6 +178,14 @@ def phase_vlm(batch: int = 8, new_tokens: int = 64) -> dict:
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
     )
+    if quantize:
+        from lumen_tpu.models.vlm.convert import quantize_decoder_int8
+
+        cfg = dataclasses.replace(
+            cfg, decoder=dataclasses.replace(cfg.decoder, weight_quant="int8")
+        )
+        model = VLMModel(cfg)
+        params = quantize_decoder_int8(jax.tree.map(np.asarray, params))
     gen = Generator(model, cfg, max_seq=prompt_len + new_tokens, max_new_cap=new_tokens)
 
     embeds = jnp.asarray(
@@ -200,8 +213,13 @@ def phase_vlm(batch: int = 8, new_tokens: int = 64) -> dict:
     return {
         "tokens_per_sec": round(total / dt, 1),
         "batch": batch,
+        "quantize": "int8" if quantize else None,
         "platform": jax.devices()[0].platform,
     }
+
+
+def phase_vlm_q8() -> dict:
+    return phase_vlm(quantize=True)
 
 
 def phase_ingest(n_images: int = 256) -> dict:
@@ -516,6 +534,7 @@ PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
     "vlm": phase_vlm,
+    "vlm_q8": phase_vlm_q8,
     "face": phase_face,
     "ocr": phase_ocr,
     "ingest": phase_ingest,
@@ -636,7 +655,7 @@ def main(args) -> None:
     # driver invocation stays well inside its time budget.
     full = args.full or os.environ.get("BENCH_FULL") == "1"
     names = ["probe", "clip"] + (
-        ["vlm", "face", "ocr", "ingest", "flash_ab"] if full else []
+        ["vlm", "vlm_q8", "face", "ocr", "ingest", "flash_ab"] if full else []
     )
     # BENCH_TIMEOUT is per heavyweight phase (probe is trivial); the group
     # shares one budget so slow-but-working later phases aren't killed by
@@ -658,6 +677,13 @@ def main(args) -> None:
         extras["vlm_decode_tokens_per_sec"] = vlm.get("tokens_per_sec")
         extras["vlm_batch"] = vlm.get("batch")
         extras["vlm_platform"] = vlm.get("platform")
+    vlm_q8 = results.get("vlm_q8")
+    if vlm_q8:
+        extras["vlm_q8_decode_tokens_per_sec"] = vlm_q8.get("tokens_per_sec")
+        if vlm and vlm.get("tokens_per_sec"):
+            extras["vlm_q8_speedup"] = round(
+                vlm_q8.get("tokens_per_sec", 0) / vlm["tokens_per_sec"], 3
+            )
     face = results.get("face")
     if face:
         extras["face_detect_images_per_sec"] = face.get("images_per_sec")

@@ -23,42 +23,17 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ...onnx_bridge import OnnxModule
+from ...onnx_bridge import OnnxModule, find_onnx_exports
 
 logger = logging.getLogger(__name__)
 
-_PRECISION_ORDER = ["fp32", "fp16"]  # reference preference chain (:245-289)
-
 
 def find_clip_onnx(model_dir: str, precision: str | None = None) -> dict[str, str]:
-    """Locate ``vision*.onnx`` / ``text*.onnx`` (bare dir or ``onnx/``
-    subdir), preferring the requested precision then fp32 then fp16 —
-    the reference's file-pick chain."""
-    names = sorted(os.listdir(model_dir)) if os.path.isdir(model_dir) else []
-    sub = os.path.join(model_dir, "onnx")
-    if os.path.isdir(sub):
-        names += [os.path.join("onnx", n) for n in sorted(os.listdir(sub))]
-
-    order = [precision] if precision else []
-    order += [p for p in _PRECISION_ORDER if p not in order]
-    found: dict[str, str] = {}
-    for kind, prefix in (("vision", "vision"), ("text", "text")):
-        candidates = [
-            n for n in names
-            if n.endswith(".onnx") and os.path.basename(n).startswith(prefix)
-        ]
-        if not candidates:
-            continue
-
-        def rank(name: str) -> tuple:
-            base = os.path.basename(name)
-            for i, prec in enumerate(order):
-                if f".{prec}." in base:
-                    return (i, base)
-            return (len(order), base)  # bare vision.onnx / text.onnx
-
-        found[kind] = os.path.join(model_dir, sorted(candidates, key=rank)[0])
-    return found
+    """Locate ``vision*.onnx`` / ``text*.onnx`` with the reference's
+    precision-preference chain (shared discovery helper)."""
+    return find_onnx_exports(
+        model_dir, {"vision": "vision", "text": "text"}, precision
+    )
 
 
 @dataclass

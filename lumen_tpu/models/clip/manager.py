@@ -259,9 +259,11 @@ class CLIPManager:
             if len(vshape) == 4 and isinstance(vshape[-1], int) and vshape[-1] > 0:
                 updates["image_size"] = int(vshape[-1])
             ctx = text_graph.context_length(self.cfg.context_length)
-            if ctx != self.cfg.context_length:
-                updates["context_length"] = ctx
-                updates["text_serving_length"] = None
+            updates["context_length"] = ctx
+            # A static export runs at exactly its built length — any pad
+            # cap (config- OR model_info-supplied) shorter than that would
+            # feed shapes the graph's fixed ops can't take.
+            updates["text_serving_length"] = None
             dim = vision_graph.probe_dim(
                 np.zeros(
                     (1, 3, updates.get("image_size", self.cfg.image_size),
@@ -281,9 +283,10 @@ class CLIPManager:
                 self.mesh,
             )
             # The jitted closures only need the graph TOPOLOGY; drop the
-            # host-RAM weight copies now that the mesh holds them.
-            vision_graph.module.params = {}
-            text_graph.module.params = {}
+            # host-RAM weight copies (params AND the aliasing initializers)
+            # now that the mesh holds them.
+            vision_graph.module.release_weights()
+            text_graph.module.release_weights()
 
             @jax.jit
             def encode_images(params, pixels_u8):

@@ -184,6 +184,7 @@ class FaceManager:
             graph_det = ScrfdGraph.from_path(onnx_models["detection"], num_anchors=det_cfg.num_anchors)
             self.det_vars = replicate(dict(graph_det.module.params), self.mesh)
             logger.info("face detector: SCRFD graph %s (%d MB params)", onnx_models["detection"], graph_det.module.param_bytes() >> 20)
+            graph_det.module.release_weights()  # mesh holds the weights now
 
             @jax.jit
             def run_detector(variables, images_u8):
@@ -221,6 +222,7 @@ class FaceManager:
             graph_rec = ArcFaceGraph.from_path(onnx_models["recognition"])
             self.rec_vars = replicate(dict(graph_rec.module.params), self.mesh)
             logger.info("face embedder: ArcFace graph %s", onnx_models["recognition"])
+            graph_rec.module.release_weights()  # mesh holds the weights now
 
             @jax.jit
             def run_embedder(variables, crops_u8):

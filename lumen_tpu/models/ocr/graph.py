@@ -17,40 +17,17 @@ import logging
 import os
 from dataclasses import dataclass
 
-from ...onnx_bridge import OnnxModule
+from ...onnx_bridge import OnnxModule, find_onnx_exports
 
 logger = logging.getLogger(__name__)
 
-_PRECISION_ORDER = ("fp32", "fp16")
-
 
 def find_onnx_models(model_dir: str, precision: str | None = None) -> dict[str, str]:
-    """Locate det/rec ``.onnx`` files in a model dir. Returns a dict with
-    any of the keys ``detection`` / ``recognition``."""
-    names = sorted(os.listdir(model_dir)) if os.path.isdir(model_dir) else []
-    # Also look inside an ``onnx/`` runtime subdir (reference layout keeps
-    # onnx files under the runtime directory, ``resources/loader.py:164``).
-    sub = os.path.join(model_dir, "onnx")
-    if os.path.isdir(sub):
-        names += [os.path.join("onnx", n) for n in sorted(os.listdir(sub))]
-
-    found: dict[str, str] = {}
-    order = [precision] if precision else []
-    order += [p for p in _PRECISION_ORDER if p not in order]
-    for kind, prefix in (("detection", "det"), ("recognition", "rec")):
-        candidates = [n for n in names if n.endswith(".onnx") and os.path.basename(n).startswith(prefix)]
-        if not candidates:
-            continue
-
-        def rank(name: str) -> tuple:
-            base = os.path.basename(name)
-            for i, prec in enumerate(order):
-                if f".{prec}." in base:
-                    return (i, base)
-            return (len(order), base)  # bare detection.onnx etc.
-
-        found[kind] = os.path.join(model_dir, sorted(candidates, key=rank)[0])
-    return found
+    """Locate det/rec ``.onnx`` files (shared precision-chain discovery).
+    Returns a dict with any of the keys ``detection`` / ``recognition``."""
+    return find_onnx_exports(
+        model_dir, {"detection": "det", "recognition": "rec"}, precision
+    )
 
 
 def _ends_in_softmax(module: OnnxModule, output_name: str) -> bool:

@@ -187,6 +187,7 @@ class OcrManager:
             self.det_vars = jax.device_put(dict(graph_det.module.params))
             logger.info("ocr detector: DBNet graph %s (%d MB params)",
                         onnx_models["detection"], graph_det.module.param_bytes() >> 20)
+            graph_det.module.release_weights()  # device holds the weights now
 
             @jax.jit
             def run_detector(variables, images_u8):
@@ -217,6 +218,7 @@ class OcrManager:
         if "recognition" in onnx_models:
             graph_rec = RecGraph.from_path(onnx_models["recognition"])
             self.rec_vars = jax.device_put(dict(graph_rec.module.params))
+            graph_rec.module.release_weights()  # device holds the weights now
             logger.info("ocr recognizer: graph %s (softmax output: %s)",
                         onnx_models["recognition"], graph_rec.outputs_probs)
 

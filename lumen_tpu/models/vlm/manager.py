@@ -356,7 +356,7 @@ class VLMManager:
             # The host fp32 copy is duplicated on device now; the compiled
             # program receives weights via the vparams argument, so free
             # the originals instead of pinning them in the closure.
-            vision_graph.module.params.clear()
+            vision_graph.module.release_weights()
         # A prompt bucket is usable only if prompt + vision tokens + the
         # decode budget fit in the KV buffer.
         v = self.vision_tokens

@@ -2,7 +2,8 @@
 SCRFD/ArcFace packs, PP-OCR det/rec) as jittable XLA programs with a real
 params pytree — no onnxruntime, no foreign runtime in the serving path."""
 
+from .discovery import find_onnx_exports
 from .executor import OnnxModule
 from .proto import OnnxGraph, load_onnx, parse_onnx
 
-__all__ = ["OnnxModule", "OnnxGraph", "load_onnx", "parse_onnx"]
+__all__ = ["OnnxModule", "OnnxGraph", "load_onnx", "parse_onnx", "find_onnx_exports"]

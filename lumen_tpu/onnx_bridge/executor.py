@@ -85,6 +85,16 @@ class OnnxModule:
     def param_bytes(self) -> int:
         return sum(a.nbytes for a in self.params.values())
 
+    def release_weights(self) -> None:
+        """Drop the host-RAM weight arrays once a device/mesh copy exists.
+        Clearing ``params`` alone frees nothing: for fp32 exports the
+        entries are no-copy aliases of ``graph.initializers``, which the
+        jitted closures keep alive through the module — both references
+        must go."""
+        for name in list(self.params):
+            self.graph.initializers.pop(name, None)
+        self.params.clear()
+
     # -- execution ---------------------------------------------------------
 
     def __call__(self, params: dict, inputs: dict):

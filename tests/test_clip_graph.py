@@ -125,16 +125,35 @@ class TestClipGraphServing:
         np.testing.assert_allclose(vec, want, atol=1e-4, rtol=1e-3)
 
     def test_graph_backend_forced_without_onnx_raises(self, tmp_path):
+        """With a config.json present, construction succeeds and
+        initialize() must hit the clip_backend=graph guard itself."""
         from lumen_tpu.models.clip import CLIPManager
+        from tests.clip_fixtures import make_tiny_hf_clip
 
         d = pathlib.Path(tmp_path) / "models" / "Empty"
         d.mkdir(parents=True)
+        (d / "config.json").write_text(json.dumps(make_tiny_hf_clip().config.to_dict()))
         (d / "model_info.json").write_text(json.dumps({
             "name": "Empty", "version": "1.0.0", "description": "x",
             "model_type": "clip",
             "source": {"format": "custom", "repo_id": "LumilioPhotos/Empty"},
             "runtimes": {"jax": {"available": True, "files": []}},
             "extra_metadata": {"clip_backend": "graph"},
+        }))
+        mgr = CLIPManager(str(d), dtype="float32")
+        with pytest.raises(FileNotFoundError, match="clip_backend=graph"):
+            mgr.initialize()
+
+    def test_no_config_and_no_towers_raises(self, tmp_path):
+        from lumen_tpu.models.clip import CLIPManager
+
+        d = pathlib.Path(tmp_path) / "models" / "Bare"
+        d.mkdir(parents=True)
+        (d / "model_info.json").write_text(json.dumps({
+            "name": "Bare", "version": "1.0.0", "description": "x",
+            "model_type": "clip",
+            "source": {"format": "custom", "repo_id": "LumilioPhotos/Bare"},
+            "runtimes": {"jax": {"available": True, "files": []}},
         }))
         with pytest.raises(FileNotFoundError):
             CLIPManager(str(d), dtype="float32")
