@@ -71,6 +71,7 @@ export function renderInstall(root, onLeave) {
         download,
         config_path: download ? wizard.state.configPath : null,
         cache_dir: wizard.state.cacheDir,
+        region: wizard.state.region, // cn routes pip through a mirror
       });
       wizard.update({ installTaskId: task.task_id, installDone: false });
       root.querySelector("#inst-cancel").disabled = false;
