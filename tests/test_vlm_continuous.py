@@ -192,3 +192,86 @@ class TestContinuousCorrectness:
         while sched._slots and time.time() < deadline:
             time.sleep(0.01)
         assert not sched._slots, "cancelled stream's slot never freed"
+
+
+class TestPoolInvalidationEscalation:
+    def test_failed_donated_admit_fails_all_and_strands_nobody(self, model_dir):
+        """When _admit dies AFTER the donation consumed the pool buffers,
+        the scheduler must fail every in-flight AND same-batch request
+        (futures resolved, _STREAM_END delivered) instead of stranding
+        callers or serving from deleted arrays."""
+        import queue as queue_mod
+        from concurrent.futures import Future
+
+        import jax
+
+        from lumen_tpu.models.vlm.continuous import ContinuousScheduler, _Request
+
+        mgr = VLMManager(
+            model_dir,
+            dtype="float32",
+            max_seq=128,
+            max_new_cap=8,
+            prefill_buckets=(16,),
+            scheduler="continuous",
+            gen_slots=2,
+            gen_block=2,
+        )
+        mgr.initialize()
+        try:
+            sched: ContinuousScheduler = mgr._continuous
+
+            # A working request first proves the scheduler is live.
+            ok = mgr.generate([ChatMessage(role="user", content="warm")], max_new_tokens=2)
+            assert ok.tokens is not None
+
+            # Sabotage: _admit consumes (donates) the pool, then raises.
+            real_admit = sched.gen._admit
+
+            def bad_admit(pool, *a, **kw):
+                jax.tree.map(
+                    lambda leaf: leaf.delete() if hasattr(leaf, "delete") else None, pool
+                )
+                raise RuntimeError("synthetic admit failure after donation")
+
+            sched.gen._admit = bad_admit
+
+            def make_req(stream=False):
+                r = _Request(
+                    embeds=None, positions=None, length=None, prompt_ids=None,
+                    max_new=4, temperature=0.0, top_p=1.0, do_sample=False,
+                    repetition_penalty=1.0, rng=jax.random.PRNGKey(0),
+                    future=Future(),
+                )
+                # Bypass prefill shape plumbing: feed the prepared tensors a
+                # real request would carry (reuse the manager's prepare).
+                prepared = mgr._prepare_inputs(
+                    [ChatMessage(role="user", content="x")], None
+                )
+                emb, pos, ln, ids = prepared[:4]
+                r.embeds, r.positions, r.length, r.prompt_ids = emb, pos, ln, ids
+                if stream:
+                    r.stream_q = queue_mod.SimpleQueue()
+                return r
+
+            r1, r2 = make_req(), make_req(stream=True)
+            sched.submit(r1)
+            sched.submit(r2)
+            with pytest.raises(RuntimeError):
+                r1.future.result(timeout=30)
+            with pytest.raises(RuntimeError):
+                r2.future.result(timeout=30)
+            # Stream consumer gets its end sentinel — no stranding.
+            from lumen_tpu.models.vlm.continuous import _STREAM_END
+
+            assert r2.stream_q.get(timeout=10) is _STREAM_END
+            # Scheduler is dead-closed; new submits are rejected loudly.
+            # (Wait for the loop thread to finish its death sweep first —
+            # a submit racing the sweep is accepted and failed by the
+            # sweep instead, which is also correct but not this assert.)
+            sched._thread.join(timeout=10)
+            sched.gen._admit = real_admit
+            with pytest.raises(RuntimeError, match="closed"):
+                sched.submit(make_req())
+        finally:
+            mgr.close()
