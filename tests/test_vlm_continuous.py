@@ -255,8 +255,12 @@ class TestPoolInvalidationEscalation:
                 return r
 
             r1, r2 = make_req(), make_req(stream=True)
-            sched.submit(r1)
-            sched.submit(r2)
+            # Enqueue both atomically: submitting one at a time races the
+            # loop (it can admit r1, die, and close the queue before the
+            # second submit, which would then raise outside the asserts).
+            with sched._cond:
+                sched._pending.extend([r1, r2])
+                sched._cond.notify()
             with pytest.raises(RuntimeError):
                 r1.future.result(timeout=30)
             with pytest.raises(RuntimeError):
