@@ -389,6 +389,26 @@ def attention(
     return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
 
 
+#: decode KV-bucket ladder starts here; caches at or below this length are
+#: read whole (the switch overhead wouldn't pay).
+_RAGGED_DECODE_MIN = 256
+
+
+def _ragged_decode_enabled() -> bool:
+    return os.environ.get("LUMEN_RAGGED_DECODE", "1") != "0"
+
+
+def _decode_masked(q, k, v, q_offsets, kv_valid, scale):
+    """Masked reference attention for the [Sq small] cache path."""
+    sq, sk = q.shape[2], k.shape[2]
+    key_slots = jnp.arange(sk)
+    q_abs = q_offsets[:, None] + jnp.arange(sq)[None, :]  # [B, Sq]
+    live = key_slots[None, :] < kv_valid[:, None]  # [B, Sk]
+    causal = key_slots[None, None, :] <= q_abs[:, :, None]  # [B, Sq, Sk]
+    mask = (live[:, None, :] & causal)[:, None]  # [B, 1, Sq, Sk]
+    return attention_reference(q, k, v, mask=mask, scale=scale)
+
+
 def attention_cached(
     q: jax.Array,
     k: jax.Array,
@@ -400,19 +420,47 @@ def attention_cached(
 ) -> jax.Array:
     """Cache-path dispatch: the Pallas cache kernel when profitable (prefill-
     size query blocks on TPU), else the XLA reference with the equivalent
-    [B, 1, Sq, Sk] mask. Single-token decode stays on XLA — a [B,H,1,K]
-    product is bandwidth-bound and gains nothing from the kernel."""
+    [B, 1, Sq, Sk] mask.
+
+    Single-token decode additionally applies RAGGED KV BUCKETING: the
+    cache buffer is allocated at ``max_seq`` but a step only needs the
+    live prefix, and a decode step's cost IS streaming the KV bytes. A
+    ``lax.switch`` over a doubling ladder of static prefix lengths makes
+    each step read ~``max(kv_valid)`` worth of cache instead of the whole
+    buffer (the XLA-native slice of TPU paged attention's dead-block
+    skip; disable with ``LUMEN_RAGGED_DECODE=0``). All branches share
+    output shapes, so the switch compiles once inside the decode loop.
+    """
     sq, sk = q.shape[2], k.shape[2]
     if _flash_usable(q.shape[-1], None) and sq >= min_flash_q:
         return flash_attention_cache(
             q, k, v, q_offsets, kv_valid, scale=scale, interpret=_interpret_mode()
         )
-    key_slots = jnp.arange(sk)
-    q_abs = q_offsets[:, None] + jnp.arange(sq)[None, :]  # [B, Sq]
-    live = key_slots[None, :] < kv_valid[:, None]  # [B, Sk]
-    causal = key_slots[None, None, :] <= q_abs[:, :, None]  # [B, Sq, Sk]
-    mask = (live[:, None, :] & causal)[:, None]  # [B, 1, Sq, Sk]
-    return attention_reference(q, k, v, mask=mask, scale=scale)
+    if sq == 1 and sk > _RAGGED_DECODE_MIN and _ragged_decode_enabled():
+        ladder = []
+        length = _RAGGED_DECODE_MIN
+        while length < sk:
+            ladder.append(length)
+            length *= 2
+        ladder.append(sk)
+        # Keys a decode step may attend: the live prefix (decode writes in
+        # order, so slot indices >= kv_valid are dead for every row).
+        bound = jnp.max(kv_valid)
+        idx = jnp.searchsorted(jnp.asarray(ladder), bound, side="left")
+
+        def branch(prefix_len):
+            def run(q, k, v, q_offsets, kv_valid):
+                return _decode_masked(
+                    q, k[:, :, :prefix_len], v[:, :, :prefix_len],
+                    q_offsets, kv_valid, scale,
+                )
+
+            return run
+
+        return jax.lax.switch(
+            idx, [branch(n) for n in ladder], q, k, v, q_offsets, kv_valid
+        )
+    return _decode_masked(q, k, v, q_offsets, kv_valid, scale)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

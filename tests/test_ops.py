@@ -297,3 +297,48 @@ class TestShardingNamedtuplePytree:
         mesh = build_mesh({"data": -1})
         sharded = shard_params({"layer": Params(kernel=jnp.ones((4, 4)))}, mesh, TRANSFORMER_TP_RULES)
         assert sharded["layer"].kernel.shape == (4, 4)
+
+
+class TestRaggedDecodeBuckets:
+    """Decode-path KV bucketing must be invisible in outputs: only the
+    bytes read change."""
+
+    def _run(self, sk, valids):
+        from lumen_tpu.ops.attention import attention_cached
+
+        b, h, d = len(valids), 4, 32
+        q, k, v = rand_qkv(jax.random.PRNGKey(0), b=b, h=h, sq=1, sk=sk, d=d)
+        q_off = jnp.asarray([v - 1 for v in valids], jnp.int32)
+        kv_valid = jnp.asarray(valids, jnp.int32)
+        return attention_cached(q, k, v, q_off, kv_valid)
+
+    @pytest.mark.parametrize(
+        "valids", [[1, 2], [255, 256], [257, 100], [512, 513], [1024, 7], [2048, 2048]]
+    )
+    def test_matches_unbucketed_across_boundaries(self, valids, monkeypatch):
+        sk = 2048
+        monkeypatch.setenv("LUMEN_RAGGED_DECODE", "1")  # pin: env may carry the kill switch
+        bucketed = self._run(sk, valids)
+        monkeypatch.setenv("LUMEN_RAGGED_DECODE", "0")
+        plain = self._run(sk, valids)
+        np.testing.assert_allclose(
+            np.asarray(bucketed), np.asarray(plain), atol=2e-6, rtol=2e-6
+        )
+
+    def test_jit_and_scan_compatible(self):
+        """The switch must compile inside a scan (the decode-loop shape)."""
+        from lumen_tpu.ops.attention import attention_cached
+
+        b, h, sk, d = 2, 2, 512, 16
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), b=b, h=h, sq=1, sk=sk, d=d)
+
+        def step(carry, t):
+            out = attention_cached(
+                q, k, v, jnp.full((b,), t, jnp.int32), jnp.full((b,), t + 1, jnp.int32)
+            )
+            return carry + out.sum(), None
+
+        total, _ = jax.jit(
+            lambda: jax.lax.scan(step, jnp.zeros(()), jnp.arange(8, dtype=jnp.int32))
+        )()
+        assert bool(jnp.isfinite(total))
