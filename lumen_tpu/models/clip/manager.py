@@ -22,7 +22,6 @@ import logging
 import os
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Literal
 
 import jax

@@ -20,7 +20,6 @@ import logging
 import os
 from dataclasses import dataclass
 
-import numpy as np
 
 from ...onnx_bridge import OnnxModule
 

@@ -14,7 +14,6 @@ bare ``detection.onnx`` fallback, plus the stock PaddleOCR export names
 from __future__ import annotations
 
 import logging
-import os
 from dataclasses import dataclass
 
 from ...onnx_bridge import OnnxModule, find_onnx_exports

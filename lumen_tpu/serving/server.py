@@ -22,7 +22,7 @@ from concurrent import futures
 
 import grpc
 
-from ..core.config import LumenConfig, ServiceConfig, load_config
+from ..core.config import LumenConfig, load_config
 from ..core.downloader import Downloader
 from ..utils.logger import setup_logging
 from .base_service import BaseService
