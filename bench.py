@@ -44,6 +44,8 @@ sys.path.insert(0, REPO)
 
 # v5e bf16 peak per chip; used only for the MFU estimate.
 PEAK_FLOPS = {"v5e": 197e12, "v6e": 918e12, "v4": 275e12}
+# HBM bandwidth per chip (GB/s); used only for the decode-BW estimate.
+PEAK_HBM_GBPS = {"v5e": 819, "v6e": 1640, "v4": 1228}
 VITB32_FLOPS_PER_IMG = 8.7e9  # ~2 * 87M vision params * 50 tokens
 
 
@@ -210,12 +212,30 @@ def phase_vlm(batch: int = 8, new_tokens: int = 64, quantize: bool = False) -> d
     for _ in range(reps):
         total += run()
     dt = time.perf_counter() - t0
-    return {
+    # Decode's cost model is streaming the decoder weights once per STEP
+    # (shared across the batch): effective weight bandwidth vs chip HBM is
+    # the decode analog of MFU. KV traffic is excluded (small here), so
+    # this is a lower bound on utilization.
+    param_bytes = sum(
+        np.asarray(l).nbytes for l in jax.tree.leaves(params.get("decoder", params))
+    )
+    steps_per_sec = (total / dt) / batch
+    weight_gbps = param_bytes * steps_per_sec / 1e9
+    out = {
         "tokens_per_sec": round(total / dt, 1),
         "batch": batch,
         "quantize": "int8" if quantize else None,
+        "weight_stream_gbps": round(weight_gbps, 1),
         "platform": jax.devices()[0].platform,
     }
+    if jax.default_backend() != "cpu":
+        kind = jax.devices()[0].device_kind.lower()
+        gen_name = next((g for g in PEAK_HBM_GBPS if g in kind),
+                        os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
+        out["hbm_util_pct"] = round(
+            100 * weight_gbps / PEAK_HBM_GBPS.get(gen_name, 819), 2
+        )
+    return out
 
 
 def phase_vlm_q8() -> dict:
@@ -677,6 +697,8 @@ def main(args) -> None:
         extras["vlm_decode_tokens_per_sec"] = vlm.get("tokens_per_sec")
         extras["vlm_batch"] = vlm.get("batch")
         extras["vlm_platform"] = vlm.get("platform")
+        if vlm.get("hbm_util_pct") is not None:
+            extras["vlm_hbm_util_pct"] = vlm["hbm_util_pct"]
     vlm_q8 = results.get("vlm_q8")
     if vlm_q8:
         extras["vlm_q8_decode_tokens_per_sec"] = vlm_q8.get("tokens_per_sec")
