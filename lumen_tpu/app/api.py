@@ -7,20 +7,25 @@ Routes (same surface as the reference, ``main.py:64-68``):
 - ``POST /api/v1/config/generate``      {preset, tier, region, cache_dir, port}
 - ``GET  /api/v1/config/current``
 - ``POST /api/v1/config/validate``      {config: <dict>} | {path}
+- ``POST /api/v1/config/validate-path`` {path}
+- ``POST /api/v1/config/load``          {path}
 - ``POST /api/v1/config/save``          {path}
 - ``GET  /api/v1/config/yaml``
 - ``GET  /api/v1/config/presets``
 - ``GET  /api/v1/hardware/info``
 - ``GET  /api/v1/hardware/detect``
 - ``GET  /api/v1/hardware/check``      ?cache_dir=...
-- ``POST /api/v1/install/setup``        {venv_path?, packages?, config_path?, download?}
+- ``POST /api/v1/install/setup``        {venv_path?, packages?, config_path?, download?, region?}
+- ``POST /api/v1/install/check-path``   {path}
 - ``GET  /api/v1/install/tasks``
 - ``GET  /api/v1/install/status/{task_id}``
+- ``GET  /api/v1/install/logs/{task_id}``
 - ``POST /api/v1/install/cancel/{task_id}``
 - ``GET  /api/v1/server/status``
 - ``POST /api/v1/server/start``         {config_path?}
 - ``POST /api/v1/server/stop``
 - ``POST /api/v1/server/restart``
+- ``GET  /api/v1/server/logs``
 - ``GET  /api/v1/metrics``
 - ``WS   /ws/logs``  frames {type: connected|log|heartbeat} with 1s heartbeat
   (reference ``websockets/logs.py:18-158``)
@@ -56,6 +61,20 @@ MANAGER_KEY: web.AppKey[ServerManager] = web.AppKey("manager", ServerManager)
 
 def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+def _int_query(request: web.Request, name: str, default: int) -> int:
+    """Parse a non-negative integer query param; raises a 400 on junk."""
+    raw = request.query.get(name)
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(reason=f"{name} must be an integer") from None
+    if value < 0:
+        raise web.HTTPBadRequest(reason=f"{name} must be >= 0")
+    return value
 
 
 def _bad_request(e: Exception) -> web.Response:
@@ -115,20 +134,48 @@ def build_app(state: AppState | None = None) -> web.Application:
             return _json_error(404, "no config generated or loaded yet")
         return web.json_response(state.config.model_dump(exclude_none=True))
 
-    async def config_validate(request: web.Request) -> web.Response:
+    def _validated(body: dict, require_path: bool = False) -> web.Response:
         from lumen_tpu.core.config import load_config, validate_config_dict
 
-        body = await _body(request)
         try:
             if "path" in body:
                 cfg = load_config(body["path"])
-            elif "config" in body:
+            elif "config" in body and not require_path:
                 cfg = validate_config_dict(body["config"])
             else:
-                return _json_error(400, "provide 'config' (dict) or 'path'")
+                return _json_error(
+                    400, "provide 'path'" if require_path else "provide 'config' (dict) or 'path'"
+                )
         except Exception as e:  # noqa: BLE001 - validation errors reported to client
             return web.json_response({"valid": False, "error": str(e)})
         return web.json_response({"valid": True, "services": sorted(cfg.services)})
+
+    async def config_validate(request: web.Request) -> web.Response:
+        return _validated(await _body(request))
+
+    async def config_validate_path(request: web.Request) -> web.Response:
+        """Reference ``POST /config/validate-path`` (``api/config.py``) —
+        the path-only view of the shared validation helper."""
+        return _validated(await _body(request), require_path=True)
+
+    async def config_load(request: web.Request) -> web.Response:
+        """Reference ``POST /config/load``: make an on-disk YAML the app's
+        current config (the wizard's open-existing path)."""
+        from lumen_tpu.core.config import load_config
+
+        body = await _body(request)
+        if "path" not in body:
+            return _json_error(400, "provide 'path'")
+        try:
+            cfg = load_config(body["path"])
+        except Exception as e:  # noqa: BLE001
+            return _json_error(400, f"config load failed: {e}")
+        state.config = cfg
+        state.config_path = os.path.expanduser(body["path"])
+        state.broadcast_log(f"config loaded from {state.config_path}")
+        return web.json_response(
+            {"path": state.config_path, "services": sorted(cfg.services)}
+        )
 
     async def config_save(request: web.Request) -> web.Response:
         body = await _body(request)
@@ -212,6 +259,47 @@ def build_app(state: AppState | None = None) -> web.Application:
         runner.add_done_callback(_bg_tasks.discard)
         return web.json_response(task.as_dict(), status=202)
 
+    async def install_check_path(request: web.Request) -> web.Response:
+        """Reference ``POST /install/check-path``: is this dir usable as an
+        install/cache target (exists or creatable, writable, free space)."""
+        body = await _body(request)
+        if "path" not in body:
+            return _json_error(400, "provide 'path'")
+        path = os.path.abspath(os.path.expanduser(body["path"]))
+        probe = path
+        while not os.path.exists(probe):
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+        exists = os.path.isdir(path)
+        # An existing non-directory (regular file) can never become the
+        # cache dir; nor can a path whose first existing ancestor is a file.
+        blocked = os.path.exists(path) and not os.path.isdir(path) or not os.path.isdir(probe)
+        writable = os.access(probe, os.W_OK)
+        try:
+            import shutil as _sh
+
+            free_gb = _sh.disk_usage(probe if os.path.isdir(probe) else os.path.dirname(probe) or "/").free / 1e9
+        except OSError:
+            free_gb = 0.0
+        return web.json_response({
+            "path": path,
+            "exists": exists,
+            "writable": writable,
+            "free_gb": round(free_gb, 1),
+            "ok": writable and not blocked,
+        })
+
+    async def install_logs(request: web.Request) -> web.Response:
+        """Reference ``GET /install/logs/{task_id}``."""
+        task = state.install_tasks.get(request.match_info["task_id"])
+        if task is None:
+            return _json_error(404, "unknown install task")
+        limit = _int_query(request, "limit", 200)
+        lines = list(task.log_lines)[-limit:] if limit else []
+        return web.json_response({"task_id": task.task_id, "lines": lines})
+
     async def install_tasks(request: web.Request) -> web.Response:
         return web.json_response(
             {"tasks": [t.as_dict() for t in state.install_tasks.values()]}
@@ -258,6 +346,17 @@ def build_app(state: AppState | None = None) -> web.Application:
         except RuntimeError as e:
             return _json_error(409, str(e))
         return web.json_response(info)
+
+    async def server_logs(request: web.Request) -> web.Response:
+        """Reference ``GET /server/logs``: recent managed-server output
+        (the WS stream only carries lines from after a client connects)."""
+        limit = _int_query(request, "limit", 200)
+        lines = [
+            {"message": e.message, "level": e.level}
+            for e in list(state.recent_logs)
+            if e.source == "server"
+        ]
+        return web.json_response({"lines": lines[-limit:] if limit else []})
 
     # -- metrics ----------------------------------------------------------
 
@@ -309,6 +408,8 @@ def build_app(state: AppState | None = None) -> web.Application:
     app.router.add_post(f"{v1}/config/generate", config_generate)
     app.router.add_get(f"{v1}/config/current", config_current)
     app.router.add_post(f"{v1}/config/validate", config_validate)
+    app.router.add_post(f"{v1}/config/validate-path", config_validate_path)
+    app.router.add_post(f"{v1}/config/load", config_load)
     app.router.add_post(f"{v1}/config/save", config_save)
     app.router.add_get(f"{v1}/config/yaml", config_yaml)
     app.router.add_get(f"{v1}/config/presets", config_presets)
@@ -316,13 +417,16 @@ def build_app(state: AppState | None = None) -> web.Application:
     app.router.add_get(f"{v1}/hardware/detect", hardware_detect)
     app.router.add_get(f"{v1}/hardware/check", hardware_check)
     app.router.add_post(f"{v1}/install/setup", install_setup)
+    app.router.add_post(f"{v1}/install/check-path", install_check_path)
     app.router.add_get(f"{v1}/install/tasks", install_tasks)
     app.router.add_get(f"{v1}/install/status/{{task_id}}", install_status)
+    app.router.add_get(f"{v1}/install/logs/{{task_id}}", install_logs)
     app.router.add_post(f"{v1}/install/cancel/{{task_id}}", install_cancel)
     app.router.add_get(f"{v1}/server/status", server_status)
     app.router.add_post(f"{v1}/server/start", server_start)
     app.router.add_post(f"{v1}/server/stop", server_stop)
     app.router.add_post(f"{v1}/server/restart", server_restart)
+    app.router.add_get(f"{v1}/server/logs", server_logs)
     app.router.add_get(f"{v1}/metrics", metrics)
     app.router.add_get("/ws/logs", ws_logs)
 

@@ -21,6 +21,7 @@ import shutil
 import sys
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 from pathlib import Path
@@ -68,6 +69,9 @@ class InstallTask:
     steps: list[InstallStep]
     status: StepStatus = StepStatus.PENDING
     error: str | None = None
+    #: bounded KEEP-RECENT history behind GET /install/logs (clients poll
+    #: for current progress/failures, so the newest lines must survive)
+    log_lines: "deque[str]" = field(default_factory=lambda: deque(maxlen=2000))
     created_at: float = field(default_factory=time.time)
     _proc: asyncio.subprocess.Process | None = None
     _cancelled: bool = False
@@ -279,4 +283,5 @@ class InstallOrchestrator:
 
     def _log(self, task: InstallTask, message: str, level: str = "info", source: str = "install") -> None:
         logger.log(logging.ERROR if level == "error" else logging.INFO, "[%s] %s", task.task_id, message)
+        task.log_lines.append(message)  # deque(maxlen): oldest drop first
         self.state.broadcast_log(message, level=level, source=source)

@@ -35,11 +35,18 @@ class AppState:
     """One instance per app process; handed to every API handler."""
 
     def __init__(self) -> None:
+        from collections import deque
+
         self.config = None  # LumenConfig | None (last generated/loaded)
         self.config_path: str | None = None
         self.install_tasks: dict[str, Any] = {}  # task_id -> InstallTask
         self.server_manager = None  # set by api.build_app
         self._subscribers: set[asyncio.Queue[LogEvent]] = set()
+        #: ring buffer behind GET /server/logs and /install/logs — WS
+        #: subscribers only see lines from after they connect; the REST
+        #: endpoints (reference api/server.py:21-234, api/install.py:85-243)
+        #: serve recent history.
+        self.recent_logs: "deque[LogEvent]" = deque(maxlen=500)
         self._lock = asyncio.Lock()
         self._loop: asyncio.AbstractEventLoop | None = None
 
@@ -63,6 +70,7 @@ class AppState:
         """Fan a log line out to all subscribers. Safe from the event loop;
         threads must use :meth:`broadcast_log_threadsafe`."""
         event = LogEvent(message=message, level=level, source=source)
+        self.recent_logs.append(event)
         for q in list(self._subscribers):
             try:
                 q.put_nowait(event)

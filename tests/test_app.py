@@ -549,3 +549,110 @@ class TestEnvCheck:
             return True
 
         assert run_async(fn())
+
+
+class TestRestParityEndpoints:
+    """The reference's remaining router surface: config load/validate-path,
+    install check-path/logs, server logs (api/{config,install,server}.py)."""
+
+    def test_config_validate_path_and_load(self, tmp_path):
+        import yaml as _yaml
+
+        from lumen_tpu.app.config_gen import config_to_yaml, generate_config
+
+        cfg = generate_config("cpu", tier="minimal", region="other", cache_dir=str(tmp_path))
+        p = tmp_path / "ok.yaml"
+        p.write_text(config_to_yaml(cfg))
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("deployment: [not, a, mapping]")
+
+        async def fn(client):
+            r = await client.post("/api/v1/config/validate-path", json={"path": str(p)})
+            assert (await r.json())["valid"] is True
+            r = await client.post("/api/v1/config/validate-path", json={"path": str(bad)})
+            assert (await r.json())["valid"] is False
+            r = await client.post("/api/v1/config/load", json={"path": str(p)})
+            assert r.status == 200
+            assert (await r.json())["services"] == ["ocr"]
+            # loaded config becomes current
+            r = await client.get("/api/v1/config/current")
+            assert r.status == 200
+            r = await client.post("/api/v1/config/load", json={"path": str(bad)})
+            assert r.status == 400
+            return True
+
+        assert with_client(fn)
+
+    def test_install_check_path(self, tmp_path):
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/install/check-path", json={"path": str(tmp_path / "new" / "cache")}
+            )
+            data = await r.json()
+            assert data["ok"] is True and data["writable"] is True
+            assert data["exists"] is False and data["free_gb"] > 0
+            r = await client.post("/api/v1/install/check-path", json={})
+            assert r.status == 400
+            return True
+
+        assert with_client(fn)
+
+    def test_install_logs_endpoint(self):
+        async def fn(client):
+            r = await client.post("/api/v1/install/setup", json={})
+            task_id = (await r.json())["task_id"]
+            for _ in range(100):
+                s = await (await client.get(f"/api/v1/install/status/{task_id}")).json()
+                if s["status"] in ("completed", "failed"):
+                    break
+                await asyncio.sleep(0.05)
+            r = await client.get(f"/api/v1/install/logs/{task_id}")
+            lines = (await r.json())["lines"]
+            assert any("check_python" in l for l in lines)
+            r = await client.get("/api/v1/install/logs/nope")
+            assert r.status == 404
+            return True
+
+        assert with_client(fn)
+
+    def test_server_logs_endpoint(self):
+        async def fn(client):
+            state = client.server.app[STATE_KEY]
+            state.broadcast_log("hello from the managed server", source="server")
+            state.broadcast_log("app line must not appear", source="app")
+            r = await client.get("/api/v1/server/logs")
+            lines = (await r.json())["lines"]
+            assert any("hello from the managed server" in l["message"] for l in lines)
+            assert not any("app line" in l["message"] for l in lines)
+            return True
+
+        assert with_client(fn)
+
+    def test_check_path_rejects_existing_file(self, tmp_path):
+        f = tmp_path / "a-file"
+        f.write_text("x")
+
+        async def fn(client):
+            r = await client.post("/api/v1/install/check-path", json={"path": str(f)})
+            data = await r.json()
+            assert data["ok"] is False
+            # a path UNDER a file is blocked too
+            r = await client.post(
+                "/api/v1/install/check-path", json={"path": str(f / "sub")}
+            )
+            assert (await r.json())["ok"] is False
+            return True
+
+        assert with_client(fn)
+
+    def test_logs_limit_validation(self):
+        async def fn(client):
+            r = await client.get("/api/v1/server/logs?limit=abc")
+            assert r.status == 400
+            state = client.server.app[STATE_KEY]
+            state.broadcast_log("srv", source="server")
+            r = await client.get("/api/v1/server/logs?limit=0")
+            assert (await r.json())["lines"] == []
+            return True
+
+        assert with_client(fn)
