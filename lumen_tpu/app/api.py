@@ -68,12 +68,17 @@ def _int_query(request: web.Request, name: str, default: int) -> int:
     raw = request.query.get(name)
     if raw is None:
         return default
+    def bad(msg: str):
+        return web.HTTPBadRequest(
+            text=json.dumps({"error": msg}), content_type="application/json"
+        )
+
     try:
         value = int(raw)
     except ValueError:
-        raise web.HTTPBadRequest(reason=f"{name} must be an integer") from None
+        raise bad(f"{name} must be an integer") from None
     if value < 0:
-        raise web.HTTPBadRequest(reason=f"{name} must be >= 0")
+        raise bad(f"{name} must be >= 0")
     return value
 
 
@@ -352,9 +357,7 @@ def build_app(state: AppState | None = None) -> web.Application:
         (the WS stream only carries lines from after a client connects)."""
         limit = _int_query(request, "limit", 200)
         lines = [
-            {"message": e.message, "level": e.level}
-            for e in list(state.recent_logs)
-            if e.source == "server"
+            {"message": e.message, "level": e.level} for e in list(state.server_logs)
         ]
         return web.json_response({"lines": lines[-limit:] if limit else []})
 

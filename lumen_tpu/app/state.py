@@ -47,6 +47,9 @@ class AppState:
         #: endpoints (reference api/server.py:21-234, api/install.py:85-243)
         #: serve recent history.
         self.recent_logs: "deque[LogEvent]" = deque(maxlen=500)
+        #: server lines separately: a chatty install must not evict the
+        #: managed server's history out from under GET /server/logs
+        self.server_logs: "deque[LogEvent]" = deque(maxlen=500)
         self._lock = asyncio.Lock()
         self._loop: asyncio.AbstractEventLoop | None = None
 
@@ -71,6 +74,8 @@ class AppState:
         threads must use :meth:`broadcast_log_threadsafe`."""
         event = LogEvent(message=message, level=level, source=source)
         self.recent_logs.append(event)
+        if source == "server":
+            self.server_logs.append(event)
         for q in list(self._subscribers):
             try:
                 q.put_nowait(event)

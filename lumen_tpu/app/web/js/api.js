@@ -35,6 +35,7 @@ export const api = {
   health: () => request("GET", "/health"),
 
   // hardware
+  configLoad: (path) => request("POST", `${V1}/config/load`, { path }),
   hardwareInfo: () => request("GET", `${V1}/hardware/info`),
   hardwareDetect: () => request("GET", `${V1}/hardware/detect`),
   hardwareCheck: (cacheDir) =>

@@ -63,6 +63,12 @@ export function renderConfig(root) {
 
   root.querySelector("#cfg-generate").onclick = async () => {
     const btn = root.querySelector("#cfg-generate");
+    if (wizard.state.preset === "(existing config)") {
+      // The welcome "open existing config" path sets a placeholder that
+      // /config/generate would reject; regeneration needs a real preset.
+      toast("pick a topology preset on the Hardware step first", true);
+      return;
+    }
     btn.disabled = true;
     try {
       await api.generateConfig({

@@ -29,12 +29,40 @@ export function renderWelcome(root) {
       el("button", { class: "btn primary", id: "welcome-start" }, resume ? "Resume setup →" : "Get started →"),
       " ",
       resume ? el("button", { class: "btn ghost", id: "welcome-reset" }, "Start over") : "",
+    ]),
+    // Reference OpenPath view: skip generation, run an existing YAML.
+    el("div", { class: "card" }, [
+      el("h3", {}, "Already have a config?"),
+      el("div", { class: "muted" }, "Load an existing lumen-config.yaml and jump straight to install/serve."),
+      el("div", { class: "row" }, [
+        el("input", { id: "welcome-path", class: "input", placeholder: "/path/to/lumen-config.yaml", style: "flex:1" }),
+        el("button", { class: "btn", id: "welcome-open" }, "Open"),
+      ]),
     ])
   );
 
   root.querySelector("#welcome-start").onclick = () => wizard.next();
   const resetBtn = root.querySelector("#welcome-reset");
   if (resetBtn) resetBtn.onclick = () => wizard.reset();
+  root.querySelector("#welcome-open").onclick = async () => {
+    const path = root.querySelector("#welcome-path").value.trim();
+    if (!path) return toast("enter a config path", true);
+    try {
+      const out = await api.configLoad(path);
+      wizard.update({
+        // Mark the prior steps complete so nav gating lets the operator
+        // jump ahead; the placeholder preset is never used for generation
+        // (the loaded YAML already carries the real settings).
+        preset: wizard.state.preset || "(existing config)",
+        configGenerated: true,
+        configPath: out.path,
+        step: "install",
+      });
+      toast(`loaded ${out.path} (services: ${out.services.join(", ")})`);
+    } catch (e) {
+      toast(e.message, true);
+    }
+  };
 
   // connectivity check so a dead control plane is obvious immediately
   api.health().catch((e) => toast(`control plane: ${e.message}`, true));
