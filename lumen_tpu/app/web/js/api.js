@@ -36,6 +36,7 @@ export const api = {
 
   // hardware
   configLoad: (path) => request("POST", `${V1}/config/load`, { path }),
+  serverLogs: () => request("GET", `${V1}/server/logs`),
   hardwareInfo: () => request("GET", `${V1}/hardware/info`),
   hardwareDetect: () => request("GET", `${V1}/hardware/detect`),
   hardwareCheck: (cacheDir) =>

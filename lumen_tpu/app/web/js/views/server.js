@@ -3,7 +3,7 @@
 
 import { api, logStream } from "../api.js";
 import { wizard } from "../wizard.js";
-import { el, toast, attachLogPane } from "../ui.js";
+import { el, toast, attachLogPane, logLine } from "../ui.js";
 
 let pollTimer = null;
 
@@ -34,7 +34,18 @@ export function renderServer(root, onLeave) {
     ])
   );
 
-  const unsubLogs = attachLogPane(root.querySelector("#srv-logs"), logStream);
+  const logPane = root.querySelector("#srv-logs");
+  const unsubLogs = attachLogPane(logPane, logStream);
+  // Backfill: the WS stream only carries lines from after this view
+  // connected; GET /server/logs serves the earlier history.
+  api
+    .serverLogs()
+    .then((out) => {
+      for (const line of (out.lines || []).reverse()) {
+        logPane.prepend(logLine({ message: line.message }));
+      }
+    })
+    .catch(() => {});
   onLeave(() => {
     unsubLogs();
     clearTimeout(pollTimer);
