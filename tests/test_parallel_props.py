@@ -1,0 +1,89 @@
+"""Property-based tests (hypothesis) for the parallelism combinators.
+
+Fixed-shape unit tests pin the common cases; these sweep random
+shapes/seeds on the single-device reference paths where the math must
+hold for ANY configuration: exact MoE routing vs a dense oracle, and
+pipeline scheduling vs sequential application.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+pytestmark = pytest.mark.multichip
+
+
+@st.composite
+def moe_case(draw):
+    d = draw(st.sampled_from([4, 8, 16]))
+    f = draw(st.sampled_from([8, 16]))
+    e = draw(st.sampled_from([2, 4, 8]))
+    t = draw(st.integers(1, 24))
+    k = draw(st.integers(1, min(e, 3)))
+    seed = draw(st.integers(0, 2**31 - 1))
+    norm = draw(st.booleans())
+    return d, f, e, t, k, seed, norm
+
+
+class TestMoEProperties:
+    @settings(max_examples=25, deadline=None)
+    @given(moe_case())
+    def test_exact_path_matches_dense_oracle(self, case):
+        from lumen_tpu.parallel import init_moe_params
+        from lumen_tpu.parallel.moe import _expert_ffn, _moe_exact_local, _topk_gates
+
+        d, f, e, t, k, seed, norm = case
+        params = init_moe_params(jax.random.PRNGKey(seed), d, f, e)
+        x = jax.random.normal(jax.random.PRNGKey(seed + 1), (t, d))
+
+        got = _moe_exact_local(params, x, n_experts=e, k=k, norm_topk=norm)
+
+        gate_vals, gate_idx = _topk_gates(x, params.router, k, norm)
+        ys = _expert_ffn(params, jnp.broadcast_to(x, (e,) + x.shape))
+        want = jnp.zeros_like(x)
+        for j in range(k):
+            picked = ys[gate_idx[:, j], jnp.arange(t)]
+            want = want + gate_vals[:, j : j + 1] * picked
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+        )
+
+
+@st.composite
+def pipe_case(draw):
+    d = draw(st.sampled_from([4, 8]))
+    n_stages = draw(st.sampled_from([2, 4, 8]))
+    micro = draw(st.sampled_from([1, 2, 4]))
+    mb = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2**31 - 1))
+    return d, n_stages, micro, mb, seed
+
+
+class TestPipelineProperties:
+    @settings(max_examples=10, deadline=None)
+    @given(pipe_case())
+    def test_pipeline_matches_sequential(self, case):
+        from lumen_tpu.parallel import pipeline_apply, stack_stage_params
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        d, n_stages, micro, mb, seed = case
+        if jax.device_count() % n_stages:
+            return
+        mesh = build_mesh({"stage": n_stages}, devices=jax.devices()[:n_stages])
+        keys = jax.random.split(jax.random.PRNGKey(seed), n_stages)
+        per_stage = [{"w": jax.random.normal(k1, (d, d)) * 0.4} for k1 in keys]
+        stacked = stack_stage_params(per_stage)
+        x = jax.random.normal(jax.random.PRNGKey(seed + 7), (micro * mb, d))
+
+        def stage_fn(p, v):
+            return jnp.tanh(v @ p["w"])
+
+        out = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=micro)
+        ref = x
+        for p in per_stage:
+            ref = stage_fn(p, ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
