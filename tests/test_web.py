@@ -157,3 +157,31 @@ class TestViewDomContract:
             app_src = f.read()
         for node_id in re.findall(r'getElementById\("([\w-]+)"\)', app_src):
             assert f'id="{node_id}"' in html, node_id
+
+
+class TestNamedImportExports:
+    def test_named_imports_are_exported_by_source(self):
+        """`import { a, b } from "./x.js"` names must exist among x.js's
+        exports — a missing one is a blank page at runtime (no bundler or
+        JS engine in this image catches it)."""
+        export_re = re.compile(
+            r"export\s+(?:async\s+)?(?:function|class|const|let|var)\s+([A-Za-z_$][\w$]*)"
+        )
+        export_list_re = re.compile(r"export\s*\{([^}]*)\}")
+        for path in _js_files():
+            with open(path) as f:
+                src = f.read()
+            for names, spec in re.findall(
+                r'import\s*\{([^}]*)\}\s*from\s+"([^"]+)"', src
+            ):
+                target = os.path.normpath(os.path.join(os.path.dirname(path), spec))
+                with open(target) as f:
+                    tsrc = f.read()
+                exported = set(export_re.findall(tsrc))
+                for group in export_list_re.findall(tsrc):
+                    exported.update(n.strip().split(" as ")[-1] for n in group.split(",") if n.strip())
+                for name in names.split(","):
+                    name = name.strip().split(" as ")[0].strip()
+                    if not name:
+                        continue
+                    assert name in exported, f"{path} imports {name} missing from {spec}"
