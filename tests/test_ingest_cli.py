@@ -1,0 +1,88 @@
+"""scripts/ingest.py end-to-end: the bulk-indexing CLI over real manager
+stacks on CPU, including the chunked caption path (dense sweep of chunk
+k+1 overlaps chunk k's captions) where row order and whole-run stats must
+survive chunking."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+
+import pytest
+
+from tests.clip_fixtures import make_clip_model_dir, png_bytes
+from tests.test_vlm import make_vlm_model_dir
+
+pytestmark = pytest.mark.integration
+
+
+@pytest.fixture(scope="module")
+def cache(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ingestcli")
+    make_clip_model_dir(root)
+    vlm_tmp = tmp_path_factory.mktemp("vlmsrc")
+    shutil.move(make_vlm_model_dir(vlm_tmp), str(root / "models" / "TinyVLM"))
+    photos = root / "photos"
+    photos.mkdir()
+    for i in range(80):  # chunk size floors at 64 -> two chunks (64 + 16)
+        (photos / f"p{i:03d}.png").write_bytes(png_bytes(seed=i % 5))
+    (root / "cfg.yaml").write_text(f"""
+metadata:
+  version: "1.0.0"
+  region: other
+  cache_dir: {root}
+deployment:
+  mode: hub
+  services: [clip, vlm]
+server:
+  port: 50933
+  host: 127.0.0.1
+  mdns:
+    enabled: false
+services:
+  clip:
+    enabled: true
+    package: lumen_tpu.serving.services.clip_service
+    import_info:
+      registry_class: lumen_tpu.serving.services.clip_service.ClipService
+    backend_settings: {{dtype: float32, batch_size: 4}}
+    models:
+      clip: {{model: TinyCLIP, runtime: jax, dataset: Tiny}}
+  vlm:
+    enabled: true
+    package: lumen_tpu.serving.services.vlm_service
+    import_info:
+      registry_class: lumen_tpu.serving.services.vlm_service.VlmService
+    backend_settings: {{dtype: float32, batch_size: 2}}
+    models:
+      vlm: {{model: TinyVLM, runtime: jax}}
+""")
+    return root
+
+
+class TestIngestCli:
+    def test_chunked_caption_run_preserves_order_and_stats(self, cache, capsys):
+        sys.path.insert(0, "scripts")
+        import ingest as ingest_cli
+
+        out = cache / "idx.jsonl"
+        rc = ingest_cli.main([
+            "--config", str(cache / "cfg.yaml"),
+            "--input", str(cache / "photos"),
+            "--output", str(out),
+            "--families", "clip,vlm",
+            "--caption-max-tokens", "2",
+            "--batch-size", "8",  # divisible by the 8-device test mesh
+            "--platform", "cpu",
+        ])
+        assert rc == 0
+        rows = [json.loads(l) for l in open(out)]
+        assert len(rows) == 80
+        paths = [r["path"] for r in rows]
+        assert paths == sorted(paths)
+        assert all(r.get("caption") for r in rows)
+        assert all("clip_embedding" in r for r in rows)
+        stats_line = [l for l in capsys.readouterr().out.splitlines() if "stage stats" in l][-1]
+        stats = json.loads(stats_line.split("stage stats: ")[1])
+        assert stats["items"] == 80
