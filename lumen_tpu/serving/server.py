@@ -69,11 +69,13 @@ class ServerHandle:
         port: int,
         mdns: MdnsAdvertiser | None,
         metrics_server=None,
+        services: dict | None = None,
     ):
         self.server = server
         self.port = port
         self.mdns = mdns
         self.metrics_server = metrics_server
+        self.services = services or {}
         self._stopped = threading.Event()
 
     def stop(self, grace: float = 5.0) -> None:
@@ -81,7 +83,20 @@ class ServerHandle:
             self.mdns.stop()
         if self.metrics_server:
             self.metrics_server.stop()
-        self.server.stop(grace)
+        # Let in-flight RPCs drain FIRST, then close the services so their
+        # batcher threads retire cleanly (instead of dying as daemons
+        # mid-batch) and any queued requests are failed loudly rather than
+        # silently dropped. grpc sets the stop event only AFTER aborting
+        # stragglers at t=grace, so the wait needs margin past the grace
+        # window or close() can race still-running handlers.
+        self.server.stop(grace).wait(grace + 5.0)
+        for name, svc in self.services.items():
+            close = getattr(svc, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    logger.exception("closing service %r failed", name)
         self._stopped.set()
 
     def wait(self) -> None:
@@ -148,7 +163,7 @@ def serve(
             properties={"tasks": ",".join(t for s in services.values() for t in s.registry.task_names())},
         )
         mdns.start()
-    return ServerHandle(server, bound, mdns, metrics_server)
+    return ServerHandle(server, bound, mdns, metrics_server, services=services)
 
 
 def main(argv: list[str] | None = None) -> int:

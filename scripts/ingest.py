@@ -74,6 +74,20 @@ def main(argv: list[str] | None = None) -> int:
 
     config = load_config(args.config)
     services = build_services(config)
+    import atexit
+
+    def _close_services():
+        for svc in services.values():
+            close = getattr(svc, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
+
+    # Batcher/scheduler threads must not outlive the run (they also leak
+    # when main() is driven in-process, e.g. from tests).
+    atexit.register(_close_services)
     wanted = {f.strip() for f in args.families.split(",") if f.strip()}
     managers: dict[str, object] = {}
     for name, svc in services.items():
@@ -220,6 +234,7 @@ def main(argv: list[str] | None = None) -> int:
     if totals.get("wall_s"):
         totals["items_per_sec"] = round(totals["items"] / totals["wall_s"], 2)
     print("stage stats:", json.dumps(totals))
+    _close_services()
     return 0
 
 

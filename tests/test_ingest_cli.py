@@ -6,7 +6,7 @@ survive chunking."""
 from __future__ import annotations
 
 import json
-import shutil
+import os
 import sys
 
 import pytest
@@ -21,8 +21,7 @@ pytestmark = pytest.mark.integration
 def cache(tmp_path_factory):
     root = tmp_path_factory.mktemp("ingestcli")
     make_clip_model_dir(root)
-    vlm_tmp = tmp_path_factory.mktemp("vlmsrc")
-    shutil.move(make_vlm_model_dir(vlm_tmp), str(root / "models" / "TinyVLM"))
+    make_vlm_model_dir(root)  # writes <root>/models/TinyVLM directly
     photos = root / "photos"
     photos.mkdir()
     for i in range(80):  # chunk size floors at 64 -> two chunks (64 + 16)
@@ -63,7 +62,11 @@ services:
 
 class TestIngestCli:
     def test_chunked_caption_run_preserves_order_and_stats(self, cache, capsys):
-        sys.path.insert(0, "scripts")
+        scripts_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+        )
+        if scripts_dir not in sys.path:
+            sys.path.insert(0, scripts_dir)
         import ingest as ingest_cli
 
         out = cache / "idx.jsonl"
