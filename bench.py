@@ -70,6 +70,10 @@ def _apply_platform_env() -> None:
 
 
 def phase_clip(batch: int = 256, iters: int = 30) -> dict:
+    """CLIP ViT-B/32 image-embed throughput. ``BENCH_SWEEP=1`` tries a
+    ladder of batch sizes and reports the best (one compile per size —
+    only worth the chip time when tuning, not in the driver's default
+    run)."""
     _apply_platform_env()
     import jax
     import jax.numpy as jnp
@@ -78,6 +82,7 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     from lumen_tpu.models.clip.modeling import CLIPConfig, CLIPModel
     from lumen_tpu.ops import flash_enabled
 
+    sweep = os.environ.get("BENCH_SWEEP") == "1" and jax.default_backend() != "cpu"
     if jax.default_backend() == "cpu":
         # Fallback evidence run on the 1-core host: prove the path, not perf.
         batch, iters = 8, 3
@@ -103,32 +108,45 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
             method=lambda m, px: m.encode_image(px),
         )
 
-    inputs = [
-        jax.device_put(
-            np.random.default_rng(i).integers(
-                0, 255, (batch, cfg.image_size, cfg.image_size, 3), np.uint8
+    def measure(b: int, n_iters: int) -> float:
+        inputs = [
+            jax.device_put(
+                np.random.default_rng(i).integers(
+                    0, 255, (b, cfg.image_size, cfg.image_size, 3), np.uint8
+                )
             )
-        )
-        for i in range(4)
-    ]
-    np.asarray(embed(params, inputs[0]))  # compile + settle
-    # Timing fences on a host fetch of the LAST result: device execution is
-    # ordered, so this covers the chain (block_until_ready alone does not
-    # truly block through the remote tunnel).
-    t0 = time.perf_counter()
-    out = None
-    for i in range(iters):
-        out = embed(params, inputs[i % len(inputs)])
-    np.asarray(out)
-    dt = time.perf_counter() - t0
-    ips = batch * iters / dt
+            for i in range(4)
+        ]
+        np.asarray(embed(params, inputs[0]))  # compile + settle
+        # Timing fences on a host fetch of the LAST result: device
+        # execution is ordered, so this covers the chain
+        # (block_until_ready alone does not truly block through the
+        # remote tunnel).
+        t0 = time.perf_counter()
+        out = None
+        for i in range(n_iters):
+            out = embed(params, inputs[i % len(inputs)])
+        np.asarray(out)
+        return b * n_iters / (time.perf_counter() - t0)
+
+    sweep_results = {}
+    if sweep:
+        for b in (128, 256, 512, 1024):
+            sweep_results[b] = round(measure(b, iters), 1)
+        batch, ips = max(sweep_results.items(), key=lambda kv: kv[1])
+    else:
+        ips = measure(batch, iters)
     platform = jax.devices()[0].platform
-    return {
+    result = {
         "images_per_sec": round(ips, 1),
+        "batch": batch,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "flash_attention": flash_enabled(),
     }
+    if sweep_results:
+        result["sweep"] = sweep_results
+    return result
 
 
 def phase_vlm(batch: int = 8, new_tokens: int = 64, quantize: bool = False) -> dict:
