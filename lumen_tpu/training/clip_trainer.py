@@ -47,7 +47,7 @@ def contrastive_loss(img_emb: jax.Array, txt_emb: jax.Array, logit_scale: jax.Ar
 
     The temperature is clamped to ln(100) inside the loss as well as after
     each update, so even a corrupted checkpoint can't overflow exp()."""
-    scale = jnp.exp(jnp.clip(logit_scale, a_max=jnp.log(100.0)))
+    scale = jnp.exp(jnp.clip(logit_scale, max=jnp.log(100.0)))
     logits = scale * img_emb @ txt_emb.T  # [B, B]
     labels = jnp.arange(logits.shape[0])
     li = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
@@ -143,7 +143,7 @@ class ClipTrainer:
             params = optax.apply_updates(params, updates)
             # CLIP convention: clamp the temperature so exp() cannot
             # overflow during long fine-tunes (open_clip clamps to ln 100).
-            params["logit_scale"] = jnp.clip(params["logit_scale"], a_max=jnp.log(100.0))
+            params["logit_scale"] = jnp.clip(params["logit_scale"], max=jnp.log(100.0))
             gnorm = optax.global_norm(grads)
             return params, opt_state, {"loss": loss, "grad_norm": gnorm}
 
