@@ -89,7 +89,9 @@ class MetricsServer:
                 if path == "/metrics":
                     self._send(200, "\n".join(metrics.prometheus_lines()) + "\n", "text/plain; version=0.0.4")
                 elif path == "/metrics.json":
-                    self._send(200, json.dumps(metrics.snapshot()))
+                    snap = metrics.snapshot()
+                    snap["device_memory"] = metrics.device_memory()
+                    self._send(200, json.dumps(snap))
                 elif path == "/health":
                     self._send(200, json.dumps({"status": "ok"}))
                 else:

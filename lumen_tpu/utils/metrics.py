@@ -112,6 +112,26 @@ class MetricsRegistry:
             "tasks": dict(sorted(tasks.items())),
         }
 
+    @staticmethod
+    def device_memory() -> dict[str, dict[str, int]]:
+        """Per-device memory stats (HBM accounting: params + KV caches +
+        live buffers). TPU backends report bytes_in_use/bytes_limit via
+        PJRT; backends without stats (CPU) yield empty dicts."""
+        try:
+            import jax
+
+            out = {}
+            for dev in jax.devices():
+                stats = getattr(dev, "memory_stats", lambda: None)() or {}
+                out[str(dev.id)] = {
+                    k: int(v)
+                    for k, v in stats.items()
+                    if isinstance(v, (int, float)) and "bytes" in k
+                }
+            return out
+        except Exception:  # noqa: BLE001 - metrics must never take down serving
+            return {}
+
     def prometheus_lines(self) -> Iterator[str]:
         """Prometheus text exposition of the same data."""
         snap = self.snapshot()
@@ -127,6 +147,12 @@ class MetricsRegistry:
                 yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
             yield f'lumen_task_latency_ms_sum{{task="{name}"}} {s["sum_ms"]}'
             yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
+        mem = self.device_memory()
+        if any(mem.values()):
+            yield "# TYPE lumen_device_memory_bytes gauge"
+            for dev_id, stats in mem.items():
+                for key, val in stats.items():
+                    yield f'lumen_device_memory_bytes{{device="{dev_id}",kind="{key}"}} {val}'
 
 
 #: process-global registry used by the serving layer

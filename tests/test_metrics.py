@@ -140,3 +140,25 @@ class TestMetricsServer:
 
         with pytest.raises(urllib.error.HTTPError):
             self._get(server + "/nope")
+
+
+class TestDeviceMemory:
+    def test_device_memory_shape(self):
+        from lumen_tpu.utils.metrics import metrics
+
+        mem = metrics.device_memory()
+        assert isinstance(mem, dict)
+        # CPU devices expose stats too on recent jax; whatever comes back
+        # must be {device_id: {key: int}} with byte-ish keys only.
+        for stats in mem.values():
+            for key, val in stats.items():
+                assert "bytes" in key and isinstance(val, int)
+
+    def test_prometheus_includes_memory_gauge_when_available(self):
+        from lumen_tpu.utils.metrics import metrics
+
+        lines = list(metrics.prometheus_lines())
+        if any(metrics.device_memory().values()):
+            assert any("lumen_device_memory_bytes" in l for l in lines)
+        else:
+            assert not any("lumen_device_memory_bytes" in l for l in lines)
