@@ -65,7 +65,7 @@ def hf_cnclip():
         layer_norm_eps=1e-5,
         hidden_act="quick_gelu",
     )
-    cfg = ChineseCLIPConfig.from_text_vision_configs(text, vision, projection_dim=PROJ)
+    cfg = ChineseCLIPConfig(text_config=text.to_dict(), vision_config=vision.to_dict(), projection_dim=PROJ)
     model = ChineseCLIPModel(cfg)
     model.eval()
     return cfg, model
