@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Example gRPC client for the lumen-tpu server.
+
+Speaks the same wire protocol as reference Lumen clients
+(``src/lumen/proto/ml_service.proto``): one bidi ``Infer`` stream per
+request, task keyword on the first message, JSON result bytes back.
+
+Usage (server from `python -m lumen_tpu.serving.server --config ...`):
+
+    python examples/client.py caps
+    python examples/client.py health
+    python examples/client.py embed-text "a photo of a cat"
+    python examples/client.py embed-image photo.jpg
+    python examples/client.py classify photo.jpg --top-k 5
+    python examples/client.py faces photo.jpg
+    python examples/client.py ocr scan.png
+    python examples/client.py caption photo.jpg --prompt "Describe this photo."
+    python examples/client.py caption photo.jpg --stream
+
+Large payloads are chunked with the protocol's seq/total/offset framing —
+the same reassembly path reference clients use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import mimetypes
+import os
+import sys
+
+import grpc
+from google.protobuf import empty_pb2
+
+from lumen_tpu.serving.proto import ml_service_pb2 as pb
+from lumen_tpu.serving.proto import ml_service_pb2_grpc as pbg
+
+CHUNK = 1 << 20  # 1 MiB
+
+
+def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
+    """Yield chunked InferRequests (single message when small)."""
+    if len(payload) <= CHUNK:
+        yield pb.InferRequest(
+            correlation_id="cli", task=task, payload=payload,
+            payload_mime=mime, meta=meta,
+        )
+        return
+    total = (len(payload) + CHUNK - 1) // CHUNK
+    for i in range(total):
+        part = payload[i * CHUNK : (i + 1) * CHUNK]
+        yield pb.InferRequest(
+            correlation_id="cli", task=task, payload=part, payload_mime=mime,
+            meta=meta if i == 0 else {}, seq=i, total=total, offset=i * CHUNK,
+        )
+
+
+def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
+           timeout: float, stream: bool = False):
+    responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
+    for resp in responses:
+        if resp.error.message:
+            raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
+        if resp.is_final:
+            return json.loads(resp.result) if resp.result else {}
+        if stream and resp.result:
+            # Delta chunks are raw UTF-8 text (result_mime text/plain);
+            # only the final response is JSON.
+            print(resp.result.decode("utf-8", errors="replace"), end="", flush=True)
+    return {}
+
+
+def _read(path: str) -> tuple[bytes, str]:
+    with open(path, "rb") as f:
+        data = f.read()
+    mime = mimetypes.guess_type(path)[0] or "application/octet-stream"
+    return data, mime
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=(__doc__ or "lumen-tpu example client").splitlines()[0]
+    )
+    ap.add_argument("--addr", default="127.0.0.1:50051")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("caps")
+    sub.add_parser("health")
+    p = sub.add_parser("embed-text"); p.add_argument("text")
+    p = sub.add_parser("embed-image"); p.add_argument("image")
+    p = sub.add_parser("classify"); p.add_argument("image"); p.add_argument("--top-k", type=int, default=5); p.add_argument("--scene", action="store_true")
+    p = sub.add_parser("faces"); p.add_argument("image"); p.add_argument("--embed", action="store_true")
+    p = sub.add_parser("ocr"); p.add_argument("image")
+    p = sub.add_parser("caption"); p.add_argument("image")
+    p.add_argument("--prompt", default="Describe this photo in one sentence.")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--stream", action="store_true")
+    args = ap.parse_args(argv)
+
+    chan = grpc.insecure_channel(args.addr)
+    grpc.channel_ready_future(chan).result(timeout=min(args.timeout, 30))
+    stub = pbg.InferenceStub(chan)
+
+    if args.cmd == "caps":
+        caps = stub.GetCapabilities(empty_pb2.Empty(), timeout=args.timeout)
+        print(json.dumps({
+            "service": caps.service_name,
+            "models": list(caps.model_ids),
+            "runtime": caps.runtime,
+            "tasks": [t.name for t in caps.tasks],
+        }, indent=2))
+        return 0
+    if args.cmd == "health":
+        stub.Health(empty_pb2.Empty(), timeout=args.timeout)
+        print("ok")
+        return 0
+
+    if args.cmd == "embed-text":
+        out = _infer(stub, "clip_text_embed", args.text.encode(), "text/plain", {}, args.timeout)
+    elif args.cmd == "embed-image":
+        data, mime = _read(args.image)
+        out = _infer(stub, "clip_image_embed", data, mime, {}, args.timeout)
+    elif args.cmd == "classify":
+        data, mime = _read(args.image)
+        task = "clip_scene_classify" if args.scene else "clip_classify"
+        out = _infer(stub, task, data, mime, {"topk": str(args.top_k)}, args.timeout)
+    elif args.cmd == "faces":
+        data, mime = _read(args.image)
+        task = "face_detect_and_embed" if args.embed else "face_detect"
+        out = _infer(stub, task, data, mime, {}, args.timeout)
+    elif args.cmd == "ocr":
+        data, mime = _read(args.image)
+        out = _infer(stub, "ocr", data, mime, {}, args.timeout)
+    elif args.cmd == "caption":
+        data, mime = _read(args.image)
+        meta = {
+            "messages": json.dumps([{"role": "user", "content": args.prompt}]),
+            "max_new_tokens": str(args.max_new_tokens),
+            "do_sample": "false",
+        }
+        task = "vlm_generate_stream" if args.stream else "vlm_generate"
+        out = _infer(stub, task, data, mime, meta, args.timeout, stream=args.stream)
+        if args.stream:
+            print()  # newline after streamed chunks
+    else:  # pragma: no cover
+        raise SystemExit(f"unknown command {args.cmd}")
+
+    # Embeddings are long; print a compact view.
+    if "vector" in out:
+        vec = out.pop("vector")
+        out["vector"] = f"[{len(vec)} floats: {vec[0]:.4f}, {vec[1]:.4f}, ...]"
+    print(json.dumps(out, indent=2, ensure_ascii=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
