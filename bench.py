@@ -551,6 +551,39 @@ def phase_baseline_torch(iters: int = 8) -> dict:
     return {"images_per_sec": round(iters / dt, 2)}
 
 
+def phase_baseline_vlm(new_tokens: int = 24) -> dict:
+    """Reference execution model for the VLM: per-request (batch 1) CPU
+    autoregressive decode of the same half-depth Qwen2-0.5B shape the TPU
+    phase runs (reference decodes one token per session.run on CPU,
+    ``packages/lumen-vlm/src/lumen_vlm/backends/onnxrt_backend.py:298-356``)."""
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(
+        vocab_size=32768,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_hidden_layers=12,
+        num_attention_heads=14,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+        tie_word_embeddings=True,
+        bos_token_id=1,
+        eos_token_id=2,
+        pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    model = Qwen2ForCausalLM(cfg).eval()
+    ids = torch.randint(3, 32000, (1, 64))
+    with torch.no_grad():
+        model.generate(ids, max_new_tokens=4, do_sample=False)  # warmup
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new_tokens, do_sample=False)
+        dt = time.perf_counter() - t0
+    n = int(out.shape[1] - ids.shape[1])
+    return {"tokens_per_sec": round(n / dt, 2)}
+
+
 def phase_probe() -> dict:
     """Cheap claim probe: backend init + one tiny op. Emitted first by the
     combined TPU child so the parent knows the claim succeeded (and on what
@@ -578,6 +611,7 @@ PHASES = {
     "ingest": phase_ingest,
     "flash_ab": phase_flash_ab,
     "baseline": phase_baseline_torch,
+    "baseline_vlm": phase_baseline_vlm,
 }
 
 
@@ -709,6 +743,11 @@ def main(args) -> None:
     baseline, base_err = _run_phase("baseline", timeout=min(tmo, 300.0))
     if base_err:
         errors.append(base_err)
+    vlm_baseline = None
+    if full:
+        vlm_baseline, vb_err = _run_phase("baseline_vlm", timeout=min(tmo, 300.0))
+        if vb_err:
+            errors.append(vb_err)
 
     vlm = results.get("vlm")
     if vlm:
@@ -760,6 +799,13 @@ def main(args) -> None:
             extras["mfu_pct"] = round(100 * value * VITB32_FLOPS_PER_IMG / peak, 2)
     if baseline:
         extras["baseline_torch_cpu_b1_images_per_sec"] = baseline.get("images_per_sec")
+    if vlm_baseline:
+        extras["baseline_torch_cpu_b1_vlm_tokens_per_sec"] = vlm_baseline.get("tokens_per_sec")
+        if vlm and vlm.get("tokens_per_sec") and vlm.get("platform") not in ("cpu", None) \
+                and vlm_baseline.get("tokens_per_sec"):
+            extras["vlm_vs_baseline"] = round(
+                vlm["tokens_per_sec"] / vlm_baseline["tokens_per_sec"], 2
+            )
     if errors:
         extras["errors"] = errors[:6]
 
