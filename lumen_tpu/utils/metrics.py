@@ -118,7 +118,19 @@ class MetricsRegistry:
         live buffers). TPU backends report bytes_in_use/bytes_limit via
         PJRT; backends without stats (CPU) yield empty dicts."""
         try:
-            import jax
+            import sys
+
+            jax = sys.modules.get("jax")
+            if jax is None:
+                return {}  # jax never imported: nothing to report
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                # Metrics must be side-effect-free: jax.devices() would
+                # INITIALIZE a backend (seconds of init — and on a TPU
+                # host, a chip claim) from inside the metrics HTTP thread
+                # of a server that may never use jax (e.g. echo).
+                return {}
 
             out = {}
             for dev in jax.devices():
