@@ -302,7 +302,9 @@ def build_app(state: AppState | None = None) -> web.Application:
         if task is None:
             return _json_error(404, "unknown install task")
         limit = _int_query(request, "limit", 200)
-        lines = list(task.log_lines)[-limit:] if limit else []
+        lines = list(task.log_lines)
+        if limit:  # limit=0 means "all lines"
+            lines = lines[-limit:]
         return web.json_response({"task_id": task.task_id, "lines": lines})
 
     async def install_tasks(request: web.Request) -> web.Response:
@@ -359,7 +361,9 @@ def build_app(state: AppState | None = None) -> web.Application:
         lines = [
             {"message": e.message, "level": e.level} for e in list(state.server_logs)
         ]
-        return web.json_response({"lines": lines[-limit:] if limit else []})
+        if limit:  # limit=0 means "all lines"
+            lines = lines[-limit:]
+        return web.json_response({"lines": lines})
 
     # -- metrics ----------------------------------------------------------
 

@@ -96,6 +96,10 @@ class CLIPManager:
         self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
         self.warmup = warmup
         self.info: ModelInfo = load_model_info(model_dir)
+        # (vision, text) ClipTowerGraph when graph-served; the probed flag
+        # memoizes a negative probe so non-graph models scan the dir once.
+        self._graphs = None
+        self._graphs_probed = False
         self.cfg = self._build_config(model_dir)
         # Deployment override for the serving-side text pad length (e.g. a
         # BERT-text model whose queries are known-short).
@@ -115,7 +119,6 @@ class CLIPManager:
     # -- configuration ----------------------------------------------------
 
     def _build_config(self, model_dir: str) -> CLIPConfig:
-        self._graphs = None  # (vision, text) ClipTowerGraph when graph-served
         cfg_path = os.path.join(model_dir, "config.json")
         if os.path.exists(cfg_path):
             with open(cfg_path, "r", encoding="utf-8") as f:
@@ -159,9 +162,11 @@ class CLIPManager:
         )
 
     def _load_graphs(self, model_dir: str):
-        """Probe for exported vision+text towers; memoized on self."""
-        if self._graphs is not None:
+        """Probe for exported vision+text towers; memoized on self (both
+        outcomes, so a non-graph model scans the directory only once)."""
+        if self._graphs_probed:
             return self._graphs
+        self._graphs_probed = True
         from .graph import ClipTowerGraph, find_clip_onnx
 
         found = find_clip_onnx(model_dir, precision=self.info.extra("precision"))
@@ -220,8 +225,16 @@ class CLIPManager:
             params = self.policy.cast_params(params)
             # DP serving: params replicated over the mesh; micro-batches are
             # data-sharded so one batched call spreads across every device
-            # (trivial placement on a 1-device mesh).
-            self.params = replicate(params, self.mesh)
+            # (trivial placement on a 1-device mesh). A mesh with a
+            # ``model`` axis additionally tensor-parallelizes the towers
+            # (both towers are standard transformers, so the shared TP
+            # rules apply — SURVEY §2.8).
+            if dict(self.mesh.shape).get("model", 1) > 1:
+                from ...parallel.sharding import TRANSFORMER_TP_RULES, shard_params
+
+                self.params = shard_params(params, self.mesh, TRANSFORMER_TP_RULES)
+            else:
+                self.params = replicate(params, self.mesh)
 
             @jax.jit
             def encode_images(params, pixels_u8):

@@ -26,16 +26,19 @@ class ClipTokenizer:
         vocab_txt = os.path.join(model_dir, "vocab.txt")
         if os.path.exists(path):
             tok = Tokenizer.from_file(path)
+            pad_id = 0
+            if tok.padding is not None and "pad_id" in tok.padding:
+                pad_id = tok.padding["pad_id"]
         elif os.path.exists(vocab_txt):
             # BERT wordpiece repos (CN-CLIP) ship vocab.txt instead of a
             # fast-tokenizer JSON; same fallback chain as the reference
             # (``onnxrt_backend.py:307-376`` tries AutoTokenizer last).
             tok = cls._bert_from_vocab(model_dir, vocab_txt)
+            # BERT pads with [PAD]'s actual id (validated present by
+            # _bert_from_vocab), not an assumed 0.
+            pad_id = tok.get_vocab()["[PAD]"]
         else:
             raise FileNotFoundError(f"no tokenizer.json or vocab.txt in {model_dir}")
-        pad_id = 0
-        if tok.padding is not None and "pad_id" in tok.padding:
-            pad_id = tok.padding["pad_id"]
         tok.no_padding()  # we pad ourselves to the static context length
         tok.enable_truncation(max_length=context_length)
         return cls(tok, context_length, pad_id)
@@ -68,7 +71,14 @@ class ClipTokenizer:
         tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
         tok.decoder = decoders.WordPiece(prefix="##")
         vocab = tok.get_vocab()
-        cls_id, sep_id = vocab.get("[CLS]", 101), vocab.get("[SEP]", 102)
+        missing = [t for t in ("[CLS]", "[SEP]", "[UNK]", "[PAD]") if t not in vocab]
+        if missing:
+            raise ValueError(
+                f"vocab.txt at {vocab_txt} lacks required special tokens "
+                f"{missing}; refusing to guess bert-base ids for a "
+                "nonstandard vocab"
+            )
+        cls_id, sep_id = vocab["[CLS]"], vocab["[SEP]"]
         tok.post_processor = TemplateProcessing(
             single="[CLS] $A [SEP]",
             pair="[CLS] $A [SEP] $B [SEP]",

@@ -112,6 +112,16 @@ class MetricsRegistry:
             "tasks": dict(sorted(tasks.items())),
         }
 
+    _probe_warned = False
+
+    @staticmethod
+    def _log_probe_failure_once(msg: str) -> None:
+        if not MetricsRegistry._probe_warned:
+            MetricsRegistry._probe_warned = True
+            import logging
+
+            logging.getLogger("lumen_tpu.metrics").warning(msg)
+
     @staticmethod
     def device_memory() -> dict[str, dict[str, int]]:
         """Per-device memory stats (HBM accounting: params + KV caches +
@@ -125,7 +135,16 @@ class MetricsRegistry:
                 return {}  # jax never imported: nothing to report
             from jax._src import xla_bridge
 
-            if not xla_bridge._backends:
+            backends = getattr(xla_bridge, "_backends", None)
+            if backends is None:
+                # Private attribute moved in a jax upgrade: degrade to
+                # empty but say so once instead of silently vanishing.
+                MetricsRegistry._log_probe_failure_once(
+                    "jax._src.xla_bridge._backends not found; "
+                    "device_memory metrics disabled"
+                )
+                return {}
+            if not backends:
                 # Metrics must be side-effect-free: jax.devices() would
                 # INITIALIZE a backend (seconds of init — and on a TPU
                 # host, a chip claim) from inside the metrics HTTP thread

@@ -651,8 +651,10 @@ class TestRestParityEndpoints:
             assert r.status == 400
             state = client.server.app[STATE_KEY]
             state.broadcast_log("srv", source="server")
+            # limit=0 means "all lines" (not "no lines").
             r = await client.get("/api/v1/server/logs?limit=0")
-            assert (await r.json())["lines"] == []
+            lines = (await r.json())["lines"]
+            assert [e["message"] for e in lines] == ["srv"]
             return True
 
         assert with_client(fn)
