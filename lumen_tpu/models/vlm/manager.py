@@ -190,11 +190,18 @@ class VLMManager:
         gen_slots: int = 8,
         gen_block: int = 8,
         quantize: str | None = None,  # None | "int8" (weight-only decoder quant)
+        mesh_axes: dict[str, int] | None = None,
     ):
         if quantize not in (None, "int8"):
             raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
         self.quantize = quantize
         self.model_dir = model_dir
+        from ...runtime.mesh import build_mesh
+
+        # Serving mesh: a ``model`` axis tensor-parallelizes the decoder, an
+        # ``expert`` axis shards MoE expert banks (SURVEY §2.8); without
+        # either the mesh is the trivial data mesh and weights replicate.
+        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
         self.policy = get_policy(dtype)
         self.warmup = warmup
         self.max_seq = max_seq
@@ -273,6 +280,41 @@ class VLMManager:
 
     # -- initialization ----------------------------------------------------
 
+    def _place_params(self, params):
+        """Place loaded weights on the serving mesh: TP rules when the mesh
+        carries a ``model`` axis, EP rules first when it carries ``expert``
+        (first-match-wins keeps expert banks on the expert axis), replicated
+        otherwise. int8-quantized trees ship (qweight, scale) leaves that
+        the kernel-path rules don't name, so they replicate with a log —
+        TP+int8 is an explicit non-goal (int8 already wins on bandwidth)."""
+        from ...parallel.sharding import (
+            MOE_EP_RULES,
+            TRANSFORMER_TP_RULES,
+            replicate,
+            shard_params,
+        )
+
+        shape = dict(self.mesh.shape)
+        rules = []
+        if shape.get("expert", 1) > 1:
+            rules += MOE_EP_RULES
+        if shape.get("model", 1) > 1:
+            if self.quantize:
+                logger.warning(
+                    "mesh has model=%d but decoder is int8-quantized; "
+                    "TP rules target kernel leaves and will not apply",
+                    shape["model"],
+                )
+            rules += TRANSFORMER_TP_RULES
+        if rules:
+            logger.info(
+                "sharding VLM params over mesh %s (%d rules)", shape, len(rules)
+            )
+            return shard_params(params, self.mesh, rules)
+        if self.mesh.devices.size > 1:
+            return replicate(params, self.mesh)
+        return jax.device_put(params)
+
     def initialize(self) -> None:
         if self._initialized:
             return
@@ -340,13 +382,22 @@ class VLMManager:
             # Quantized decoder was cast pre-quantization; the kept native
             # vision tower still needs its (ordinary) dtype cast.
             params["vision"] = self.policy.cast_params(params["vision"])
-        self.params = jax.device_put(params)
+        self.params = self._place_params(params)
         self.tokenizer = VlmTokenizer.from_model_dir(self.model_dir)
         if vision_graph is not None:
             self.vision_tokens = vision_graph.probe(
                 self.cfg.vision.image_size, self.cfg.decoder.hidden_size
             )
-            self._vision_params = jax.device_put(dict(vision_graph.module.params))
+            if self.mesh.devices.size > 1:
+                from ...parallel.sharding import replicate
+
+                # The graph-served vision tower has no TP rules; replicate
+                # so it composes with a sharded decoder on the same mesh.
+                self._vision_params = replicate(
+                    dict(vision_graph.module.params), self.mesh
+                )
+            else:
+                self._vision_params = jax.device_put(dict(vision_graph.module.params))
             logger.info(
                 "vlm vision tower: graph %s (%d MB params, %d tokens)",
                 vision_onnx,

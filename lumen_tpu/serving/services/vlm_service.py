@@ -79,6 +79,7 @@ class VlmService(BaseService):
             gen_slots=gen_batch,  # pool width = configured decode batch
             gen_block=bs.decode_block,
             quantize=bs.quantize,
+            mesh_axes=bs.mesh.axes if bs.mesh else None,
             **kw,
         )
         manager.initialize()

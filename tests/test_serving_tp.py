@@ -1,0 +1,264 @@
+"""Serving-side TP/EP sharding tests.
+
+Round-2 verdict: TP/EP rules existed but were applied only by the trainer;
+every serving manager replicated its weights. These tests pin the serving
+path: a mesh with a ``model`` axis tensor-parallelizes the VLM decoder and
+the CLIP towers at weight-load, an ``expert`` axis shards MoE expert banks,
+and the sharded decode is token-identical to the replicated one on the
+simulated 8-device CPU mesh (SURVEY §2.8; reference has no mesh at all —
+its scaling is a gRPC thread pool, ``src/lumen/server.py:232-235``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from lumen_tpu.models.vlm import ChatMessage, VLMManager
+from lumen_tpu.models.vlm.modeling import VLMConfig, VLMModel
+from tests.test_vlm import make_vlm_model_dir, write_vlm_tokenizer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the simulated 8-device mesh"
+)
+
+PROMPT = [ChatMessage(role="user", content="describe the image")]
+
+
+def _leaf_sharding_specs(params) -> dict[str, tuple]:
+    out = {}
+
+    def visit(keypath, leaf):
+        from lumen_tpu.parallel.sharding import keypath_str
+
+        out[keypath_str(keypath)] = tuple(leaf.sharding.spec)
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return out
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return make_vlm_model_dir(tmp_path_factory.mktemp("tp"))
+
+
+def _mgr(model_dir, **kw):
+    mgr = VLMManager(
+        model_dir,
+        dtype="float32",
+        max_seq=128,
+        max_new_cap=16,
+        prefill_buckets=(16, 32),
+        gen_batch_size=2,
+        gen_batch_latency_ms=1.0,
+        **kw,
+    )
+    mgr.initialize()
+    return mgr
+
+
+class TestVlmTensorParallel:
+    def test_tp_decode_token_identical(self, model_dir):
+        repl = _mgr(model_dir)
+        try:
+            want = repl.generate(PROMPT, max_new_tokens=12)
+        finally:
+            repl.close()
+        tp = _mgr(model_dir, mesh_axes={"data": 4, "model": 2})
+        try:
+            got = tp.generate(PROMPT, max_new_tokens=12)
+        finally:
+            tp.close()
+        assert got.tokens == want.tokens
+        assert got.text == want.text
+
+    def test_tp_params_actually_sharded(self, model_dir):
+        mgr = _mgr(model_dir, mesh_axes={"data": 4, "model": 2})
+        try:
+            specs = _leaf_sharding_specs(mgr.params)
+        finally:
+            mgr.close()
+        # Megatron layout: QKV/up kernels shard the output dim, down/out
+        # kernels the input dim.
+        assert specs["decoder/layers_0/attn/q_proj/kernel"] == (None, "model")
+        assert specs["decoder/layers_0/attn/o_proj/kernel"] == ("model",)
+        assert specs["decoder/layers_0/mlp/gate_proj/kernel"] == (None, "model")
+        assert specs["decoder/layers_0/mlp/down_proj/kernel"] == ("model",)
+        # Norms replicate.
+        assert specs["decoder/final_norm/scale"] == ()
+
+    def test_trivial_mesh_unsharded(self, model_dir):
+        mgr = _mgr(model_dir)
+        try:
+            specs = _leaf_sharding_specs(mgr.params)
+        finally:
+            mgr.close()
+        assert all(s == () for s in specs.values())
+
+
+# -- MoE / expert parallelism -------------------------------------------------
+
+
+def make_moe_model_dir(tmp_path) -> str:
+    """Tiny Qwen2-MoE-shaped checkpoint saved in HF config terms so the
+    manager's from_hf path reconstructs the same MoE config."""
+    from safetensors.numpy import save_file
+
+    from lumen_tpu.runtime.weights import flatten_variables
+
+    cfg = VLMConfig.tiny()
+    cfg = dataclasses.replace(
+        cfg,
+        decoder=dataclasses.replace(
+            cfg.decoder,
+            moe_experts=4,
+            moe_top_k=2,
+            moe_intermediate_size=32,
+            moe_norm_topk=True,
+        ),
+    )
+    model = VLMModel(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, 4), np.int32),
+        np.zeros((1, cfg.vision.image_size, cfg.vision.image_size, 3), np.float32),
+    )
+    model_dir = tmp_path / "models" / "TinyMoE"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    save_file(flatten_variables(dict(variables)), str(model_dir / "model.safetensors"))
+    d, v = cfg.decoder, cfg.vision
+    config = {
+        "text_config": {
+            "hidden_size": d.hidden_size,
+            "num_hidden_layers": d.layers,
+            "num_attention_heads": d.heads,
+            "num_key_value_heads": d.kv_heads,
+            "intermediate_size": d.intermediate_size,
+            "vocab_size": d.vocab_size,
+            "rope_theta": d.rope_theta,
+            "max_position_embeddings": d.max_position_embeddings,
+            "bos_token_id": cfg.bos_token_id,
+            "eos_token_id": cfg.eos_token_id,
+            "pad_token_id": cfg.pad_token_id,
+            "tie_word_embeddings": True,
+            "num_experts": d.moe_experts,
+            "num_experts_per_tok": d.moe_top_k,
+            "moe_intermediate_size": d.moe_intermediate_size,
+            "decoder_sparse_step": d.moe_every,
+            "norm_topk_prob": d.moe_norm_topk,
+        },
+        "vision_config": {
+            "image_size": v.image_size,
+            "patch_size": v.patch_size,
+            "hidden_size": v.width,
+            "num_hidden_layers": v.layers,
+            "num_attention_heads": v.heads,
+        },
+        "image_token_index": cfg.image_token_id,
+    }
+    (model_dir / "config.json").write_text(json.dumps(config))
+    write_vlm_tokenizer(str(model_dir / "tokenizer.json"))
+    (model_dir / "tokenizer_config.json").write_text(json.dumps({
+        "chat_template": (
+            "{% for m in messages %}<|{{ m.role }}|> {{ m.content }} {% endfor %}"
+            "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+        )
+    }))
+    info = {
+        "name": "TinyMoE",
+        "version": "1.0.0",
+        "description": "tiny test moe vlm",
+        "model_type": "vlm",
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/TinyMoE"},
+        "runtimes": {"jax": {"available": True, "files": ["model.safetensors"]}},
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+@pytest.fixture(scope="module")
+def moe_model_dir(tmp_path_factory):
+    return make_moe_model_dir(tmp_path_factory.mktemp("ep"))
+
+
+class TestVlmExpertParallel:
+    def test_ep_decode_token_identical(self, moe_model_dir):
+        repl = _mgr(moe_model_dir)
+        try:
+            want = repl.generate(PROMPT, max_new_tokens=12)
+        finally:
+            repl.close()
+        ep = _mgr(moe_model_dir, mesh_axes={"data": 4, "expert": 2})
+        try:
+            got = ep.generate(PROMPT, max_new_tokens=12)
+        finally:
+            ep.close()
+        assert got.tokens == want.tokens
+
+    def test_ep_params_actually_sharded(self, moe_model_dir):
+        mgr = _mgr(moe_model_dir, mesh_axes={"data": 4, "expert": 2})
+        try:
+            specs = _leaf_sharding_specs(mgr.params)
+        finally:
+            mgr.close()
+        assert specs["decoder/layers_0/mlp/w_gate"] == ("expert",)
+        assert specs["decoder/layers_0/mlp/w_up"] == ("expert",)
+        assert specs["decoder/layers_0/mlp/w_down"] == ("expert",)
+        # Router is tiny and every token needs it: replicated.
+        assert specs["decoder/layers_0/mlp/router"] == ()
+
+    def test_ep_plus_tp_composes(self, moe_model_dir):
+        """mesh {data:2, expert:2, model:2}: EP rules win on expert banks
+        (first match), TP rules on the dense projections."""
+        mgr = _mgr(moe_model_dir, mesh_axes={"data": 2, "expert": 2, "model": 2})
+        try:
+            specs = _leaf_sharding_specs(mgr.params)
+            got = mgr.generate(PROMPT, max_new_tokens=8)
+        finally:
+            mgr.close()
+        assert specs["decoder/layers_0/mlp/w_gate"] == ("expert",)
+        assert specs["decoder/layers_0/attn/q_proj/kernel"] == (None, "model")
+        assert len(got.tokens) == 8
+
+
+# -- config -> service path ---------------------------------------------------
+
+
+class TestServiceMeshConfig:
+    def test_vlm_service_from_config_with_tp_mesh(self, tmp_path):
+        """A config carrying mesh {data: 4, model: 2} serves correctly on
+        the simulated 8-device mesh, end to end through the service layer."""
+        from lumen_tpu.core.config import ServiceConfig
+        from lumen_tpu.serving.services.vlm_service import VlmService
+
+        cache_dir = str(tmp_path)
+        make_vlm_model_dir(tmp_path)
+        raw = {
+            "enabled": True,
+            "package": "lumen_tpu.models.vlm",
+            "import_info": {
+                "registry_class": "lumen_tpu.serving.services.vlm_service.VlmService"
+            },
+            "backend_settings": {
+                "batch_size": 2,
+                "dtype": "float32",
+                "mesh": {"axes": {"data": 4, "model": 2}},
+                "batch_buckets": [16, 32],
+            },
+            "models": {"vlm": {"model": "TinyVLM", "runtime": "jax"}},
+        }
+        svc = VlmService.from_config(ServiceConfig.model_validate(raw), cache_dir)
+        try:
+            mesh_shape = dict(svc.manager.mesh.shape)
+            assert mesh_shape == {"data": 4, "model": 2}
+            specs = _leaf_sharding_specs(svc.manager.params)
+            assert specs["decoder/layers_0/attn/q_proj/kernel"] == (None, "model")
+            out = svc.manager.generate(PROMPT, max_new_tokens=8)
+            assert len(out.tokens) == 8
+        finally:
+            svc.close()
