@@ -91,6 +91,7 @@ PHASE_EST_S = {
     "face": 300,
     "ocr": 330,
     "ingest": 360,
+    "bench_grpc": 900,
 }
 
 # v5e bf16 peak per chip; used only for the MFU estimate.
@@ -654,6 +655,443 @@ def phase_baseline_vlm(new_tokens: int = 24) -> dict:
     return {"tokens_per_sec": round(n / dt, 2)}
 
 
+# ---------------------------------------------------------------------------
+# gRPC serving benchmark (BASELINE.md protocol: warm model, p50/p95 +
+# steady-state rps over many requests, 1- and 10-concurrent clients)
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_ms: list[float], q: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, int(round(q * (len(sorted_ms) - 1))))
+    return sorted_ms[idx]
+
+
+def _grpc_measure(stub, pb, task: str, payload: bytes, mime: str,
+                  meta: dict, n: int, concurrency: int) -> dict:
+    """Drive ``n`` unary Infer round-trips at the given client concurrency
+    over one shared channel; returns {p50_ms, p95_ms, rps, n, concurrency}."""
+    import threading
+
+    def one(cid: str) -> float:
+        t0 = time.perf_counter()
+        resps = list(
+            stub.Infer(iter([pb.InferRequest(
+                correlation_id=cid, task=task, payload=payload,
+                payload_mime=mime, meta=meta,
+            )]))
+        )
+        if not resps or resps[-1].HasField("error"):
+            msg = resps[-1].error.message if resps else "no response"
+            raise RuntimeError(f"{task}: {msg}")
+        return (time.perf_counter() - t0) * 1e3
+
+    for i in range(2):  # warm (compile + caches) before timing
+        one(f"warm{i}")
+    lat: list[float] = []
+    worker_errors: list[BaseException] = []
+    lock = threading.Lock()
+    counts = [n // concurrency + (1 if i < n % concurrency else 0)
+              for i in range(concurrency)]
+
+    def worker(wid: int, count: int) -> None:
+        try:
+            mine = [one(f"w{wid}-{i}") for i in range(count)]
+        except BaseException as e:  # noqa: BLE001 - re-raised after join
+            with lock:
+                worker_errors.append(e)
+            return
+        with lock:
+            lat.extend(mine)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i, c))
+               for i, c in enumerate(counts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if worker_errors:
+        # Partial latency samples would publish a valid-looking but
+        # corrupted distribution; fail the phase loudly instead.
+        raise RuntimeError(
+            f"{task}: {len(worker_errors)} worker(s) failed: {worker_errors[0]}"
+        )
+    lat.sort()
+    return {
+        "p50_ms": round(_percentile(lat, 0.50), 2),
+        "p95_ms": round(_percentile(lat, 0.95), 2),
+        "rps": round(len(lat) / wall, 2),
+        "n": len(lat),
+        "concurrency": concurrency,
+    }
+
+
+def _start_grpc(services: dict):
+    """The repo's real serving path: HubRouter behind a grpc server on an
+    ephemeral loopback port (same wiring as serving/server.py, minus config
+    I/O), 10 workers to match the reference's ThreadPoolExecutor(10)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import grpc
+
+    from lumen_tpu.serving.proto import ml_service_pb2 as pb
+    from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+        InferenceStub,
+        add_InferenceServicer_to_server,
+    )
+    from lumen_tpu.serving.router import HubRouter
+
+    server = grpc.server(ThreadPoolExecutor(max_workers=10))
+    add_InferenceServicer_to_server(HubRouter(services), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    return server, channel, InferenceStub(channel), pb
+
+
+def _bench_jpeg(size: int) -> bytes:
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    arr = np.random.default_rng(0).integers(0, 255, (size, size, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=85)
+    return buf.getvalue()
+
+
+def _write_bench_clip_dir(root: str, tiny: bool) -> str:
+    """Random-weight HF-format CLIP checkpoint (ViT-B/32 unless tiny) that
+    the manager's normal convert path loads — the bench exercises the real
+    weight-load + serve stack, just without a download."""
+    import json as _json
+
+    import torch
+    from safetensors.torch import save_file
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from tokenizers.processors import TemplateProcessing
+    from transformers import CLIPConfig as HFCLIPConfig, CLIPModel as HFCLIPModel
+
+    if tiny:
+        cfg = HFCLIPConfig(
+            projection_dim=32,
+            text_config={"hidden_size": 48, "num_hidden_layers": 2,
+                         "num_attention_heads": 4, "vocab_size": 128,
+                         "max_position_embeddings": 16, "intermediate_size": 192,
+                         "hidden_act": "quick_gelu", "eos_token_id": 127},
+            vision_config={"hidden_size": 64, "num_hidden_layers": 2,
+                           "num_attention_heads": 4, "image_size": 32,
+                           "patch_size": 16, "intermediate_size": 256,
+                           "hidden_act": "quick_gelu"},
+        )
+        eot = 127
+    else:
+        cfg = HFCLIPConfig()  # ViT-B/32 defaults (the reference's headline model)
+        eot = 49407
+    torch.manual_seed(0)
+    model = HFCLIPModel(cfg).eval()
+    model_dir = os.path.join(root, "models", "BenchCLIP")
+    os.makedirs(model_dir, exist_ok=True)
+    state = {k: v for k, v in model.state_dict().items() if "position_ids" not in k}
+    save_file(state, os.path.join(model_dir, "model.safetensors"))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump(cfg.to_dict(), f)
+    vocab = {"<unk>": 0, "a": 1, "photo": 2, "of": 3, "cat": 4, "<eot>": eot}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.post_processor = TemplateProcessing(
+        single="$A <eot>", special_tokens=[("<eot>", eot)]
+    )
+    tok.save(os.path.join(model_dir, "tokenizer.json"))
+    with open(os.path.join(model_dir, "model_info.json"), "w") as f:
+        _json.dump({
+            "name": "BenchCLIP", "version": "1.0.0", "description": "bench",
+            "model_type": "clip",
+            "embedding_dim": cfg.projection_dim,
+            "source": {"format": "custom", "repo_id": "bench/clip"},
+            "runtimes": {"jax": {"available": True, "files": ["model.safetensors"]}},
+        }, f)
+    return model_dir
+
+
+def _write_bench_vlm_dir(root: str, tiny: bool) -> str:
+    """Random-weight flax-native VLM checkpoint: half-depth Qwen2-0.5B
+    decoder + small vision tower (same shapes as phase_vlm so compile-cache
+    warmth carries over between phases where programs coincide)."""
+    import json as _json
+
+    import jax
+    import numpy as np
+    from safetensors.numpy import save_file
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    from lumen_tpu.models.vlm.modeling import VLMConfig
+    from lumen_tpu.runtime.weights import flatten_variables
+
+    if tiny:
+        cfg = VLMConfig.tiny()
+    else:
+        cfg = VLMConfig.from_hf({
+            "text_config": {
+                "hidden_size": 896, "num_hidden_layers": 12,
+                "num_attention_heads": 14, "num_key_value_heads": 2,
+                "intermediate_size": 4864, "vocab_size": 32768,
+                "max_position_embeddings": 1024,
+                "bos_token_id": 1, "eos_token_id": 2, "pad_token_id": 0,
+                "tie_word_embeddings": True,
+            },
+            "vision_config": {
+                "image_size": 224, "patch_size": 32, "hidden_size": 256,
+                "num_hidden_layers": 2, "num_attention_heads": 4,
+            },
+            "image_token_index": 32767,
+        })
+    from lumen_tpu.models.vlm.modeling import VLMModel
+
+    model = VLMModel(cfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 4), jax.numpy.int32),
+            jax.numpy.zeros(
+                (1, cfg.vision.image_size, cfg.vision.image_size, 3),
+                jax.numpy.float32,
+            ),
+        )
+    )
+    rng = np.random.default_rng(0)
+    flat = {
+        k: (0.02 * rng.standard_normal(v.shape)).astype(np.float32)
+        for k, v in flatten_variables(
+            jax.tree.map(lambda s: np.zeros(s.shape, np.float32), dict(shapes))
+        ).items()
+    }
+    model_dir = os.path.join(root, "models", "BenchVLM")
+    os.makedirs(model_dir, exist_ok=True)
+    save_file(flat, os.path.join(model_dir, "model.safetensors"))
+    d, v = cfg.decoder, cfg.vision
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        _json.dump({
+            "text_config": {
+                "hidden_size": d.hidden_size, "num_hidden_layers": d.layers,
+                "num_attention_heads": d.heads, "num_key_value_heads": d.kv_heads,
+                "intermediate_size": d.intermediate_size, "vocab_size": d.vocab_size,
+                "rope_theta": d.rope_theta,
+                "max_position_embeddings": d.max_position_embeddings,
+                "bos_token_id": cfg.bos_token_id, "eos_token_id": cfg.eos_token_id,
+                "pad_token_id": cfg.pad_token_id, "tie_word_embeddings": True,
+            },
+            "vision_config": {
+                "image_size": v.image_size, "patch_size": v.patch_size,
+                "hidden_size": v.width, "num_hidden_layers": v.layers,
+                "num_attention_heads": v.heads,
+            },
+            "image_token_index": cfg.image_token_id,
+        }, f)
+    words = {"<pad>": 0, "<bos>": 1, "<eos>": 2, "<unk>": 3,
+             "describe": 10, "the": 11, "image": 12}
+    tok = Tokenizer(models.WordLevel(words, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.save(os.path.join(model_dir, "tokenizer.json"))
+    with open(os.path.join(model_dir, "tokenizer_config.json"), "w") as f:
+        _json.dump({"chat_template": (
+            "{% for m in messages %}<|{{ m.role }}|> {{ m.content }} {% endfor %}"
+            "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+        )}, f)
+    with open(os.path.join(model_dir, "model_info.json"), "w") as f:
+        _json.dump({
+            "name": "BenchVLM", "version": "1.0.0", "description": "bench",
+            "model_type": "vlm",
+            "source": {"format": "custom", "repo_id": "bench/vlm"},
+            "runtimes": {"jax": {"available": True, "files": ["model.safetensors"]}},
+        }, f)
+    return model_dir
+
+
+def phase_bench_grpc() -> dict:
+    """BASELINE.md:25-29 protocol against THIS repo's server: warm gRPC
+    Infer path, p50/p95 + steady-state rps, 1- and 10-concurrent clients,
+    for clip_image_embed and (on TPU) vlm_generate."""
+    _apply_platform_env()
+    import json as _json
+    import shutil
+    import tempfile
+
+    import jax
+
+    from lumen_tpu.models.clip.manager import CLIPManager
+    from lumen_tpu.serving.services.clip_service import ClipService
+
+    cpu = jax.default_backend() == "cpu"
+    n = 40 if cpu else 1000
+    root = tempfile.mkdtemp(prefix="bench_grpc_")
+    out: dict = {"platform": jax.devices()[0].platform}
+    try:
+        _state("bench_grpc:clip:build")
+        clip_dir = _write_bench_clip_dir(root, tiny=cpu)
+        mgr = CLIPManager(
+            clip_dir,
+            dtype="float32" if cpu else "bfloat16",
+            batch_size=4 if cpu else 64,
+            max_batch_latency_ms=2.0,
+        )
+        svc = ClipService({"clip": mgr})
+        mgr.initialize()
+        server, channel, stub, pb = _start_grpc({"clip": svc})
+        try:
+            jpeg = _bench_jpeg(32 if cpu else 224)
+            _state("bench_grpc:clip:c1")
+            out["clip_image_embed_c1"] = _grpc_measure(
+                stub, pb, "clip_image_embed", jpeg, "image/jpeg", {}, n, 1
+            )
+            _state("bench_grpc:clip:c10")
+            out["clip_image_embed_c10"] = _grpc_measure(
+                stub, pb, "clip_image_embed", jpeg, "image/jpeg", {}, n, 10
+            )
+        finally:
+            channel.close()
+            server.stop(0)
+            svc.close()
+
+        if not cpu:
+            from lumen_tpu.models.vlm import VLMManager
+            from lumen_tpu.serving.services.vlm_service import VlmService
+
+            _state("bench_grpc:vlm:build")
+            vlm_dir = _write_bench_vlm_dir(root, tiny=cpu)
+            vmgr = VLMManager(
+                vlm_dir, dtype="bfloat16", max_seq=256, max_new_cap=32,
+                prefill_buckets=(64,), gen_batch_size=8,
+                gen_batch_latency_ms=4.0,
+            )
+            vsvc = VlmService(vmgr)
+            vmgr.initialize()
+            server, channel, stub, pb = _start_grpc({"vlm": vsvc})
+            try:
+                meta = {
+                    "messages": _json.dumps(
+                        [{"role": "user", "content": "describe the image"}]
+                    ),
+                    "max_new_tokens": "16",
+                }
+                jpeg = _bench_jpeg(224)
+                _state("bench_grpc:vlm:c1")
+                out["vlm_generate_c1"] = _grpc_measure(
+                    stub, pb, "vlm_generate", jpeg, "image/jpeg", meta, 200, 1
+                )
+                _state("bench_grpc:vlm:c10")
+                out["vlm_generate_c10"] = _grpc_measure(
+                    stub, pb, "vlm_generate", jpeg, "image/jpeg", meta, 1000, 10
+                )
+            finally:
+                channel.close()
+                server.stop(0)
+                vsvc.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
+def phase_bench_grpc_ref() -> dict:
+    """The reference's execution model behind the SAME transport: a service
+    whose handler runs a torch-CPU batch-1 forward per request (the
+    reference serves one image per request through ORT/libtorch on CPU —
+    ``packages/lumen-clip/src/lumen_clip/backends/onnxrt_backend.py:465-494``),
+    measured with the identical client harness so the ratio is
+    apples-to-apples."""
+    import io
+    import json as _json
+
+    import torch
+    from PIL import Image
+    from transformers import (
+        CLIPVisionConfig,
+        CLIPVisionModelWithProjection,
+        Qwen2Config,
+        Qwen2ForCausalLM,
+    )
+
+    from lumen_tpu.serving import BaseService, TaskDefinition, TaskRegistry
+
+    vis_cfg = CLIPVisionConfig(
+        hidden_size=768, num_hidden_layers=12, num_attention_heads=12,
+        image_size=224, patch_size=32, intermediate_size=3072, projection_dim=512,
+    )
+    clip = CLIPVisionModelWithProjection(vis_cfg).eval()
+    qcfg = Qwen2Config(
+        vocab_size=32768, hidden_size=896, intermediate_size=4864,
+        num_hidden_layers=12, num_attention_heads=14, num_key_value_heads=2,
+        max_position_embeddings=512, tie_word_embeddings=True,
+        bos_token_id=1, eos_token_id=2, pad_token_id=0,
+    )
+    torch.manual_seed(0)
+    qwen = Qwen2ForCausalLM(qcfg).eval()
+
+    class TorchRefService(BaseService):
+        def __init__(self):
+            registry = TaskRegistry("ref")
+            registry.register(TaskDefinition(name="ref_image_embed", handler=self._embed))
+            registry.register(TaskDefinition(name="ref_generate", handler=self._generate))
+            super().__init__(registry)
+
+        def capability(self):
+            return self.registry.build_capability(
+                model_ids=["torch-ref"], runtime="torch-cpu", precisions=["fp32"]
+            )
+
+        def healthy(self):
+            return True
+
+        def close(self):
+            pass
+
+        def _embed(self, payload, mime, meta):
+            img = Image.open(io.BytesIO(payload)).convert("RGB").resize((224, 224))
+            import numpy as np
+
+            x = torch.from_numpy(
+                np.asarray(img, np.float32).transpose(2, 0, 1)[None] / 255.0
+            )
+            with torch.no_grad():
+                z = clip(pixel_values=x).image_embeds
+            return z.numpy().tobytes(), "application/octet-stream", {}
+
+        def _generate(self, payload, mime, meta):
+            ids = torch.randint(3, 32000, (1, 64))
+            with torch.no_grad():
+                out = qwen.generate(
+                    ids, max_new_tokens=int(meta.get("max_new_tokens", "16")),
+                    do_sample=False,
+                )
+            return _json.dumps({"tokens": int(out.shape[1] - 64)}).encode(), \
+                "application/json", {}
+
+    svc = TorchRefService()
+    server, channel, stub, pb = _start_grpc({"ref": svc})
+    try:
+        jpeg = _bench_jpeg(224)
+        out = {
+            "clip_image_embed_c1": _grpc_measure(
+                stub, pb, "ref_image_embed", jpeg, "image/jpeg", {}, 150, 1
+            ),
+            "clip_image_embed_c10": _grpc_measure(
+                stub, pb, "ref_image_embed", jpeg, "image/jpeg", {}, 150, 10
+            ),
+            "vlm_generate_c1": _grpc_measure(
+                stub, pb, "ref_generate", jpeg, "image/jpeg",
+                {"max_new_tokens": "16"}, 8, 1
+            ),
+        }
+    finally:
+        channel.close()
+        server.stop(0)
+        svc.close()
+    return out
+
+
 def phase_probe() -> dict:
     """Cheap claim probe: backend init + one tiny op. Emitted first by the
     combined TPU child so the parent knows the claim succeeded (and on what
@@ -681,6 +1119,8 @@ PHASES = {
     "ocr": phase_ocr,
     "ingest": phase_ingest,
     "flash_ab": phase_flash_ab,
+    "bench_grpc": phase_bench_grpc,
+    "bench_grpc_ref": phase_bench_grpc_ref,
     "baseline": phase_baseline_torch,
     "baseline_vlm": phase_baseline_vlm,
 }
@@ -898,7 +1338,8 @@ def main(args) -> None:
     names = (
         ["probe", "clip"]
         if light
-        else ["probe", "clip", "flash_ab", "vlm", "vlm_q8", "face", "ocr", "ingest"]
+        else ["probe", "clip", "flash_ab", "vlm", "vlm_q8", "face", "ocr",
+              "ingest", "bench_grpc"]
     )
 
     # torch-CPU baselines run concurrently with the claim wait: the TPU
@@ -910,6 +1351,9 @@ def main(args) -> None:
         baseline_box["clip"], baseline_box["clip_err"] = res, err
         res, err = _run_phase("baseline_vlm", timeout=420)
         baseline_box["vlm"], baseline_box["vlm_err"] = res, err
+        if not light:
+            res, err = _run_phase("bench_grpc_ref", timeout=600)
+            baseline_box["grpc_ref"], baseline_box["grpc_ref_err"] = res, err
 
     bt = threading.Thread(target=_baselines, daemon=True)
     bt.start()
@@ -976,6 +1420,25 @@ def main(args) -> None:
     if ingest:
         extras["ingest_images_per_sec"] = ingest.get("images_per_sec")
         extras["ingest_platform"] = ingest.get("platform")
+    grpc_res = results.get("bench_grpc")
+    if grpc_res:
+        extras["grpc"] = grpc_res
+    grpc_ref = baseline_box.get("grpc_ref")
+    if baseline_box.get("grpc_ref_err"):
+        errors.append(baseline_box["grpc_ref_err"])
+    if grpc_ref:
+        extras["grpc_ref_torch_cpu"] = grpc_ref
+        if (
+            grpc_res
+            and grpc_res.get("platform") not in ("cpu", None)
+            and grpc_res.get("clip_image_embed_c10", {}).get("rps")
+            and grpc_ref.get("clip_image_embed_c10", {}).get("rps")
+        ):
+            extras["grpc_clip_c10_rps_vs_ref"] = round(
+                grpc_res["clip_image_embed_c10"]["rps"]
+                / grpc_ref["clip_image_embed_c10"]["rps"],
+                2,
+            )
     flash_ab = results.get("flash_ab")
     if flash_ab:
         extras["flash_ab_ref_ms"] = flash_ab.get("ref_ms")
