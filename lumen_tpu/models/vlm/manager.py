@@ -290,7 +290,6 @@ class VLMManager:
         from ...parallel.sharding import (
             MOE_EP_RULES,
             TRANSFORMER_TP_RULES,
-            replicate,
             shard_params,
         )
 
@@ -300,20 +299,26 @@ class VLMManager:
             rules += MOE_EP_RULES
         if shape.get("model", 1) > 1:
             if self.quantize:
+                # Skip the TP rules entirely: the kernel-path rules can't
+                # match (qweight/scale leaves), and letting the embedding/
+                # bias rules half-apply would shard the tied lm_head while
+                # every projection replicates — all-reduce cost, no
+                # compute-sharding benefit.
                 logger.warning(
                     "mesh has model=%d but decoder is int8-quantized; "
-                    "TP rules target kernel leaves and will not apply",
+                    "TP+int8 is unsupported, serving replicated",
                     shape["model"],
                 )
-            rules += TRANSFORMER_TP_RULES
+            else:
+                rules += TRANSFORMER_TP_RULES
         if rules:
             logger.info(
                 "sharding VLM params over mesh %s (%d rules)", shape, len(rules)
             )
-            return shard_params(params, self.mesh, rules)
-        if self.mesh.devices.size > 1:
-            return replicate(params, self.mesh)
-        return jax.device_put(params)
+        # shard_params with no rules degrades every leaf to replication,
+        # and NamedSharding placement on a 1-device mesh is device_put —
+        # one call covers all cases.
+        return shard_params(params, self.mesh, rules)
 
     def initialize(self) -> None:
         if self._initialized:
@@ -388,16 +393,14 @@ class VLMManager:
             self.vision_tokens = vision_graph.probe(
                 self.cfg.vision.image_size, self.cfg.decoder.hidden_size
             )
-            if self.mesh.devices.size > 1:
-                from ...parallel.sharding import replicate
+            from ...parallel.sharding import replicate
 
-                # The graph-served vision tower has no TP rules; replicate
-                # so it composes with a sharded decoder on the same mesh.
-                self._vision_params = replicate(
-                    dict(vision_graph.module.params), self.mesh
-                )
-            else:
-                self._vision_params = jax.device_put(dict(vision_graph.module.params))
+            # The graph-served vision tower has no TP rules; replicate so
+            # it composes with a sharded decoder on the same mesh (on a
+            # 1-device mesh this is plain device placement).
+            self._vision_params = replicate(
+                dict(vision_graph.module.params), self.mesh
+            )
             logger.info(
                 "vlm vision tower: graph %s (%d MB params, %d tokens)",
                 vision_onnx,

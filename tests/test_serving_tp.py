@@ -226,6 +226,50 @@ class TestVlmExpertParallel:
         assert len(got.tokens) == 8
 
 
+# -- CLIP tensor parallelism --------------------------------------------------
+
+
+class TestClipTensorParallel:
+    def test_tp_embeddings_match_replicated(self, tmp_path_factory):
+        from tests.clip_fixtures import make_clip_model_dir, png_bytes
+
+        from lumen_tpu.models.clip.manager import CLIPManager
+
+        model_dir = make_clip_model_dir(tmp_path_factory.mktemp("cliptp"))
+        img = png_bytes(size=32, seed=3)
+
+        repl = CLIPManager(model_dir, dtype="float32", batch_size=2)
+        repl.initialize()
+        try:
+            want = repl.encode_image(img)
+        finally:
+            repl.close()
+
+        tp = CLIPManager(
+            model_dir, dtype="float32", batch_size=2,
+            mesh_axes={"data": 4, "model": 2},
+        )
+        tp.initialize()
+        try:
+            from lumen_tpu.parallel.sharding import keypath_str
+
+            specs = {}
+            jax.tree_util.tree_map_with_path(
+                lambda kp, leaf: specs.__setitem__(
+                    keypath_str(kp), tuple(leaf.sharding.spec)
+                ),
+                tp.params,
+            )
+            # The towers' projections are actually TP-sharded, not silently
+            # degraded to replication.
+            assert specs["vision/blocks_0/attn/q_proj/kernel"] == (None, "model")
+            assert specs["vision/blocks_0/mlp/fc2/kernel"] == ("model",)
+            got = tp.encode_image(img)
+        finally:
+            tp.close()
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
 # -- config -> service path ---------------------------------------------------
 
 
