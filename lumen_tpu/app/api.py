@@ -116,9 +116,17 @@ def build_app(state: AppState | None = None) -> web.Application:
 
     async def config_generate(request: web.Request) -> web.Response:
         body = await _body(request)
+        preset_name = body.get("preset", "cpu")
+        if preset_name == "auto":
+            # Pick mesh axes + batch defaults from the hardware probe
+            # (reference analog: detection-ordered PresetRegistry,
+            # ``utils/preset_registry.py:118-170``).
+            report = await asyncio.to_thread(hardware_report)
+            preset_name = report["recommended_preset"]
+            state.broadcast_log(f"hardware probe recommends preset {preset_name}")
         try:
             cfg = generate_config(
-                preset_name=body.get("preset", "cpu"),
+                preset_name=preset_name,
                 tier=body.get("tier", "light_weight"),
                 region=body.get("region", "other"),
                 cache_dir=body.get("cache_dir", "~/.lumen-tpu"),
@@ -131,7 +139,7 @@ def build_app(state: AppState | None = None) -> web.Application:
         # The previous save (if any) no longer matches the new config; a
         # path-less /server/start must not launch the stale YAML.
         state.config_path = None
-        state.broadcast_log(f"config generated (preset={body.get('preset', 'cpu')})")
+        state.broadcast_log(f"config generated (preset={preset_name})")
         return web.json_response(cfg.model_dump(exclude_none=True))
 
     async def config_current(request: web.Request) -> web.Response:
@@ -140,20 +148,36 @@ def build_app(state: AppState | None = None) -> web.Application:
         return web.json_response(state.config.model_dump(exclude_none=True))
 
     def _validated(body: dict, require_path: bool = False) -> web.Response:
-        from lumen_tpu.core.config import load_config, validate_config_dict
+        from lumen_tpu.core.config import (
+            load_config,
+            load_config_loose,
+            validate_config_dict,
+            validate_config_loose,
+        )
 
+        loose = bool(body.get("loose"))
+        warnings: list[str] = []
         try:
             if "path" in body:
-                cfg = load_config(body["path"])
+                if loose:
+                    cfg, warnings = load_config_loose(body["path"])
+                else:
+                    cfg = load_config(body["path"])
             elif "config" in body and not require_path:
-                cfg = validate_config_dict(body["config"])
+                if loose:
+                    cfg, warnings = validate_config_loose(body["config"])
+                else:
+                    cfg = validate_config_dict(body["config"])
             else:
                 return _json_error(
                     400, "provide 'path'" if require_path else "provide 'config' (dict) or 'path'"
                 )
         except Exception as e:  # noqa: BLE001 - validation errors reported to client
             return web.json_response({"valid": False, "error": str(e)})
-        return web.json_response({"valid": True, "services": sorted(cfg.services)})
+        out = {"valid": True, "services": sorted(cfg.services)}
+        if warnings:
+            out["warnings"] = warnings
+        return web.json_response(out)
 
     async def config_validate(request: web.Request) -> web.Response:
         return _validated(await _body(request))
@@ -249,6 +273,7 @@ def build_app(state: AppState | None = None) -> web.Application:
         options = InstallOptions(
             venv_path=body.get("venv_path"),
             packages=list(body.get("packages", [])),
+            release_packages=list(body.get("release_packages", [])),
             config_path=body.get("config_path") if body.get("download") else None,
             cache_dir=body.get("cache_dir"),
             region=body.get("region", "other"),

@@ -25,12 +25,6 @@ from importlib import metadata
 #: VMs attach chips through VFIO.
 _DEVICE_GLOBS = ("/dev/accel*", "/dev/vfio/*")
 
-#: PyPI indexes by region (reference MirrorSelector picks CN mirrors for
-#: wheel installs when the deployment region is cn).
-PIP_INDEXES = {
-    "cn": "https://pypi.tuna.tsinghua.edu.cn/simple",
-    "other": None,  # default index
-}
 
 
 @dataclass
@@ -144,6 +138,10 @@ def environment_report(cache_dir: str = "~/.lumen-tpu", need_gb: float = 10.0) -
 
 
 def pip_index_url(region: str) -> str | None:
-    """Region -> PyPI index (None = default). Unknown regions use the
-    default rather than failing: mirror choice is an optimization."""
-    return PIP_INDEXES.get(region)
+    """Region -> preferred PyPI index (None = default). Delegates to the
+    package resolver so ONE module owns the mirror policy (the installer's
+    pip step uses the same source via ``pip_index_args``)."""
+    from lumen_tpu.app.package_resolver import PYPI_OFFICIAL, pypi_indexes
+
+    preferred = pypi_indexes(region)[0]
+    return None if preferred == PYPI_OFFICIAL else preferred

@@ -118,7 +118,14 @@ def detect_hardware(timeout: float = 60.0) -> HardwareInfo:
 def hardware_report(hw: HardwareInfo | None = None) -> dict:
     """Detection + the preset recommendation the wizard shows."""
     hw = hw or detect_hardware()
-    plat = "tpu" if hw.platform == "tpu" else "cpu"
+    # A proxied PJRT plugin (e.g. the axon tunnel) reports its own platform
+    # name while device_kind still carries the real TPU generation string;
+    # treat anything with a recognizable TPU kind as TPU.
+    plat = (
+        "tpu"
+        if hw.platform in ("tpu", "axon") or parse_generation(hw.device_kind)
+        else "cpu"
+    )
     supported = supported_presets(plat, hw.device_count, hw.device_kind)
     best = supported[0] if supported else detect_preset(plat, hw.device_count)
     generation = parse_generation(hw.device_kind)

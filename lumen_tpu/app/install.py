@@ -60,6 +60,10 @@ class InstallOptions:
     #: deployment region; cn selects a PyPI mirror for the pip step
     #: (reference MirrorSelector, ``utils/package_resolver.py:19-321``)
     region: str = "other"
+    #: package names resolved from the project's GitHub releases (wheel
+    #: assets, mirror-aware) and installed from the downloaded files —
+    #: reference GitHubPackageResolver flow
+    release_packages: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -75,6 +79,8 @@ class InstallTask:
     created_at: float = field(default_factory=time.time)
     _proc: asyncio.subprocess.Process | None = None
     _cancelled: bool = False
+    #: local wheel paths produced by the resolve_release_wheels step
+    _resolved_wheels: list[str] = field(default_factory=list)
     #: resolved (expanduser'd) cache dir this install CREATED, or None when
     #: it pre-existed / wasn't requested — cancellation may only wipe a dir
     #: this install itself made, never a pre-existing path the
@@ -112,7 +118,9 @@ class InstallOrchestrator:
         steps = [InstallStep("check_python")]
         if options.venv_path:
             steps.append(InstallStep("create_venv"))
-        if options.packages:
+        if options.release_packages:
+            steps.append(InstallStep("resolve_release_wheels"))
+        if options.packages or options.release_packages:
             steps.append(InstallStep("install_packages"))
         steps.append(InstallStep("verify_imports"))
         if options.config_path:
@@ -181,18 +189,49 @@ class InstallOrchestrator:
             raise RuntimeError(f"venv creation failed: {out[-500:]}")
         step.detail = path
 
+    async def _step_resolve_release_wheels(self, task: InstallTask, step: InstallStep) -> None:
+        """Resolve wheels from the project's GitHub releases with CN mirror
+        rewriting (reference GitHubPackageResolver,
+        ``utils/package_resolver.py:61-321``); downloaded paths are fed to
+        the pip step as local files."""
+        from lumen_tpu.app.package_resolver import ReleaseWheelResolver
+
+        resolver = ReleaseWheelResolver(region=task.options.region)
+        dest = Path(task.options.cache_dir or "~/.lumen-tpu").expanduser() / "wheels"
+
+        def log_from_worker(msg: str) -> None:
+            # Runs inside asyncio.to_thread: deque.append is thread-safe,
+            # but WS fan-out must hop back to the loop.
+            task.log_lines.append(msg)
+            self.state.broadcast_log_threadsafe(msg, source="install")
+
+        wheels = await asyncio.to_thread(
+            resolver.fetch_packages,
+            list(task.options.release_packages),
+            dest,
+            log_from_worker,
+        )
+        task._resolved_wheels = [str(w) for w in wheels]
+        step.detail = ", ".join(w.name for w in wheels)
+
     async def _step_install_packages(self, task: InstallTask, step: InstallStep) -> None:
-        from lumen_tpu.app.env_check import pip_index_url
+        from lumen_tpu.app.package_resolver import pip_index_args
 
         python = self._env_python(task)
-        mirror = pip_index_url(task.options.region)
-        extra = ("--index-url", mirror) if mirror else ()
+        # Mirror-first with the official index as fallback, so a mirror
+        # outage degrades instead of failing the install.
+        index_args = (
+            pip_index_args(task.options.region)
+            if task.options.region == "cn"
+            else []
+        )
+        targets = list(task._resolved_wheels) + list(task.options.packages)
         rc, out = await self._exec(
-            task, python, "-m", "pip", "install", *extra, *task.options.packages
+            task, python, "-m", "pip", "install", *index_args, *targets
         )
         if rc != 0:
             raise RuntimeError(f"pip install failed: {out[-500:]}")
-        step.detail = ", ".join(task.options.packages)
+        step.detail = ", ".join(targets)
 
     async def _step_verify_imports(self, task: InstallTask, step: InstallStep) -> None:
         """Reference ``InstallationVerifier.verify_imports`` (python -c in

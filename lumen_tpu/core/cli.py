@@ -26,6 +26,11 @@ def main(argv: list[str] | None = None) -> int:
 
     val = sub.add_parser("validate", help="validate a lumen config file")
     val.add_argument("--config", required=True)
+    val.add_argument(
+        "--loose",
+        action="store_true",
+        help="warn on unknown fields instead of failing (dev configs)",
+    )
 
     vmi = sub.add_parser("validate-model-info", help="validate a model directory's model_info.json")
     vmi.add_argument("model_dir")
@@ -45,7 +50,14 @@ def main(argv: list[str] | None = None) -> int:
 
 def _run(args: argparse.Namespace) -> int:
     if args.cmd == "validate":
-        cfg = load_config(args.config)
+        if args.loose:
+            from .config import load_config_loose
+
+            cfg, warnings = load_config_loose(args.config)
+            for w in warnings:
+                print(f"warning: {w}", file=sys.stderr)
+        else:
+            cfg = load_config(args.config)
         print(f"OK: {len(cfg.services)} services, mode={cfg.deployment.mode}")
         return 0
     if args.cmd == "validate-model-info":

@@ -228,13 +228,7 @@ class LumenConfig(BaseModel):
         return {n: self.services[n] for n in sel if self.services[n].enabled}
 
 
-def load_config(path: str) -> LumenConfig:
-    """Load + strictly validate a YAML config file.
-
-    Production entry point, same role as the reference's
-    ``load_and_validate_config()``
-    (``lumen_resources/lumen_config_validator.py:244-270``).
-    """
+def _load_raw(path: str) -> dict[str, Any]:
     try:
         with open(os.path.expanduser(path), "r", encoding="utf-8") as f:
             raw = yaml.safe_load(f)
@@ -244,7 +238,24 @@ def load_config(path: str) -> LumenConfig:
         raise ConfigError(f"config file is not valid YAML: {path}", detail=str(e)) from e
     if not isinstance(raw, dict):
         raise ConfigError(f"config root must be a mapping, got {type(raw).__name__}")
-    return validate_config_dict(raw)
+    return raw
+
+
+def load_config(path: str) -> LumenConfig:
+    """Load + strictly validate a YAML config file.
+
+    Production entry point, same role as the reference's
+    ``load_and_validate_config()``
+    (``lumen_resources/lumen_config_validator.py:244-270``).
+    """
+    return validate_config_dict(_load_raw(path))
+
+
+def load_config_loose(path: str) -> tuple[LumenConfig, list[str]]:
+    """File-path variant of :func:`validate_config_loose` with the same
+    error wrapping as :func:`load_config` (missing files and bad YAML are
+    ``ConfigError``, not raw tracebacks)."""
+    return validate_config_loose(_load_raw(path))
 
 
 def validate_config_dict(raw: dict[str, Any]) -> LumenConfig:
@@ -252,6 +263,50 @@ def validate_config_dict(raw: dict[str, Any]) -> LumenConfig:
         return LumenConfig.model_validate(raw)
     except Exception as e:  # pydantic.ValidationError
         raise ConfigError("config validation failed", detail=str(e)) from e
+
+
+def validate_config_loose(raw: dict[str, Any]) -> tuple[LumenConfig, list[str]]:
+    """Lenient validation: unknown fields are dropped with a warning
+    instead of failing, everything else still validates strictly.
+
+    Reference analog: the Draft7 jsonschema "flexible" mode next to strict
+    pydantic (``lumen_resources/lumen_config_validator.py:19-270``), used
+    for development configs and forward-compat fields. Returns the
+    validated config plus the list of ignored-field warnings.
+    """
+    import copy
+
+    raw = copy.deepcopy(raw)
+    warnings: list[str] = []
+    # Each pass strips every unknown-field error pydantic reports; nested
+    # models can reveal further extras once parents parse, so iterate (the
+    # bound is paranoid — one level of reveal per pass).
+    for _ in range(20):
+        try:
+            return LumenConfig.model_validate(raw), warnings
+        except Exception as e:
+            errors = getattr(e, "errors", None)
+            extras = [
+                err for err in (errors() if callable(errors) else [])
+                if err.get("type") == "extra_forbidden"
+            ]
+            if not extras:
+                raise ConfigError("config validation failed", detail=str(e)) from e
+            for err in extras:
+                loc = err["loc"]
+                node: Any = raw
+                try:
+                    for key in loc[:-1]:
+                        node = node[key]
+                    node.pop(loc[-1], None)
+                except (KeyError, IndexError, TypeError):
+                    raise ConfigError(
+                        "config validation failed", detail=str(e)
+                    ) from e
+                warnings.append(
+                    "ignored unknown field " + ".".join(str(k) for k in loc)
+                )
+    raise ConfigError("config validation failed", detail="loose-mode did not converge")
 
 
 def config_json_schema() -> dict[str, Any]:

@@ -23,6 +23,7 @@ import os
 from ...core.config import ServiceConfig
 from ...core.result_schemas import EmbeddingV1, LabelsV1, LabelItem
 from ...models.clip import CLIPManager
+from ...runtime.rknn import require_executable_runtime
 from ..base_service import BaseService, InvalidArgument, Unavailable, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -101,6 +102,7 @@ class ClipService(BaseService):
         bs = service_config.backend_settings
         managers: dict[str, CLIPManager] = {}
         for alias, mc in service_config.models.items():
+            require_executable_runtime(mc)
             key = "bioclip" if "bioclip" in alias.lower() else "clip"
             model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
             managers[key] = CLIPManager(

@@ -19,6 +19,7 @@ import numpy as np
 from ...core.config import ServiceConfig
 from ...core.result_schemas import FaceItem, FaceV1
 from ...models.face import FaceManager
+from ...runtime.rknn import require_executable_runtime
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -64,6 +65,7 @@ class FaceService(BaseService):
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "FaceService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
+        require_executable_runtime(mc)
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
         manager = FaceManager(
             model_dir,

@@ -13,6 +13,7 @@ import os
 from ...core.config import ServiceConfig
 from ...core.result_schemas import OcrItem, OCRV1
 from ...models.ocr import OcrManager
+from ...runtime.rknn import require_executable_runtime
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -40,6 +41,7 @@ class OcrService(BaseService):
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "OcrService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
+        require_executable_runtime(mc)
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
         manager = OcrManager(
             model_dir,

@@ -19,6 +19,7 @@ import os
 from ...core.config import ServiceConfig
 from ...core.result_schemas import TextGenerationV1
 from ...models.vlm import ChatMessage, VLMManager
+from ...runtime.rknn import require_executable_runtime
 from ..base_service import BaseService, InvalidArgument
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -55,6 +56,7 @@ class VlmService(BaseService):
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "VlmService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))
+        require_executable_runtime(mc)
         model_dir = os.path.join(cache_dir, "models", mc.model.split("/")[-1])
         kw = {}
         if bs.batch_buckets:

@@ -220,6 +220,39 @@ class TestConfigApi:
 
 
 class TestHardwareApi:
+    def test_axon_platform_counts_as_tpu(self):
+        """A proxied PJRT plugin reports platform='axon' but a real TPU
+        device_kind; the report must recommend TPU presets, not cpu."""
+        from lumen_tpu.app.hardware import HardwareInfo, hardware_report
+
+        hw = HardwareInfo(platform="axon", device_kind="TPU v5 lite", device_count=1)
+        report = hardware_report(hw)
+        assert report["generation"] == "v5e"
+        assert report["recommended_preset"] == "tpu_v5e_1"
+
+    def test_config_generate_auto_uses_probe(self, monkeypatch):
+        """preset='auto' picks mesh axes + batch defaults from the
+        hardware probe (VERDICT r2 item 9)."""
+        import lumen_tpu.app.api as api_mod
+
+        monkeypatch.setattr(
+            api_mod, "hardware_report",
+            lambda: {"recommended_preset": "tpu_v5e_16_dp_tp"},
+        )
+
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/config/generate",
+                json={"preset": "auto", "tier": "full"},
+            )
+            assert r.status == 200
+            cfg = await r.json()
+            mesh = cfg["services"]["clip"]["backend_settings"]["mesh"]["axes"]
+            assert mesh == {"data": -1, "model": 2}
+            return True
+
+        assert with_client(fn)
+
     def test_detect_reports_preset(self):
         async def fn(client):
             r = await client.get("/api/v1/hardware/detect")
@@ -500,8 +533,9 @@ class TestEnvCheck:
 
     def test_pip_index_by_region(self):
         from lumen_tpu.app.env_check import pip_index_url
+        from lumen_tpu.app.package_resolver import PYPI_MIRROR_CN
 
-        assert pip_index_url("cn") and "tsinghua" in pip_index_url("cn")
+        assert pip_index_url("cn") == PYPI_MIRROR_CN
         assert pip_index_url("other") is None
         assert pip_index_url("unknown-region") is None
 

@@ -4,6 +4,7 @@ import pytest
 
 from lumen_tpu.core.config import (
     LumenConfig,
+    ModelConfig,
     load_config,
     validate_config_dict,
 )
@@ -127,3 +128,53 @@ class TestConfigLoading:
         p.write_text("metadata: [unclosed")
         with pytest.raises(ConfigError):
             load_config(str(p))
+
+
+class TestLooseValidation:
+    def test_unknown_fields_become_warnings(self):
+        from lumen_tpu.core.config import validate_config_loose
+
+        raw = make_raw()
+        raw["future_top_level"] = {"x": 1}
+        raw["metadata"]["experimental_flag"] = True
+        raw["services"]["clip"]["unknown_knob"] = "v"
+        with pytest.raises(ConfigError):
+            validate_config_dict(raw)  # strict still fails
+        cfg, warnings = validate_config_loose(raw)
+        assert isinstance(cfg, LumenConfig)
+        assert len(warnings) == 3
+        assert any("future_top_level" in w for w in warnings)
+        assert any("metadata.experimental_flag" in w for w in warnings)
+        assert any("services.clip.unknown_knob" in w for w in warnings)
+
+    def test_real_errors_still_fail_loose(self):
+        from lumen_tpu.core.config import validate_config_loose
+
+        raw = make_raw()
+        raw["server"]["port"] = "not-a-port"
+        raw["extra_field"] = 1
+        with pytest.raises(ConfigError):
+            validate_config_loose(raw)
+
+    def test_clean_config_no_warnings(self):
+        from lumen_tpu.core.config import validate_config_loose
+
+        cfg, warnings = validate_config_loose(make_raw())
+        assert warnings == []
+        assert cfg.deployment.mode == "hub"
+
+
+class TestRknnPlaceholder:
+    def test_rknn_runtime_raises_documented_error(self):
+        from lumen_tpu.runtime.rknn import RknnBackend, require_executable_runtime
+
+        mc = ModelConfig(model="ViT-B-32", runtime="rknn", rknn_device="rk3588")
+        with pytest.raises(ImportError, match="JAX/XLA on TPU only"):
+            require_executable_runtime(mc)
+        with pytest.raises(ImportError, match="rk3588"):
+            RknnBackend(mc)
+
+    def test_jax_runtime_passes_gate(self):
+        from lumen_tpu.runtime.rknn import require_executable_runtime
+
+        require_executable_runtime(ModelConfig(model="ViT-B-32", runtime="jax"))

@@ -1,0 +1,187 @@
+"""Mirror-aware package resolution tests (offline: injected fetchers).
+
+Reference behavior being mirrored:
+``lumen-app/src/lumen_app/utils/package_resolver.py:19-321`` — CN region
+rewrites GitHub URLs through the proxy mirror and prefers the CN PyPI
+index, with official endpoints always kept as fallback; wheels resolve
+from the latest GitHub release's assets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from lumen_tpu.app.package_resolver import (
+    API_BASE,
+    GITHUB_MIRROR_CN,
+    PYPI_MIRROR_CN,
+    PYPI_OFFICIAL,
+    ReleaseWheelResolver,
+    github_urls,
+    pip_index_args,
+    pypi_indexes,
+)
+
+
+class TestMirrorSelection:
+    def test_github_urls_cn_mirror_first_original_fallback(self):
+        base = "https://github.com/LumilioPhotos/lumen-tpu/releases/download/v1/x.whl"
+        urls = github_urls(base, "cn")
+        assert urls[0].startswith(GITHUB_MIRROR_CN)
+        assert urls[-1] == base
+
+    def test_github_urls_other_no_mirror(self):
+        base = "https://github.com/o/r/releases/download/v1/x.whl"
+        assert github_urls(base, "other") == [base]
+
+    def test_pypi_indexes(self):
+        assert pypi_indexes("cn") == [PYPI_MIRROR_CN, PYPI_OFFICIAL]
+        assert pypi_indexes("other") == [PYPI_OFFICIAL]
+
+    def test_pip_index_args_mirror_with_fallback(self):
+        args = pip_index_args("cn")
+        assert args == [
+            "--index-url", PYPI_MIRROR_CN, "--extra-index-url", PYPI_OFFICIAL,
+        ]
+
+
+def _fake_release_api(tag="v1.2.0", assets=None):
+    assets = assets if assets is not None else [
+        {"name": "lumen_tpu-1.2.0-py3-none-any.whl",
+         "browser_download_url": "https://github.com/x/y/releases/download/v1.2.0/lumen_tpu-1.2.0-py3-none-any.whl"},
+        {"name": "lumen_tpu-1.2.0.tar.gz",
+         "browser_download_url": "https://github.com/x/y/releases/download/v1.2.0/lumen_tpu-1.2.0.tar.gz"},
+    ]
+
+    def fetch(url):
+        if url.endswith("/releases/latest"):
+            return {"tag_name": tag}
+        assert url == f"{API_BASE}/repos/LumilioPhotos/lumen-tpu/releases/tags/{tag}"
+        return {"assets": assets}
+
+    return fetch
+
+
+class TestReleaseWheelResolver:
+    def test_resolves_wheel_not_sdist(self):
+        r = ReleaseWheelResolver(region="other", fetch_json=_fake_release_api())
+        url, tag = r.resolve_wheel_url("lumen-tpu")
+        assert tag == "v1.2.0"
+        assert url.endswith("py3-none-any.whl")
+
+    def test_missing_wheel_raises(self):
+        r = ReleaseWheelResolver(
+            region="other", fetch_json=_fake_release_api(assets=[])
+        )
+        with pytest.raises(RuntimeError, match="no wheel asset"):
+            r.resolve_wheel_url("lumen-tpu")
+
+    def test_download_cn_tries_mirror_then_falls_back(self, tmp_path):
+        attempts = []
+
+        def retrieve(url, dest):
+            attempts.append(url)
+            if GITHUB_MIRROR_CN in url:
+                raise OSError("mirror down")
+            open(dest, "wb").write(b"wheel")
+
+        r = ReleaseWheelResolver(
+            region="cn", fetch_json=_fake_release_api(), urlretrieve=retrieve
+        )
+        url, _ = r.resolve_wheel_url("lumen-tpu")
+        out = r.download(url, tmp_path)
+        assert out.read_bytes() == b"wheel"
+        assert GITHUB_MIRROR_CN in attempts[0]  # mirror tried first
+        assert attempts[1] == url  # official fallback used
+
+    def test_all_mirrors_failing_raises(self, tmp_path):
+        def retrieve(url, dest):
+            raise OSError("offline")
+
+        r = ReleaseWheelResolver(
+            region="cn", fetch_json=_fake_release_api(), urlretrieve=retrieve
+        )
+        with pytest.raises(RuntimeError, match="all mirrors failed"):
+            r.download("https://github.com/x/y/releases/download/v1/a.whl", tmp_path)
+
+    def test_fetch_packages_shares_one_tag_lookup(self, tmp_path):
+        calls = []
+        fetch = _fake_release_api()
+
+        def counting_fetch(url):
+            calls.append(url)
+            return fetch(url)
+
+        def retrieve(url, dest):
+            open(dest, "wb").write(b"w")
+
+        r = ReleaseWheelResolver(
+            region="other", fetch_json=counting_fetch, urlretrieve=retrieve
+        )
+        wheels = r.fetch_packages(["lumen-tpu"], tmp_path)
+        assert len(wheels) == 1
+        assert sum(1 for u in calls if u.endswith("/releases/latest")) == 1
+
+
+class TestInstallerWiring:
+    def test_release_step_feeds_pip_targets(self, tmp_path, monkeypatch):
+        """resolve_release_wheels downloads via the resolver and the pip
+        step installs the local wheel files."""
+        from lumen_tpu.app.install import InstallOptions, InstallOrchestrator
+        from lumen_tpu.app.state import AppState
+
+        async def scenario():
+            state = AppState()
+            state.bind_loop(asyncio.get_running_loop())
+            orch = InstallOrchestrator(state)
+            opts = InstallOptions(
+                release_packages=["lumen-tpu"],
+                cache_dir=str(tmp_path / "cache"),
+                verify_imports=["json"],
+            )
+            task = orch.create_task(opts)
+            assert [s.name for s in task.steps] == [
+                "check_python", "resolve_release_wheels",
+                "install_packages", "verify_imports",
+            ]
+
+            import lumen_tpu.app.install as install_mod
+
+            class FakeResolver:
+                def __init__(self, region):
+                    self.region = region
+
+                def fetch_packages(self, packages, dest, log=None):
+                    import pathlib
+
+                    dest = pathlib.Path(dest)
+                    dest.mkdir(parents=True, exist_ok=True)
+                    p = dest / "lumen_tpu-1.0-py3-none-any.whl"
+                    p.write_bytes(b"w")
+                    return [p]
+
+            import lumen_tpu.app.package_resolver as pr
+
+            monkeypatch.setattr(
+                pr, "ReleaseWheelResolver",
+                lambda region: FakeResolver(region),
+            )
+
+            ran: list[list[str]] = []
+
+            async def fake_exec(task_, *cmd):
+                ran.append(list(cmd))
+                return 0, ""
+
+            monkeypatch.setattr(orch, "_exec", fake_exec)
+            await orch.run(task)
+            assert task.status.value == "completed", task.error
+            pip_cmds = [c for c in ran if "pip" in c]
+            assert any(
+                any(str(a).endswith("py3-none-any.whl") for a in c) for c in pip_cmds
+            )
+            return True
+
+        assert asyncio.run(scenario())
