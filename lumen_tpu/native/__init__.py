@@ -26,9 +26,43 @@ logger = logging.getLogger(__name__)
 
 ABI_VERSION = 1
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_SRC_DIR, "build", "liblumen_host_ops.so")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+# Canonical source ships inside the package (wheels are self-contained);
+# the repo-root ``native/`` dir holds the Makefile + dev build output.
+_SRC_PATH = os.path.join(_PKG_DIR, "_src", "host_ops.cpp")
+
+
+def _build_dir() -> str:
+    """Prefer the repo checkout's ``native/build`` (dev workflow, shared
+    with the Makefile); installed wheels build into a per-user cache since
+    site-packages may not be writable."""
+    repo_native = os.path.join(_REPO_ROOT, "native")
+    if os.path.isdir(repo_native) and os.access(repo_native, os.W_OK):
+        return os.path.join(repo_native, "build")
+    return os.path.join(
+        os.path.expanduser(os.environ.get("XDG_CACHE_HOME", "~/.cache")),
+        "lumen-tpu",
+        "native",
+    )
+
+
+def _src_digest() -> str:
+    """Short content hash of the C++ source: the cached .so is keyed on it
+    so a package upgrade whose host_ops.cpp changed (even without an ABI
+    bump) rebuilds instead of silently loading the old binary."""
+    import hashlib
+
+    try:
+        with open(_SRC_PATH, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        return "nosrc"
+
+
+_LIB_PATH = os.path.join(
+    _build_dir(), f"liblumen_host_ops-{ABI_VERSION}-{_src_digest()}.so"
+)
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -41,7 +75,7 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
-    src = os.path.join(_SRC_DIR, "host_ops.cpp")
+    src = _SRC_PATH
     if not os.path.exists(src):
         return False
     os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
