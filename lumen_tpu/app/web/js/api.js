@@ -1,11 +1,26 @@
 // API client for the lumen-tpu control plane (role of the reference's
-// typed web-ui/src/lib/api.ts). One function per endpoint of
-// lumen_tpu/app/api.py; errors normalize to Error(message).
+// typed web-ui/src/lib/api.ts). Every path resolves through the GENERATED
+// route manifest (api.generated.js, rebuilt from the live aiohttp app by
+// scripts/generate_api_client.py and pinned by tests/test_web.py), so a
+// server-side route rename breaks the client loudly instead of 404ing.
+// Failures normalize to ApiError with a kind (network/permission/
+// business/server) for errors.js to present.
 
-const V1 = "/api/v1";
+import { ROUTES, fillPath } from "./api.generated.js";
+import { ApiError } from "./errors.js";
 
-async function request(method, path, body) {
-  const opts = { method, headers: {} };
+async function call(routeName, { params, query, body } = {}) {
+  const route = ROUTES[routeName];
+  // A plain Error: this is a client-side programming bug (typo'd route
+  // name), not a network condition — ApiError(null) would present it as
+  // "control plane unreachable".
+  if (!route) throw new Error(`unknown route: ${routeName}`);
+  let path = fillPath(route.path, params || {});
+  if (query) {
+    const qs = new URLSearchParams(query).toString();
+    if (qs) path += `?${qs}`;
+  }
+  const opts = { method: route.method, headers: {} };
   if (body !== undefined) {
     opts.headers["Content-Type"] = "application/json";
     opts.body = JSON.stringify(body);
@@ -14,7 +29,7 @@ async function request(method, path, body) {
   try {
     res = await fetch(path, opts);
   } catch (e) {
-    throw new Error(`control plane unreachable: ${e.message}`);
+    throw new ApiError(`control plane unreachable: ${e.message}`, null);
   }
   const text = await res.text();
   let data = null;
@@ -24,50 +39,58 @@ async function request(method, path, body) {
     data = { raw: text };
   }
   if (!res.ok) {
-    const err = new Error((data && data.error) || `${method} ${path} -> HTTP ${res.status}`);
-    err.status = res.status;
-    throw err;
+    throw new ApiError(
+      (data && data.error) || `${route.method} ${path} -> HTTP ${res.status}`,
+      res.status
+    );
   }
   return data;
 }
 
 export const api = {
-  health: () => request("GET", "/health"),
+  health: () => call("health"),
 
   // hardware
-  configLoad: (path) => request("POST", `${V1}/config/load`, { path }),
-  serverLogs: () => request("GET", `${V1}/server/logs`),
-  hardwareInfo: () => request("GET", `${V1}/hardware/info`),
-  hardwareDetect: () => request("GET", `${V1}/hardware/detect`),
+  configLoad: (path) => call("config_load", { body: { path } }),
+  serverLogs: () => call("server_logs"),
+  hardwareInfo: () => call("hardware_info"),
+  hardwareDetect: () => call("hardware_detect"),
   hardwareCheck: (cacheDir) =>
     // no client-side default: an absent param uses the server's default
-    request("GET", `${V1}/hardware/check` + (cacheDir ? `?cache_dir=${encodeURIComponent(cacheDir)}` : "")),
+    call("hardware_check", cacheDir ? { query: { cache_dir: cacheDir } } : {}),
 
   // config
-  presets: () => request("GET", `${V1}/config/presets`),
-  generateConfig: (opts) => request("POST", `${V1}/config/generate`, opts),
-  currentConfig: () => request("GET", `${V1}/config/current`),
-  validateConfig: (cfg) => request("POST", `${V1}/config/validate`, { config: cfg }),
-  saveConfig: (path) => request("POST", `${V1}/config/save`, { path }),
+  presets: () => call("config_presets"),
+  /** @param {{preset: string, tier: string, region?: string, cache_dir?: string}} opts */
+  generateConfig: (opts) => call("config_generate", { body: opts }),
+  /** @returns {Promise<LumenConfig>} (typedef in api.generated.js) */
+  currentConfig: () => call("config_current"),
+  /** @param {LumenConfig} cfg @param {boolean=} loose */
+  validateConfig: (cfg, loose) =>
+    call("config_validate", { body: loose ? { config: cfg, loose: true } : { config: cfg } }),
+  saveConfig: (path) => call("config_save", { body: { path } }),
   configYaml: async () => {
-    const res = await fetch(`${V1}/config/yaml`);
-    if (!res.ok) throw new Error(`no config yet (HTTP ${res.status})`);
+    const res = await fetch(ROUTES.config_yaml.path);
+    if (!res.ok) throw new ApiError(`no config yet (HTTP ${res.status})`, res.status);
     return res.text();
   },
 
   // install
-  installSetup: (opts) => request("POST", `${V1}/install/setup`, opts),
-  installTasks: () => request("GET", `${V1}/install/tasks`),
-  installStatus: (id) => request("GET", `${V1}/install/status/${id}`),
-  installCancel: (id) => request("POST", `${V1}/install/cancel/${id}`),
+  installSetup: (opts) => call("install_setup", { body: opts }),
+  installTasks: () => call("install_tasks"),
+  installStatus: (id) => call("install_status", { params: { task_id: id } }),
+  installLogs: (id, limit) =>
+    call("install_logs", { params: { task_id: id }, query: limit ? { limit } : undefined }),
+  installCancel: (id) => call("install_cancel", { params: { task_id: id } }),
+  installCheckPath: (path) => call("install_check_path", { body: { path } }),
 
   // server
-  serverStatus: () => request("GET", `${V1}/server/status`),
-  serverStart: (opts) => request("POST", `${V1}/server/start`, opts || {}),
-  serverStop: () => request("POST", `${V1}/server/stop`),
-  serverRestart: () => request("POST", `${V1}/server/restart`),
+  serverStatus: () => call("server_status"),
+  serverStart: (opts) => call("server_start", { body: opts || {} }),
+  serverStop: () => call("server_stop"),
+  serverRestart: () => call("server_restart"),
   metrics: async () => {
-    const res = await fetch(`${V1}/metrics`);
+    const res = await fetch(ROUTES.metrics.path);
     return res.text();
   },
 };

@@ -19,6 +19,15 @@ export function el(tag, attrs = {}, children = []) {
   return node;
 }
 
+import { describeUiError } from "./errors.js";
+
+/** Uniform failure surface: classify the error (network/permission/
+ * business/server) and toast "<title>: <message>". */
+export function toastError(error, fallback) {
+  const d = describeUiError(error, fallback);
+  toast(`${d.title}: ${d.message}`, true);
+}
+
 let toastTimer = null;
 
 export function toast(message, isError = false) {

@@ -3,7 +3,7 @@
 
 import { api, logStream } from "../api.js";
 import { wizard } from "../wizard.js";
-import { el, toast, attachLogPane } from "../ui.js";
+import { el, toast, toastError, attachLogPane } from "../ui.js";
 
 const STEP_ICONS = {
   pending: "○",
@@ -67,6 +67,20 @@ export function renderInstall(root, onLeave) {
         btn.disabled = false;
         return;
       }
+      if (wizard.state.cacheDir) {
+        // Pre-flight the cache target (reference Install view checks the
+        // path before starting): surface unwritable/low-disk up front
+        // instead of failing minutes into the downloads.
+        const check = await api.installCheckPath(wizard.state.cacheDir);
+        if (!check.ok) {
+          toast(`cache dir ${check.path} is not usable (writable=${check.writable}, free=${check.free_gb}GB)`, true);
+          btn.disabled = false;
+          return;
+        }
+        if (check.free_gb < 5) {
+          toast(`cache dir has only ${check.free_gb}GB free — model downloads may fail`, true);
+        }
+      }
       const task = await api.installSetup({
         download,
         config_path: download ? wizard.state.configPath : null,
@@ -77,7 +91,7 @@ export function renderInstall(root, onLeave) {
       root.querySelector("#inst-cancel").disabled = false;
       poll(root, task.task_id, ++pollGen);
     } catch (e) {
-      toast(e.message, true);
+      toastError(e, "could not start the install");
       btn.disabled = false;
     }
   };
@@ -88,7 +102,7 @@ export function renderInstall(root, onLeave) {
       await api.installCancel(wizard.state.installTaskId);
       toast("cancelling…");
     } catch (e) {
-      toast(e.message, true);
+      toastError(e, "could not cancel the install");
     }
   };
 }
@@ -118,7 +132,8 @@ async function poll(root, taskId, gen) {
   }
   if (!root.isConnected || gen !== pollGen) return;
 
-  root.querySelector("#inst-bar").style.width = `${Math.round((task.progress || 0) * 100)}%`;
+  // task.progress is already a 0-100 percentage (install.py progress).
+  root.querySelector("#inst-bar").style.width = `${Math.round(task.progress || 0)}%`;
   const list = root.querySelector("#inst-steps");
   list.replaceChildren(
     ...task.steps.map((step) =>

@@ -63,6 +63,10 @@ def _src_digest() -> str:
 _LIB_PATH = os.path.join(
     _build_dir(), f"liblumen_host_ops-{ABI_VERSION}-{_src_digest()}.so"
 )
+# A `make -C native` prebuild lands at the unkeyed Makefile name; accept it
+# as a fallback (the ABI gate in load() still applies) so prebuilding for a
+# g++-less runtime keeps working alongside the digest-keyed self-build.
+_PREBUILT_PATH = os.path.join(_build_dir(), "liblumen_host_ops.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -131,22 +135,24 @@ def load() -> ctypes.CDLL | None:
         if os.environ.get("LUMEN_TPU_NO_NATIVE") == "1":
             return None
         for attempt in range(2):
-            if os.path.exists(_LIB_PATH):
+            for candidate in (_LIB_PATH, _PREBUILT_PATH):
+                if not os.path.exists(candidate):
+                    continue
                 try:
-                    lib = _bind(ctypes.CDLL(_LIB_PATH))
+                    lib = _bind(ctypes.CDLL(candidate))
                     if lib.lumen_host_ops_abi_version() == ABI_VERSION:
                         _lib = lib
-                        logger.info("native host-ops loaded: %s", _LIB_PATH)
+                        logger.info("native host-ops loaded: %s", candidate)
                         return _lib
                     logger.info("native host-ops ABI mismatch; rebuilding")
-                    _unlink_quiet(_LIB_PATH)
+                    _unlink_quiet(candidate)
                 except (OSError, AttributeError) as e:
                     # Stale/corrupt artifact (OSError: unloadable;
                     # AttributeError: loadable but missing a symbol, e.g.
                     # built from older sources): remove it so the rebuild
                     # below gets a clean slate.
                     logger.warning("native host-ops load failed: %s", e)
-                    _unlink_quiet(_LIB_PATH)
+                    _unlink_quiet(candidate)
             if attempt == 0 and not _build():
                 break
         return None

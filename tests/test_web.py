@@ -101,38 +101,145 @@ class TestModuleImports:
 
 
 class TestApiContract:
-    def test_client_paths_match_registered_routes(self):
-        """Every endpoint api.js calls exists on the server with the same
-        method."""
+    def test_generated_client_is_current(self):
+        """api.generated.js must byte-match a fresh render from the live
+        app's router + pydantic schema — the same freshness guarantee the
+        reference gets from regenerating types/schema.d.ts in CI. On
+        failure run: python scripts/generate_api_client.py"""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "generate_api_client",
+            os.path.join(os.path.dirname(__file__), "..", "scripts", "generate_api_client.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+            checked_in = f.read()
+        assert checked_in == mod.render(), (
+            "api.generated.js is stale; run scripts/generate_api_client.py"
+        )
+
+    def test_client_calls_use_known_route_names(self):
+        """Every call("name") in api.js names a route the generated
+        manifest carries (and the client covers a real share of the
+        surface)."""
         with open(os.path.join(WEB, "js", "api.js")) as f:
             src = f.read()
-        calls = re.findall(r'request\("(\w+)",\s*(?:`\$\{V1\}(/[^`]+)`|"(/[^"]+)")', src)
-        raw_fetches = re.findall(r'fetch\(`\$\{V1\}(/[^`]+)`\)', src)
-        wanted = []
-        for method, v1path, abspath in calls:
-            path = f"/api/v1{v1path}" if v1path else abspath
-            path = path.split("?", 1)[0]  # query strings aren't routed
-            wanted.append((method, re.sub(r"\$\{[^}]+\}", "{param}", path)))
-        for p in raw_fetches:
-            wanted.append(("GET", f"/api/v1{p}"))
-        assert len(wanted) >= 15  # the client actually covers the surface
+        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+            gen = f.read()
+        route_names = set(re.findall(r"^  (\w+): \{ method", gen, re.M))
+        called = set(re.findall(r'call\("(\w+)"', src))
+        assert len(called) >= 15  # the client actually covers the surface
+        missing = called - route_names
+        assert not missing, f"client calls unknown routes: {sorted(missing)}"
+        # Direct fetches (text endpoints) also resolve through ROUTES.
+        assert re.search(r"fetch\(ROUTES\.\w+\.path\)", src)
 
-        from lumen_tpu.app.api import build_app
-
-        app = build_app()
-        routes = set()
-        for route in app.router.routes():
-            info = route.resource.get_info() if route.resource else {}
-            path = info.get("path") or info.get("formatter") or ""
-            routes.add((route.method, re.sub(r"\{[^}]+\}", "{param}", path)))
-
-        for method, path in wanted:
-            assert (method, path) in routes, f"client calls unregistered {method} {path}"
+    def test_typedefs_cover_config_models(self):
+        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+            gen = f.read()
+        for model in ("LumenConfig", "BackendSettings", "MeshConfig", "Metadata"):
+            assert f"@typedef {{Object}} {model}" in gen, model
 
     def test_ws_logs_route_used_by_client(self):
+        # Must be in the CLIENT (LogStream's URL) — the generated manifest
+        # always carries it because it mirrors the server's router, so
+        # checking there would be a tautology.
         with open(os.path.join(WEB, "js", "api.js")) as f:
             src = f.read()
         assert "/ws/logs" in src
+
+
+class TestWizardFlow:
+    """The wizard's API journey (install -> config -> server start) driven
+    end-to-end against the real app, including the failure paths each view
+    handles (reference wizard views: web-ui/src/views/)."""
+
+    def test_full_flow_with_failures(self, tmp_path):
+        async def fn():
+            client = _client()
+            await client.start_server()
+            try:
+                # -- hardware step: probe + recommendation
+                r = await client.get("/api/v1/hardware/detect")
+                assert r.status == 200
+                rec = (await r.json())["recommended_preset"]
+
+                # -- install pre-flight failure path: a path whose first
+                # existing ancestor is a regular file can never become a
+                # cache dir (root can write most directories, so a plain
+                # unwritable-dir probe is environment-dependent).
+                blocker = tmp_path / "a-file"
+                blocker.write_text("x")
+                r = await client.post(
+                    "/api/v1/install/check-path",
+                    json={"path": str(blocker / "sub")},
+                )
+                assert (await r.json())["ok"] is False
+                # and the success path
+                r = await client.post(
+                    "/api/v1/install/check-path", json={"path": str(tmp_path)}
+                )
+                assert (await r.json())["ok"] is True
+
+                # -- install: env-verify-only task runs to completion
+                r = await client.post(
+                    "/api/v1/install/setup",
+                    json={"download": False, "cache_dir": str(tmp_path / "cache")},
+                )
+                assert r.status == 202  # accepted: runs in the background
+                task_id = (await r.json())["task_id"]
+                for _ in range(200):
+                    r = await client.get(f"/api/v1/install/status/{task_id}")
+                    task = await r.json()
+                    if task["status"] in ("completed", "failed", "cancelled"):
+                        break
+                    import asyncio as _a
+
+                    await _a.sleep(0.1)
+                assert task["status"] == "completed", task
+                assert 0 <= task["progress"] <= 100  # 0-100 scale (view contract)
+
+                # unknown install task id -> 404 (the view's resume path)
+                r = await client.get("/api/v1/install/status/nope")
+                assert r.status == 404
+
+                # -- config: generate from the probe's recommendation, save
+                r = await client.post(
+                    "/api/v1/config/generate",
+                    json={"preset": rec, "tier": "light_weight",
+                          "cache_dir": str(tmp_path / "cache")},
+                )
+                assert r.status == 200
+                cfg_path = str(tmp_path / "lumen.yaml")
+                r = await client.post("/api/v1/config/save", json={"path": cfg_path})
+                assert r.status == 200
+                assert os.path.exists(cfg_path)
+
+                # bad preset -> 400 (config view error path)
+                r = await client.post(
+                    "/api/v1/config/generate", json={"preset": "nope"}
+                )
+                assert r.status == 400
+
+                # -- server step failure path: the managed server needs a
+                # saved config; starting against a missing file fails
+                # cleanly rather than orphaning a process.
+                r = await client.post(
+                    "/api/v1/server/start",
+                    json={"config_path": str(tmp_path / "missing.yaml")},
+                )
+                assert r.status in (400, 404, 409, 500)
+                status = await (await client.get("/api/v1/server/status")).json()
+                # no orphaned process: the manager lands in a terminal
+                # non-running state with no pid
+                assert status["status"] in ("stopped", "failed")
+                assert status["pid"] is None
+            finally:
+                await client.close()
+
+        run_async(fn())
 
 
 class TestViewDomContract:
