@@ -1,0 +1,105 @@
+"""Record an on-chip test artifact (``TPUTESTS_r{N}.json``).
+
+Runs ``LUMEN_TPU_TESTS=1 pytest -m tpu`` — the device-path smoke tests
+(ragged decode, int8 dot, grouped GEMM; ``tests/test_ops.py``) that the CPU
+suite always skips — against the real chip, with the same
+claim-can-block-forever handling as ``bench.py``: the pytest child runs
+under a hard timeout, and on a timeout the run is retried in a fresh
+process while the budget lasts (the axon pool frees chips unpredictably).
+
+Usage: ``python scripts/run_tpu_tests.py [--out TPUTESTS_r03.json]``
+Env: ``TPUTESTS_BUDGET`` total seconds (default 1800);
+``TPUTESTS_ATTEMPT_TIMEOUT`` per pytest run (default 900 — a claim +
+3 small compiles fit comfortably when a chip is actually free).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_once(timeout: float) -> dict:
+    env = dict(os.environ)
+    env["LUMEN_TPU_TESTS"] = "1"
+    env.pop("JAX_PLATFORMS", None)  # let the axon registration pick the chip
+    cmd = [
+        sys.executable, "-m", "pytest", "-m", "tpu", "tests/test_ops.py",
+        "-q", "-rA", "--timeout-method=thread",
+    ]
+    # pytest-timeout may be absent; fall back to plain -q then.
+    probe = subprocess.run(
+        [sys.executable, "-c", "import pytest_timeout"], capture_output=True
+    )
+    if probe.returncode != 0:
+        cmd = cmd[:-1]
+        if "--timeout-method=thread" in cmd:
+            cmd.remove("--timeout-method=thread")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
+        )
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+        return {
+            "outcome": "timeout",
+            "seconds": round(time.time() - t0, 1),
+            "tail": out.strip().splitlines()[-5:],
+        }
+    out = (proc.stdout or "") + (proc.stderr or "")
+    m = re.search(r"(\d+) passed", out)
+    s = re.search(r"(\d+) skipped", out)
+    f = re.search(r"(\d+) failed", out)
+    return {
+        "outcome": "ok" if proc.returncode == 0 and m else f"rc={proc.returncode}",
+        "passed": int(m.group(1)) if m else 0,
+        "skipped": int(s.group(1)) if s else 0,
+        "failed": int(f.group(1)) if f else 0,
+        "seconds": round(time.time() - t0, 1),
+        "tail": out.strip().splitlines()[-6:],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "TPUTESTS_r03.json"))
+    args = ap.parse_args()
+    budget = float(os.environ.get("TPUTESTS_BUDGET", "1800"))
+    attempt_timeout = float(os.environ.get("TPUTESTS_ATTEMPT_TIMEOUT", "900"))
+    deadline = time.time() + budget
+    attempts = []
+    result: dict = {"cmd": "LUMEN_TPU_TESTS=1 pytest -m tpu tests/test_ops.py"}
+    while time.time() < deadline:
+        left = deadline - time.time()
+        if left < 120:  # not enough for a claim + compile; don't burn a stub attempt
+            break
+        r = run_once(min(attempt_timeout, left))
+        attempts.append(r)
+        print(json.dumps(r), flush=True)
+        if r["outcome"] == "ok" and r.get("passed", 0) > 0:
+            break
+        if r["outcome"] not in ("timeout",) and r.get("failed", 0) > 0:
+            break  # real failures: record them, don't grind the budget
+    result["attempts"] = attempts
+    final = attempts[-1] if attempts else {"outcome": "no-attempt"}
+    result["ok"] = final.get("outcome") == "ok" and final.get("failed", 0) == 0 \
+        and final.get("passed", 0) > 0
+    result["passed"] = final.get("passed", 0)
+    result["failed"] = final.get("failed", 0)
+    result["recorded_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2)
+    print(f"wrote {args.out}: ok={result['ok']}")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,7 +114,7 @@ class TestApiContract:
         )
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+        with open(os.path.join(WEB, "js", "api.generated.js"), encoding="utf-8") as f:
             checked_in = f.read()
         assert checked_in == mod.render(), (
             "api.generated.js is stale; run scripts/generate_api_client.py"
@@ -124,9 +124,9 @@ class TestApiContract:
         """Every call("name") in api.js names a route the generated
         manifest carries (and the client covers a real share of the
         surface)."""
-        with open(os.path.join(WEB, "js", "api.js")) as f:
+        with open(os.path.join(WEB, "js", "api.js"), encoding="utf-8") as f:
             src = f.read()
-        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+        with open(os.path.join(WEB, "js", "api.generated.js"), encoding="utf-8") as f:
             gen = f.read()
         route_names = set(re.findall(r"^  (\w+): \{ method", gen, re.M))
         called = set(re.findall(r'call\("(\w+)"', src))
@@ -137,7 +137,7 @@ class TestApiContract:
         assert re.search(r"fetch\(ROUTES\.\w+\.path\)", src)
 
     def test_typedefs_cover_config_models(self):
-        with open(os.path.join(WEB, "js", "api.generated.js")) as f:
+        with open(os.path.join(WEB, "js", "api.generated.js"), encoding="utf-8") as f:
             gen = f.read()
         for model in ("LumenConfig", "BackendSettings", "MeshConfig", "Metadata"):
             assert f"@typedef {{Object}} {model}" in gen, model
@@ -146,7 +146,7 @@ class TestApiContract:
         # Must be in the CLIENT (LogStream's URL) — the generated manifest
         # always carries it because it mirrors the server's router, so
         # checking there would be a tautology.
-        with open(os.path.join(WEB, "js", "api.js")) as f:
+        with open(os.path.join(WEB, "js", "api.js"), encoding="utf-8") as f:
             src = f.read()
         assert "/ws/logs" in src
 
