@@ -226,6 +226,29 @@ class TestVlmExpertParallel:
         assert len(got.tokens) == 8
 
 
+class TestContinuousSchedulerOnTpMesh:
+    def test_continuous_tp_decode_matches_replicated(self, model_dir):
+        """The slot-pool scheduler composes with TP-sharded weights: same
+        tokens as the replicated coalescing path."""
+        repl = _mgr(model_dir)
+        try:
+            want = repl.generate(PROMPT, max_new_tokens=10)
+        finally:
+            repl.close()
+        cont_tp = _mgr(
+            model_dir,
+            mesh_axes={"data": 4, "model": 2},
+            scheduler="continuous",
+            gen_slots=2,
+            gen_block=4,
+        )
+        try:
+            got = cont_tp.generate(PROMPT, max_new_tokens=10)
+        finally:
+            cont_tp.close()
+        assert got.tokens == want.tokens
+
+
 # -- CLIP tensor parallelism --------------------------------------------------
 
 
