@@ -77,9 +77,36 @@ def _host_memory_gb() -> float:
     return 0.0
 
 
+def _env_declared_tpu() -> tuple[str, str, int] | None:
+    """(platform, device_kind, device_count) from environment declarations
+    alone — used when the live probe can't answer. On a shared pool,
+    backend init BLOCKS while no chip is free, so a probe timeout on a TPU
+    host means 'TPU present but busy', not 'no TPU'."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if accel:
+        # Topology suffix carries the slice size ("v5litepod-8" -> 8); a
+        # busy 8-chip slice must not get a 1-chip preset recommendation.
+        count = 1
+        tail = accel.rsplit("-", 1)
+        if len(tail) == 2 and tail[1].isdigit():
+            count = max(1, int(tail[1]))
+        return "tpu", accel, count
+    platforms = os.environ.get("JAX_PLATFORMS", "").split(",")
+    if os.environ.get("PALLAS_AXON_POOL_IPS") or "axon" in platforms:
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        # "TPU {gen}" round-trips through presets.parse_generation for
+        # every known generation; the axon tunnel claims one chip.
+        return "tpu", f"TPU {gen}", 1
+    return None
+
+
 def detect_hardware(timeout: float = 60.0) -> HardwareInfo:
     """Probe accelerators in a subprocess; never initializes a backend in
-    the control-plane process."""
+    the control-plane process. A probe that times out while the
+    environment declares a TPU (pool busy — the claim blocks) still
+    reports the TPU with device_count=1 and the timeout recorded in
+    ``error``, so preset auto-detection doesn't regress to the cpu tier
+    on a momentarily-contended host."""
     probe = {"platform": "none", "device_kind": "", "device_count": 0, "process_count": 0}
     try:
         out = subprocess.run(
@@ -95,7 +122,19 @@ def detect_hardware(timeout: float = 60.0) -> HardwareInfo:
                 break
             except json.JSONDecodeError:
                 continue
-    except (subprocess.TimeoutExpired, OSError) as e:
+    except subprocess.TimeoutExpired:
+        # Only a TIMEOUT means "pool busy" — a spawn failure (OSError
+        # below) keeps its real message instead of a misdiagnosis.
+        declared = _env_declared_tpu()
+        if declared is not None:
+            probe["platform"], probe["device_kind"], probe["device_count"] = declared
+            probe["error"] = (
+                f"live probe timed out after {timeout:.0f}s (chip pool busy); "
+                "platform taken from environment declaration"
+            )
+        else:
+            probe["error"] = f"probe timed out after {timeout:.0f}s"
+    except OSError as e:
         probe["error"] = str(e)
 
     tpu_env = {
