@@ -41,10 +41,18 @@ export function renderInstall(root, onLeave) {
       el("p", { class: "muted", id: "inst-error" }),
     ]),
     el("div", { class: "card" }, [
+      el("h3", {}, "Previous install tasks"),
+      el("div", { class: "muted" },
+        "Tasks from this control-plane session (reference SessionHub role): click one to resume watching it."),
+      el("ul", { class: "steplist", id: "inst-history" }),
+    ]),
+    el("div", { class: "card" }, [
       el("h3", {}, "Live logs"),
       el("div", { class: "logpane", id: "inst-logs" }),
     ])
   );
+
+  refreshHistory(root);
 
   const unsubLogs = attachLogPane(root.querySelector("#inst-logs"), logStream);
   onLeave(() => {
@@ -107,6 +115,86 @@ export function renderInstall(root, onLeave) {
   };
 }
 
+async function refreshHistory(root) {
+  let tasks;
+  try {
+    tasks = (await api.installTasks()).tasks || [];
+  } catch {
+    return; // history is best-effort; the live pane still works
+  }
+  if (!root.isConnected) return;
+  const list = root.querySelector("#inst-history");
+  if (!list) return;
+  if (!tasks.length) {
+    list.replaceChildren(el("li", { class: "muted" }, "none yet"));
+    return;
+  }
+  list.replaceChildren(
+    ...tasks
+      .slice()
+      .sort((a, b) => (b.created_at || 0) - (a.created_at || 0))
+      .map((t) =>
+        el("li", { class: t.status }, [
+          el("span", { class: "step-ico" }, STEP_ICONS[t.status] || "○"),
+          el(
+            "a",
+            {
+              href: "#",
+              onclick: (ev) => {
+                ev.preventDefault();
+                const active =
+                  wizard.state.installTaskId && !wizard.state.installDone;
+                if (t.status === "running" || t.status === "pending") {
+                  // Reattach to a live task (e.g. after a page reload).
+                  wizard.update({ installTaskId: t.task_id, installDone: false });
+                  poll(root, t.task_id, ++pollGen);
+                } else if (active && t.task_id !== wizard.state.installTaskId) {
+                  // Never detach the UI (and the Cancel button) from a
+                  // RUNNING install just to look at an old one.
+                  toast("an install is in progress — finish or cancel it first", true);
+                } else {
+                  // Terminal task: inspect once, no state writes, no
+                  // replayed completion/failure toasts.
+                  renderTaskOnce(root, t.task_id);
+                }
+              },
+            },
+            t.task_id
+          ),
+          el("span", { class: "step-detail" }, `${t.status} · ${t.progress ?? 0}%`),
+        ])
+      )
+  );
+}
+
+function renderTask(root, task) {
+  // task.progress is already a 0-100 percentage (install.py progress).
+  root.querySelector("#inst-bar").style.width = `${Math.round(task.progress || 0)}%`;
+  const list = root.querySelector("#inst-steps");
+  list.replaceChildren(
+    ...task.steps.map((step) =>
+      el("li", { class: step.status }, [
+        el("span", { class: "step-ico" }, STEP_ICONS[step.status] || "○"),
+        step.name,
+        el("span", { class: "step-detail" }, step.detail || ""),
+      ])
+    )
+  );
+  root.querySelector("#inst-status").textContent = `status: ${task.status}`;
+  root.querySelector("#inst-error").textContent = task.error || "";
+}
+
+async function renderTaskOnce(root, taskId) {
+  // Read-only inspection of a (terminal) task: render its steps/error
+  // without touching wizard state, poll chains, or toasts.
+  try {
+    const task = await api.installStatus(taskId);
+    if (root.isConnected) renderTask(root, task);
+  } catch (e) {
+    toastError(e, "could not load the task");
+  }
+}
+
 async function poll(root, taskId, gen) {
   if (!root.isConnected || gen !== pollGen) return; // view switched / superseded
   clearTimeout(pollTimer); // a Start-triggered poll replaces a stale chain
@@ -132,20 +220,7 @@ async function poll(root, taskId, gen) {
   }
   if (!root.isConnected || gen !== pollGen) return;
 
-  // task.progress is already a 0-100 percentage (install.py progress).
-  root.querySelector("#inst-bar").style.width = `${Math.round(task.progress || 0)}%`;
-  const list = root.querySelector("#inst-steps");
-  list.replaceChildren(
-    ...task.steps.map((step) =>
-      el("li", { class: step.status }, [
-        el("span", { class: "step-ico" }, STEP_ICONS[step.status] || "○"),
-        step.name,
-        el("span", { class: "step-detail" }, step.detail || ""),
-      ])
-    )
-  );
-  root.querySelector("#inst-status").textContent = `status: ${task.status}`;
-  root.querySelector("#inst-error").textContent = task.error || "";
+  renderTask(root, task);
 
   if (task.status === "running" || task.status === "pending") {
     root.querySelector("#inst-cancel").disabled = false;
@@ -159,5 +234,6 @@ async function poll(root, taskId, gen) {
     } else if (task.status === "failed") {
       toast(`install failed: ${task.error || "see logs"}`, true);
     }
+    refreshHistory(root); // terminal state: reflect it in the task list
   }
 }

@@ -88,10 +88,20 @@ class VlmService(BaseService):
         return cls(manager)
 
     def capability(self):
+        # Suggested client concurrency = the decode width the scheduler
+        # actually coalesces (slot-pool width for continuous, batcher
+        # width otherwise) — advertising 1 made clients serialize requests
+        # the server batches fine (reference field semantics: proto
+        # Capability.max_concurrency, "Suggested max concurrency").
+        width = (
+            self.manager.gen_slots
+            if self.manager.scheduler == "continuous"
+            else self.manager.gen_batch_size
+        )
         return self.registry.build_capability(
             model_ids=[self.manager.model_id],
             runtime="jax-tpu",
-            max_concurrency=1,
+            max_concurrency=max(1, width),
             precisions=["bf16", "fp32"],
             extra={
                 "max_new_cap": str(self.manager.max_new_cap),
