@@ -140,22 +140,33 @@ async function refreshHistory(root) {
             "a",
             {
               href: "#",
-              onclick: (ev) => {
+              onclick: async (ev) => {
                 ev.preventDefault();
-                const active =
-                  wizard.state.installTaskId && !wizard.state.installDone;
-                if (t.status === "running" || t.status === "pending") {
-                  // Reattach to a live task (e.g. after a page reload).
+                // Never detach the UI (and the Cancel button) from the
+                // install it is watching — guard FIRST, regardless of what
+                // the (possibly stale) list snapshot claims about t.
+                const current = wizard.state.installTaskId;
+                if (current && !wizard.state.installDone && t.task_id !== current) {
+                  toast("an install is in progress — finish or cancel it first", true);
+                  return;
+                }
+                // Decide reattach-vs-inspect from a FRESH status, not the
+                // mount-time snapshot (the task may have finished since).
+                let fresh;
+                try {
+                  fresh = await api.installStatus(t.task_id);
+                } catch (e) {
+                  toastError(e, "could not load the task");
+                  return;
+                }
+                if (!root.isConnected) return;
+                if (fresh.status === "running" || fresh.status === "pending") {
                   wizard.update({ installTaskId: t.task_id, installDone: false });
                   poll(root, t.task_id, ++pollGen);
-                } else if (active && t.task_id !== wizard.state.installTaskId) {
-                  // Never detach the UI (and the Cancel button) from a
-                  // RUNNING install just to look at an old one.
-                  toast("an install is in progress — finish or cancel it first", true);
                 } else {
-                  // Terminal task: inspect once, no state writes, no
+                  // Terminal: read-only render, no state writes, no
                   // replayed completion/failure toasts.
-                  renderTaskOnce(root, t.task_id);
+                  renderTask(root, fresh);
                 }
               },
             },
@@ -182,17 +193,6 @@ function renderTask(root, task) {
   );
   root.querySelector("#inst-status").textContent = `status: ${task.status}`;
   root.querySelector("#inst-error").textContent = task.error || "";
-}
-
-async function renderTaskOnce(root, taskId) {
-  // Read-only inspection of a (terminal) task: render its steps/error
-  // without touching wizard state, poll chains, or toasts.
-  try {
-    const task = await api.installStatus(taskId);
-    if (root.isConnected) renderTask(root, task);
-  } catch (e) {
-    toastError(e, "could not load the task");
-  }
 }
 
 async function poll(root, taskId, gen) {

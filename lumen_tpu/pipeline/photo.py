@@ -20,7 +20,6 @@ from typing import Any, Iterable, Iterator
 import numpy as np
 
 from lumen_tpu.ops.image import decode_image_bytes, letterbox_numpy
-from lumen_tpu.parallel.sharding import replicate
 from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
 
 logger = logging.getLogger(__name__)
@@ -78,13 +77,12 @@ class PhotoIngestPipeline:
                 mgr._ensure_ready()  # stages reach into post-initialize state
         self.clip, self.face, self.ocr, self.vlm = clip, face, ocr, vlm
         self.ocr_det_size = ocr_det_size
-        # Re-place manager weights replicated over the pipeline mesh so the
-        # per-request and ingest paths share ONE device copy (a second
-        # replicated copy per family could evict HBM needed for activations).
-        # The managers' own micro-batchers keep sharding inputs with their
-        # OWN mesh, so the pipeline mesh must cover the identical device
-        # set/order — otherwise per-request serving after ingest hits
-        # device-assignment mismatches or silent resharding.
+        # The per-request and ingest paths must share ONE device copy of
+        # each family's weights (a second copy could evict HBM needed for
+        # activations), and the managers' micro-batchers keep sharding
+        # inputs with their OWN mesh — so the pipeline mesh must cover the
+        # identical device set/order, or per-request serving after ingest
+        # hits device-assignment mismatches / silent resharding.
         pipeline_devs = tuple(mesh.devices.flat)
         for name, mgr in (("clip", clip), ("face", face), ("ocr", ocr), ("vlm", vlm)):
             if mgr is None:
@@ -97,12 +95,11 @@ class PhotoIngestPipeline:
                     "build the pipeline with the managers' mesh (or managers with the "
                     "pipeline's) so both paths share one device placement"
                 )
-        if clip is not None:
-            clip.params = replicate(clip.params, mesh)
-        if face is not None:
-            face.det_vars = replicate(face.det_vars, mesh)
-        if ocr is not None:
-            ocr.det_vars = replicate(ocr.det_vars, mesh)
+        # Managers place their params at initialize() (replicated, or
+        # TP-sharded when their mesh has a model axis); the device-set
+        # guard above already proved that placement is valid here, so the
+        # pipeline must NOT re-place — a blanket replicate() would silently
+        # undo a TP-sharded CLIP tower.
         self.classify_top_k = classify_top_k
         self.caption = caption
         self.caption_prompt = caption_prompt

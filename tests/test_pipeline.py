@@ -280,3 +280,50 @@ class TestPhotoCaptioning:
             assert records[1].error and "boom" in records[1].error
         finally:
             clip_mgr.close()
+
+
+class TestIngestWithTpManager:
+    def test_tp_sharded_clip_survives_pipeline_and_matches(self, tmp_path_factory):
+        """Building the photo pipeline must NOT undo a TP-sharded CLIP
+        tower (a blanket replicate() used to), and the ingest result must
+        match the per-request path bit-for-bit."""
+        from lumen_tpu.models.clip.manager import CLIPManager
+        from lumen_tpu.parallel.sharding import keypath_str
+        from lumen_tpu.pipeline.photo import PhotoIngestPipeline
+
+        model_dir = make_clip_model_dir(tmp_path_factory.mktemp("tpingest"))
+        mgr = CLIPManager(
+            model_dir, dtype="float32", batch_size=4,
+            mesh_axes={"data": 4, "model": 2},
+        )
+        mgr.initialize()
+        try:
+            pipe = PhotoIngestPipeline(mgr.mesh, clip=mgr, batch_size=8)
+            specs = {}
+            jax.tree_util.tree_map_with_path(
+                lambda kp, leaf: specs.__setitem__(
+                    keypath_str(kp), tuple(leaf.sharding.spec)
+                ),
+                mgr.params,
+            )
+            assert specs["vision/blocks_0/attn/q_proj/kernel"] == (None, "model")
+            payload = png_bytes(seed=5)
+            rec = list(pipe.run([payload] * 3))[0]
+            direct = mgr.encode_image(payload)
+            np.testing.assert_allclose(rec.clip_embedding, direct, atol=2e-5)
+        finally:
+            mgr.close()
+
+    def test_mismatched_mesh_devices_rejected(self, tmp_path_factory):
+        from lumen_tpu.models.clip.manager import CLIPManager
+        from lumen_tpu.pipeline.photo import PhotoIngestPipeline
+
+        model_dir = make_clip_model_dir(tmp_path_factory.mktemp("meshguard"))
+        mgr = CLIPManager(model_dir, dtype="float32", batch_size=4)
+        mgr.initialize()
+        try:
+            half = build_mesh({"data": -1}, devices=jax.devices()[:4])
+            with pytest.raises(ValueError, match="differ from pipeline mesh"):
+                PhotoIngestPipeline(half, clip=mgr, batch_size=8)
+        finally:
+            mgr.close()
