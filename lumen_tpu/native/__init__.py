@@ -1,4 +1,4 @@
-"""ctypes bindings for the native host-ops library (``native/host_ops.cpp``).
+"""ctypes bindings for the native host-ops library (``_src/host_ops.cpp``).
 
 The C core covers the host half of the serving hot loops — letterbox/resize,
 NMS, CTC collapse — GIL-free so the ingest pipeline's preprocess workers
