@@ -46,6 +46,7 @@ ViT-B/32 vision tower at batch 1 and report the throughput ratio.
 """
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -139,7 +140,7 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     import numpy as np
 
     from lumen_tpu.models.clip.modeling import CLIPConfig, CLIPModel
-    from lumen_tpu.ops import flash_enabled
+    from lumen_tpu.ops import flash_for_seq
 
     sweep = os.environ.get("BENCH_SWEEP") == "1" and jax.default_backend() != "cpu"
     if jax.default_backend() == "cpu":
@@ -210,7 +211,9 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
         "batch": batch,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
-        "flash_attention": flash_enabled(),
+        # seq 50 = ViT-B/32 vision tower tokens; records the path the
+        # HEADLINE number actually took (short seqs stay on fused XLA).
+        "flash_attention": flash_for_seq(50),
     }
     if sweep_results:
         result["sweep"] = sweep_results
@@ -579,14 +582,11 @@ def phase_flash_ab(iters: int = 20) -> dict:
         jax.random.normal(key, (b, h, s, d), jnp.bfloat16) for key in ks
     )
     ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
-    fla = jax.jit(
-        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=cpu)
-    )
 
-    def time_fn(fn):
-        _state("flash_ab:compile")
+    def time_fn(fn, tag):
+        _state(f"flash_ab:compile:{tag}")
         np.asarray(fn(q, k, v))  # compile + settle
-        _state("flash_ab:measure")
+        _state(f"flash_ab:measure:{tag}")
         t0 = time.perf_counter()
         out = None
         for _ in range(iters):
@@ -594,11 +594,24 @@ def phase_flash_ab(iters: int = 20) -> dict:
         np.asarray(out)
         return (time.perf_counter() - t0) / iters * 1e3  # ms/iter
 
-    ref_ms = time_fn(ref)
-    flash_ms = time_fn(fla)
+    ref_ms = time_fn(ref, "ref")
+    # Block-size sweep on chip (compile cache makes repeats cheap); CPU
+    # interpret mode runs one config as a correctness proof only.
+    configs = [(128, 128)] if cpu else [(128, 128), (128, 256), (256, 256), (128, 512)]
+    by_config = {}
+    for bq, bk in configs:
+        fn = jax.jit(
+            functools.partial(
+                flash_attention, causal=True, block_q=bq, block_k=bk, interpret=cpu
+            )
+        )
+        by_config[f"{bq}x{bk}"] = round(time_fn(fn, f"{bq}x{bk}"), 3)
+    best_cfg, flash_ms = min(by_config.items(), key=lambda kv: kv[1])
     return {
         "ref_ms": round(ref_ms, 3),
-        "flash_ms": round(flash_ms, 3),
+        "flash_ms": flash_ms,
+        "flash_ms_by_block": by_config,
+        "flash_best_block": best_cfg,
         "flash_speedup": round(ref_ms / flash_ms, 3) if flash_ms else None,
         "shape": f"b{b} h{h} s{s} d{d} causal bf16",
         "platform": jax.devices()[0].platform,
@@ -946,6 +959,10 @@ def phase_bench_grpc() -> dict:
             dtype="float32" if cpu else "bfloat16",
             batch_size=4 if cpu else 64,
             max_batch_latency_ms=2.0,
+            # Compile every bucket during build, not inside the measured
+            # (warm-path-by-protocol) request loop: the first on-chip run
+            # died when a cold tunnel compile outlived the request wait.
+            warmup=not cpu,
         )
         svc = ClipService({"clip": mgr})
         mgr.initialize()
@@ -986,7 +1003,7 @@ def phase_bench_grpc() -> dict:
             vmgr = VLMManager(
                 vlm_dir, dtype="bfloat16", max_seq=256, max_new_cap=32,
                 prefill_buckets=(64,), gen_batch_size=8,
-                gen_batch_latency_ms=4.0,
+                gen_batch_latency_ms=4.0, warmup=True,
             )
             vsvc = VlmService(vmgr)
             vmgr.initialize()
@@ -1241,8 +1258,16 @@ class _ChildAttempt:
         out: dict[str, dict] = {}
         for parsed in _parse_json_lines(text):
             phase = parsed.pop("phase", None)
-            if phase:
+            if not phase:
+                continue
+            # A later diagnostic marker must not clobber a good line (a
+            # phase can flush a partial result and THEN crash its tail —
+            # bench_grpc's two halves), but the crash must stay visible:
+            # keep it on the surviving dict as ``tail_error``.
+            if _is_ok(parsed) or not _is_ok(out.get(phase)):
                 out[phase] = parsed
+            elif "error" in parsed:
+                out[phase].setdefault("tail_error", parsed["error"])
         return out
 
     def err_tail(self) -> str:
@@ -1261,6 +1286,35 @@ class _ChildAttempt:
             self.proc.kill()
             self.proc.wait(timeout=30)
         self.drain()
+
+
+def _is_ok(res: dict | None) -> bool:
+    """A real phase result — not an error/skip diagnostic marker."""
+    return res is not None and "error" not in res and "skipped" not in res
+
+
+def _merge_results(into: dict[str, dict], fresh: dict[str, dict]) -> None:
+    """Merge child output. Two protections: a diagnostic marker never
+    clobbers a good result (but its error is kept as ``tail_error`` so the
+    final artifact still reports the failed half of a partially-flushed
+    phase), and a CPU-fallback result never clobbers an on-chip one (a
+    flaky tunnel can hand a later attempt the cpu backend)."""
+    for name, res in fresh.items():
+        prev = into.get(name)
+        if not _is_ok(res):
+            if _is_ok(prev):
+                if "error" in res:
+                    prev.setdefault("tail_error", res["error"])
+            else:
+                into[name] = res
+        elif (
+            _is_ok(prev)
+            and prev.get("platform") not in (None, "cpu")
+            and res.get("platform") == "cpu"
+        ):
+            continue
+        else:
+            into[name] = res
 
 
 def _run_tpu_attempts(
@@ -1292,7 +1346,7 @@ def _run_tpu_attempts(
         if probed is None:
             rc = child.proc.poll()
             child.kill()
-            results.update(child.results())
+            _merge_results(results, child.results())
             if rc is not None and rc != 0:
                 errors.append(
                     f"attempt {attempt}: child rc={rc}: {child.err_tail()}"
@@ -1322,8 +1376,8 @@ def _run_tpu_attempts(
                     f"after probe; last={child.last_hb}; {child.err_tail()}"
                 )
         child.drain()
-        results.update(child.results())
-        missing = [n for n in names if n not in results]
+        _merge_results(results, child.results())
+        missing = [n for n in names if not _is_ok(results.get(n))]
         if not missing:
             break
         # Chip was claimable moments ago: retry only the missing phases
@@ -1384,11 +1438,15 @@ def main(args) -> None:
     bt.start()
 
     results = _run_tpu_attempts(names, budget_end, probe_window, errors)
-    # A phase the child skipped for budget is a diagnostic, not a result.
+    # A phase that skipped (budget) or errored is a diagnostic, not a result.
     for name, res in list(results.items()):
-        if "skipped" in res:
-            errors.append(f"{name}: {res['skipped']}")
+        if not _is_ok(res):
+            errors.append(f"{name}: {res.get('skipped') or res.get('error')}")
             del results[name]
+        elif "tail_error" in res:
+            # Partially-flushed phase whose later half crashed: the good
+            # half is published, the crash still lands in errors[].
+            errors.append(f"{name} (partial): {res['tail_error']}")
 
     # CPU fallback for the headline (and the cheap A/B) so a number always
     # exists; heavyweight phases report honestly as absent instead of
@@ -1528,13 +1586,44 @@ if __name__ == "__main__":
     if _args.phase_group:
         # One process, one chip claim, one JSON line per completed phase
         # (flushed immediately so the parent can salvage partial progress).
-        # A phase crash stops the group loudly — the parent retries or
-        # CPU-falls-back for whatever is missing. Trailing phases that no
-        # longer fit the deadline are skipped with a marker instead of
-        # being killed mid-compile.
+        # A phase crash must NOT kill the group: exiting releases the chip,
+        # and under a saturated pool a fresh child's re-claim can block for
+        # hours (observed live: the very first claimed child died on one
+        # phase and the replacement never got the chip back). Instead the
+        # error is flushed as a marker, the group continues, and errored
+        # phases are retried once at the end — all under the original
+        # claim. Trailing phases that no longer fit the deadline are
+        # skipped with a marker instead of being killed mid-compile.
         _start_heartbeat()
         _deadline = float(os.environ.get("BENCH_GROUP_DEADLINE", "0")) or None
         _est = dict(PHASE_EST_S)
+
+        def _try_phase(_name: str) -> bool:
+            """Run one phase; flush its result or error marker. True=ok."""
+            _state(f"{_name}:running")
+            try:
+                _res = PHASES[_name]()
+            except Exception as e:  # noqa: BLE001 - keep the claim alive
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+                print(
+                    json.dumps(
+                        {"phase": _name, "error": f"{type(e).__name__}: {e}"[:400]}
+                    ),
+                    flush=True,
+                )
+                return False
+            _res["phase"] = _name
+            print(json.dumps(_res), flush=True)
+            if _name == "probe" and _res.get("platform") == "cpu":
+                # CPU fallback workloads are tiny; the TPU-sized estimates
+                # would skip phases that actually fit.
+                for _k in _est:
+                    _est[_k] = 120
+            return True
+
+        _errored: list[str] = []
         for _name in _args.phase_group.split(","):
             if _deadline is not None and _name != "probe":
                 _left = _deadline - time.time()
@@ -1547,14 +1636,14 @@ if __name__ == "__main__":
                         flush=True,
                     )
                     continue
-            _state(f"{_name}:running")
-            _res = PHASES[_name]()
-            _res["phase"] = _name
-            print(json.dumps(_res), flush=True)
-            if _name == "probe" and _res.get("platform") == "cpu":
-                # CPU fallback workloads are tiny; the TPU-sized estimates
-                # would skip phases that actually fit.
-                _est = {k: 120 for k in _est}
+            if not _try_phase(_name):
+                if _name == "probe":
+                    sys.exit(1)  # no claim — nothing downstream can run
+                _errored.append(_name)
+        for _name in _errored:  # one retry each, claim still held
+            if _deadline is not None and _deadline - time.time() < _est.get(_name, 300):
+                continue
+            _try_phase(_name)
         sys.exit(0)
     try:
         main(_args)

@@ -7,6 +7,7 @@ from .attention import (
     flash_attention,
     flash_attention_cache,
     flash_enabled,
+    flash_for_seq,
     repeat_kv,
 )
 from .ctc import ctc_collapse, ctc_greedy_device, load_ctc_vocab
@@ -32,6 +33,7 @@ __all__ = [
     "flash_attention",
     "flash_attention_cache",
     "flash_enabled",
+    "flash_for_seq",
     "repeat_kv",
     "ctc_greedy_device",
     "ctc_collapse",

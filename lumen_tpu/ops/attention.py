@@ -23,6 +23,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width; scratch stats are padded to this
 
+#: batch*heads and q-block axes carry no state between steps, so megacore
+#: chips (v4/v5p: two TensorCores per chip) may split them; the k axis is
+#: the online-softmax accumulation and must stay sequential.
+_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
+
 
 def _on_tpu() -> bool:
     """True when the default backend executes on TPU hardware. The axon
@@ -35,10 +42,18 @@ def _on_tpu() -> bool:
 
 
 def flash_enabled() -> bool:
-    """Would :func:`attention` route an unmasked call through the Pallas
-    kernel right now? (Reported by ``bench.py`` so perf numbers record
-    which attention path produced them.)"""
-    return _flash_usable(0, None)
+    """Would :func:`attention` route an unmasked long-sequence call through
+    the Pallas kernel right now? (Reported by ``bench.py`` so perf numbers
+    record which attention path produced them.)"""
+    return _flash_usable(0, None, _min_flash_seq())
+
+
+def flash_for_seq(sq: int) -> bool:
+    """Would :func:`attention` use the Pallas kernel for THIS query length?
+    Workload-accurate variant of :func:`flash_enabled` — the CLIP towers
+    (seq 50/77) sit below the min-seq gate, so benchmarks must not stamp
+    their numbers with the long-sequence answer."""
+    return _flash_usable(0, None, sq)
 
 
 def attention_reference(
@@ -208,6 +223,7 @@ def flash_attention(
             pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
             pltpu.VMEM((block_q_eff, _LANES), jnp.float32),
         ],
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(*qkv)
     return out.reshape(b, h, sq_p, d)[:, :, :sq]
@@ -346,6 +362,7 @@ def flash_attention_cache(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        compiler_params=_DIM_SEMANTICS,
         interpret=interpret,
     )(
         q_offsets.astype(jnp.int32),
@@ -357,13 +374,29 @@ def flash_attention_cache(
     return out.reshape(b, h, sq_p, d)[:, :, :sq]
 
 
-def _flash_usable(head_dim: int, mask) -> bool:
+#: Below this query length the whole problem fits one fused XLA attention
+#: and the kernel's grid degenerates (CLIP towers are seq 50/77: the grid
+#: would be (B*heads, 1, 1) sequential steps of sub-MXU-tile matmuls).
+#: Flash pays where online softmax saves HBM traffic — long sequences.
+_MIN_FLASH_SEQ_DEFAULT = 256
+
+
+def _min_flash_seq() -> int:
+    try:
+        return int(os.environ.get("LUMEN_FLASH_MIN_SEQ", _MIN_FLASH_SEQ_DEFAULT))
+    except ValueError:
+        return _MIN_FLASH_SEQ_DEFAULT
+
+
+def _flash_usable(head_dim: int, mask, sq: int) -> bool:
     force = os.environ.get("LUMEN_FLASH")
     if force == "0":
         return False
     if mask is not None or head_dim > 256:
         return False
-    return force == "1" or _on_tpu()
+    if force == "1":  # tests force the kernel on small CPU shapes
+        return True
+    return _on_tpu() and sq >= _min_flash_seq()
 
 
 def _interpret_mode() -> bool:
@@ -380,11 +413,14 @@ def attention(
     causal: bool = False,
     scale: float | None = None,
 ) -> jax.Array:
-    """Dispatch: Pallas flash kernel on TPU for unmasked/causal attention,
-    XLA reference elsewhere (CPU tests, explicit masks). ``LUMEN_FLASH=0``
+    """Dispatch: Pallas flash kernel on TPU for unmasked/causal attention on
+    sequences long enough to pay (``LUMEN_FLASH_MIN_SEQ``, default 256 —
+    short-sequence callers like the CLIP towers stay on the fused XLA path,
+    where one batched einsum beats a degenerate one-block kernel grid), XLA
+    reference elsewhere (CPU tests, explicit masks). ``LUMEN_FLASH=0``
     disables the kernel; ``LUMEN_FLASH=1`` forces it (interpret mode off
     TPU, for tests)."""
-    if _flash_usable(q.shape[-1], mask):
+    if _flash_usable(q.shape[-1], mask, q.shape[2]):
         return flash_attention(q, k, v, causal=causal, scale=scale, interpret=_interpret_mode())
     return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
 
@@ -432,7 +468,12 @@ def attention_cached(
     output shapes, so the switch compiles once inside the decode loop.
     """
     sq, sk = q.shape[2], k.shape[2]
-    if _flash_usable(q.shape[-1], None) and sq >= min_flash_q:
+    # Gate on the KEY length, not the query length: prefill chunks are
+    # short (sq 64) against a long cache buffer (sk >> sq), and the
+    # kernel's win is streaming those keys without a [B,1,Sq,Sk] HBM
+    # mask. min_flash_q still keeps near-decode query blocks on the
+    # cheaper masked path.
+    if _flash_usable(q.shape[-1], None, sk) and sq >= min_flash_q:
         return flash_attention_cache(
             q, k, v, q_offsets, kv_valid, scale=scale, interpret=_interpret_mode()
         )

@@ -19,6 +19,7 @@ per bucket at startup turns the reference's "model load time" into our
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -143,7 +144,17 @@ class MicroBatcher:
             self._queue.put((item, fut))
         return fut
 
-    def __call__(self, item: Any, timeout: float | None = 60.0) -> Any:
+    def __call__(self, item: Any, timeout: float | None = None) -> Any:
+        """Submit and wait. The default wait must tolerate a cold XLA
+        compile of a new bucket THROUGH the axon tunnel (observed >60s on
+        a v5e: the first on-chip gRPC bench died on exactly this) — the
+        client's own RPC deadline, not this timeout, bounds user-visible
+        latency. ``LUMEN_BATCH_TIMEOUT_S`` overrides; unset → 300s."""
+        if timeout is None:
+            try:
+                timeout = float(os.environ.get("LUMEN_BATCH_TIMEOUT_S", "300"))
+            except ValueError:
+                timeout = 300.0
         return self.submit(item).result(timeout=timeout)
 
     # -- collector thread -------------------------------------------------

@@ -1,0 +1,163 @@
+"""Session-long TPU bench collector.
+
+Runs in the background for the whole working session, retrying the chip
+claim until one lands, then immediately collects the full bench phase set
+plus the on-chip pytest suite and commits the artifacts. Complements
+``bench.py`` (which the driver runs once at round end with a bounded
+budget): this script's job is to *sample chip availability across many
+hours* so at least one artifact with real TPU numbers exists even if the
+pool is saturated at round end.
+
+Claim strategy: two prior 3-4h sessions retried the claim in fixed
+20-minute kill-and-relaunch windows and never landed one. Whether the
+axon tunnel queues claimants (hold wins) or can wedge a single claim
+forever (retry wins) is unobservable from here, so this collector hedges:
+it alternates one long hold with a few short retry windows.
+
+Appends one record per attempt segment to ``TPU_SESSION_r03.jsonl`` and,
+on success, writes ``TPU_SESSION_r03.json`` + ``TPUTESTS_r03.json`` and
+commits them.
+
+Usage: ``python scripts/collect_tpu_session.py`` (background).
+Env: ``COLLECT_BUDGET`` seconds (default 36000).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the harness exports the claim-retry loop)
+
+NAMES = [
+    "probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
+    "face", "ocr", "ingest",
+]
+LOG = os.path.join(REPO, "TPU_SESSION_r03.jsonl")
+OUT = os.path.join(REPO, "TPU_SESSION_r03.json")
+TESTS_OUT = os.path.join(REPO, "TPUTESTS_r03.json")
+
+# Alternate one long hold (maybe the tunnel queues claimants) with short
+# kill-and-relaunch windows (maybe a single claim can wedge).
+WINDOWS = [5400.0, 1200.0, 1200.0, 1200.0]
+
+
+def _append(rec: dict) -> None:
+    rec["ts"] = round(time.time(), 1)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _commit(paths: list[str], message: str) -> None:
+    try:
+        subprocess.run(["git", "add", *paths], cwd=REPO, check=True, timeout=60)
+        subprocess.run(
+            ["git", "commit", "-m", message], cwd=REPO, check=True, timeout=60
+        )
+    except Exception as e:  # noqa: BLE001 - foreground session may hold the lock
+        _append({"event": "commit-failed", "error": str(e)})
+
+
+def _reload_results() -> dict[str, dict]:
+    """Resume: pick up full phase results persisted by earlier segments so
+    a collector restart doesn't forfeit numbers already collected (the
+    chip may never be claimable again this session)."""
+    out: dict[str, dict] = {}
+    if not os.path.exists(LOG):
+        return out
+    with open(LOG) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            for name, res in (rec.get("results") or {}).items():
+                prev = out.get(name)
+                if (
+                    prev is not None
+                    and prev.get("platform") not in (None, "cpu")
+                    and res.get("platform") == "cpu"
+                ):
+                    continue  # never downgrade an on-chip record
+                out[name] = res
+    return out
+
+
+def main() -> None:
+    budget = float(os.environ.get("COLLECT_BUDGET", "36000"))
+    end = time.time() + budget
+    results: dict[str, dict] = _reload_results()
+    all_errors: list[str] = []
+    seg = 0
+    _append({"event": "start", "budget_s": budget, "names": NAMES,
+             "resumed": sorted(results)})
+
+    while time.time() < end - 180:
+        win = WINDOWS[seg % len(WINDOWS)]
+        seg += 1
+        seg_end = min(time.time() + win + 120.0, end)
+        errors: list[str] = []
+        missing = [n for n in NAMES if n not in results and n != "probe"]
+        res = bench._run_tpu_attempts(
+            ["probe", *missing], seg_end, win, errors
+        )
+        fresh = {k: v for k, v in res.items() if bench._is_ok(v)}
+        for k, v in fresh.items():
+            prev = results.get(k)
+            # A CPU-fallback result (flaky tunnel handing a later attempt
+            # the cpu backend) must never clobber an on-chip one.
+            if (
+                prev is not None
+                and prev.get("platform") not in (None, "cpu")
+                and v.get("platform") == "cpu"
+            ):
+                continue
+            results[k] = v
+        all_errors.extend(errors)
+        probe = results.get("probe") or {}
+        _append({
+            "event": "segment",
+            "window_s": win,
+            "errors": errors,
+            "completed": sorted(fresh),
+            "results": fresh,  # full numbers: restarts must not lose these
+            "probe": probe or None,
+        })
+        on_chip = probe.get("platform") not in (None, "cpu")
+        done = on_chip and all(n in results for n in NAMES)
+        if done or (on_chip and time.time() > end - 600):
+            break
+
+    probe = results.get("probe") or {}
+    if probe.get("platform") not in (None, "cpu"):
+        with open(OUT, "w") as f:
+            json.dump(
+                {"probe": probe, "results": results, "errors": all_errors},
+                f, indent=2,
+            )
+        _append({"event": "success", "phases": sorted(results)})
+        # On-chip pytest artifact (VERDICT r2 item 3) while the pool is warm.
+        budget_left = max(600.0, end - time.time())
+        env = dict(os.environ)
+        env["TPUTESTS_BUDGET"] = f"{min(budget_left, 2400.0):.0f}"
+        try:
+            subprocess.run(
+                [sys.executable, "scripts/run_tpu_tests.py", "--out", TESTS_OUT],
+                cwd=REPO, env=env, timeout=min(budget_left, 2700.0),
+            )
+        except Exception as e:  # noqa: BLE001
+            _append({"event": "tpu-tests-failed", "error": str(e)})
+        paths = [p for p in (OUT, TESTS_OUT, LOG) if os.path.exists(p)]
+        _commit(paths, "Record in-session TPU bench + on-chip test artifacts")
+    else:
+        _append({"event": "exhausted", "errors_total": len(all_errors)})
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,35 @@ class TestAttention:
         out = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out[:, :, 0]), np.asarray(v[:, :, 0]), atol=1e-5)
 
+    def test_flash_dispatch_seq_gating(self, monkeypatch):
+        # On TPU the kernel only takes sequences long enough to pay; the
+        # CLIP towers (seq 50/77) must stay on the fused XLA path where
+        # one batched einsum beats a degenerate one-block kernel grid.
+        import importlib
+
+        # the package re-exports a *function* named ``attention`` that
+        # shadows the submodule attribute, so import_module it is
+        attn_mod = importlib.import_module("lumen_tpu.ops.attention")
+
+        monkeypatch.delenv("LUMEN_FLASH", raising=False)
+        monkeypatch.setattr(attn_mod, "_on_tpu", lambda: True)
+        assert not attn_mod._flash_usable(64, None, 50)
+        assert not attn_mod._flash_usable(64, None, 77)
+        assert attn_mod._flash_usable(64, None, 256)
+        assert attn_mod._flash_usable(64, None, 1024)
+        # explicit masks and oversized heads always fall back
+        assert not attn_mod._flash_usable(64, object(), 1024)
+        assert not attn_mod._flash_usable(512, None, 1024)
+        # forcing bypasses the gate (CPU interpret-mode tests)
+        monkeypatch.setenv("LUMEN_FLASH", "1")
+        assert attn_mod._flash_usable(64, None, 50)
+        monkeypatch.setenv("LUMEN_FLASH", "0")
+        assert not attn_mod._flash_usable(64, None, 1024)
+        # threshold is env-tunable for on-chip A/B exploration
+        monkeypatch.delenv("LUMEN_FLASH", raising=False)
+        monkeypatch.setenv("LUMEN_FLASH_MIN_SEQ", "64")
+        assert attn_mod._flash_usable(64, None, 77)
+
     def test_repeat_kv(self):
         x = jnp.arange(2 * 2 * 3 * 4).reshape(2, 2, 3, 4)
         y = repeat_kv(x, 3)
