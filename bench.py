@@ -129,6 +129,42 @@ def _apply_platform_env() -> None:
     enable_persistent_cache()
 
 
+#: Peak dense bf16 FLOP/s per chip, by jax device_kind (public TPU specs).
+_PEAK_BF16_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _mfu_pct(ips: float, lowered_fn, batch: int, device_kind: str) -> float | None:
+    """Model FLOPs utilization for a throughput measurement: XLA's own
+    compiled cost analysis (exact flops for the executed program) over the
+    chip's peak bf16 rate. None when the device kind is unknown or the
+    backend doesn't expose cost analysis."""
+    peak = _PEAK_BF16_FLOPS.get(device_kind)
+    if peak is None:
+        for kind, val in _PEAK_BF16_FLOPS.items():
+            if kind.lower() in (device_kind or "").lower():
+                peak = val
+                break
+    if not peak or not ips:
+        return None
+    try:
+        ca = lowered_fn().compile().cost_analysis()
+        flops = (ca[0] if isinstance(ca, list) else ca or {}).get("flops")
+    except Exception:  # noqa: BLE001 - diagnostics only, never fail the phase
+        return None
+    if not flops:
+        return None
+    return round(100.0 * ips * (flops / batch) / peak, 2)
+
+
 def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     """CLIP ViT-B/32 image-embed throughput. ``BENCH_SWEEP=1`` tries a
     ladder of batch sizes and reports the best (one compile per size —
@@ -206,15 +242,27 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
             measure(128, 2)
         ips = measure(batch, iters)
     platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
     result = {
         "images_per_sec": round(ips, 1),
         "batch": batch,
         "platform": platform,
-        "device_kind": jax.devices()[0].device_kind,
+        "device_kind": device_kind,
         # seq 50 = ViT-B/32 vision tower tokens; records the path the
         # HEADLINE number actually took (short seqs stay on fused XLA).
         "flash_attention": flash_for_seq(50),
     }
+    mfu = _mfu_pct(
+        ips,
+        lambda: embed.lower(
+            params,
+            np.zeros((batch, cfg.image_size, cfg.image_size, 3), np.uint8),
+        ),
+        batch,
+        device_kind,
+    )
+    if mfu is not None:
+        result["mfu_pct"] = mfu
     if sweep_results:
         result["sweep"] = sweep_results
     return result
@@ -1317,6 +1365,48 @@ def _merge_results(into: dict[str, dict], fresh: dict[str, dict]) -> None:
             into[name] = res
 
 
+def _load_session_artifact() -> dict[str, dict]:
+    """On-chip phase results recorded earlier in the round by
+    ``scripts/collect_tpu_session.py`` (committed artifacts). Used ONLY
+    when the live attempt cannot claim a chip: a number measured on real
+    hardware this round, published with explicit provenance, beats
+    publishing a 1-core CPU fallback as the headline."""
+    import glob
+    import re
+
+    out: dict[str, dict] = {}
+    by_round: dict[int, list[str]] = {}
+    for path in glob.glob(os.path.join(REPO, "TPU_SESSION_r*.json*")):
+        m = re.search(r"TPU_SESSION_r(\d+)\.jsonl?$", path)
+        if m:
+            by_round.setdefault(int(m.group(1)), []).append(path)
+    if not by_round:
+        return out
+    # Latest round only: a stale round's numbers must not masquerade as
+    # current. jsonl (segment log) first so the json summary wins.
+    paths = sorted(by_round[max(by_round)], key=lambda p: not p.endswith(".jsonl"))
+    for path in paths:
+        try:
+            with open(path) as f:
+                if path.endswith(".jsonl"):
+                    recs = []
+                    for line in f:
+                        try:
+                            recs.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            continue
+                    chunks = [r.get("results") or {} for r in recs]
+                else:
+                    chunks = [json.load(f).get("results") or {}]
+        except (OSError, json.JSONDecodeError):
+            continue
+        for chunk in chunks:
+            for name, res in chunk.items():
+                if isinstance(res, dict) and res.get("platform") not in (None, "cpu"):
+                    out[name] = dict(res, source=os.path.basename(path))
+    return out
+
+
 def _run_tpu_attempts(
     names: list[str], budget_end: float, probe_window: float, errors: list
 ) -> dict[str, dict]:
@@ -1448,6 +1538,31 @@ def main(args) -> None:
             # half is published, the crash still lands in errors[].
             errors.append(f"{name} (partial): {res['tail_error']}")
 
+    # Live attempt got no chip (or only a CPU fallback): backfill the
+    # REQUESTED phases from the latest committed in-session artifact —
+    # real-hardware numbers recorded earlier, each stamped with its
+    # source file.
+    session_used: list[str] = []
+    session_sources: set[str] = set()
+    for name, res in _load_session_artifact().items():
+        if name not in names:
+            continue
+        live = results.get(name)
+        if not _is_ok(live) or live.get("platform") == "cpu":
+            results[name] = res
+            session_used.append(name)
+            session_sources.add(res.get("source", "?"))
+    if session_used:
+        session_used.sort()
+        extras["from_session_artifact"] = session_used
+        errors.append(
+            "phases "
+            + ",".join(session_used)
+            + ": live claim unavailable; values are recorded in-session "
+            "on-chip measurements from "
+            + ",".join(sorted(session_sources))
+        )
+
     # CPU fallback for the headline (and the cheap A/B) so a number always
     # exists; heavyweight phases report honestly as absent instead of
     # publishing meaningless 1-core numbers. Every tail step is clamped to
@@ -1536,13 +1651,19 @@ def main(args) -> None:
         extras["device_kind"] = clip.get("device_kind", "")
         extras["flash_attention"] = clip.get("flash_attention")
         if platform != "cpu":
-            kind = (clip.get("device_kind") or "").lower()
-            gen = next(
-                (g for g in PEAK_FLOPS if g in kind),
-                os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"),
-            )
-            peak = PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
-            extras["mfu_pct"] = round(100 * value * VITB32_FLOPS_PER_IMG / peak, 2)
+            if clip.get("mfu_pct") is not None:
+                # Phase-level MFU from XLA's compiled cost analysis —
+                # exact flops for the executed program; prefer it over
+                # the analytic ViT-B/32 estimate below.
+                extras["mfu_pct"] = clip["mfu_pct"]
+            else:
+                kind = (clip.get("device_kind") or "").lower()
+                gen = next(
+                    (g for g in PEAK_FLOPS if g in kind),
+                    os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"),
+                )
+                peak = PEAK_FLOPS.get(gen, PEAK_FLOPS["v5e"])
+                extras["mfu_pct"] = round(100 * value * VITB32_FLOPS_PER_IMG / peak, 2)
     if baseline:
         extras["baseline_torch_cpu_b1_images_per_sec"] = baseline.get("images_per_sec")
     if vlm_baseline:

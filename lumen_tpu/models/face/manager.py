@@ -28,7 +28,13 @@ import numpy as np
 from ...core.model_info import dataclass_from_extra, load_model_info
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...ops.nms import nms_jax
-from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
+from ...runtime.batcher import (
+    MicroBatcher,
+    batch_wait_timeout,
+    mesh_buckets,
+    mesh_sharded,
+    warmup_batcher,
+)
 from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
@@ -455,10 +461,13 @@ class FaceManager:
             if self.spec.rec_color == "bgr":
                 crop = crop[:, :, ::-1]
             crops.append(np.ascontiguousarray(crop))
-        # Concurrent submits coalesce into one batched device call.
+        # Concurrent submits coalesce into one batched device call. The
+        # wait shares the compile-tolerant default — a cold rec-bucket
+        # compile through the tunnel can exceed a fixed 60s.
         futures = [self._rec_batcher.submit(c) for c in crops]
+        wait = batch_wait_timeout()
         for f, fut in zip(faces, futures):
-            f.embedding = fut.result(timeout=60)
+            f.embedding = fut.result(timeout=wait)
 
     # -- comparisons (reference face_model.py:371-429) --------------------
 

@@ -75,6 +75,16 @@ def warmup_batcher(batcher: "MicroBatcher", make_dummy: Callable[[int], Any]) ->
         batcher.fn(make_dummy(b), b)
 
 
+def batch_wait_timeout() -> float:
+    """Default seconds a caller waits on a batched-call future — must
+    tolerate a cold bucket compile through the tunnel (see
+    :meth:`MicroBatcher.__call__`). ``LUMEN_BATCH_TIMEOUT_S`` overrides."""
+    try:
+        return float(os.environ.get("LUMEN_BATCH_TIMEOUT_S", "300"))
+    except ValueError:
+        return 300.0
+
+
 def bucket_for(n: int, buckets: list[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -151,10 +161,7 @@ class MicroBatcher:
         client's own RPC deadline, not this timeout, bounds user-visible
         latency. ``LUMEN_BATCH_TIMEOUT_S`` overrides; unset → 300s."""
         if timeout is None:
-            try:
-                timeout = float(os.environ.get("LUMEN_BATCH_TIMEOUT_S", "300"))
-            except ValueError:
-                timeout = 300.0
+            timeout = batch_wait_timeout()
         return self.submit(item).result(timeout=timeout)
 
     # -- collector thread -------------------------------------------------

@@ -1,0 +1,123 @@
+"""Unit tests for bench.py's parent-harness logic: result merging (good
+results vs diagnostic markers vs CPU fallbacks), per-phase line parsing,
+and the in-session artifact backfill. These guard the claim-retention
+protocol the on-chip collection depends on — a phase crash or a flaky
+tunnel must never erase real TPU numbers."""
+
+import json
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import bench  # noqa: E402
+
+
+class TestMergeRules:
+    def test_marker_never_clobbers_good_result(self):
+        r = {"clip": {"images_per_sec": 500, "platform": "tpu"}}
+        bench._merge_results(r, {"clip": {"error": "late crash"}})
+        assert r["clip"]["images_per_sec"] == 500
+        assert r["clip"]["tail_error"] == "late crash"
+
+    def test_cpu_fallback_never_clobbers_on_chip(self):
+        r = {"clip": {"images_per_sec": 500, "platform": "tpu"}}
+        bench._merge_results(r, {"clip": {"images_per_sec": 9, "platform": "cpu"}})
+        assert r["clip"]["platform"] == "tpu"
+
+    def test_good_result_replaces_marker_and_cpu(self):
+        r = {"vlm": {"error": "x"}, "clip": {"images_per_sec": 9, "platform": "cpu"}}
+        bench._merge_results(
+            r,
+            {
+                "vlm": {"tokens_per_sec": 5, "platform": "tpu"},
+                "clip": {"images_per_sec": 500, "platform": "tpu"},
+            },
+        )
+        assert bench._is_ok(r["vlm"])
+        assert r["clip"]["platform"] == "tpu"
+
+    def test_is_ok(self):
+        assert not bench._is_ok(None)
+        assert not bench._is_ok({"error": "x"})
+        assert not bench._is_ok({"skipped": "budget"})
+        assert bench._is_ok({"images_per_sec": 1})
+
+
+class TestChildLineParsing:
+    def _child(self, lines: list[str]) -> "bench._ChildAttempt":
+        child = object.__new__(bench._ChildAttempt)
+        child._out_lines = [line + "\n" for line in lines]
+        child._lock = threading.Lock()
+        return child
+
+    def test_partial_then_error_keeps_partial_and_tail(self):
+        child = self._child(
+            [
+                json.dumps({"phase": "bench_grpc", "partial": True, "rps": 10}),
+                json.dumps({"phase": "bench_grpc", "error": "vlm half died"}),
+            ]
+        )
+        res = child.results()["bench_grpc"]
+        assert res["rps"] == 10
+        assert res["tail_error"] == "vlm half died"
+
+    def test_retry_success_overwrites_error(self):
+        child = self._child(
+            [
+                json.dumps({"phase": "face", "error": "transient"}),
+                json.dumps({"phase": "face", "images_per_sec": 42}),
+            ]
+        )
+        assert child.results()["face"] == {"images_per_sec": 42}
+
+    def test_garbage_lines_ignored(self):
+        child = self._child(["not json", "[1,2]", "42", json.dumps({"phase": "p", "x": 1})])
+        assert child.results() == {"p": {"x": 1}}
+
+
+class TestSessionArtifactBackfill:
+    @pytest.fixture()
+    def repo(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "REPO", str(tmp_path))
+        return tmp_path
+
+    def test_loads_on_chip_results_only(self, repo):
+        (repo / "TPU_SESSION_r03.jsonl").write_text(
+            json.dumps({"event": "segment",
+                        "results": {"clip": {"images_per_sec": 900, "platform": "tpu"},
+                                    "ocr": {"det_images_per_sec": 5, "platform": "cpu"}}})
+            + "\n"
+        )
+        out = bench._load_session_artifact()
+        assert out["clip"]["images_per_sec"] == 900
+        assert out["clip"]["source"] == "TPU_SESSION_r03.jsonl"
+        assert "ocr" not in out  # cpu records are not hardware evidence
+
+    def test_json_summary_wins_over_jsonl(self, repo):
+        (repo / "TPU_SESSION_r03.jsonl").write_text(
+            json.dumps({"results": {"clip": {"images_per_sec": 1, "platform": "tpu"}}}) + "\n"
+        )
+        (repo / "TPU_SESSION_r03.json").write_text(
+            json.dumps({"results": {"clip": {"images_per_sec": 2, "platform": "tpu"}}})
+        )
+        assert bench._load_session_artifact()["clip"]["images_per_sec"] == 2
+
+    def test_latest_round_only(self, repo):
+        (repo / "TPU_SESSION_r02.json").write_text(
+            json.dumps({"results": {"clip": {"images_per_sec": 1, "platform": "tpu"},
+                                    "vlm": {"tokens_per_sec": 9, "platform": "tpu"}}})
+        )
+        (repo / "TPU_SESSION_r03.json").write_text(
+            json.dumps({"results": {"clip": {"images_per_sec": 2, "platform": "tpu"}}})
+        )
+        out = bench._load_session_artifact()
+        assert out["clip"]["images_per_sec"] == 2
+        assert "vlm" not in out  # stale round must not masquerade as current
+
+    def test_empty_or_missing_files(self, repo):
+        assert bench._load_session_artifact() == {}
+        (repo / "TPU_SESSION_r03.jsonl").write_text("garbage\n")
+        assert bench._load_session_artifact() == {}
