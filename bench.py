@@ -1211,6 +1211,36 @@ PHASES = {
     "baseline_vlm": phase_baseline_vlm,
 }
 
+if os.environ.get("BENCH_TEST_PHASES") == "1":
+    # Test-only stub phases (tests/test_bench_harness.py): exercise the
+    # group runner's keep-the-claim-alive protocol — error markers,
+    # continue-past-crash, end-of-group retry — in milliseconds, with no
+    # jax import and no chip. The real probe is replaced so the group
+    # path under test never touches a backend.
+    _STUB_STATE = {"flaky_runs": 0}
+
+    def _stub_probe() -> dict:
+        return {"platform": "stub", "device_kind": "stub"}
+
+    def _stub_ok() -> dict:
+        return {"platform": "stub", "x": 1}
+
+    def _stub_flaky() -> dict:
+        _STUB_STATE["flaky_runs"] += 1
+        if _STUB_STATE["flaky_runs"] == 1:
+            raise RuntimeError("transient stub failure")
+        return {"platform": "stub", "recovered": True}
+
+    def _stub_broken() -> dict:
+        raise RuntimeError("permanent stub failure")
+
+    PHASES.update(
+        probe=_stub_probe,
+        stub_ok=_stub_ok,
+        stub_flaky=_stub_flaky,
+        stub_broken=_stub_broken,
+    )
+
 
 # ---------------------------------------------------------------------------
 # Parent harness

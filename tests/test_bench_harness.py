@@ -78,6 +78,51 @@ class TestChildLineParsing:
         assert child.results() == {"p": {"x": 1}}
 
 
+class TestGroupRunnerProtocol:
+    """End-to-end subprocess runs of ``bench.py --phase-group`` with stub
+    phases (BENCH_TEST_PHASES=1): a phase crash must flush an error marker
+    and continue under the same process (the claim), with one retry at the
+    end of the group."""
+
+    def _run_group(self, names: str) -> tuple[int, dict[str, list[dict]]]:
+        import subprocess
+
+        env = dict(__import__("os").environ)
+        env["BENCH_TEST_PHASES"] = "1"
+        env.pop("BENCH_GROUP_DEADLINE", None)
+        proc = subprocess.run(
+            [sys.executable, str(Path(bench.__file__)), "--phase-group", names],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd=str(Path(bench.__file__).parent),
+        )
+        by_phase: dict[str, list[dict]] = {}
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            by_phase.setdefault(rec.pop("phase", "?"), []).append(rec)
+        return proc.returncode, by_phase
+
+    def test_crash_continues_and_retries(self):
+        rc, lines = self._run_group("probe,stub_flaky,stub_ok,stub_broken")
+        assert rc == 0
+        assert lines["probe"] == [{"platform": "stub", "device_kind": "stub"}]
+        # stub_ok ran even though stub_flaky crashed before it
+        assert lines["stub_ok"] == [{"platform": "stub", "x": 1}]
+        # flaky: error marker first, then the end-of-group retry succeeds
+        assert "error" in lines["stub_flaky"][0]
+        assert lines["stub_flaky"][1] == {"platform": "stub", "recovered": True}
+        # broken: initial error + retry error, nothing else
+        assert all("error" in rec for rec in lines["stub_broken"])
+        assert len(lines["stub_broken"]) == 2
+
+    def test_all_green_group(self):
+        rc, lines = self._run_group("probe,stub_ok")
+        assert rc == 0
+        assert "error" not in lines["stub_ok"][0]
+
+
 class TestSessionArtifactBackfill:
     @pytest.fixture()
     def repo(self, tmp_path, monkeypatch):
