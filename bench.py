@@ -166,10 +166,13 @@ def _mfu_pct(ips: float, lowered_fn, batch: int, device_kind: str) -> float | No
 
 
 def phase_clip(batch: int = 256, iters: int = 30) -> dict:
-    """CLIP ViT-B/32 image-embed throughput. ``BENCH_SWEEP=1`` tries a
-    ladder of batch sizes and reports the best (one compile per size —
-    only worth the chip time when tuning, not in the driver's default
-    run)."""
+    """CLIP ViT-B/32 image-embed throughput. When ``batch`` is left at its
+    default on an accelerator, a short two-point probe (256 vs 512, result
+    key ``probe``) picks the headline batch — switching only on a clear
+    margin — before the full-``iters`` measurement; an explicit ``batch``
+    is honored as-is. ``BENCH_SWEEP=1`` instead tries the full ladder at
+    full iters and reports it under ``sweep`` (one compile per size —
+    only worth the chip time when tuning)."""
     _apply_platform_env()
     import jax
     import jax.numpy as jnp
@@ -230,16 +233,27 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
         return b * n_iters / (time.perf_counter() - t0)
 
     sweep_results = {}
+    probe_results = {}
     if sweep:
         for b in (128, 256, 512, 1024):
             sweep_results[b] = round(measure(b, iters), 1)
         batch, ips = max(sweep_results.items(), key=lambda kv: kv[1])
+    elif jax.default_backend() != "cpu":
+        # Smallest-first warm: a cheap batch-128 compile lands in the
+        # persistent cache first, so a later killed run still leaves
+        # reusable executables behind.
+        measure(128, 2)
+        if batch == 256:  # default → probe; an explicit batch is honored
+            # Two-point probe (one extra compile, cached across runs):
+            # switch to 512 only on a clear >5% margin — 8 iters is
+            # decision-grade for that gap, not for a coin flip, and the
+            # headline must not flap between batch sizes run to run.
+            probe_iters = 8
+            probe_results = {b: round(measure(b, probe_iters), 1) for b in (256, 512)}
+            if probe_results[512] > 1.05 * probe_results[256]:
+                batch = 512
+        ips = measure(batch, iters)
     else:
-        if jax.default_backend() != "cpu":
-            # Smallest-first warm: a cheap batch-128 compile lands in the
-            # persistent cache first, so a later killed run still leaves
-            # reusable executables behind.
-            measure(128, 2)
         ips = measure(batch, iters)
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
@@ -265,6 +279,8 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
         result["mfu_pct"] = mfu
     if sweep_results:
         result["sweep"] = sweep_results
+    if probe_results:
+        result["probe_images_per_sec"] = {"iters": 8, **probe_results}
     return result
 
 
