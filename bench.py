@@ -95,6 +95,8 @@ PHASE_EST_S = {
     # The phase's CLIP half (phase-start gate); the VLM half is budgeted
     # separately inside the phase by BENCH_GRPC_VLM_EST_S.
     "bench_grpc": 420,
+    # ~5 small on-chip compiles (ragged/int8/grouped-GEMM/flash kernels).
+    "tpu_tests": 300,
 }
 
 # In-phase estimate for bench_grpc's VLM half (manager init + prefill and
@@ -168,9 +170,9 @@ def _mfu_pct(ips: float, lowered_fn, batch: int, device_kind: str) -> float | No
 def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     """CLIP ViT-B/32 image-embed throughput. When ``batch`` is left at its
     default on an accelerator, a short two-point probe (256 vs 512, result
-    key ``probe``) picks the headline batch — switching only on a clear
-    margin — before the full-``iters`` measurement; an explicit ``batch``
-    is honored as-is. ``BENCH_SWEEP=1`` instead tries the full ladder at
+    key ``probe_images_per_sec``) picks the headline batch — switching only
+    on a clear margin — before the full-``iters`` measurement; an explicit
+    ``batch`` is honored as-is. ``BENCH_SWEEP=1`` instead tries the full ladder at
     full iters and reports it under ``sweep`` (one compile per size —
     only worth the chip time when tuning)."""
     _apply_platform_env()
@@ -249,7 +251,10 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
             # decision-grade for that gap, not for a coin flip, and the
             # headline must not flap between batch sizes run to run.
             probe_iters = 8
-            probe_results = {b: round(measure(b, probe_iters), 1) for b in (256, 512)}
+            probe_results = {
+                "iters": probe_iters,
+                **{b: round(measure(b, probe_iters), 1) for b in (256, 512)},
+            }
             if probe_results[512] > 1.05 * probe_results[256]:
                 batch = 512
         ips = measure(batch, iters)
@@ -280,7 +285,7 @@ def phase_clip(batch: int = 256, iters: int = 30) -> dict:
     if sweep_results:
         result["sweep"] = sweep_results
     if probe_results:
-        result["probe_images_per_sec"] = {"iters": 8, **probe_results}
+        result["probe_images_per_sec"] = probe_results
     return result
 
 
@@ -1212,6 +1217,82 @@ def phase_probe() -> dict:
     }
 
 
+def phase_tpu_tests() -> dict:
+    """Run the device-path smoke tests (``-m tpu``: ragged decode, int8
+    dot, grouped GEMM, both flash kernels; ``tests/test_ops.py``)
+    IN-PROCESS, under the group child's existing chip claim — a separate
+    pytest process would need a SECOND claim from a usually-saturated
+    pool. Writes the on-chip test artifact (``TPUTESTS_OUT``, default
+    ``TPUTESTS_r03.json``) and returns the tallies either way: a recorded
+    failure on real hardware is evidence too."""
+    _apply_platform_env()
+    import contextlib
+    import io as _io
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    result: dict = {"platform": platform, "device_kind": jax.devices()[0].device_kind}
+    if platform == "cpu":
+        # The CPU suite already covers these in interpret mode; running
+        # them here would record nothing new.
+        result["outcome"] = "not-run (no chip)"
+        return result
+
+    import pytest as _pytest
+
+    os.environ["LUMEN_TPU_TESTS"] = "1"  # conftest: keep the live backend
+
+    class _Tally:
+        def __init__(self):
+            self.passed, self.failed, self.skipped = 0, 0, 0
+            self.failures: list[str] = []
+
+        def pytest_runtest_logreport(self, report):
+            if report.when == "call":
+                if report.passed:
+                    self.passed += 1
+                elif report.failed:
+                    self.failed += 1
+                    self.failures.append(report.nodeid)
+            if report.skipped:
+                self.skipped += 1
+
+    tally = _Tally()
+    _state("tpu_tests:running")
+    buf = _io.StringIO()  # pytest's report must not pollute the JSON-line protocol
+    with contextlib.redirect_stdout(buf):
+        rc = _pytest.main(["-m", "tpu", "tests/test_ops.py", "-q", "-p", "no:cacheprovider"],
+                          plugins=[tally])
+    # Key names must not collide with the harness's diagnostic markers:
+    # a literal "skipped"/"error" key would make _is_ok() classify a
+    # successful run as not-a-result. rc 5 = nothing collected — that is
+    # a selection problem, not a test failure.
+    if int(rc) == 5 or (tally.passed == 0 and tally.failed == 0):
+        outcome = "no-tests"
+    elif int(rc) == 0:
+        outcome = "passed"
+    else:
+        outcome = "failed"
+    result.update(
+        exit_code=int(rc),
+        n_passed=tally.passed,
+        n_failed=tally.failed,
+        n_skipped=tally.skipped,
+        outcome=outcome,
+    )
+    if tally.failures:
+        result["failures"] = tally.failures[:10]
+        result["report_tail"] = buf.getvalue().strip().splitlines()[-10:]
+    out_path = os.path.join(REPO, os.environ.get("TPUTESTS_OUT", "TPUTESTS_r03.json"))
+    try:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    except OSError as e:
+        result["artifact_error"] = str(e)
+    return result
+
+
 PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
@@ -1225,6 +1306,7 @@ PHASES = {
     "bench_grpc_ref": phase_bench_grpc_ref,
     "baseline": phase_baseline_torch,
     "baseline_vlm": phase_baseline_vlm,
+    "tpu_tests": phase_tpu_tests,
 }
 
 if os.environ.get("BENCH_TEST_PHASES") == "1":
@@ -1554,7 +1636,7 @@ def main(args) -> None:
         ["probe", "clip"]
         if light
         else ["probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
-              "face", "ocr", "ingest"]
+              "face", "ocr", "ingest", "tpu_tests"]
     )
 
     # torch-CPU baselines run concurrently with the claim wait: the TPU
@@ -1667,6 +1749,13 @@ def main(args) -> None:
     grpc_res = results.get("bench_grpc")
     if grpc_res:
         extras["grpc"] = grpc_res
+    tpu_tests = results.get("tpu_tests")
+    if tpu_tests and tpu_tests.get("platform") != "cpu":
+        extras["tpu_tests"] = {
+            k: tpu_tests[k]
+            for k in ("outcome", "n_passed", "n_failed", "n_skipped", "device_kind")
+            if k in tpu_tests
+        }
     grpc_ref = baseline_box.get("grpc_ref")
     if baseline_box.get("grpc_ref_err"):
         errors.append(baseline_box["grpc_ref_err"])

@@ -37,7 +37,7 @@ import bench  # noqa: E402  (the harness exports the claim-retry loop)
 
 NAMES = [
     "probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
-    "face", "ocr", "ingest",
+    "face", "ocr", "ingest", "tpu_tests",
 ]
 LOG = os.path.join(REPO, "TPU_SESSION_r03.jsonl")
 OUT = os.path.join(REPO, "TPU_SESSION_r03.json")
@@ -87,6 +87,19 @@ def _reload_results() -> dict[str, dict]:
                     continue  # never downgrade an on-chip record
                 out[name] = res
     return out
+
+
+def _tests_artifact_real() -> bool:
+    """Does ``TPUTESTS_r03.json`` already record an actual on-chip test
+    run? Handles both writers: the in-claim bench phase ({"outcome":
+    "passed"|"failed", ...}) and the standalone runner ({"ok": bool,
+    "attempts": [...]}). Timeout/no-attempt artifacts don't count."""
+    try:
+        with open(TESTS_OUT) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return bool(data.get("ok")) or data.get("outcome") in ("passed", "failed")
 
 
 def main() -> None:
@@ -150,17 +163,23 @@ def main() -> None:
                 f, indent=2,
             )
         _append({"event": "success", "phases": sorted(results)})
-        # On-chip pytest artifact (VERDICT r2 item 3) while the pool is warm.
-        budget_left = max(600.0, end - time.time())
-        env = dict(os.environ)
-        env["TPUTESTS_BUDGET"] = f"{min(budget_left, 2400.0):.0f}"
-        try:
-            subprocess.run(
-                [sys.executable, "scripts/run_tpu_tests.py", "--out", TESTS_OUT],
-                cwd=REPO, env=env, timeout=min(budget_left, 2700.0),
-            )
-        except Exception as e:  # noqa: BLE001
-            _append({"event": "tpu-tests-failed", "error": str(e)})
+        # On-chip pytest artifact: normally produced by the in-claim
+        # ``tpu_tests`` bench phase; fall back to the standalone runner
+        # (needs its own claim) only when no artifact records a REAL
+        # on-chip run — a stale timeout/no-attempt artifact from an
+        # earlier session must not suppress the retry.
+        ran_in_claim = (results.get("tpu_tests") or {}).get("platform") not in (None, "cpu")
+        if not ran_in_claim and not _tests_artifact_real():
+            budget_left = max(600.0, end - time.time())
+            env = dict(os.environ)
+            env["TPUTESTS_BUDGET"] = f"{min(budget_left, 2400.0):.0f}"
+            try:
+                subprocess.run(
+                    [sys.executable, "scripts/run_tpu_tests.py", "--out", TESTS_OUT],
+                    cwd=REPO, env=env, timeout=min(budget_left, 2700.0),
+                )
+            except Exception as e:  # noqa: BLE001
+                _append({"event": "tpu-tests-failed", "error": str(e)})
         paths = [p for p in (OUT, TESTS_OUT, LOG) if os.path.exists(p)]
         _commit(paths, "Record in-session TPU bench + on-chip test artifacts")
     else:
