@@ -1255,6 +1255,12 @@ def phase_tpu_tests() -> dict:
                 elif report.failed:
                     self.failed += 1
                     self.failures.append(report.nodeid)
+            elif report.failed:
+                # fixture/teardown error (pytest's "error" outcome) —
+                # without this the artifact would say "failed" with
+                # n_failed=0 and no diagnostics.
+                self.failed += 1
+                self.failures.append(f"{report.nodeid} ({report.when} error)")
             if report.skipped:
                 self.skipped += 1
 
@@ -1262,8 +1268,14 @@ def phase_tpu_tests() -> dict:
     _state("tpu_tests:running")
     buf = _io.StringIO()  # pytest's report must not pollute the JSON-line protocol
     with contextlib.redirect_stdout(buf):
-        rc = _pytest.main(["-m", "tpu", "tests/test_ops.py", "-q", "-p", "no:cacheprovider"],
-                          plugins=[tally])
+        # --capture=sys: pytest's default fd-level capture would steal fd 2
+        # for the whole run, silencing the [bench-hb] heartbeat thread that
+        # tells the parent WHERE a killed child died.
+        rc = _pytest.main(
+            ["-m", "tpu", "tests/test_ops.py", "-q", "--capture=sys",
+             "-p", "no:cacheprovider"],
+            plugins=[tally],
+        )
     # Key names must not collide with the harness's diagnostic markers:
     # a literal "skipped"/"error" key would make _is_ok() classify a
     # successful run as not-a-result. rc 5 = nothing collected — that is
@@ -1284,6 +1296,10 @@ def phase_tpu_tests() -> dict:
     if tally.failures:
         result["failures"] = tally.failures[:10]
         result["report_tail"] = buf.getvalue().strip().splitlines()[-10:]
+    if outcome == "no-tests":
+        # A collection problem must not clobber a previously recorded REAL
+        # on-chip run (the artifact may be the round's only evidence).
+        return result
     out_path = os.path.join(REPO, os.environ.get("TPUTESTS_OUT", "TPUTESTS_r03.json"))
     try:
         with open(out_path, "w") as f:

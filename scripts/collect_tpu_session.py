@@ -91,15 +91,21 @@ def _reload_results() -> dict[str, dict]:
 
 def _tests_artifact_real() -> bool:
     """Does ``TPUTESTS_r03.json`` already record an actual on-chip test
-    run? Handles both writers: the in-claim bench phase ({"outcome":
+    run (pass OR fail — a recorded failure on real hardware is evidence
+    too)? Handles both writers: the in-claim bench phase ({"outcome":
     "passed"|"failed", ...}) and the standalone runner ({"ok": bool,
-    "attempts": [...]}). Timeout/no-attempt artifacts don't count."""
+    "attempts": [{"outcome": "ok"|"rc=N"|"timeout"}, ...]}).
+    Timeout/no-attempt/no-tests artifacts don't count."""
     try:
         with open(TESTS_OUT) as f:
             data = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    return bool(data.get("ok")) or data.get("outcome") in ("passed", "failed")
+    if data.get("outcome") in ("passed", "failed"):
+        return True  # in-claim phase writer
+    final = (data.get("attempts") or [{}])[-1]
+    # standalone runner: "ok" or "rc=N" means pytest actually ran on chip
+    return bool(data.get("ok")) or str(final.get("outcome", "")).startswith(("ok", "rc="))
 
 
 def main() -> None:
@@ -168,7 +174,11 @@ def main() -> None:
         # (needs its own claim) only when no artifact records a REAL
         # on-chip run — a stale timeout/no-attempt artifact from an
         # earlier session must not suppress the retry.
-        ran_in_claim = (results.get("tpu_tests") or {}).get("platform") not in (None, "cpu")
+        in_claim = results.get("tpu_tests") or {}
+        ran_in_claim = (
+            in_claim.get("platform") not in (None, "cpu")
+            and in_claim.get("outcome") in ("passed", "failed")
+        )
         if not ran_in_claim and not _tests_artifact_real():
             budget_left = max(600.0, end - time.time())
             env = dict(os.environ)

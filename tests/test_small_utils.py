@@ -1,0 +1,80 @@
+"""Coverage for the small shared utilities: ONNX export discovery
+(precision-preference chain), logging setup idempotence, and the
+persistent compile cache switch."""
+
+import logging
+import os
+
+from lumen_tpu.onnx_bridge.discovery import find_onnx_exports
+from lumen_tpu.runtime.compile_cache import enable_persistent_cache
+from lumen_tpu.utils.logger import setup_logging
+
+
+class TestExportDiscovery:
+    def _mkfiles(self, root, names):
+        for n in names:
+            path = root / n
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_bytes(b"onnx")
+
+    def test_prefers_requested_precision_then_fp32_then_fp16(self, tmp_path):
+        self._mkfiles(tmp_path, ["vision.fp16.onnx", "vision.fp32.onnx"])
+        out = find_onnx_exports(str(tmp_path), {"vision": "vision"}, precision="fp16")
+        assert out["vision"].endswith("vision.fp16.onnx")
+        out = find_onnx_exports(str(tmp_path), {"vision": "vision"})
+        assert out["vision"].endswith("vision.fp32.onnx")
+
+    def test_bare_name_is_last_resort(self, tmp_path):
+        self._mkfiles(tmp_path, ["text.onnx"])
+        out = find_onnx_exports(str(tmp_path), {"text": "text"})
+        assert out["text"].endswith("text.onnx")
+
+    def test_scans_onnx_runtime_subdir(self, tmp_path):
+        self._mkfiles(tmp_path, [os.path.join("onnx", "det.fp32.onnx")])
+        out = find_onnx_exports(str(tmp_path), {"det": "det"})
+        assert out["det"].endswith(os.path.join("onnx", "det.fp32.onnx"))
+
+    def test_missing_component_and_missing_dir(self, tmp_path):
+        self._mkfiles(tmp_path, ["vision.fp32.onnx"])
+        out = find_onnx_exports(str(tmp_path), {"vision": "vision", "text": "text"})
+        assert "text" not in out
+        assert find_onnx_exports(str(tmp_path / "nope"), {"x": "x"}) == {}
+
+
+class TestLoggerSetup:
+    def test_idempotent_single_handler(self):
+        setup_logging("INFO")
+        setup_logging("DEBUG")  # re-run must replace, not stack
+        ours = [
+            h for h in logging.getLogger().handlers
+            if getattr(h, "_lumen_tpu", False)
+        ]
+        assert len(ours) == 1
+        assert logging.getLogger().level == logging.DEBUG
+
+    def test_non_tty_output_has_no_ansi(self, capsys):
+        setup_logging("INFO")
+        logging.getLogger("t").info("plain message")
+        err = capsys.readouterr().err
+        assert "plain message" in err
+        assert "\x1b[" not in err  # capsys pipe is not a tty
+
+
+class TestCompileCache:
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_COMPILE_CACHE", "0")
+        assert enable_persistent_cache() is None
+
+    def test_custom_dir_created_and_configured(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("LUMEN_COMPILE_CACHE", raising=False)
+        target = tmp_path / "xla-cache"
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        try:
+            got = enable_persistent_cache(str(target))
+            assert got == str(target)
+            assert target.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(target)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before)
