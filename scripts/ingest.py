@@ -53,6 +53,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--caption-prompt", default="Describe this photo in one sentence.")
     parser.add_argument("--caption-max-tokens", type=int, default=32)
     parser.add_argument("--limit", type=int, default=None)
+    parser.add_argument(
+        "--resume", action="store_true",
+        help="append to --output, skipping images it already records "
+        "(error rows count as recorded: delete a row to retry it)",
+    )
     parser.add_argument("--embed-encoding", choices=["list", "b64"], default="b64",
                         help="embedding serialization (b64 = little-endian fp32)")
     parser.add_argument("--platform", default=None, choices=["cpu", "tpu"],
@@ -126,6 +131,55 @@ def main(argv: list[str] | None = None) -> int:
     if not paths:
         print("no images found", file=sys.stderr)
         return 2
+    resuming = args.resume and os.path.exists(args.output)
+    if resuming:
+        # A multi-hour library index WILL get interrupted; --resume keeps
+        # every finished row (SURVEY.md §5 checkpoint/resume stance).
+        done: set[str] = set()
+        first_row: dict | None = None
+        valid_end = 0  # byte offset after the last COMPLETE line
+        with open(args.output, "rb") as f:
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break  # torn tail from the interruption — drop it below
+                valid_end += len(line)
+                try:
+                    row = json.loads(line)
+                    # Comparison is abspath-normalized so resuming with a
+                    # differently-spelled --input (relative vs absolute,
+                    # other cwd) still matches; rows keep their spelling.
+                    done.add(os.path.abspath(row["path"]))
+                    if first_row is None:
+                        first_row = row
+                except (json.JSONDecodeError, KeyError, UnicodeDecodeError):
+                    continue
+        if valid_end < os.path.getsize(args.output):
+            # Appending after a partial line would corrupt two records.
+            os.truncate(args.output, valid_end)
+        if first_row is not None and "error" not in first_row:
+            # Cheap schema guard: appending rows shaped by different flags
+            # than the original run makes a mixed-schema index.
+            if ("caption" in first_row) != ("vlm" in wanted):
+                print(
+                    "resume warning: existing rows and --families disagree "
+                    "on captions; the index will mix schemas", file=sys.stderr,
+                )
+            old_embed = first_row.get("clip_embedding")
+            if old_embed is not None:
+                old_enc = "list" if isinstance(old_embed, list) else "b64"
+                if old_enc != args.embed_encoding:
+                    print(
+                        f"resume warning: existing rows use {old_enc} embeddings "
+                        f"but --embed-encoding is {args.embed_encoding}",
+                        file=sys.stderr,
+                    )
+        skipped = len(done)
+        paths = [p for p in paths if os.path.abspath(p) not in done]
+        print(f"resume: {skipped} image(s) already indexed, {len(paths)} to go")
+        if not paths:
+            print(f"nothing to do -> {args.output}")
+            _close_services()
+            return 0
     print(f"indexing {len(paths)} images over {mesh.devices.size} device(s)...")
 
     def encode_vec(vec):
@@ -187,7 +241,7 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.perf_counter()
     n_errors = 0
     offset = 0
-    with open(args.output, "w", encoding="utf-8") as out:
+    with open(args.output, "a" if resuming else "w", encoding="utf-8") as out:
         for rec in records():
             row = {"path": paths[offset]}
             offset += 1

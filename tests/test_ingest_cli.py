@@ -14,6 +14,13 @@ import pytest
 from tests.clip_fixtures import make_clip_model_dir, png_bytes
 from tests.test_vlm import make_vlm_model_dir
 
+_SCRIPTS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if _SCRIPTS_DIR not in sys.path:
+    sys.path.insert(0, _SCRIPTS_DIR)
+import ingest as ingest_cli  # noqa: E402
+
 pytestmark = pytest.mark.integration
 
 
@@ -62,13 +69,6 @@ services:
 
 class TestIngestCli:
     def test_chunked_caption_run_preserves_order_and_stats(self, cache, capsys):
-        scripts_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
-        )
-        if scripts_dir not in sys.path:
-            sys.path.insert(0, scripts_dir)
-        import ingest as ingest_cli
-
         out = cache / "idx.jsonl"
         rc = ingest_cli.main([
             "--config", str(cache / "cfg.yaml"),
@@ -89,3 +89,37 @@ class TestIngestCli:
         stats_line = [l for l in capsys.readouterr().out.splitlines() if "stage stats" in l][-1]
         stats = json.loads(stats_line.split("stage stats: ")[1])
         assert stats["items"] == 80
+
+    def test_resume_skips_recorded_rows_and_drops_torn_tail(self, cache, capsys):
+        """An interrupted index (complete rows + one torn line) resumes:
+        finished rows are kept verbatim, the torn tail is truncated, and
+        only the remaining images are processed and appended."""
+        photos = cache / "photos"
+        all_paths = sorted(str(photos / n) for n in os.listdir(photos))
+        out = cache / "resume.jsonl"
+        # Simulate the interruption: first 70 rows complete, then a torn line.
+        with open(out, "w") as f:
+            for p in all_paths[:70]:
+                f.write(json.dumps({"path": p, "clip_embedding": "kept"}) + "\n")
+            f.write('{"path": "' + all_paths[70] + '", "clip_emb')  # no newline
+        args = [
+            "--config", str(cache / "cfg.yaml"),
+            "--input", str(photos),
+            "--output", str(out),
+            "--families", "clip",
+            "--batch-size", "8",
+            "--platform", "cpu",
+            "--resume",
+        ]
+        assert ingest_cli.main(args) == 0
+        rows = [json.loads(l) for l in open(out)]
+        assert len(rows) == 80
+        assert [r["path"] for r in rows] == all_paths[:70] + all_paths[70:]
+        # Pre-existing rows were kept verbatim, not regenerated.
+        assert all(r["clip_embedding"] == "kept" for r in rows[:70])
+        assert all(r["clip_embedding"] != "kept" for r in rows[70:])
+        assert "resume: 70 image(s) already indexed, 10 to go" in capsys.readouterr().out
+        # A second resume over a complete index is a no-op exiting 0.
+        assert ingest_cli.main(args) == 0
+        assert "nothing to do" in capsys.readouterr().out
+        assert len(open(out).read().splitlines()) == 80
