@@ -1211,10 +1211,36 @@ def phase_probe() -> dict:
     _state("probe:claim")  # first device op below blocks until a chip frees
     x = float(np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))[0, 0])
     assert x == 8.0
-    return {
-        "platform": jax.devices()[0].platform,
-        "device_kind": jax.devices()[0].device_kind,
+    dev = jax.devices()[0]
+    out = {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "jax_version": jax.__version__,
     }
+    try:  # chip identification for the artifact; absent on some backends
+        stats = dev.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            out["hbm_gib"] = round(limit / 2**30, 1)
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
+    return out
+
+
+def current_round() -> int:
+    """The build round in progress, derived from the driver's own per-round
+    artifacts (``BENCH_r{N}.json`` is written at the END of round N, so the
+    highest one present + 1 is the live round). Round-stamps the artifacts
+    this harness writes so no round overwrites another's evidence."""
+    import glob
+    import re
+
+    rounds = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+        if (m := re.search(r"BENCH_r(\d+)\.json$", p))
+    ]
+    return max(rounds) + 1 if rounds else 1
 
 
 def phase_tpu_tests() -> dict:
@@ -1300,7 +1326,10 @@ def phase_tpu_tests() -> dict:
         # A collection problem must not clobber a previously recorded REAL
         # on-chip run (the artifact may be the round's only evidence).
         return result
-    out_path = os.path.join(REPO, os.environ.get("TPUTESTS_OUT", "TPUTESTS_r03.json"))
+    out_path = os.path.join(
+        REPO,
+        os.environ.get("TPUTESTS_OUT", f"TPUTESTS_r{current_round():02d}.json"),
+    )
     try:
         with open(out_path, "w") as f:
             json.dump(result, f, indent=2)

@@ -39,9 +39,10 @@ NAMES = [
     "probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
     "face", "ocr", "ingest", "tpu_tests",
 ]
-LOG = os.path.join(REPO, "TPU_SESSION_r03.jsonl")
-OUT = os.path.join(REPO, "TPU_SESSION_r03.json")
-TESTS_OUT = os.path.join(REPO, "TPUTESTS_r03.json")
+_ROUND = bench.current_round()
+LOG = os.path.join(REPO, f"TPU_SESSION_r{_ROUND:02d}.jsonl")
+OUT = os.path.join(REPO, f"TPU_SESSION_r{_ROUND:02d}.json")
+TESTS_OUT = os.path.join(REPO, f"TPUTESTS_r{_ROUND:02d}.json")
 
 # Alternate one long hold (maybe the tunnel queues claimants) with short
 # kill-and-relaunch windows (maybe a single claim can wedge).
