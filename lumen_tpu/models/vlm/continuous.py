@@ -30,12 +30,14 @@ import logging
 import os
 import queue as queue_mod
 import threading
+import weakref
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 import jax
 import numpy as np
 
+from ...utils.metrics import metrics
 from .manager import _PendingGen
 
 logger = logging.getLogger(__name__)
@@ -109,6 +111,22 @@ class ContinuousScheduler:
         self.admitted = 0
         self._thread = threading.Thread(target=self._loop, name="vlm-continuous", daemon=True)
         self._thread.start()
+        ref = weakref.ref(self)  # registry must not pin the pool/params
+
+        def _gauges() -> dict:
+            s = ref()
+            if s is None:
+                return {}
+            return {
+                "blocks_run": s.blocks_run,
+                "admitted": s.admitted,
+                "slots_total": s.n_slots,
+                "slots_live": len(s._slots),
+                "queue_depth": len(s._pending),
+            }
+
+        self._gauge_fn = _gauges
+        metrics.register_gauges("vlm-continuous", _gauges)
 
     # -- public API --------------------------------------------------------
 
@@ -154,6 +172,7 @@ class ContinuousScheduler:
         err = RuntimeError("continuous scheduler closed")
         for req in pending + [s.request for s in live]:
             _fail(req, err)
+        metrics.unregister_gauges("vlm-continuous", getattr(self, "_gauge_fn", None))
 
     # -- scheduler loop ----------------------------------------------------
 

@@ -16,6 +16,7 @@ import logging
 import os
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Sequence
 
@@ -27,6 +28,7 @@ from ...core.model_info import ModelInfo, load_model_info
 from ...ops.image import decode_image_bytes
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_state_dict
+from ...utils.metrics import metrics
 from .chat import ChatMessage, VlmTokenizer
 from .convert import convert_vlm_checkpoint
 from .generate import Generator
@@ -98,6 +100,20 @@ class _GenBatcher:
         self._closed = False
         self._thread = threading.Thread(target=self._loop, name="vlm-gen-batcher", daemon=True)
         self._thread.start()
+        ref = weakref.ref(self)  # registry must not pin the runner/params
+
+        def _gauges() -> dict:
+            b = ref()
+            if b is None:
+                return {}
+            return {
+                "batches_run": b.batches_run,
+                "rows_run": b.rows_run,
+                "queue_depth": len(b._queue),
+            }
+
+        self._gauge_fn = _gauges
+        metrics.register_gauges("vlm-coalesce", _gauges)
 
     def submit(self, item: _PendingGen):
         item.future = self._Future()
@@ -117,6 +133,7 @@ class _GenBatcher:
             pending, self._queue = self._queue, []
         for item in pending:
             item.future.set_exception(RuntimeError("generation batcher closed"))
+        metrics.unregister_gauges("vlm-coalesce", getattr(self, "_gauge_fn", None))
 
     def _take_batch(self) -> list[_PendingGen]:
         with self._cond:

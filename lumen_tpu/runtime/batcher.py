@@ -23,11 +23,14 @@ import os
 import queue
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Any, Callable
 
 import jax
 import numpy as np
+
+from ..utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -131,6 +134,20 @@ class MicroBatcher:
     def start(self) -> "MicroBatcher":
         self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
         self._thread.start()
+        # Live state on /metrics: queue depth + batch/padding telemetry
+        # (latency histograms can't show a backed-up or waste-heavy queue).
+        # The provider closes over a weakref so the global registry never
+        # pins a dropped batcher (and its captured params) in memory.
+        ref = weakref.ref(self)
+
+        def _gauges() -> dict:
+            b = ref()
+            if b is None:
+                return {}
+            return {**b.stats, "queue_depth": b._queue.qsize()}
+
+        self._gauge_fn = _gauges
+        metrics.register_gauges(f"batcher:{self.name}", _gauges)
         return self
 
     def close(self) -> None:
@@ -143,6 +160,10 @@ class MicroBatcher:
             self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=10)
+        # Ownership-guarded: a newer same-name batcher keeps its gauges.
+        metrics.unregister_gauges(
+            f"batcher:{self.name}", getattr(self, "_gauge_fn", None)
+        )
 
     # -- client side ------------------------------------------------------
 

@@ -14,7 +14,7 @@ import math
 import threading
 import time
 from bisect import bisect_left
-from typing import Iterator
+from typing import Callable, Iterator
 
 
 def _default_bounds() -> list[float]:
@@ -80,7 +80,29 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._hist: dict[str, LatencyHistogram] = {}
         self._errors: dict[str, int] = {}
+        self._gauges: dict[str, Callable[[], dict]] = {}
         self.started_at = time.time()
+
+    def register_gauges(self, provider: str, fn: Callable[[], dict]) -> None:
+        """Attach a named callable returning ``{gauge_name: number}``,
+        sampled at snapshot time. Batchers and decode schedulers use this
+        to expose live state (queue depth, pool occupancy, padding waste)
+        that per-request latency histograms can't show.
+
+        Providers should close over a ``weakref`` to their component (see
+        the batcher) — the process-global registry must not be what keeps
+        a dropped component's weights alive. Re-registering a name
+        replaces the previous provider (last writer wins)."""
+        with self._lock:
+            self._gauges[provider] = fn
+
+    def unregister_gauges(self, provider: str, fn: Callable | None = None) -> None:
+        """Remove a provider. Pass the registered ``fn`` to make removal
+        ownership-guarded: if a newer same-name registration replaced
+        yours, your close() must not delete the live component's gauges."""
+        with self._lock:
+            if fn is None or self._gauges.get(provider) is fn:
+                self._gauges.pop(provider, None)
 
     def observe(self, task: str, ms: float) -> None:
         hist = self._hist.get(task)
@@ -97,6 +119,7 @@ class MetricsRegistry:
         with self._lock:
             hists = dict(self._hist)
             errors = dict(self._errors)
+            providers = dict(self._gauges)
         tasks = {
             name: {**h.snapshot(), "errors": errors.get(name, 0)}
             for name, h in hists.items()
@@ -107,10 +130,27 @@ class MetricsRegistry:
         for name, n in errors.items():
             if name not in tasks:
                 tasks[name] = {**empty, "errors": n}
-        return {
+        gauges: dict[str, dict] = {}
+        for name, fn in sorted(providers.items()):
+            try:
+                vals = fn() or {}
+            except Exception:  # noqa: BLE001 - metrics must never take down serving
+                continue
+            vals = {
+                k: v for k, v in vals.items()
+                # bools pass isinstance(int) but render as True/False,
+                # which breaks the whole Prometheus scrape parse
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+            if vals:
+                gauges[name] = vals
+        out = {
             "uptime_s": round(time.time() - self.started_at, 1),
             "tasks": dict(sorted(tasks.items())),
         }
+        if gauges:
+            out["gauges"] = gauges
+        return out
 
     _probe_warned = False
 
@@ -178,6 +218,14 @@ class MetricsRegistry:
                 yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
             yield f'lumen_task_latency_ms_sum{{task="{name}"}} {s["sum_ms"]}'
             yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
+        if snap.get("gauges"):
+            yield "# TYPE lumen_component_gauge gauge"
+            for provider, vals in snap["gauges"].items():
+                for key, val in vals.items():
+                    yield (
+                        f'lumen_component_gauge{{provider="{provider}",'
+                        f'name="{key}"}} {val}'
+                    )
         mem = self.device_memory()
         if any(mem.values()):
             yield "# TYPE lumen_device_memory_bytes gauge"

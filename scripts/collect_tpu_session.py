@@ -14,9 +14,10 @@ axon tunnel queues claimants (hold wins) or can wedge a single claim
 forever (retry wins) is unobservable from here, so this collector hedges:
 it alternates one long hold with a few short retry windows.
 
-Appends one record per attempt segment to ``TPU_SESSION_r03.jsonl`` and,
-on success, writes ``TPU_SESSION_r03.json`` + ``TPUTESTS_r03.json`` and
-commits them.
+Appends one record per attempt segment to ``TPU_SESSION_r{N}.jsonl``
+(round derived from the driver's own artifacts, ``bench.current_round``)
+and, on success, writes ``TPU_SESSION_r{N}.json`` + ``TPUTESTS_r{N}.json``
+and commits them.
 
 Usage: ``python scripts/collect_tpu_session.py`` (background).
 Env: ``COLLECT_BUDGET`` seconds (default 36000).
@@ -43,6 +44,11 @@ _ROUND = bench.current_round()
 LOG = os.path.join(REPO, f"TPU_SESSION_r{_ROUND:02d}.jsonl")
 OUT = os.path.join(REPO, f"TPU_SESSION_r{_ROUND:02d}.json")
 TESTS_OUT = os.path.join(REPO, f"TPUTESTS_r{_ROUND:02d}.json")
+# Pin the in-claim tpu_tests phase (a child process that would otherwise
+# recompute the round at write time) to THIS collector's round: if the
+# driver finishes the round mid-session, the phase and the gating/commit
+# below must still agree on one artifact name.
+os.environ.setdefault("TPUTESTS_OUT", os.path.basename(TESTS_OUT))
 
 # Alternate one long hold (maybe the tunnel queues claimants) with short
 # kill-and-relaunch windows (maybe a single claim can wedge).
@@ -91,7 +97,7 @@ def _reload_results() -> dict[str, dict]:
 
 
 def _tests_artifact_real() -> bool:
-    """Does ``TPUTESTS_r03.json`` already record an actual on-chip test
+    """Does the round's ``TPUTESTS_r{N}.json`` already record an actual on-chip test
     run (pass OR fail — a recorded failure on real hardware is evidence
     too)? Handles both writers: the in-claim bench phase ({"outcome":
     "passed"|"failed", ...}) and the standalone runner ({"ok": bool,

@@ -73,6 +73,47 @@ class TestRegistry:
         assert 'lumen_task_requests_total{task="face_detect"} 1' in text
         assert 'quantile="0.99"' in text
 
+    def test_gauge_providers(self):
+        reg = MetricsRegistry()
+        reg.register_gauges("pool", lambda: {"slots_live": 3, "label": "ignored"})
+        reg.register_gauges("broken", lambda: 1 / 0)
+        snap = reg.snapshot()
+        # non-numeric values filtered; a raising provider never breaks serving
+        assert snap["gauges"] == {"pool": {"slots_live": 3}}
+        text = "\n".join(reg.prometheus_lines())
+        assert 'lumen_component_gauge{provider="pool",name="slots_live"} 3' in text
+        reg.unregister_gauges("pool")
+        reg.unregister_gauges("missing")  # no-op
+        assert "gauges" not in reg.snapshot()
+
+    def test_gauge_bools_filtered_and_ownership_guard(self):
+        reg = MetricsRegistry()
+        reg.register_gauges("p", lambda: {"healthy": True, "n": 2})
+        assert reg.snapshot()["gauges"]["p"] == {"n": 2}  # bools break Prometheus
+        old = lambda: {"n": 1}  # noqa: E731
+        new = lambda: {"n": 9}  # noqa: E731
+        reg.register_gauges("q", old)
+        reg.register_gauges("q", new)  # replacement (new component, same name)
+        reg.unregister_gauges("q", old)  # stale owner must NOT delete live gauges
+        assert reg.snapshot()["gauges"]["q"] == {"n": 9}
+        reg.unregister_gauges("q", new)
+        assert "q" not in reg.snapshot().get("gauges", {})
+
+    def test_microbatcher_registers_gauges(self):
+        from lumen_tpu.runtime.batcher import MicroBatcher
+        from lumen_tpu.utils.metrics import metrics as global_metrics
+
+        b = MicroBatcher(lambda tree, n: tree, max_batch=4, name="gauge-test").start()
+        try:
+            b([1.0])
+            gauges = global_metrics.snapshot()["gauges"]["batcher:gauge-test"]
+            assert gauges["items"] == 1
+            assert gauges["batches"] == 1
+            assert "queue_depth" in gauges
+        finally:
+            b.close()
+        assert "batcher:gauge-test" not in global_metrics.snapshot().get("gauges", {})
+
 
 class TestDispatchHook:
     def test_infer_records_latency_and_errors(self):
