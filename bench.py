@@ -1908,12 +1908,19 @@ if __name__ == "__main__":
                 import traceback
 
                 traceback.print_exc(file=sys.stderr)
-                print(
-                    json.dumps(
-                        {"phase": _name, "error": f"{type(e).__name__}: {e}"[:400]}
-                    ),
-                    flush=True,
-                )
+                # A FAILED probe is "no claim", not a phase result: the
+                # child exits rc=1 and the parent keys on the return code.
+                # Printing a probe marker here would make a parent watching
+                # stdout mistake a tunnel UNAVAILABLE for a landed claim
+                # (observed live: it clobbered a collector's recorded
+                # on-chip probe with the error dict).
+                if _name != "probe":
+                    print(
+                        json.dumps(
+                            {"phase": _name, "error": f"{type(e).__name__}: {e}"[:400]}
+                        ),
+                        flush=True,
+                    )
                 return False
             _res["phase"] = _name
             print(json.dumps(_res), flush=True)
