@@ -236,9 +236,21 @@ class VLMManager:
         if self.quantize:
             import dataclasses
 
+            # Kernel formulation for the int8 projections; "dynamic"
+            # (W8A8, native MXU int8 dot) is the fallback for stacks where
+            # the dequant convert doesn't fuse (see DecoderConfig).
+            q8_kernel = os.environ.get("LUMEN_Q8_KERNEL", "dequant")
+            if q8_kernel not in ("dequant", "dynamic"):
+                raise ValueError(
+                    f"LUMEN_Q8_KERNEL must be 'dequant' or 'dynamic', got {q8_kernel!r}"
+                )
             self.cfg = dataclasses.replace(
                 self.cfg,
-                decoder=dataclasses.replace(self.cfg.decoder, weight_quant=self.quantize),
+                decoder=dataclasses.replace(
+                    self.cfg.decoder,
+                    weight_quant=self.quantize,
+                    weight_quant_kernel=q8_kernel,
+                ),
             )
         self.model = VLMModel(self.cfg)
         self.model_id = self.info.name

@@ -82,9 +82,12 @@ class TestQuantTransform:
 
 
 class TestQuantServing:
-    def test_quantized_manager_close_to_fp(self, model_dir):
+    @pytest.mark.parametrize("kernel", ["dequant", "dynamic"])
+    def test_quantized_manager_close_to_fp(self, model_dir, kernel, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_KERNEL", kernel)
         fp = _mgr(model_dir, None)
         q8 = _mgr(model_dir, "int8")
+        assert q8.cfg.decoder.weight_quant_kernel == kernel
         try:
             # int8 params loaded where expected
             attn = q8.params["decoder"]["layers_0"]["attn"]["q_proj"]
@@ -108,6 +111,56 @@ class TestQuantServing:
     def test_invalid_quantize_rejected(self, model_dir):
         with pytest.raises(ValueError, match="quantize"):
             VLMManager(model_dir, quantize="int4")
+
+    def test_invalid_q8_kernel_rejected(self, model_dir, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_KERNEL", "magic")
+        with pytest.raises(ValueError, match="LUMEN_Q8_KERNEL"):
+            VLMManager(model_dir, quantize="int8")
+
+    def test_dynamic_kernel_matches_dequant_logits(self):
+        """Same q+scale params through both formulations: activation
+        rounding is the only difference, so logits stay close."""
+        import dataclasses
+
+        import jax
+
+        from lumen_tpu.models.vlm.modeling import DecoderConfig, QDense
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 32), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+        scale = np.maximum(np.abs(np.asarray(w)).max(axis=0) / 127.0, 1e-8)
+        q = np.clip(np.round(np.asarray(w) / scale), -127, 127).astype(np.int8)
+        params = {
+            "params": {
+                "q": jnp.asarray(q),
+                "scale": jnp.asarray(scale, jnp.float32),
+                "bias": jnp.zeros((16,), jnp.float32),
+            }
+        }
+        y_deq = QDense(16, kernel_mode="dequant").apply(params, x)
+        y_dyn = QDense(16, kernel_mode="dynamic").apply(params, x)
+        ref = x @ w
+        # both track the fp product; dynamic adds only activation rounding
+        for y in (y_deq, y_dyn):
+            cos = float(
+                (np.asarray(y) * np.asarray(ref)).sum()
+                / (np.linalg.norm(np.asarray(y)) * np.linalg.norm(np.asarray(ref)))
+            )
+            assert cos > 0.999, cos
+        np.testing.assert_allclose(
+            np.asarray(y_dyn), np.asarray(y_deq), rtol=0.05, atol=0.05
+        )
+        # the factory actually threads the mode into the module it builds
+        from lumen_tpu.models.vlm.modeling import _dense
+
+        cfg = dataclasses.replace(
+            DecoderConfig(), weight_quant="int8", weight_quant_kernel="dynamic"
+        )
+        mod = _dense(cfg, 16, name="p", use_bias=True, dtype=jnp.float32)
+        assert isinstance(mod, QDense) and mod.kernel_mode == "dynamic"
+        # unknown modes raise instead of silently running dequant
+        with pytest.raises(ValueError, match="kernel_mode"):
+            QDense(16, kernel_mode="dyanmic").apply(params, x)
 
 
 class TestUntiedLmHead:
