@@ -25,13 +25,26 @@ class ChipSpec:
     kind_patterns: tuple[str, ...]  # matched against jax device_kind, lowercased
     hbm_gb: float
     bf16_tflops: float
-    base_batch: int  # comfortable per-chip CLIP-class batch
+    base_batch: int  # comfortable per-chip batch the service knobs derive from
+    #: measured per-chip CLIP batch override (None -> base_batch); only set
+    #: for generations with an on-chip measurement behind the number
+    clip_batch: int | None = None
 
 
 # Ordered so more-specific patterns ("lite") are tested before bare "v5".
 CHIP_SPECS: tuple[ChipSpec, ...] = (
     ChipSpec("v6e", ("v6 lite", "v6e"), 32.0, 918.0, base_batch=64),
-    ChipSpec("v5e", ("v5 lite", "v5litepod", "v5e"), 16.0, 197.0, base_batch=32),
+    # v5e clip_batch=128 on measurement: one v5e chip ran the ViT-B/32
+    # embed at batch 256 at 5322 images/sec (round-3 on-chip bench,
+    # BASELINE.md); 128 keeps HBM headroom for co-resident services while
+    # feeding the MXU far better than 32. base_batch (which face/OCR
+    # batches derive from) stays conservative — those paths haven't been
+    # measured on chip yet, and other generations keep the old sizing
+    # until measured.
+    ChipSpec(
+        "v5e", ("v5 lite", "v5litepod", "v5e"), 16.0, 197.0,
+        base_batch=32, clip_batch=128,
+    ),
     ChipSpec("v5p", ("v5p", "v5"), 95.0, 459.0, base_batch=96),
     ChipSpec("v4", ("v4",), 32.0, 275.0, base_batch=64),
     ChipSpec("v3", ("v3",), 32.0, 123.0, base_batch=32),
@@ -101,7 +114,7 @@ def _tpu_preset(
         generation=generation,
         chips=chips,
         mesh_axes=dict(mesh_axes or {"data": -1}),
-        batch_size=spec.base_batch * dp,
+        batch_size=(spec.clip_batch or spec.base_batch) * dp,
         face_batch=max(8, spec.base_batch // 2) * dp,
         ocr_batch=max(4, spec.base_batch // 4),
         vlm_gen_batch=8 if spec.hbm_gb >= 32 else 4,
