@@ -34,13 +34,14 @@ class ChipSpec:
 # Ordered so more-specific patterns ("lite") are tested before bare "v5".
 CHIP_SPECS: tuple[ChipSpec, ...] = (
     ChipSpec("v6e", ("v6 lite", "v6e"), 32.0, 918.0, base_batch=64),
-    # v5e clip_batch=128 on measurement: one v5e chip ran the ViT-B/32
-    # embed at batch 256 at 5322 images/sec (round-3 on-chip bench,
-    # BASELINE.md); 128 keeps HBM headroom for co-resident services while
-    # feeding the MXU far better than 32. base_batch (which face/OCR
-    # batches derive from) stays conservative — those paths haven't been
-    # measured on chip yet, and other generations keep the old sizing
-    # until measured.
+    # v5e clip_batch=128: a round-3 on-chip run put the ViT-B/32 embed at
+    # batch 256 / 5322 images/sec (BASELINE.md; provisional provenance,
+    # but the implied 23.5% MFU is exactly where this shape lands on a
+    # 197-TFLOP chip), and first principles agree — batch-128 ViT-B/32
+    # activations are tens of MB against 16 GB HBM, so 32 was simply
+    # starving the MXU. base_batch (which face/OCR batches derive from)
+    # stays conservative — those paths haven't been measured on chip yet,
+    # and other generations keep the old sizing until measured.
     ChipSpec(
         "v5e", ("v5 lite", "v5litepod", "v5e"), 16.0, 197.0,
         base_batch=32, clip_batch=128,
