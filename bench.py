@@ -528,6 +528,9 @@ def phase_ingest(n_images: int = 256) -> dict:
     assert len(records) == n_images
     return {
         "images_per_sec": round(n_images / dt, 1),
+        # Lane telemetry: is the end-to-end number decode(host)-bound or
+        # device-bound? Decides where round-4 effort goes.
+        "stage_stats": pipe.stats.as_dict(),
         "platform": jax.devices()[0].platform,
     }
 
