@@ -1279,6 +1279,17 @@ def current_round() -> int:
     return max(rounds) + 1 if rounds else 1
 
 
+def _tests_outcome(rc: int, n_passed: int, n_failed: int) -> str:
+    """Map a pytest exit + tallies to the artifact outcome. Key names must
+    not collide with the harness's diagnostic markers (a literal
+    "skipped"/"error" key would make ``_is_ok`` classify a successful run
+    as not-a-result), and rc 5 / nothing-ran is a SELECTION problem
+    ("no-tests"), not a test failure."""
+    if rc == 5 or (n_passed == 0 and n_failed == 0):
+        return "no-tests"
+    return "passed" if rc == 0 else "failed"
+
+
 def phase_tpu_tests() -> dict:
     """Run the device-path smoke tests (``-m tpu``: ragged decode, int8
     dot, grouped GEMM, both flash kernels; ``tests/test_ops.py``)
@@ -1338,16 +1349,7 @@ def phase_tpu_tests() -> dict:
              "-p", "no:cacheprovider"],
             plugins=[tally],
         )
-    # Key names must not collide with the harness's diagnostic markers:
-    # a literal "skipped"/"error" key would make _is_ok() classify a
-    # successful run as not-a-result. rc 5 = nothing collected — that is
-    # a selection problem, not a test failure.
-    if int(rc) == 5 or (tally.passed == 0 and tally.failed == 0):
-        outcome = "no-tests"
-    elif int(rc) == 0:
-        outcome = "passed"
-    else:
-        outcome = "failed"
+    outcome = _tests_outcome(int(rc), tally.passed, tally.failed)
     result.update(
         exit_code=int(rc),
         n_passed=tally.passed,

@@ -123,6 +123,19 @@ class TestGroupRunnerProtocol:
         assert "error" not in lines["stub_ok"][0]
 
 
+class TestTpuTestsOutcome:
+    def test_outcome_mapping(self):
+        # real runs
+        assert bench._tests_outcome(0, 5, 0) == "passed"
+        assert bench._tests_outcome(1, 3, 2) == "failed"
+        # fixture/teardown errors: rc 1 with call-failures possibly 0 but
+        # tally counts setup errors as failed, so they still read failed
+        assert bench._tests_outcome(1, 0, 1) == "failed"
+        # selection problems are not failures
+        assert bench._tests_outcome(5, 0, 0) == "no-tests"
+        assert bench._tests_outcome(0, 0, 0) == "no-tests"  # all-skipped
+
+
 class TestSessionArtifactBackfill:
     @pytest.fixture()
     def repo(self, tmp_path, monkeypatch):
