@@ -92,9 +92,17 @@ class ContinuousScheduler:
     sentinel on retirement, exposed via :meth:`submit_stream`).
     """
 
-    def __init__(self, generator, params, slots: int = 8, block: int = 8):
+    def __init__(
+        self, generator, params, slots: int = 8, block: int = 8,
+        name: str = "vlm",
+    ):
         self.gen = generator
         self.params = params
+        # Gauge provider id: per-model-name, matching the batcher's
+        # ``batcher:{name}`` semantics — distinct models coexist; a
+        # same-name replacement takes over the slot (last-writer-wins
+        # register, ownership-guarded unregister).
+        self.name = name
         self.n_slots = slots
         self.block = block
         self.pool = generator.init_pool(slots)
@@ -126,7 +134,7 @@ class ContinuousScheduler:
             }
 
         self._gauge_fn = _gauges
-        metrics.register_gauges("vlm-continuous", _gauges)
+        metrics.register_gauges(f"vlm-continuous:{self.name}", _gauges)
 
     # -- public API --------------------------------------------------------
 
@@ -172,7 +180,8 @@ class ContinuousScheduler:
         err = RuntimeError("continuous scheduler closed")
         for req in pending + [s.request for s in live]:
             _fail(req, err)
-        metrics.unregister_gauges("vlm-continuous", getattr(self, "_gauge_fn", None))
+        if fn := getattr(self, "_gauge_fn", None):
+            metrics.unregister_gauges(f"vlm-continuous:{self.name}", fn)
 
     # -- scheduler loop ----------------------------------------------------
 

@@ -86,11 +86,19 @@ class _GenBatcher:
     mixed request configs, so aggregate tokens/sec scales with batch.
     """
 
-    def __init__(self, runner, max_batch: int = 4, max_latency_ms: float = 6.0):
+    def __init__(
+        self, runner, max_batch: int = 4, max_latency_ms: float = 6.0,
+        name: str = "vlm",
+    ):
         from concurrent.futures import Future
 
         self._Future = Future
         self._runner = runner
+        # Gauge provider id: per-model-name, matching the batcher's
+        # ``batcher:{name}`` semantics — distinct models coexist; a
+        # same-name replacement takes over the slot (last-writer-wins
+        # register, ownership-guarded unregister).
+        self.name = name
         self.max_batch = max_batch
         self.max_latency_s = max_latency_ms / 1e3
         self.batches_run = 0  # observability: how often we actually batched
@@ -113,7 +121,7 @@ class _GenBatcher:
             }
 
         self._gauge_fn = _gauges
-        metrics.register_gauges("vlm-coalesce", _gauges)
+        metrics.register_gauges(f"vlm-coalesce:{self.name}", _gauges)
 
     def submit(self, item: _PendingGen):
         item.future = self._Future()
@@ -133,7 +141,8 @@ class _GenBatcher:
             pending, self._queue = self._queue, []
         for item in pending:
             item.future.set_exception(RuntimeError("generation batcher closed"))
-        metrics.unregister_gauges("vlm-coalesce", getattr(self, "_gauge_fn", None))
+        if fn := getattr(self, "_gauge_fn", None):
+            metrics.unregister_gauges(f"vlm-coalesce:{self.name}", fn)
 
     def _take_batch(self) -> list[_PendingGen]:
         with self._cond:
@@ -502,13 +511,15 @@ class VLMManager:
             from .continuous import ContinuousScheduler
 
             self._continuous = ContinuousScheduler(
-                self.generator, self.params, slots=self.gen_slots, block=self.gen_block
+                self.generator, self.params, slots=self.gen_slots,
+                block=self.gen_block, name=self.info.name,
             )
         else:
             self._batcher = _GenBatcher(
                 self._run_gen_batch,
                 max_batch=self.gen_batch_size,
                 max_latency_ms=self.gen_batch_latency_ms,
+                name=self.info.name,
             )
         self._initialized = True
         if self.warmup:

@@ -161,9 +161,10 @@ class MicroBatcher:
         if self._thread:
             self._thread.join(timeout=10)
         # Ownership-guarded: a newer same-name batcher keeps its gauges.
-        metrics.unregister_gauges(
-            f"batcher:{self.name}", getattr(self, "_gauge_fn", None)
-        )
+        # A never-started instance has no _gauge_fn — it must not pass
+        # None (= unconditional) and evict a live same-name batcher's.
+        if fn := getattr(self, "_gauge_fn", None):
+            metrics.unregister_gauges(f"batcher:{self.name}", fn)
 
     # -- client side ------------------------------------------------------
 

@@ -163,7 +163,12 @@ class TestSessionArtifactBackfill:
         )
         assert bench._load_session_artifact()["clip"]["images_per_sec"] == 2
 
-    def test_latest_round_only(self, repo):
+    def test_per_phase_newest_round_wins(self, repo):
+        """A phase measured in the newest round wins; a phase the newest
+        round hasn't (re-)measured keeps the older round's on-chip number,
+        stamped with its source file so the round it came from stays
+        visible (the current round's collector log exists from session
+        start but may hold only some phases under a saturated pool)."""
         (repo / "TPU_SESSION_r02.json").write_text(
             json.dumps({"results": {"clip": {"images_per_sec": 1, "platform": "tpu"},
                                     "vlm": {"tokens_per_sec": 9, "platform": "tpu"}}})
@@ -173,7 +178,9 @@ class TestSessionArtifactBackfill:
         )
         out = bench._load_session_artifact()
         assert out["clip"]["images_per_sec"] == 2
-        assert "vlm" not in out  # stale round must not masquerade as current
+        assert out["clip"]["source"] == "TPU_SESSION_r03.json"
+        assert out["vlm"]["tokens_per_sec"] == 9
+        assert out["vlm"]["source"] == "TPU_SESSION_r02.json"
 
     def test_empty_or_missing_files(self, repo):
         assert bench._load_session_artifact() == {}
