@@ -322,10 +322,12 @@ class VLMManager:
         """Place loaded weights on the serving mesh: TP rules when the mesh
         carries a ``model`` axis, EP rules first when it carries ``expert``
         (first-match-wins keeps expert banks on the expert axis), replicated
-        otherwise. int8-quantized trees ship (qweight, scale) leaves that
-        the kernel-path rules don't name, so they replicate with a log —
-        TP+int8 is an explicit non-goal (int8 already wins on bandwidth)."""
+        otherwise. int8-quantized trees ship (q, scale) leaves with their
+        own rules (``INT8_TP_RULES``: scales shard along the same output
+        axis as their q matrices) — TP x int8 is the advertised deployment
+        shape for a quantized 2B on a multi-chip host."""
         from ...parallel.sharding import (
+            INT8_TP_RULES,
             MOE_EP_RULES,
             TRANSFORMER_TP_RULES,
             shard_params,
@@ -337,18 +339,8 @@ class VLMManager:
             rules += MOE_EP_RULES
         if shape.get("model", 1) > 1:
             if self.quantize:
-                # Skip the TP rules entirely: the kernel-path rules can't
-                # match (qweight/scale leaves), and letting the embedding/
-                # bias rules half-apply would shard the tied lm_head while
-                # every projection replicates — all-reduce cost, no
-                # compute-sharding benefit.
-                logger.warning(
-                    "mesh has model=%d but decoder is int8-quantized; "
-                    "TP+int8 is unsupported, serving replicated",
-                    shape["model"],
-                )
-            else:
-                rules += TRANSFORMER_TP_RULES
+                rules += INT8_TP_RULES
+            rules += TRANSFORMER_TP_RULES
         if rules:
             logger.info(
                 "sharding VLM params over mesh %s (%d rules)", shape, len(rules)

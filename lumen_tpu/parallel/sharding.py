@@ -32,6 +32,22 @@ TRANSFORMER_TP_RULES: list[ShardingRule] = [
     (r".*embedding$", P(None, "model")),
 ]
 
+# Tensor-parallel rules for int8 weight-only-quantized projections
+# (``models/vlm/modeling.QDense``: ``q [in, out] int8`` + per-output-channel
+# ``scale [out]``). Same Megatron layout as the kernel rules above — the
+# scale vector shards along the SAME output axis as its q matrix, and an
+# input-sharded projection's scale/bias stay replicated (their dim is the
+# unsharded output). int8 dot partials accumulate exactly in int32, so the
+# TP decode is token-identical to replicated int8 (pinned by
+# tests/test_serving_tp.py). lm_head q/scale replicate, matching the bf16
+# rules (no lm_head entry). Prepend to TRANSFORMER_TP_RULES so the shared
+# embedding rule still applies.
+INT8_TP_RULES: list[ShardingRule] = [
+    (r".*(q_proj|k_proj|v_proj|qkv|fc1|gate_proj|up_proj)/q$", P(None, "model")),
+    (r".*(q_proj|k_proj|v_proj|qkv|fc1|gate_proj|up_proj)/(scale|bias)$", P("model")),
+    (r".*(o_proj|out_proj|fc2|down_proj)/q$", P("model", None)),
+]
+
 # Expert parallelism for MoE decoder layers (``models/vlm/modeling.MoEFFN``):
 # stacked expert banks [E, ...] split their leading dim over ``expert``; the
 # router stays replicated (it's tiny and every token needs it). Prepend to

@@ -101,6 +101,48 @@ class TestVlmTensorParallel:
         assert all(s == () for s in specs.values())
 
 
+class TestVlmTensorParallelInt8:
+    """TP x int8 — the advertised deployment shape for a quantized 2B on a
+    multi-chip host (round-3 verdict lifted the exclusion). int8 dot
+    partials accumulate exactly in int32, so the sharded decode must be
+    token-identical to replicated int8 for BOTH kernel formulations."""
+
+    @pytest.mark.parametrize("kernel", ["dequant", "dynamic"])
+    def test_tp_int8_decode_token_identical(self, model_dir, kernel, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_KERNEL", kernel)
+        repl = _mgr(model_dir, quantize="int8")
+        try:
+            want = repl.generate(PROMPT, max_new_tokens=12)
+        finally:
+            repl.close()
+        tp = _mgr(model_dir, quantize="int8", mesh_axes={"data": 4, "model": 2})
+        try:
+            got = tp.generate(PROMPT, max_new_tokens=12)
+        finally:
+            tp.close()
+        assert got.tokens == want.tokens
+        assert got.text == want.text
+
+    def test_tp_int8_params_actually_sharded(self, model_dir):
+        tp = _mgr(model_dir, quantize="int8", mesh_axes={"data": 4, "model": 2})
+        try:
+            specs = _leaf_sharding_specs(tp.params)
+        finally:
+            tp.close()
+        # q matrices follow the Megatron kernel layout; each scale vector
+        # shards along the same output axis as its q (or replicates when
+        # the output dim is the unsharded one).
+        assert specs["decoder/layers_0/attn/q_proj/q"] == (None, "model")
+        assert specs["decoder/layers_0/attn/q_proj/scale"] == ("model",)
+        assert specs["decoder/layers_0/attn/o_proj/q"] == ("model",)
+        assert specs["decoder/layers_0/attn/o_proj/scale"] == ()
+        assert specs["decoder/layers_0/mlp/gate_proj/q"] == (None, "model")
+        assert specs["decoder/layers_0/mlp/down_proj/q"] == ("model",)
+        # Embeddings still shard via the shared TP rules; norms replicate.
+        assert specs["decoder/embed_tokens/embedding"] == (None, "model")
+        assert specs["decoder/final_norm/scale"] == ()
+
+
 # -- MoE / expert parallelism -------------------------------------------------
 
 
