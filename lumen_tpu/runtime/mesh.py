@@ -61,8 +61,15 @@ def build_mesh(
     axes: dict[str, int] | None = None,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a named mesh; default is all devices on one ``data`` axis."""
-    devices = list(devices if devices is not None else jax.devices())
+    """Build a named mesh; default is every device this process can
+    address, on one ``data`` axis. Under multi-process JAX
+    (``jax.distributed``) the default is deliberately the LOCAL devices,
+    not the global 8+: serving managers built per host must be able to
+    fetch their own results (a mesh spanning non-addressable devices
+    can't be read from one process), which is the per-host-frontend
+    layout of SURVEY §7 step 10. Cross-host programs (training,
+    multi-host ingest) pass the global ``jax.devices()`` explicitly."""
+    devices = list(devices if devices is not None else jax.local_devices())
     axes = axes or {DATA_AXIS: -1}
     resolved = resolve_axes(axes, len(devices))
     names = tuple(resolved)

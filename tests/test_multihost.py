@@ -32,7 +32,9 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = r"""
 import json, os, sys
 
-port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+port, pid, out_path, clip_dir, img_path = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]
+)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["LUMEN_COORDINATOR"] = f"127.0.0.1:{port}"
@@ -92,6 +94,38 @@ resps = list(stub.Infer(iter([InferRequest(correlation_id="c", task="echo", payl
 echo_ok = resps[-1].result == payload
 server.stop(0)
 
+# Per-host CLIP frontend: a REAL model service behind the hub router on
+# every host (SURVEY §7 step 10's per-host serving layout). Same weights
+# on both hosts, same image -> the parent asserts the two frontends
+# return the SAME embedding (cross-host serving consistency).
+from lumen_tpu.models.clip.manager import CLIPManager
+from lumen_tpu.serving.services.clip_service import ClipService
+
+mgr = CLIPManager(clip_dir, dtype="float32", batch_size=2)
+mgr.initialize()
+clip_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+ml_service_pb2_grpc.add_InferenceServicer_to_server(
+    HubRouter({"clip": ClipService({"clip": mgr})}), clip_server
+)
+clip_port = clip_server.add_insecure_port("127.0.0.1:0")
+clip_server.start()
+clip_stub = ml_service_pb2_grpc.InferenceStub(
+    grpc.insecure_channel(f"127.0.0.1:{clip_port}")
+)
+img = open(img_path, "rb").read()
+(clip_resp,) = clip_stub.Infer(iter([InferRequest(
+    correlation_id="e", task="clip_image_embed", payload=img,
+    payload_mime="image/png", seq=0, total=1,
+)]))
+if clip_resp.HasField("error"):
+    embedding = None
+    embed_error = f"{clip_resp.error.code}: {clip_resp.error.message} / {clip_resp.error.detail}"
+else:
+    embedding = json.loads(clip_resp.result)
+    embed_error = None
+clip_server.stop(0)
+mgr.close()
+
 # All hosts reach the end before teardown (DCN barrier).
 from jax.experimental import multihost_utils
 multihost_utils.sync_global_devices("smoke-done")
@@ -106,6 +140,8 @@ json.dump(
         "total": total,
         "primary": distributed.is_primary(),
         "echo_ok": bool(echo_ok),
+        "embedding": embedding,
+        "embed_error": embed_error,
     },
     open(out_path, "w"),
 )
@@ -120,6 +156,12 @@ def _free_port() -> int:
 
 @pytest.mark.slow
 def test_two_process_group_serves_and_reduces(tmp_path):
+    from tests.clip_fixtures import make_clip_model_dir, png_bytes
+
+    clip_dir = make_clip_model_dir(tmp_path)
+    img_path = str(tmp_path / "img.png")
+    with open(img_path, "wb") as f:
+        f.write(png_bytes(seed=3))
     port = _free_port()
     outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
     procs = []
@@ -127,7 +169,8 @@ def test_two_process_group_serves_and_reduces(tmp_path):
     for pid in range(2):
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER, str(port), str(pid), outs[pid]],
+                [sys.executable, "-c", _WORKER, str(port), str(pid), outs[pid],
+                 clip_dir, img_path],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
@@ -160,3 +203,11 @@ def test_two_process_group_serves_and_reduces(tmp_path):
     base = sum(range(12)) * 2
     want = float(base + base + 2 * 1000.0 * 12)
     assert results[0]["total"] == results[1]["total"] == want
+    # Both per-host CLIP frontends served the embed, and identically:
+    # same weights + same image must give the same vector on every host.
+    e0, e1 = results[0]["embedding"], results[1]["embedding"]
+    assert e0 is not None and e1 is not None, (
+        results[0]["embed_error"], results[1]["embed_error"]
+    )
+    assert e0["dim"] == 32 and len(e0["vector"]) == 32
+    assert e0["vector"] == e1["vector"]
