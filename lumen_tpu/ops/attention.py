@@ -405,6 +405,21 @@ def _interpret_mode() -> bool:
     return not _on_tpu()
 
 
+def _flash_blocks() -> tuple[int, int]:
+    """Serving-path flash tile sizes (``LUMEN_FLASH_BLOCK_Q``/``_K``,
+    default 128x128): the bench's on-chip block sweep
+    (``bench.py phase_flash_ab``) picks the winner per chip generation and
+    deployments apply it without a code change."""
+    try:
+        bq = int(os.environ.get("LUMEN_FLASH_BLOCK_Q", 128))
+        bk = int(os.environ.get("LUMEN_FLASH_BLOCK_K", 128))
+    except ValueError:
+        return (128, 128)
+    # A tuning-knob typo (0, negative) must degrade, not crash the server:
+    # block sizes below one VPU sublane tile make no sense anyway.
+    return (max(16, bq), max(16, bk))
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -421,7 +436,11 @@ def attention(
     disables the kernel; ``LUMEN_FLASH=1`` forces it (interpret mode off
     TPU, for tests)."""
     if _flash_usable(q.shape[-1], mask, q.shape[2]):
-        return flash_attention(q, k, v, causal=causal, scale=scale, interpret=_interpret_mode())
+        bq, bk = _flash_blocks()
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale,
+            block_q=bq, block_k=bk, interpret=_interpret_mode(),
+        )
     return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
 
 
