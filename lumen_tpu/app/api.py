@@ -147,6 +147,50 @@ def build_app(state: AppState | None = None) -> web.Application:
             return _json_error(404, "no config generated or loaded yet")
         return web.json_response(state.config.model_dump(exclude_none=True))
 
+    def _field_errors(e: Exception) -> list[dict] | None:
+        """Pydantic ValidationError -> per-field error list the web UI can
+        anchor to inputs ({"loc": "services.clip.port", "msg", "type"});
+        None for non-pydantic failures (I/O, YAML parse). The core layer
+        wraps pydantic in ConfigError (``validate_config_dict ... from e``),
+        so follow the cause chain to the ValidationError."""
+        errs = None
+        seen = 0
+        cur: BaseException | None = e
+        while cur is not None and seen < 5:
+            errs = getattr(cur, "errors", None)
+            if callable(errs):
+                break
+            cur = cur.__cause__
+            seen += 1
+        if not callable(errs):
+            return None
+        out = []
+        try:
+            for err in errs():
+                out.append({
+                    "loc": ".".join(str(p) for p in err.get("loc", ())),
+                    "msg": err.get("msg", ""),
+                    "type": err.get("type", ""),
+                })
+        except Exception:  # noqa: BLE001 - error reporting must not raise
+            return None
+        return out or None
+
+    def _parse_yaml_body(text: str) -> dict:
+        """YAML editor text -> config dict; parse failures carry the
+        problem line/column so the UI can point at the spot."""
+        import yaml
+
+        try:
+            data = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            mark = getattr(e, "problem_mark", None)
+            at = f" at line {mark.line + 1}, column {mark.column + 1}" if mark else ""
+            raise ValueError(f"YAML parse error{at}: {getattr(e, 'problem', e)}") from e
+        if not isinstance(data, dict):
+            raise ValueError(f"YAML must be a mapping, got {type(data).__name__}")
+        return data
+
     def _validated(body: dict, require_path: bool = False) -> web.Response:
         from lumen_tpu.core.config import (
             load_config,
@@ -163,6 +207,14 @@ def build_app(state: AppState | None = None) -> web.Application:
                     cfg, warnings = load_config_loose(body["path"])
                 else:
                     cfg = load_config(body["path"])
+            elif "yaml" in body and not require_path:
+                # The web UI's editable-YAML flow: validate the editor
+                # text as typed, before anything touches disk.
+                data = _parse_yaml_body(body["yaml"])
+                if loose:
+                    cfg, warnings = validate_config_loose(data)
+                else:
+                    cfg = validate_config_dict(data)
             elif "config" in body and not require_path:
                 if loose:
                     cfg, warnings = validate_config_loose(body["config"])
@@ -170,10 +222,14 @@ def build_app(state: AppState | None = None) -> web.Application:
                     cfg = validate_config_dict(body["config"])
             else:
                 return _json_error(
-                    400, "provide 'path'" if require_path else "provide 'config' (dict) or 'path'"
+                    400, "provide 'path'" if require_path else "provide 'config' (dict), 'yaml' (text), or 'path'"
                 )
         except Exception as e:  # noqa: BLE001 - validation errors reported to client
-            return web.json_response({"valid": False, "error": str(e)})
+            out = {"valid": False, "error": str(e)}
+            fe = _field_errors(e)
+            if fe:
+                out["field_errors"] = fe
+            return web.json_response(out)
         out = {"valid": True, "services": sorted(cfg.services)}
         if warnings:
             out["warnings"] = warnings
@@ -208,14 +264,49 @@ def build_app(state: AppState | None = None) -> web.Application:
 
     async def config_save(request: web.Request) -> web.Response:
         body = await _body(request)
-        if state.config is None:
+        cfg = state.config
+        warnings: list[str] = []
+        if "yaml" in body:
+            # Editable-YAML flow: the edited text must validate before it
+            # becomes the current config or touches disk. Errors use the
+            # same shape as /config/validate (field_errors included) so
+            # the UI renders them in one place; ``loose`` matches the
+            # validate endpoint so a config the UI just called valid
+            # can't flip verdicts at save time.
+            from lumen_tpu.core.config import (
+                validate_config_dict,
+                validate_config_loose,
+            )
+
+            try:
+                data = _parse_yaml_body(body["yaml"])
+                if body.get("loose"):
+                    cfg, warnings = validate_config_loose(data)
+                else:
+                    cfg = validate_config_dict(data)
+            except Exception as e:  # noqa: BLE001 - reported to client
+                out = {"valid": False, "error": str(e)}
+                fe = _field_errors(e)
+                if fe:
+                    out["field_errors"] = fe
+                return web.json_response(out, status=400)
+        if cfg is None:
             return _json_error(404, "no config to save")
         path = os.path.expanduser(body.get("path", "lumen-config.yaml"))
-        with open(path, "w", encoding="utf-8") as f:
-            f.write(config_to_yaml(state.config))
+        try:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(config_to_yaml(cfg))
+        except OSError as e:
+            # The edited config must NOT become current when the write
+            # failed — the client was just told the save didn't happen.
+            return _json_error(400, f"could not write {path}: {e}")
+        state.config = cfg
         state.config_path = path
         state.broadcast_log(f"config saved to {path}")
-        return web.json_response({"path": path})
+        out = {"path": path}
+        if warnings:
+            out["warnings"] = warnings
+        return web.json_response(out)
 
     async def config_yaml(request: web.Request) -> web.Response:
         if state.config is None:

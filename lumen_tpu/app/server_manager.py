@@ -46,6 +46,7 @@ class ServerManager:
         self.config_path: str | None = None
         self.extra_args: list[str] = []
         self.started_at: float | None = None
+        self.exit_code: int | None = None  # last child exit (crash triage)
         self._ready = asyncio.Event()
         self._capture_task: asyncio.Task | None = None
         # Serializes start/stop/restart: two concurrent starts must not both
@@ -67,6 +68,7 @@ class ServerManager:
         self.extra_args = list(extra_args or [])
         self.port = None
         self.metrics_port = None
+        self.exit_code = None
         cmd = [sys.executable, "-m", "lumen_tpu.serving.server", "--config", config_path]
         cmd += self.extra_args
         self.state.broadcast_log(f"starting server: {' '.join(cmd)}", source="server")
@@ -104,6 +106,7 @@ class ServerManager:
                 self.metrics_port = int(m.group(2))
         # EOF: process exited.
         rc = await self.proc.wait()
+        self.exit_code = rc
         if self.status in (ServerStatus.STARTING, ServerStatus.RUNNING):
             self.status = ServerStatus.FAILED if rc else ServerStatus.STOPPED
         self.state.broadcast_log(f"server exited with code {rc}", source="server")
@@ -191,5 +194,6 @@ class ServerManager:
             "port": self.port,
             "metrics_port": self.metrics_port,
             "config_path": self.config_path,
+            "exit_code": self.exit_code,
             "uptime_s": round(time.time() - self.started_at, 1) if self.started_at and self.status == ServerStatus.RUNNING else None,
         }

@@ -41,7 +41,8 @@ async function call(routeName, { params, query, body } = {}) {
   if (!res.ok) {
     throw new ApiError(
       (data && data.error) || `${route.method} ${path} -> HTTP ${res.status}`,
-      res.status
+      res.status,
+      data
     );
   }
   return data;
@@ -68,7 +69,13 @@ export const api = {
   /** @param {LumenConfig} cfg @param {boolean=} loose */
   validateConfig: (cfg, loose) =>
     call("config_validate", { body: loose ? { config: cfg, loose: true } : { config: cfg } }),
+  /** Validate editor YAML text as typed (per-field errors in the response). */
+  validateConfigYaml: (yaml, loose) =>
+    call("config_validate", { body: loose ? { yaml, loose: true } : { yaml } }),
   saveConfig: (path) => call("config_save", { body: { path } }),
+  /** Validate + persist edited YAML and make it the current config. */
+  saveConfigYaml: (yaml, path, loose) =>
+    call("config_save", { body: loose ? { yaml, path, loose: true } : { yaml, path } }),
   configYaml: async () => {
     const res = await fetch(ROUTES.config_yaml.path);
     if (!res.ok) throw new ApiError(`no config yet (HTTP ${res.status})`, res.status);

@@ -4,10 +4,11 @@
 // raw fetch messages.
 
 export class ApiError extends Error {
-  /** @param {string} message @param {number|null} status */
-  constructor(message, status = null) {
+  /** @param {string} message @param {number|null} status @param {object|null} data parsed error body (e.g. /config/validate field_errors) */
+  constructor(message, status = null, data = null) {
     super(message);
     this.status = status;
+    this.data = data;
     this.kind =
       status === null ? "network"
       : status === 401 || status === 403 ? "permission"

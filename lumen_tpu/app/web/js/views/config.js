@@ -46,16 +46,24 @@ export function renderConfig(root) {
         el("h3", {}, "Save & validate"),
         field("Config file path", el("input", { type: "text", id: "cfg-path", value: s.configPath || "lumen-config.yaml" })),
         el("div", { class: "row" }, [
-          el("button", { class: "btn", id: "cfg-save", disabled: s.configGenerated ? undefined : "1" }, "Save YAML"),
-          el("button", { class: "btn", id: "cfg-validate", disabled: s.configGenerated ? undefined : "1" }, "Validate"),
+          el("button", { class: "btn", id: "cfg-save" }, "Validate & save"),
+          el("button", { class: "btn", id: "cfg-validate" }, "Validate"),
+          el("label", { class: "checkrow" }, [
+            el("input", { type: "checkbox", id: "cfg-loose" }),
+            "loose (unknown fields warn)",
+          ]),
           el("span", { class: "muted", id: "cfg-save-status" }, s.configPath ? `saved: ${s.configPath}` : ""),
         ]),
         el("p", { class: "muted" }, "The server step launches the gRPC hub from this saved file."),
       ]),
     ]),
     el("div", { class: "card" }, [
-      el("h3", {}, "Generated YAML"),
-      el("pre", { class: "code", id: "cfg-yaml" }, s.configGenerated ? "loading…" : "— generate first —"),
+      el("h3", {}, "Config YAML (editable)"),
+      el("p", { class: "muted" },
+        "Edit freely — Validate checks the text below (per-field errors appear here), Validate & save writes it to the path above and makes it current."),
+      el("textarea", { class: "code", id: "cfg-yaml", rows: "18", spellcheck: "false" },
+        s.configGenerated ? "loading…" : "# — generate first, or edit YAML here —"),
+      el("div", { id: "cfg-errors" }),
     ])
   );
 
@@ -94,32 +102,67 @@ export function renderConfig(root) {
 
   root.querySelector("#cfg-save").onclick = async () => {
     try {
-      const { path } = await api.saveConfig(root.querySelector("#cfg-path").value);
-      wizard.update({ configPath: path });
-      root.querySelector("#cfg-save-status").textContent = `saved: ${path}`;
-      toast(`saved ${path}`);
+      const out = await api.saveConfigYaml(
+        root.querySelector("#cfg-yaml").value,
+        root.querySelector("#cfg-path").value,
+        root.querySelector("#cfg-loose").checked
+      );
+      renderValidation(root, { valid: true, warnings: out.warnings });
+      wizard.update({ configPath: out.path, configGenerated: true });
+      root.querySelector("#cfg-save-status").textContent = `saved: ${out.path}`;
+      toast(`saved ${out.path}`);
     } catch (e) {
+      // 400 bodies carry the /config/validate error shape — render the
+      // per-field list instead of only toasting the summary string.
+      renderValidation(root, e.data && e.data.valid === false ? e.data : { valid: false, error: e.message });
       toast(e.message, true);
     }
   };
 
   root.querySelector("#cfg-validate").onclick = async () => {
     try {
-      const cfg = await api.currentConfig();
-      const v = await api.validateConfig(cfg);
+      const v = await api.validateConfigYaml(
+        root.querySelector("#cfg-yaml").value,
+        root.querySelector("#cfg-loose").checked
+      );
+      renderValidation(root, v);
       if (v.valid) toast(`valid — services: ${v.services.join(", ")}`);
-      else toast(`invalid: ${v.error}`, true);
+      else toast("invalid — see errors below", true);
     } catch (e) {
       toast(e.message, true);
     }
   };
 }
 
+// Per-field validation feedback (reference Config view's inline error
+// states): one row per pydantic error, anchored by its config path.
+function renderValidation(root, v) {
+  const box = root.querySelector("#cfg-errors");
+  if (!box) return;
+  if (v.valid) {
+    box.replaceChildren(
+      el("p", { class: "ok-note" }, "✓ valid"),
+      ...(v.warnings || []).map((w) => el("p", { class: "warn-note" }, `⚠ ${w}`))
+    );
+    return;
+  }
+  const rows = (v.field_errors || []).map((fe) =>
+    el("p", { class: "err-note" }, [
+      el("code", {}, fe.loc || "(config)"),
+      ` — ${fe.msg}`,
+    ])
+  );
+  box.replaceChildren(
+    el("p", { class: "err-note" }, `✕ ${v.error || "invalid"}`),
+    ...rows
+  );
+}
+
 async function loadYaml(root) {
   try {
-    root.querySelector("#cfg-yaml").textContent = await api.configYaml();
+    root.querySelector("#cfg-yaml").value = await api.configYaml();
   } catch (e) {
-    root.querySelector("#cfg-yaml").textContent = `(${e.message})`;
+    root.querySelector("#cfg-yaml").value = `# (${e.message})`;
   }
 }
 

@@ -226,13 +226,26 @@ async function poll(root, taskId, gen) {
     root.querySelector("#inst-cancel").disabled = false;
     pollTimer = setTimeout(() => poll(root, taskId, gen), 900);
   } else {
-    root.querySelector("#inst-start").disabled = false;
+    const startBtn = root.querySelector("#inst-start");
+    startBtn.disabled = false;
     root.querySelector("#inst-cancel").disabled = true;
     if (task.status === "completed") {
       wizard.update({ installDone: true });
       toast("install complete");
     } else if (task.status === "failed") {
+      // Failure state with a one-click retry (reference Install view's
+      // error affordance): the failed step is marked ✕ in the list above,
+      // the task error is shown, and Start becomes Retry with the same
+      // parameters.
+      startBtn.textContent = "Retry install";
+      const failedStep = (task.steps || []).find((s) => s.status === "failed");
+      root.querySelector("#inst-error").textContent =
+        (task.error ? `install failed: ${task.error}` : "install failed — see logs") +
+        (failedStep ? ` (step: ${failedStep.name})` : "");
       toast(`install failed: ${task.error || "see logs"}`, true);
+    } else if (task.status === "cancelled") {
+      startBtn.textContent = "Re-run install";
+      root.querySelector("#inst-status").textContent = "install cancelled";
     }
     refreshHistory(root); // terminal state: reflect it in the task list
   }

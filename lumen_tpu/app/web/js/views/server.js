@@ -101,9 +101,23 @@ async function refresh(root) {
     ...kv("uptime", info.uptime_s != null ? `${Math.round(info.uptime_s)}s` : "—")
   );
   const live = info.status === "running" || info.status === "starting";
+  const crashed = info.status === "failed";
+  // Crash recovery (reference Server view's failure states): say what
+  // happened (exit code) and leave BOTH recovery paths enabled — Restart
+  // relaunches with the same config, Start allows picking a new one.
+  const msg = root.querySelector("#srv-msg");
+  if (crashed) {
+    msg.textContent =
+      `server exited unexpectedly (exit code ${info.exit_code ?? "?"}) — ` +
+      "see the logs below, then Restart to relaunch with the same config.";
+    msg.classList.add("err-note");
+  } else {
+    if (msg.classList.contains("err-note")) msg.textContent = "";
+    msg.classList.remove("err-note");
+  }
   root.querySelector("#srv-start").disabled = live;
   root.querySelector("#srv-stop").disabled = !live;
-  root.querySelector("#srv-restart").disabled = !live;
+  root.querySelector("#srv-restart").disabled = !(live || crashed);
   pollTimer = setTimeout(() => refresh(root), 2500);
 }
 

@@ -505,6 +505,47 @@ class TestServerManagerApi:
 
         assert with_client(fn)
 
+    def test_crash_reports_exit_code_and_restart_recovers(self, tmp_path):
+        """A crashed managed server must land in ``failed`` with the exit
+        code recorded (the server view's crash banner reads it), and
+        restart must relaunch from that state — the UI's two recovery
+        affordances."""
+        import signal
+
+        config_path = make_echo_config(tmp_path)
+
+        async def fn(client):
+            r = await client.post(
+                "/api/v1/server/start",
+                json={
+                    "config_path": config_path,
+                    "extra_args": ["--skip-download", "--port", "0", "--metrics-port", "0"],
+                },
+            )
+            assert r.status == 200, await r.text()
+            status = await (await client.get("/api/v1/server/status")).json()
+            os.kill(status["pid"], signal.SIGKILL)
+            for _ in range(100):
+                status = await (await client.get("/api/v1/server/status")).json()
+                if status["status"] in ("failed", "stopped"):
+                    break
+                await asyncio.sleep(0.1)
+            assert status["status"] == "failed"
+            assert status["exit_code"] not in (None, 0)
+            assert status["pid"] is None
+
+            r = await client.post("/api/v1/server/restart")
+            assert r.status == 200, await r.text()
+            info = await r.json()
+            assert info["status"] == "running"
+            assert info["exit_code"] is None  # fresh start clears the crash
+
+            r = await client.post("/api/v1/server/stop")
+            assert (await r.json())["status"] == "stopped"
+            return True
+
+        assert with_client(fn)
+
 
 class TestWsLogs:
     def test_connected_log_heartbeat_frames(self):

@@ -242,6 +242,86 @@ class TestWizardFlow:
         run_async(fn())
 
 
+class TestConfigYamlEditing:
+    """The config view's editable-YAML flow (reference Config view's
+    inline validation): validate the editor text as typed with per-field
+    errors, and validate-and-save making the edited text the current
+    config — an invalid edit must never reach disk or app state."""
+
+    def test_yaml_validate_and_save_flow(self, tmp_path):
+        async def fn():
+            import yaml as _yaml
+
+            client = _client()
+            await client.start_server()
+            try:
+                r = await client.get("/api/v1/hardware/detect")
+                rec = (await r.json())["recommended_preset"]
+                r = await client.post(
+                    "/api/v1/config/generate",
+                    json={"preset": rec, "tier": "light_weight",
+                          "cache_dir": str(tmp_path / "cache")},
+                )
+                assert r.status == 200
+                yaml_text = await (await client.get("/api/v1/config/yaml")).text()
+
+                # editor text valid as-is
+                r = await client.post(
+                    "/api/v1/config/validate", json={"yaml": yaml_text}
+                )
+                v = await r.json()
+                assert v["valid"] is True and v["services"]
+
+                # YAML parse failure points at the spot
+                r = await client.post(
+                    "/api/v1/config/validate",
+                    json={"yaml": "services:\n  clip: [unclosed"},
+                )
+                v = await r.json()
+                assert v["valid"] is False and "line" in v["error"]
+
+                # a bad field comes back as a structured loc/msg the UI
+                # anchors to the editor (not just one opaque string)
+                data = _yaml.safe_load(yaml_text)
+                data["server"]["port"] = 1  # below ge=1024
+                bad = _yaml.safe_dump(data)
+                r = await client.post("/api/v1/config/validate", json={"yaml": bad})
+                v = await r.json()
+                assert v["valid"] is False
+                assert any("port" in fe["loc"] for fe in v["field_errors"])
+
+                # save rejects the same invalid edit with the same shape,
+                # writes nothing, and keeps the previous current config
+                bad_path = tmp_path / "bad.yaml"
+                r = await client.post(
+                    "/api/v1/config/save",
+                    json={"yaml": bad, "path": str(bad_path)},
+                )
+                assert r.status == 400
+                v = await r.json()
+                assert v["valid"] is False and v.get("field_errors")
+                assert not bad_path.exists()
+                cur = await (await client.get("/api/v1/config/current")).json()
+                assert cur["server"]["port"] != 1
+
+                # a valid edit saves, persists, and becomes current
+                data2 = _yaml.safe_load(yaml_text)
+                data2["server"]["port"] = 50123
+                r = await client.post(
+                    "/api/v1/config/save",
+                    json={"yaml": _yaml.safe_dump(data2),
+                          "path": str(tmp_path / "edited.yaml")},
+                )
+                assert r.status == 200
+                assert (tmp_path / "edited.yaml").exists()
+                cur = await (await client.get("/api/v1/config/current")).json()
+                assert cur["server"]["port"] == 50123
+            finally:
+                await client.close()
+
+        run_async(fn())
+
+
 class TestViewDomContract:
     def test_view_ids_are_defined_before_use(self):
         """Every id queried with querySelector('#x') inside a view module is
