@@ -191,6 +191,29 @@ def build_app(state: AppState | None = None) -> web.Application:
             raise ValueError(f"YAML must be a mapping, got {type(data).__name__}")
         return data
 
+    def _validate_yaml_text(text: str, loose: bool):
+        """Editor YAML -> (cfg, warnings). Shared by /config/validate and
+        /config/save so the two can never diverge on parse/loose
+        semantics (the UI promises their verdicts agree)."""
+        from lumen_tpu.core.config import (
+            validate_config_dict,
+            validate_config_loose,
+        )
+
+        data = _parse_yaml_body(text)
+        if loose:
+            return validate_config_loose(data)
+        return validate_config_dict(data), []
+
+    def _invalid_body(e: Exception) -> dict:
+        """The one error shape both config endpoints return for a failed
+        validation: summary string + per-field errors when pydantic."""
+        out = {"valid": False, "error": str(e)}
+        fe = _field_errors(e)
+        if fe:
+            out["field_errors"] = fe
+        return out
+
     def _validated(body: dict, require_path: bool = False) -> web.Response:
         from lumen_tpu.core.config import (
             load_config,
@@ -210,11 +233,7 @@ def build_app(state: AppState | None = None) -> web.Application:
             elif "yaml" in body and not require_path:
                 # The web UI's editable-YAML flow: validate the editor
                 # text as typed, before anything touches disk.
-                data = _parse_yaml_body(body["yaml"])
-                if loose:
-                    cfg, warnings = validate_config_loose(data)
-                else:
-                    cfg = validate_config_dict(data)
+                cfg, warnings = _validate_yaml_text(body["yaml"], loose)
             elif "config" in body and not require_path:
                 if loose:
                     cfg, warnings = validate_config_loose(body["config"])
@@ -225,11 +244,7 @@ def build_app(state: AppState | None = None) -> web.Application:
                     400, "provide 'path'" if require_path else "provide 'config' (dict), 'yaml' (text), or 'path'"
                 )
         except Exception as e:  # noqa: BLE001 - validation errors reported to client
-            out = {"valid": False, "error": str(e)}
-            fe = _field_errors(e)
-            if fe:
-                out["field_errors"] = fe
-            return web.json_response(out)
+            return web.json_response(_invalid_body(e))
         out = {"valid": True, "services": sorted(cfg.services)}
         if warnings:
             out["warnings"] = warnings
@@ -268,28 +283,15 @@ def build_app(state: AppState | None = None) -> web.Application:
         warnings: list[str] = []
         if "yaml" in body:
             # Editable-YAML flow: the edited text must validate before it
-            # becomes the current config or touches disk. Errors use the
-            # same shape as /config/validate (field_errors included) so
-            # the UI renders them in one place; ``loose`` matches the
-            # validate endpoint so a config the UI just called valid
-            # can't flip verdicts at save time.
-            from lumen_tpu.core.config import (
-                validate_config_dict,
-                validate_config_loose,
-            )
-
+            # becomes the current config or touches disk. Same helper and
+            # error shape as /config/validate, so a config the UI just
+            # called valid can't flip verdicts at save time.
             try:
-                data = _parse_yaml_body(body["yaml"])
-                if body.get("loose"):
-                    cfg, warnings = validate_config_loose(data)
-                else:
-                    cfg = validate_config_dict(data)
+                cfg, warnings = _validate_yaml_text(
+                    body["yaml"], bool(body.get("loose"))
+                )
             except Exception as e:  # noqa: BLE001 - reported to client
-                out = {"valid": False, "error": str(e)}
-                fe = _field_errors(e)
-                if fe:
-                    out["field_errors"] = fe
-                return web.json_response(out, status=400)
+                return web.json_response(_invalid_body(e), status=400)
         if cfg is None:
             return _json_error(404, "no config to save")
         path = os.path.expanduser(body.get("path", "lumen-config.yaml"))
@@ -312,6 +314,79 @@ def build_app(state: AppState | None = None) -> web.Application:
         if state.config is None:
             return _json_error(404, "no config generated or loaded yet")
         return web.Response(text=config_to_yaml(state.config), content_type="text/yaml")
+
+    async def session_status(request: web.Request) -> web.Response:
+        """Reference SessionHub's ``checkInstallationPath``
+        (``web-ui/src/views/SessionHub.tsx``): given a saved config, is
+        this deployment ready to start as-is? Loads the config,
+        offline-checks every enabled model in the cache
+        (``Downloader.check_all`` — never downloads), and recommends
+        ``start_existing`` vs ``run_install`` vs ``open_config``."""
+        from lumen_tpu.core.config import load_config
+        from lumen_tpu.core.downloader import Downloader
+
+        body = await _body(request)
+        path = body.get("config_path") or state.config_path
+        if not path:
+            return web.json_response({
+                "config_valid": False,
+                "ready_to_start": False,
+                "recommended_action": "open_config",
+                "message": "no config loaded — open or generate one first",
+            })
+        try:
+            cfg = load_config(path)
+        except Exception as e:  # noqa: BLE001 - reported as a recommendation
+            return web.json_response({
+                "config_valid": False,
+                "ready_to_start": False,
+                "recommended_action": "open_config",
+                "message": f"config at {path} does not validate: {e}",
+            })
+        cache_dir = os.path.expanduser(cfg.metadata.cache_dir)
+        if not os.path.isdir(cache_dir):
+            # Nothing cached — and constructing the Downloader would
+            # os.makedirs the cache dir, a side effect a read-only status
+            # check must not have.
+            models = [
+                {"service": s, "alias": a, "model": m.model, "present": False,
+                 "error": f"cache dir {cache_dir} does not exist"}
+                for s, svc in cfg.enabled_services().items()
+                for a, m in svc.models.items()
+            ]
+        else:
+            try:
+                report = await asyncio.to_thread(lambda: Downloader(cfg).check_all())
+            except Exception as e:  # noqa: BLE001 - recommend, don't 500
+                return web.json_response({
+                    "config_valid": True,
+                    "config_path": os.path.expanduser(path),
+                    "services": sorted(cfg.enabled_services()),
+                    "models": [],
+                    "ready_to_start": False,
+                    "recommended_action": "run_install",
+                    "message": f"could not check the cache at {cache_dir}: {e}",
+                })
+            models = [
+                {"service": r.service, "alias": r.alias, "model": r.model,
+                 "present": r.ok, **({"error": r.error} if r.error else {})}
+                for r in report.results
+            ]
+        missing = [m for m in models if not m["present"]]
+        ready = not missing
+        return web.json_response({
+            "config_valid": True,
+            "config_path": os.path.expanduser(path),
+            "services": sorted(cfg.enabled_services()),
+            "models": models,
+            "ready_to_start": ready,
+            "recommended_action": "start_existing" if ready else "run_install",
+            "message": (
+                "all models present in the cache — the server can start as-is"
+                if ready else
+                f"{len(missing)} of {len(models)} models missing or invalid — run the installer"
+            ),
+        })
 
     async def config_presets(request: web.Request) -> web.Response:
         return web.json_response(
@@ -536,6 +611,7 @@ def build_app(state: AppState | None = None) -> web.Application:
     app.router.add_post(f"{v1}/config/save", config_save)
     app.router.add_get(f"{v1}/config/yaml", config_yaml)
     app.router.add_get(f"{v1}/config/presets", config_presets)
+    app.router.add_post(f"{v1}/session/status", session_status)
     app.router.add_get(f"{v1}/hardware/info", hardware_info)
     app.router.add_get(f"{v1}/hardware/detect", hardware_detect)
     app.router.add_get(f"{v1}/hardware/check", hardware_check)

@@ -72,7 +72,9 @@ export const api = {
   /** Validate editor YAML text as typed (per-field errors in the response). */
   validateConfigYaml: (yaml, loose) =>
     call("config_validate", { body: loose ? { yaml, loose: true } : { yaml } }),
-  saveConfig: (path) => call("config_save", { body: { path } }),
+  /** Reference SessionHub: is the deployment at config_path ready to start as-is? */
+  sessionStatus: (configPath) =>
+    call("session_status", { body: configPath ? { config_path: configPath } : {} }),
   /** Validate + persist edited YAML and make it the current config. */
   saveConfigYaml: (yaml, path, loose) =>
     call("config_save", { body: loose ? { yaml, path, loose: true } : { yaml, path } }),

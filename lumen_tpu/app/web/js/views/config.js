@@ -89,8 +89,6 @@ export function renderConfig(root) {
       });
       wizard.update({ configGenerated: true, configPath: null });
       root.querySelector("#cfg-status").textContent = "config generated";
-      root.querySelector("#cfg-save").disabled = false;
-      root.querySelector("#cfg-validate").disabled = false;
       await loadYaml(root);
       toast("config generated");
     } catch (e) {

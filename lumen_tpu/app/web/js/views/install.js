@@ -230,6 +230,7 @@ async function poll(root, taskId, gen) {
     startBtn.disabled = false;
     root.querySelector("#inst-cancel").disabled = true;
     if (task.status === "completed") {
+      startBtn.textContent = "Re-run install";  // clear a stale Retry label
       wizard.update({ installDone: true });
       toast("install complete");
     } else if (task.status === "failed") {

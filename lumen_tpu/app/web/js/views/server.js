@@ -112,7 +112,9 @@ async function refresh(root) {
       "see the logs below, then Restart to relaunch with the same config.";
     msg.classList.add("err-note");
   } else {
-    if (msg.classList.contains("err-note")) msg.textContent = "";
+    // #srv-msg only ever carries transient notices (fetch errors, the
+    // crash banner) — a successful status poll clears it outright.
+    msg.textContent = "";
     msg.classList.remove("err-note");
   }
   root.querySelector("#srv-start").disabled = live;

@@ -126,6 +126,36 @@ class Downloader:
                 report.results.append(self._download_one(svc_name, alias, model_cfg))
         return report
 
+    def check_all(self) -> DownloadReport:
+        """Offline presence/integrity check: is every enabled model
+        already in the cache with its declared files (and dataset labels)?
+        Never downloads and never raises — per-model failures are reported
+        so the session-resume flow (``/api/v1/session/status``, the
+        reference SessionHub's ``checkInstallationPath`` recommendation)
+        can decide start-existing vs run-installer."""
+        report = DownloadReport()
+        for svc_name, svc in self.config.enabled_services().items():
+            for alias, model_cfg in svc.models.items():
+                res = DownloadResult(
+                    service=svc_name, alias=alias, model=model_cfg.model, ok=False
+                )
+                try:
+                    if not self.platform.is_cached(model_cfg.model):
+                        raise DownloadError(
+                            f"model {model_cfg.model!r} is not in the cache",
+                            repo_id=model_cfg.model,
+                        )
+                    path = self.platform.local_dir(model_cfg.model)
+                    info = load_model_info(path)
+                    self.validate_files(path, info, model_cfg)
+                    res.path, res.ok = path, True
+                except (ResourceError, OSError) as e:
+                    # OSError too (permission-denied listdir/stat): the
+                    # "never raises" contract holds for unreadable caches.
+                    res.error = str(e)
+                report.results.append(res)
+        return report
+
     # -- internals --------------------------------------------------------
 
     def _download_one(self, svc: str, alias: str, model_cfg: ModelConfig) -> DownloadResult:

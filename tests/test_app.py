@@ -444,6 +444,70 @@ class TestServerStatusBeforeStart:
         assert with_client(fn)
 
 
+class TestSessionStatus:
+    """`/session/status` — the reference SessionHub's resume flow: an
+    opened config is offline-checked against the cache and the endpoint
+    recommends start-existing vs run-installer vs open-config."""
+
+    def _write_config(self, tmp_path, cache_dir):
+        from tests.test_core_config import make_raw
+
+        raw = make_raw()
+        raw["metadata"]["cache_dir"] = str(cache_dir)
+        # No dataset requirement: the presence check then only needs the
+        # declared runtime files.
+        raw["services"]["clip"]["models"]["clip"].pop("dataset")
+        path = tmp_path / "cfg.yaml"
+        path.write_text(yaml.safe_dump(raw))
+        return str(path)
+
+    def test_recommendations(self, tmp_path):
+        from tests.test_core_resources import make_model_info
+
+        async def fn(client):
+            # no config anywhere -> open_config
+            r = await client.post("/api/v1/session/status", json={})
+            d = await r.json()
+            assert d["recommended_action"] == "open_config"
+
+            # unparseable config path -> open_config with the reason
+            bad = tmp_path / "bad.yaml"
+            bad.write_text("nope: [")
+            r = await client.post(
+                "/api/v1/session/status", json={"config_path": str(bad)}
+            )
+            d = await r.json()
+            assert d["config_valid"] is False
+            assert d["recommended_action"] == "open_config"
+
+            # valid config, empty cache -> run_install naming the model
+            cfg_path = self._write_config(tmp_path, tmp_path / "cache")
+            r = await client.post(
+                "/api/v1/session/status", json={"config_path": cfg_path}
+            )
+            d = await r.json()
+            assert d["config_valid"] is True
+            assert d["ready_to_start"] is False
+            assert d["recommended_action"] == "run_install"
+            assert [m["model"] for m in d["models"] if not m["present"]] == ["ViT-B-32"]
+
+            # model present with its declared files -> start_existing
+            model_dir = tmp_path / "cache" / "models" / "ViT-B-32"
+            model_dir.mkdir(parents=True)
+            (model_dir / "model_info.json").write_text(json.dumps(make_model_info()))
+            (model_dir / "model.safetensors").write_bytes(b"x")
+            r = await client.post(
+                "/api/v1/session/status", json={"config_path": cfg_path}
+            )
+            d = await r.json()
+            assert d["ready_to_start"] is True
+            assert d["recommended_action"] == "start_existing"
+            assert d["services"] == ["clip"]
+            return True
+
+        assert with_client(fn)
+
+
 @pytest.mark.integration
 class TestServerManagerApi:
     def test_start_status_health_stop(self, tmp_path):
