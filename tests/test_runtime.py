@@ -97,6 +97,22 @@ class TestBatcher:
         finally:
             b.close()
 
+    def test_close_never_started_spares_live_gauges(self):
+        """ADVICE r3: closing a never-started same-name batcher must not
+        evict a live batcher's gauge provider (gauges register at start();
+        an unstarted instance has none to unregister)."""
+        from lumen_tpu.utils.metrics import metrics
+
+        fn = lambda tree, n: tree  # noqa: E731
+        live = MicroBatcher(fn, max_batch=2, max_latency_ms=1, name="gauge-t").start()
+        try:
+            stale = MicroBatcher(fn, max_batch=2, max_latency_ms=1, name="gauge-t")
+            stale.close()  # never started
+            assert "batcher:gauge-t" in (metrics.snapshot().get("gauges") or {})
+        finally:
+            live.close()
+        assert "batcher:gauge-t" not in (metrics.snapshot().get("gauges") or {})
+
     def test_concurrent_submissions_batch_together(self):
         seen_batches = []
 
