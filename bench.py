@@ -2102,6 +2102,15 @@ def _assemble(
     if ingest:
         extras["ingest_images_per_sec"] = ingest.get("images_per_sec")
         extras["ingest_platform"] = ingest.get("platform")
+        # North-star decomposition (BASELINE.json: >=2000 img/s on
+        # v5e-16 == >=125/chip): chip-side ceiling vs this 1-core host's
+        # decode rate; production hosts scale the latter by core count.
+        if ingest.get("images_per_sec_device") is not None:
+            extras["ingest_images_per_sec_device"] = ingest["images_per_sec_device"]
+        if ingest.get("host_decode_images_per_sec_1core") is not None:
+            extras["ingest_host_decode_images_per_sec_1core"] = (
+                ingest["host_decode_images_per_sec_1core"]
+            )
     grpc_res = results.get("bench_grpc")
     if grpc_res:
         extras["grpc"] = grpc_res
