@@ -60,11 +60,16 @@ class ConvBnAct(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # Explicit k//2 padding, not "SAME": identical for stride 1 (odd
+        # kernels) but torch-compatible at stride 2, where SAME pads (0, 1)
+        # at even sizes vs torch's symmetric (1, 1) — the divergence that
+        # broke converted-IResNet parity (scripts/run_arch_parity.py).
+        p = self.kernel // 2
         x = nn.Conv(
             self.features,
             (self.kernel, self.kernel),
             strides=(self.stride, self.stride),
-            padding="SAME",
+            padding=((p, p), (p, p)),
             use_bias=False,
             name="conv",
             dtype=x.dtype,
@@ -189,8 +194,12 @@ class IBasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         bn = lambda name: nn.BatchNorm(use_running_average=True, epsilon=1e-5, name=name, dtype=x.dtype)
+        # Explicit symmetric padding, NOT "SAME": torch Conv2d(3, s=2, p=1)
+        # pads (1, 1) while SAME pads (0, 1) at even sizes — converted
+        # InsightFace checkpoints diverge (cos 0.984) under SAME at the
+        # stride-2 blocks. Caught by scripts/run_arch_parity.py (round 5).
         conv = lambda name, stride: nn.Conv(
-            self.features, (3, 3), strides=(stride, stride), padding="SAME",
+            self.features, (3, 3), strides=(stride, stride), padding=((1, 1), (1, 1)),
             use_bias=False, name=name, dtype=x.dtype,
         )
         residual = x

@@ -2,7 +2,14 @@
 
 from .convert import convert_ocr_checkpoint, flatten_variables
 from .manager import OcrManager, OcrResult, OcrSpec
-from .modeling import DBNet, DBNetConfig, SVTRConfig, SVTRRecognizer
+from .modeling import (
+    ClsConfig,
+    DBNet,
+    DBNetConfig,
+    SVTRConfig,
+    SVTRRecognizer,
+    TextlineClassifier,
+)
 from .postprocess import (
     box_score_fast,
     boxes_from_prob_map,
@@ -20,6 +27,8 @@ __all__ = [
     "DBNetConfig",
     "SVTRRecognizer",
     "SVTRConfig",
+    "TextlineClassifier",
+    "ClsConfig",
     "convert_ocr_checkpoint",
     "flatten_variables",
     "boxes_from_prob_map",

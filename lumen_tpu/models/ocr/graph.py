@@ -22,10 +22,13 @@ logger = logging.getLogger(__name__)
 
 
 def find_onnx_models(model_dir: str, precision: str | None = None) -> dict[str, str]:
-    """Locate det/rec ``.onnx`` files (shared precision-chain discovery).
-    Returns a dict with any of the keys ``detection`` / ``recognition``."""
+    """Locate det/rec/cls ``.onnx`` files (shared precision-chain discovery).
+    Returns a dict with any of the keys ``detection`` / ``recognition`` /
+    ``classification`` (PP-OCR textline orientation, ``cls*.onnx``)."""
     return find_onnx_exports(
-        model_dir, {"detection": "det", "recognition": "rec"}, precision
+        model_dir,
+        {"detection": "det", "recognition": "rec", "classification": "cls"},
+        precision,
     )
 
 
@@ -82,3 +85,32 @@ class RecGraph:
         import jax.numpy as jnp
 
         return jnp.asarray(self.module(params, {self.module.input_names[0]: x_nchw})[0])
+
+
+@dataclass
+class ClsGraph:
+    """Textline-orientation graph: [B,3,H,W] normalized crops -> [B,2]
+    probabilities over (0deg, 180deg). PP-OCR's ``cls`` model (the
+    reference declares the slot but never executes it —
+    ``onnxrt_backend.py:73`` keeps ``cls_sess = None``; here it runs)."""
+
+    module: OnnxModule
+    outputs_probs: bool
+
+    @classmethod
+    def from_path(cls, path: str) -> "ClsGraph":
+        module = OnnxModule.from_path(path)
+        return cls(
+            module=module,
+            outputs_probs=_ends_in_softmax(module, module.output_names[0]),
+        )
+
+    def __call__(self, params: dict, x_nchw):
+        import jax
+
+        import jax.numpy as jnp
+
+        out = jnp.asarray(self.module(params, {self.module.input_names[0]: x_nchw})[0])
+        if not self.outputs_probs:
+            out = jax.nn.softmax(out.astype(jnp.float32), axis=-1)
+        return out.astype(jnp.float32)

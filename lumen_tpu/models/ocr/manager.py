@@ -33,7 +33,14 @@ from ...runtime.batcher import bucket_for
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
 from .convert import convert_ocr_checkpoint
-from .modeling import DBNet, DBNetConfig, SVTRConfig, SVTRRecognizer
+from .modeling import (
+    ClsConfig,
+    DBNet,
+    DBNetConfig,
+    SVTRConfig,
+    SVTRRecognizer,
+    TextlineClassifier,
+)
 from .postprocess import boxes_from_prob_map, rotate_crop, sorted_boxes
 
 logger = logging.getLogger(__name__)
@@ -76,6 +83,11 @@ class OcrSpec:
     drop_rec_below_threshold: bool = True
     charset_file: str = "ppocr_keys_v1.txt"
     use_space_char: bool = True
+    # Textline-orientation classifier (PP-OCR ``cls``): flip a crop 180deg
+    # only above this confidence (PaddleOCR's cls_thresh default).
+    cls_thresh: float = 0.9
+    cls_height: int = 48
+    cls_width: int = 192
 
     @classmethod
     def from_extra(cls, extra: dict | None) -> "OcrSpec":
@@ -250,8 +262,47 @@ class OcrManager:
                 ids, conf = ctc_greedy_device(logits)
                 return _mask_padding(ids, conf, crops_u8.shape[2], logits.shape[1], widths)
 
+        # Optional textline-orientation classifier. Unlike det/rec, a
+        # missing cls is NOT an error: the backend contract marks it
+        # optional ("if available", reference ``lumen_ocr/backends/
+        # base.py:63-136``) and the reference itself never executes one
+        # (``onnxrt_backend.py:73``). Precedence mirrors det/rec: real
+        # ONNX export first, then a native Flax checkpoint.
+        run_cls = None
+        if "classification" in onnx_models:
+            from .graph import ClsGraph
+
+            graph_cls = ClsGraph.from_path(onnx_models["classification"])
+            self.cls_vars = jax.device_put(dict(graph_cls.module.params))
+            graph_cls.module.release_weights()
+            self._cls_hw = (s.cls_height, s.cls_width)
+            logger.info("ocr cls: graph %s", onnx_models["classification"])
+
+            @jax.jit
+            def run_cls(variables, crops_u8):
+                x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
+                return graph_cls(variables, x.transpose(0, 3, 1, 2))
+
+        elif os.path.exists(os.path.join(self.model_dir, "classification.safetensors")):
+            self.cls_cfg = dataclass_from_extra(ClsConfig, self.info.extra("classifier"))
+            self.classifier = TextlineClassifier(self.cls_cfg)
+            self.cls_vars = self._load_variables(
+                "classification.safetensors",
+                self.classifier,
+                (1, self.cls_cfg.height, self.cls_cfg.width, 3),
+                "classification",
+            )
+            self._cls_hw = (self.cls_cfg.height, self.cls_cfg.width)
+
+            @jax.jit
+            def run_cls(variables, crops_u8):
+                x = (crops_u8.astype(jnp.float32) / 255.0 - rec_mean) / rec_std
+                logits = self.classifier.apply(variables, x.astype(compute))
+                return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
         self._run_detector = run_detector
         self._run_recognizer = run_recognizer
+        self._run_cls = run_cls
         if self.warmup:
             import time as _time
 
@@ -384,6 +435,46 @@ class OcrManager:
                     results[i] = collapsed[row]
         return results  # type: ignore[return-value]
 
+    # -- textline orientation ---------------------------------------------
+
+    @property
+    def has_angle_cls(self) -> bool:
+        return getattr(self, "_run_cls", None) is not None
+
+    def classify_angles(self, crops: list[np.ndarray]) -> list[bool]:
+        """True where a crop is upside-down (class 180 above ``cls_thresh``).
+        One batched device call on letterboxed ``cls_height x cls_width``
+        crops — the PP-OCR cls contract the reference declares but never
+        executes (``onnxrt_backend.py:73``)."""
+        self._ensure_ready()
+        if not self.has_angle_cls or not crops:
+            return [False] * len(crops)
+        import cv2
+
+        h, w = self._cls_hw
+        prepared = np.zeros((len(crops), h, w, 3), np.uint8)
+        for i, crop in enumerate(crops):
+            ch, cw = crop.shape[:2]
+            new_w = min(max(int(round(cw * h / max(ch, 1))), 1), w)
+            prepared[i, :, :new_w] = cv2.resize(
+                crop, (new_w, h), interpolation=cv2.INTER_LINEAR
+            )
+        # Batch-bucket like recognize_crops: without padding to a static
+        # bucket every distinct crop count compiles a fresh XLA program.
+        # Padding rows are all-zero crops; their predictions are discarded.
+        probs = np.zeros((len(crops), 2), np.float32)
+        max_bb = max(self.spec.rec_batch_buckets)
+        for start in range(0, len(crops), max_bb):
+            chunk = prepared[start : start + max_bb]
+            bb = bucket_for(len(chunk), list(self.spec.rec_batch_buckets))
+            batch = np.zeros((bb, h, w, 3), np.uint8)
+            batch[: len(chunk)] = chunk
+            out = np.asarray(self._run_cls(self.cls_vars, batch))
+            probs[start : start + len(chunk)] = out[: len(chunk)]
+        # PaddleOCR semantics: rotate only when 180 wins the argmax AND
+        # clears cls_thresh — below it, leaving the crop alone is safer.
+        return [bool(p.argmax() == 1 and p[1] > self.spec.cls_thresh) for p in probs]
+
     # -- end-to-end -------------------------------------------------------
 
     def predict(
@@ -393,9 +484,10 @@ class OcrManager:
         rec_threshold: float | None = None,
         box_threshold: float | None = None,
         unclip_ratio: float | None = None,
+        use_angle_cls: bool = False,
     ) -> list[OcrResult]:
         """Full pipeline on raw image bytes (reference ``predict`` contract,
-        ``lumen_ocr/backends/base.py:63-136``)."""
+        ``lumen_ocr/backends/base.py:63-136``, including ``use_angle_cls``)."""
         img = decode_image_bytes(image_bytes, color="rgb")
         boxes = self.detect(
             img,
@@ -405,17 +497,31 @@ class OcrManager:
         )
         if not boxes:
             return []
-        return self.recognize_boxes(img, boxes, rec_threshold=rec_threshold)
+        return self.recognize_boxes(
+            img, boxes, rec_threshold=rec_threshold, use_angle_cls=use_angle_cls
+        )
 
     def recognize_boxes(
         self,
         img: np.ndarray,
         boxes: list[tuple[np.ndarray, float]],
         rec_threshold: float | None = None,
+        use_angle_cls: bool = False,
     ) -> list[OcrResult]:
         """Crop each detected quad, recognize, and apply the rec-confidence
         drop policy. Shared with the batch-ingest pipeline."""
         crops = [rotate_crop(img, quad) for quad, _ in boxes]
+        if use_angle_cls:
+            if self.has_angle_cls:
+                flips = self.classify_angles(crops)
+                crops = [
+                    np.ascontiguousarray(c[::-1, ::-1]) if f else c
+                    for c, f in zip(crops, flips)
+                ]
+            else:
+                # Contract says "if available" — absent model degrades to
+                # a no-op (exactly the reference's permanent behavior).
+                logger.debug("use_angle_cls requested but no cls model in %s", self.model_dir)
         texts = self.recognize_crops(crops)
         thr = self.spec.rec_threshold if rec_threshold is None else rec_threshold
         out: list[OcrResult] = []

@@ -49,11 +49,15 @@ class ConvBnAct(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # Explicit k//2 padding, not "SAME": identical for stride 1 (odd
+        # kernels) but torch-compatible at stride 2 — see the IResNet
+        # parity note in models/face/modeling.py (run_arch_parity.py).
+        p = self.kernel // 2
         x = nn.Conv(
             self.features,
             (self.kernel, self.kernel),
             strides=(self.stride, self.stride),
-            padding="SAME",
+            padding=((p, p), (p, p)),
             use_bias=False,
             name="conv",
             dtype=x.dtype,
@@ -208,3 +212,37 @@ class SVTRRecognizer(nn.Module):
         tokens = nn.LayerNorm(epsilon=c.eps, name="ln_out", dtype=tokens.dtype)(tokens)
         feat = tokens.reshape(b, h, w, d).mean(axis=1)  # pool height -> [B, T, d]
         return nn.Dense(c.vocab_size, name="ctc_head", dtype=feat.dtype)(feat)
+
+
+# -- textline orientation classifier ----------------------------------------
+
+
+@dataclass(frozen=True)
+class ClsConfig:
+    """PP-OCR ``cls`` model shape: 3x48x192 crops -> 2 classes (0, 180).
+    The reference declares the slot but never runs it (``lumen_ocr/
+    backends/onnxrt_backend.py:73`` keeps ``cls_sess = None``); here a
+    native Flax classifier (or a real ``cls*.onnx`` via the bridge) backs
+    the wire contract's ``use_angle_cls`` knob for real."""
+
+    height: int = 48
+    width: int = 192
+    channels: tuple[int, ...] = (16, 32, 64)
+
+    @classmethod
+    def tiny(cls) -> "ClsConfig":
+        return cls(height=32, width=64, channels=(8, 16))
+
+
+class TextlineClassifier(nn.Module):
+    """[B, H, W, 3] normalized crops -> [B, 2] orientation logits
+    (class 0 = upright, class 1 = rotated 180deg)."""
+
+    cfg: ClsConfig
+
+    @nn.compact
+    def __call__(self, x):
+        for i, c in enumerate(self.cfg.channels):
+            x = ConvBnAct(c, stride=2, name=f"conv{i}")(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        return nn.Dense(2, name="head", dtype=x.dtype)(x)

@@ -410,14 +410,18 @@ def _flash_blocks() -> tuple[int, int]:
     default 128x128): the bench's on-chip block sweep
     (``bench.py phase_flash_ab``) picks the winner per chip generation and
     deployments apply it without a code change."""
-    try:
-        bq = int(os.environ.get("LUMEN_FLASH_BLOCK_Q", 128))
-        bk = int(os.environ.get("LUMEN_FLASH_BLOCK_K", 128))
-    except ValueError:
-        return (128, 128)
-    # A tuning-knob typo (0, negative) must degrade, not crash the server:
-    # block sizes below one VPU sublane tile make no sense anyway.
-    return (max(16, bq), max(16, bk))
+    # Parsed independently: a typo in one variable must not discard a
+    # valid value in the other. A tuning-knob typo (0, negative, huge)
+    # must degrade, not crash the server — clamp to [16, 1024]; above
+    # 1024 the q x k tile alone exceeds VMEM on every current TPU.
+    def _one(name: str) -> int:
+        try:
+            v = int(os.environ.get(name, 128))
+        except ValueError:
+            return 128
+        return min(1024, max(16, v))
+
+    return (_one("LUMEN_FLASH_BLOCK_Q"), _one("LUMEN_FLASH_BLOCK_K"))
 
 
 def attention(

@@ -37,11 +37,15 @@ TRANSFORMER_TP_RULES: list[ShardingRule] = [
 # ``scale [out]``). Same Megatron layout as the kernel rules above — the
 # scale vector shards along the SAME output axis as its q matrix, and an
 # input-sharded projection's scale/bias stay replicated (their dim is the
-# unsharded output). int8 dot partials accumulate exactly in int32, so the
-# TP decode is token-identical to replicated int8 (pinned by
-# tests/test_serving_tp.py). lm_head q/scale replicate, matching the bf16
-# rules (no lm_head entry). Prepend to TRANSFORMER_TP_RULES so the shared
-# embedding rule still applies.
+# unsharded output). Token-identity of the TP decode vs replicated int8
+# depends on the kernel mode: with ``LUMEN_Q8_KERNEL=dynamic`` (W8A8,
+# int8 x int8 -> int32 dot) the sharded partials accumulate exactly in
+# int32, so identity is guaranteed; the default ``dequant`` mode does a
+# float dot where contraction-dim sharding reorders accumulation, so its
+# identity is empirical — pinned on a small CPU mesh by
+# tests/test_serving_tp.py, not a bit-exactness guarantee at scale.
+# lm_head q/scale replicate, matching the bf16 rules (no lm_head entry).
+# Prepend to TRANSFORMER_TP_RULES so the shared embedding rule applies.
 INT8_TP_RULES: list[ShardingRule] = [
     (r".*(q_proj|k_proj|v_proj|qkv|fc1|gate_proj|up_proj)/q$", P(None, "model")),
     (r".*(q_proj|k_proj|v_proj|qkv|fc1|gate_proj|up_proj)/(scale|bias)$", P("model")),

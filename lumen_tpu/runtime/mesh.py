@@ -79,7 +79,15 @@ def build_mesh(
     else:
         arr = mesh_utils.create_device_mesh(shape, devices=devices)
     mesh = Mesh(arr, names)
-    logger.info("mesh: %s over %d device(s)", dict(zip(names, shape)), len(devices))
+    # Log the device scope explicitly under multi-process JAX: the default
+    # is per-host (local) devices, and a cross-host program that meant to
+    # pass jax.devices() but didn't is diagnosable only from this line.
+    n_global = jax.device_count()
+    scope = "local" if len(devices) < n_global else "global"
+    logger.info(
+        "mesh: %s over %d device(s) (%s scope; %d devices globally)",
+        dict(zip(names, shape)), len(devices), scope, n_global,
+    )
     return mesh
 
 

@@ -91,6 +91,21 @@ class OcrService(BaseService):
                     kw[arg] = float(meta[meta_key])
                 except ValueError as e:
                     raise InvalidArgument(f"meta {meta_key!r} must be a number") from e
+        # Textline-orientation knob from the reference's wire contract
+        # (``lumen_ocr/backends/base.py:63-136``): boolean meta flag.
+        cls_key = first_meta_key(meta, "use_angle_cls", "ocr.use_angle_cls")
+        if cls_key is not None:
+            val = meta[cls_key].strip().lower()
+            if val in ("1", "true", "yes", "on"):
+                kw["use_angle_cls"] = True
+            elif val in ("0", "false", "no", "off", ""):
+                kw["use_angle_cls"] = False
+            else:
+                # Same loud-failure policy as the numeric knobs above: a
+                # typo'd flag must not silently serve reversed text.
+                raise InvalidArgument(
+                    f"meta {cls_key!r} must be a boolean (got {meta[cls_key]!r})"
+                )
         try:
             results = self.manager.predict(payload, **kw)
         except ValueError as e:

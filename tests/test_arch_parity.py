@@ -1,0 +1,101 @@
+"""Full-architecture checkpoint-fidelity gate (round-5 VERDICT item 1).
+
+Two tiers:
+
+1. Artifact gate (always on): the committed ``PARITY_r05.json`` must show
+   every family passing its parity bar. A converter/modeling change that
+   breaks real-checkpoint fidelity must re-run
+   ``python scripts/run_arch_parity.py`` and re-commit the artifact —
+   this test makes "forgot to re-verify" loud.
+2. Live re-execution (``LUMEN_ARCH_PARITY=1``): re-runs the fast families
+   (clip / face_rec / face_det / ocr) in-process. The 0.5B VLM family is
+   script-only (minutes of compile; its artifact record carries the
+   greedy-token transcript for inspection).
+
+Why stand-ins prove fidelity: each family builds the PUBLISHED model's
+exact architecture and serialized layout (HF CLIPModel ViT-B/32, torch
+IResNet-50 in InsightFace key layout, SCRFD det_10g output contract via
+real torch->ONNX export, DBNet-MobileNetV3 + SVTR at PP-OCR shapes,
+full-depth Qwen2-0.5B) with seeded random weights, then converts and
+executes through the same path a downloaded checkpoint takes. Parity is
+weight-value-independent — both sides run identical values — so only
+the download itself is untestable on this no-network host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "PARITY_r05.json")
+
+FAMILIES = ("clip", "face_rec", "face_det", "ocr", "vlm")
+
+run_live = pytest.mark.skipif(
+    not os.environ.get("LUMEN_ARCH_PARITY"),
+    reason="full-architecture re-execution is opt-in (LUMEN_ARCH_PARITY=1); "
+    "the artifact gate below always runs",
+)
+
+
+class TestParityArtifact:
+    def test_artifact_exists(self):
+        assert os.path.exists(ARTIFACT), (
+            "PARITY_r05.json missing; run scripts/run_arch_parity.py"
+        )
+
+    @pytest.mark.parametrize("family", FAMILIES)
+    def test_family_passed(self, family):
+        with open(ARTIFACT) as f:
+            records = json.load(f)["families"]
+        rec = records.get(family)
+        assert rec, f"family {family} absent from PARITY_r05.json"
+        assert "error" not in rec, f"{family} errored: {rec.get('error')}"
+        assert rec["pass"] is True, f"{family} failed its parity bar: {rec}"
+
+    def test_vlm_record_is_full_depth(self):
+        """The VLM record must be the real 0.5B architecture, not a tiny
+        stand-in: ~494M params, 24 layers in the architecture string."""
+        with open(ARTIFACT) as f:
+            rec = json.load(f)["families"]["vlm"]
+        assert rec["params"] > 400_000_000
+        assert "24L" in rec["architecture"]
+        assert rec["greedy_identical"] is True
+        assert rec["prefill_argmax_identical"] is True
+
+    def test_clip_record_is_vit_b32(self):
+        with open(ARTIFACT) as f:
+            rec = json.load(f)["families"]["clip"]
+        assert rec["params"] > 140_000_000  # ViT-B/32 CLIP is ~151M
+        assert rec["image_cosine_min"] > 0.999
+        assert rec["text_cosine_min"] > 0.999
+
+
+def _scripts():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import run_arch_parity
+
+    return run_arch_parity
+
+
+@run_live
+class TestParityLive:
+    def test_clip_vit_b32(self):
+        rec = _scripts().run_clip()
+        assert rec["pass"], rec
+
+    def test_iresnet50(self):
+        rec = _scripts().run_face_rec()
+        assert rec["pass"], rec
+
+    def test_scrfd_bridge(self, tmp_path):
+        rec = _scripts().run_face_det(str(tmp_path))
+        assert rec["pass"], rec
+
+    def test_ppocr_bridge(self, tmp_path):
+        rec = _scripts().run_ocr(str(tmp_path))
+        assert rec["pass"], rec

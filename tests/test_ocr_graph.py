@@ -218,3 +218,150 @@ class TestGraphPipeline:
         assert len(results) == 1
         assert "a" in results[0].text
         assert results[0].confidence > 0.5
+
+
+# -- textline orientation (use_angle_cls) ------------------------------------
+
+
+class TopBottomRec(nn.Module):
+    """Orientation-sensitive rec: class 1 ('a') fires when a column's TOP
+    half is bright and bottom dark; class 2 ('b') on the reverse; blank on
+    uniform columns. A 180deg flip turns 'a' crops into 'b' crops, so the
+    recognized string observes whether the cls flip was applied."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.conv = nn.Conv2d(3, vocab_size, kernel_size=(48, 8), stride=(48, 8))
+        with torch.no_grad():
+            self.conv.weight[:] = 0.0
+            self.conv.bias[:] = -10.0
+            w = 10.0 / (3 * 24 * 8)
+            self.conv.weight[1, :, :24, :] = w   # 'a': top bright...
+            self.conv.weight[1, :, 24:, :] = -w  # ...bottom dark
+            self.conv.weight[2] = -self.conv.weight[1]  # 'b': mirrored
+            self.conv.bias[0] = -3.0  # blank beats a/b on uniform columns
+            self.conv.bias[1] = 0.0
+            self.conv.bias[2] = 0.0
+        self.conv.weight.requires_grad_(False)
+
+    def forward(self, x):
+        f = self.conv(x * 2.0)
+        f = f.squeeze(2).permute(0, 2, 1)
+        return torch.softmax(20.0 * f, dim=-1)
+
+
+class TopHalfCls(nn.Module):
+    """PP-OCR cls contract: [B,3,H,W] -> [B,2] softmax over (0, 180).
+    Upright means the top half is brighter than the bottom half."""
+
+    def forward(self, x):
+        top = x[:, :, :24, :].mean(dim=(1, 2, 3))
+        bot = x[:, :, 24:, :].mean(dim=(1, 2, 3))
+        d = 20.0 * (top - bot)
+        return torch.softmax(torch.stack([d, -d], dim=-1), dim=-1)
+
+
+def make_cls_ocr_model_dir(tmp_path):
+    model_dir = tmp_path / "models" / "ClsOCR"
+    model_dir.mkdir(parents=True, exist_ok=True)
+    vocab_size = 1 + len(VOCAB_CHARS) + 1
+    export_onnx(
+        BrightnessDet(),
+        (torch.randn(1, 3, 64, 64),),
+        str(model_dir / "detection.fp32.onnx"),
+        input_names=["x"],
+        dynamic_axes={"x": {0: "b", 2: "h", 3: "w"}},
+    )
+    export_onnx(
+        TopBottomRec(vocab_size),
+        (torch.randn(1, 3, 48, 80),),
+        str(model_dir / "recognition.fp32.onnx"),
+        input_names=["x"],
+        dynamic_axes={"x": {0: "b", 3: "w"}},
+    )
+    export_onnx(
+        TopHalfCls(),
+        (torch.randn(1, 3, 48, 192),),
+        str(model_dir / "cls.fp32.onnx"),
+        input_names=["x"],
+        dynamic_axes={"x": {0: "b"}},
+    )
+    (model_dir / "ppocr_keys_v1.txt").write_text("\n".join(VOCAB_CHARS) + "\n")
+    info = {
+        "name": "ClsOCR",
+        "version": "1.0.0",
+        "description": "graph-backed ocr pack with angle classifier",
+        "model_type": "ocr",
+        "source": {"format": "custom", "repo_id": "LumilioPhotos/ClsOCR"},
+        "runtimes": {
+            "onnx": {
+                "available": True,
+                "files": [
+                    "detection.fp32.onnx",
+                    "recognition.fp32.onnx",
+                    "cls.fp32.onnx",
+                ],
+            }
+        },
+        "extra_metadata": {
+            "ocr": {
+                "det_buckets": [320],
+                "rec_threshold": 0.2,
+                "min_size": 2.0,
+            }
+        },
+    }
+    (model_dir / "model_info.json").write_text(json.dumps(info))
+    return str(model_dir)
+
+
+@pytest.fixture(scope="module")
+def cls_ocr_mgr(tmp_path_factory):
+    from lumen_tpu.models.ocr import OcrManager
+
+    model_dir = make_cls_ocr_model_dir(tmp_path_factory.mktemp("clsocr"))
+    mgr = OcrManager(model_dir, dtype="float32")
+    mgr.initialize()
+    yield mgr
+    mgr.close()
+
+
+def _upright_crop(w: int = 80) -> np.ndarray:
+    crop = np.zeros((48, w, 3), np.uint8)
+    crop[:24] = 255  # bright top half == upright
+    return crop
+
+
+class TestAngleCls:
+    def test_cls_model_discovered(self, cls_ocr_mgr):
+        assert cls_ocr_mgr.has_angle_cls
+
+    def test_classify_angles(self, cls_ocr_mgr):
+        up = _upright_crop()
+        down = np.ascontiguousarray(up[::-1, ::-1])
+        assert cls_ocr_mgr.classify_angles([up, down]) == [False, True]
+
+    def test_rec_observes_orientation(self, cls_ocr_mgr):
+        up = _upright_crop()
+        down = np.ascontiguousarray(up[::-1, ::-1])
+        [(t_up, _), (t_down, _)] = cls_ocr_mgr.recognize_crops([up, down])
+        assert t_up == "a"
+        assert t_down == "b"
+
+    def test_recognize_boxes_flips_when_enabled(self, cls_ocr_mgr):
+        img = np.ascontiguousarray(_upright_crop(160)[::-1, ::-1])  # 180deg page
+        quad = np.array([[0, 0], [159, 0], [159, 47], [0, 47]], np.float32)
+        boxes = [(quad, 1.0)]
+        plain = cls_ocr_mgr.recognize_boxes(img, boxes, use_angle_cls=False)
+        fixed = cls_ocr_mgr.recognize_boxes(img, boxes, use_angle_cls=True)
+        assert plain[0].text == "b"   # upside-down read as-is
+        assert fixed[0].text == "a"   # classifier flipped it upright
+
+    def test_absent_cls_degrades_to_noop(self, graph_ocr_mgr):
+        # The plain pack has no cls model: the knob is accepted and ignored
+        # (the reference's permanent behavior, ``onnxrt_backend.py:73``).
+        assert not graph_ocr_mgr.has_angle_cls
+        crop = np.full((48, 160, 3), 255, np.uint8)
+        quad = np.array([[0, 0], [159, 0], [159, 47], [0, 47]], np.float32)
+        out = graph_ocr_mgr.recognize_boxes(crop, [(quad, 1.0)], use_angle_cls=True)
+        assert out[0].text == "a"
