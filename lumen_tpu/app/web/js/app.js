@@ -8,6 +8,8 @@ import { renderHardware } from "./views/hardware.js";
 import { renderConfig } from "./views/config.js";
 import { renderInstall } from "./views/install.js";
 import { renderServer } from "./views/server.js";
+import { renderOpenPath } from "./views/openpath.js";
+import { renderSessionHub } from "./views/sessionhub.js";
 
 const VIEWS = {
   welcome: renderWelcome,
@@ -15,6 +17,9 @@ const VIEWS = {
   config: renderConfig,
   install: renderInstall,
   server: renderServer,
+  // aux routes outside the setup stepper (reference /open, /session)
+  openpath: renderOpenPath,
+  sessionhub: renderSessionHub,
 };
 
 const viewEl = document.getElementById("view");
@@ -56,12 +61,18 @@ function render() {
   // view
   viewEl.replaceChildren();
   VIEWS[wizard.step](viewEl, onLeave);
-  // nav
+  // nav — aux views (openpath/sessionhub) have no stepper index: Back
+  // walks their own chain (wizard.back), Next is hidden.
   const idx = wizard.stepIndex();
-  backBtn.disabled = idx === 0;
-  const last = idx === STEPS.length - 1;
-  nextBtn.style.visibility = last ? "hidden" : "visible";
-  nextBtn.disabled = !last && !wizard.canEnter(STEPS[idx + 1].id);
+  if (idx < 0) {
+    backBtn.disabled = false;
+    nextBtn.style.visibility = "hidden";
+  } else {
+    backBtn.disabled = idx === 0;
+    const last = idx === STEPS.length - 1;
+    nextBtn.style.visibility = last ? "hidden" : "visible";
+    nextBtn.disabled = !last && !wizard.canEnter(STEPS[idx + 1].id);
+  }
 }
 
 backBtn.onclick = () => wizard.back();

@@ -30,93 +30,25 @@ export function renderWelcome(root) {
       " ",
       resume ? el("button", { class: "btn ghost", id: "welcome-reset" }, "Start over") : "",
     ]),
-    // Reference OpenPath view: skip generation, run an existing YAML.
+    // Reference OpenPath / SessionHub entry: existing deployments go
+    // through their own first-class views (views/openpath.js ->
+    // views/sessionhub.js), not the setup stepper.
     el("div", { class: "card" }, [
       el("h3", {}, "Already have a config?"),
-      el("div", { class: "muted" }, "Load an existing lumen-config.yaml and jump straight to install/serve."),
+      el("div", { class: "muted" }, "Open an existing lumen-config.yaml — the session hub checks the deployment and routes to serve or install."),
       el("div", { class: "row" }, [
-        el("input", { id: "welcome-path", class: "input", placeholder: "/path/to/lumen-config.yaml", style: "flex:1" }),
-        el("button", { class: "btn", id: "welcome-open" }, "Open"),
+        el("button", { class: "btn", id: "welcome-open" }, "Open existing deployment →"),
       ]),
-      // Reference SessionHub: after opening, the recommendation card says
-      // whether this deployment can start as-is or needs the installer.
-      el("div", { id: "welcome-session" }),
     ])
   );
 
   root.querySelector("#welcome-start").onclick = () => wizard.next();
   const resetBtn = root.querySelector("#welcome-reset");
   if (resetBtn) resetBtn.onclick = () => wizard.reset();
-  root.querySelector("#welcome-open").onclick = async () => {
-    const path = root.querySelector("#welcome-path").value.trim();
-    if (!path) return toast("enter a config path", true);
-    try {
-      const out = await api.configLoad(path);
-      // Mark the prior steps complete so nav gating lets the operator
-      // jump ahead; the placeholder preset is never used for generation
-      // (the loaded YAML already carries the real settings). Stay ON the
-      // welcome view: the session card below recommends where to go
-      // (jumping immediately would unmount the card before it rendered).
-      wizard.update({
-        preset: wizard.state.preset || "(existing config)",
-        configGenerated: true,
-        configPath: out.path,
-      });
-      toast(`loaded ${out.path} (services: ${out.services.join(", ")})`);
-      renderSessionCard(root, out.path);
-    } catch (e) {
-      toast(e.message, true);
-    }
-  };
+  root.querySelector("#welcome-open").onclick = () => wizard.goto("openpath");
 
   // connectivity check so a dead control plane is obvious immediately
   api.health().catch((e) => toast(`control plane: ${e.message}`, true));
-}
-
-// SessionHub recommendation card: offline-checks the opened config's
-// models in the cache and routes — start the server as-is, or run the
-// installer for what's missing.
-async function renderSessionCard(root, configPath) {
-  const box = root.querySelector("#welcome-session");
-  if (!box) return;
-  box.replaceChildren(el("p", { class: "muted" }, "checking installed models…"));
-  let s;
-  try {
-    s = await api.sessionStatus(configPath);
-  } catch (e) {
-    box.replaceChildren(el("p", { class: "err-note" }, `could not check the deployment: ${e.message}`));
-    return;
-  }
-  if (!root.isConnected) return;
-  const go = (step, label) => {
-    const btn = el("button", { class: "btn primary" }, label);
-    btn.onclick = () => wizard.update({ step });
-    return btn;
-  };
-  if (s.ready_to_start) {
-    box.replaceChildren(
-      el("p", { class: "ok-note" }, `✓ ${s.message}`),
-      el("div", { class: "row" }, [go("server", "Go to Server →")])
-    );
-  } else {
-    box.replaceChildren(
-      el("p", { class: "warn-note" }, `⚠ ${s.message}`),
-      el(
-        "ul",
-        { class: "steplist" },
-        (s.models || [])
-          .filter((m) => !m.present)
-          .map((m) =>
-            el("li", { class: "failed" }, [
-              el("span", { class: "step-ico" }, "✕"),
-              `${m.service}/${m.alias}: ${m.model}`,
-              el("span", { class: "step-detail" }, m.error || "missing"),
-            ])
-          )
-      ),
-      el("div", { class: "row" }, [go("install", "Run install →")])
-    );
-  }
 }
 
 function feature(title, text) {

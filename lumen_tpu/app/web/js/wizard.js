@@ -13,6 +13,11 @@ export const STEPS = [
   { id: "server", title: "Server" },
 ];
 
+// Routes outside the linear setup flow (reference /open and /session,
+// web-ui/src/views/{OpenPath,SessionHub}.tsx): always enterable; Back
+// walks sessionhub -> openpath -> welcome.
+export const AUX_VIEWS = ["openpath", "sessionhub"];
+
 const DEFAULT_STATE = {
   step: "welcome",
   // hardware
@@ -39,7 +44,9 @@ function load() {
     const state = { ...DEFAULT_STATE, ...saved, hardware: null };
     // A step id from another version (or corruption) must not crash the
     // boot render — fall back to the first step.
-    if (!STEPS.some((s) => s.id === state.step)) state.step = "welcome";
+    if (!STEPS.some((s) => s.id === state.step) && !AUX_VIEWS.includes(state.step)) {
+      state.step = "welcome";
+    }
     return state;
   } catch {
     return { ...DEFAULT_STATE };
@@ -110,15 +117,17 @@ class Wizard {
   }
 
   goto(id) {
-    if (this.canEnter(id)) this.update({ step: id });
+    if (AUX_VIEWS.includes(id) || this.canEnter(id)) this.update({ step: id });
   }
 
   next() {
     const idx = this.stepIndex();
-    if (idx < STEPS.length - 1) this.goto(STEPS[idx + 1].id);
+    if (idx >= 0 && idx < STEPS.length - 1) this.goto(STEPS[idx + 1].id);
   }
 
   back() {
+    if (this.state.step === "sessionhub") return this.update({ step: "openpath" });
+    if (this.state.step === "openpath") return this.update({ step: "welcome" });
     const idx = this.stepIndex();
     if (idx > 0) this.update({ step: STEPS[idx - 1].id });
   }

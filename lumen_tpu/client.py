@@ -58,9 +58,19 @@ def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
 def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
            timeout: float, stream: bool = False):
     responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
+    chunks: dict[int, bytes] = {}
     for resp in responses:
         if resp.error.message:
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
+        if resp.total > 1:
+            # Chunked unary result (seq/total/offset on InferResponse):
+            # a single JSON payload split by the server's
+            # RESPONSE_CHUNK_BYTES — reassemble, never print raw.
+            chunks[resp.seq] = resp.result
+            if resp.is_final:
+                data = b"".join(chunks[i] for i in sorted(chunks))
+                return json.loads(data) if data else {}
+            continue
         if resp.is_final:
             return json.loads(resp.result) if resp.result else {}
         if stream and resp.result:

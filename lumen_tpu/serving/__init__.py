@@ -4,7 +4,13 @@ TPU-native counterpart of the reference's ``src/lumen`` hub package plus the
 per-package service scaffolding it duplicates.
 """
 
-from .base_service import BaseService, InvalidArgument, ServiceError, Unavailable
+from .base_service import (
+    BaseService,
+    InvalidArgument,
+    ServiceError,
+    Unavailable,
+    reassemble_result,
+)
 from .registry import TaskDefinition, TaskRegistry
 from .router import HubRouter
 
@@ -16,4 +22,5 @@ __all__ = [
     "TaskDefinition",
     "TaskRegistry",
     "HubRouter",
+    "reassemble_result",
 ]

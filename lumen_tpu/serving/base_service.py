@@ -19,6 +19,7 @@ chunks into one response, ``fastvlm_service.py:492-506``).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Iterator
@@ -32,6 +33,32 @@ from .proto.ml_service_pb2_grpc import InferenceServicer
 from .registry import TaskRegistry
 
 logger = logging.getLogger(__name__)
+
+
+def reassemble_result(responses) -> tuple[bytes, str, dict[str, str]]:
+    """Client-side inverse of the server's chunked unary response: join
+    ``seq``/``total``/``offset`` chunks back into (result, mime, meta).
+    Works on single-message responses too. Raises :class:`ServiceError`
+    on a wire error or an incomplete stream (missing chunks / cut short
+    before ``is_final``) — truncated bytes must never pass as a result."""
+    parts: dict[int, bytes] = {}
+    mime, meta = "", {}
+    total = 0
+    for r in responses:
+        # code 0 is ERROR_CODE_UNSPECIFIED but the field being SET at all
+        # means failure (matching the server's _error emission).
+        if r.HasField("error") and (r.error.code or r.error.message):
+            raise ServiceError(r.error.code, r.error.message, r.error.detail)
+        parts[r.seq] = r.result
+        total = max(total, r.total)
+        mime = r.result_mime or mime
+        meta = dict(r.meta) or meta
+    if total and len(parts) < total:
+        raise ServiceError(
+            0,
+            f"incomplete chunked response: {len(parts)} of {total} chunks",
+        )
+    return b"".join(parts[i] for i in sorted(parts)), mime, meta
 
 
 class ServiceError(Exception):
@@ -157,6 +184,28 @@ class BaseService(InferenceServicer):
             lat_ms = (time.perf_counter() - t0) * 1e3
             metrics.observe(asm.task, lat_ms)
             meta["lat_ms"] = f"{lat_ms:.2f}"
+            yield from self._chunked_response(cid, result, mime, meta)
+        else:
+            # Streaming handler: iterator of (bytes, mime, meta) chunks.
+            yield from self._stream_out(cid, asm.task, out, t0)
+
+    #: Split unary results larger than this into seq/total/offset chunks
+    #: (the proto carries the fields on InferResponse for exactly this,
+    #: reference ``ml_service.proto:60-73``). Must stay under the 64 MB
+    #: gRPC message cap (``server.GRPC_OPTIONS``) with protobuf headroom.
+    RESPONSE_CHUNK_BYTES = int(
+        os.environ.get("LUMEN_RESPONSE_CHUNK_BYTES", 48 * 1024 * 1024)
+    )
+
+    def _chunked_response(
+        self, cid: str, result: bytes, mime: str, meta: dict[str, str]
+    ) -> Iterator[pb.InferResponse]:
+        """One message when the result fits; otherwise seq/total/offset
+        chunks with ``is_final`` on the last. meta rides every chunk so a
+        client reading only the final message still sees it, and early
+        readers (progress UIs) see it too."""
+        size = self.RESPONSE_CHUNK_BYTES
+        if len(result) <= size:
             yield pb.InferResponse(
                 correlation_id=cid,
                 is_final=True,
@@ -166,9 +215,20 @@ class BaseService(InferenceServicer):
                 seq=0,
                 total=1,
             )
-        else:
-            # Streaming handler: iterator of (bytes, mime, meta) chunks.
-            yield from self._stream_out(cid, asm.task, out, t0)
+            return
+        n = (len(result) + size - 1) // size
+        for i in range(n):
+            off = i * size
+            yield pb.InferResponse(
+                correlation_id=cid,
+                is_final=(i == n - 1),
+                result=result[off : off + size],
+                meta=meta,
+                result_mime=mime,
+                seq=i,
+                total=n,
+                offset=off,
+            )
 
     def _stream_out(self, cid: str, task_name: str, chunks, t0: float) -> Iterator[pb.InferResponse]:
         seq = 0

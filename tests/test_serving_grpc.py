@@ -241,3 +241,67 @@ class TestMdnsPackets:
 
         tid, flags, qd, an, ns, ar = struct.unpack("!HHHHHH", pkt[:12])
         assert flags == 0x8400 and an == 4
+
+
+class TestChunkedResponses:
+    """Response-side seq/total/offset chunking (proto carries the fields
+    on InferResponse, reference ``ml_service.proto:60-73``; the reference
+    itself never splits results — it relies on the 64 MB cap)."""
+
+    def test_large_result_is_chunked(self, hub):
+        from lumen_tpu.serving import reassemble_result
+
+        stub, router = hub
+        svc = router.services["echo"]
+        old = svc.RESPONSE_CHUNK_BYTES
+        svc.RESPONSE_CHUNK_BYTES = 16  # instance override; class default untouched
+        try:
+            payload = bytes(range(256)) * 2  # 512 B -> 32 chunks
+            resps = list(stub.Infer(iter([one_request("echo_echo", payload=payload)])))
+        finally:
+            svc.RESPONSE_CHUNK_BYTES = old
+        assert len(resps) == 32
+        for i, r in enumerate(resps):
+            assert r.seq == i
+            assert r.total == 32
+            assert r.offset == i * 16
+            assert r.is_final == (i == 31)
+            assert r.result_mime  # mime rides every chunk
+            assert r.meta["echoed"] == "1"
+        data, mime, meta = reassemble_result(resps)
+        assert data == payload
+        assert meta["echoed"] == "1"
+
+    def test_small_result_single_message(self, hub):
+        from lumen_tpu.serving import reassemble_result
+
+        stub, _ = hub
+        resps = list(stub.Infer(iter([one_request("echo_echo", payload=b"hi")])))
+        assert len(resps) == 1
+        assert resps[0].seq == 0 and resps[0].total == 1 and resps[0].is_final
+        data, _, _ = reassemble_result(resps)
+        assert data == b"hi"
+
+    def test_reassemble_raises_on_wire_error(self, hub):
+        from lumen_tpu.serving import ServiceError, reassemble_result
+
+        stub, _ = hub
+        resps = list(stub.Infer(iter([one_request("echo_fail")])))
+        with pytest.raises(ServiceError):
+            reassemble_result(resps)
+
+    def test_reassemble_raises_on_incomplete_stream(self, hub):
+        from lumen_tpu.serving import ServiceError, reassemble_result
+
+        stub, router = hub
+        svc = router.services["echo"]
+        old = svc.RESPONSE_CHUNK_BYTES
+        svc.RESPONSE_CHUNK_BYTES = 16
+        try:
+            payload = bytes(64)
+            resps = list(stub.Infer(iter([one_request("echo_echo", payload=payload)])))
+        finally:
+            svc.RESPONSE_CHUNK_BYTES = old
+        assert len(resps) == 4
+        with pytest.raises(ServiceError, match="incomplete"):
+            reassemble_result(resps[:-1])  # stream cut short before is_final
