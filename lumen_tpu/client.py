@@ -57,19 +57,19 @@ def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
 
 def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
            timeout: float, stream: bool = False):
+    from lumen_tpu.serving import ServiceError, reassemble_result
+
     responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
-    chunks: dict[int, bytes] = {}
+    chunked: list = []
     for resp in responses:
         if resp.error.message:
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
-        if resp.total > 1:
+        if resp.total > 1 or chunked:
             # Chunked unary result (seq/total/offset on InferResponse):
-            # a single JSON payload split by the server's
-            # RESPONSE_CHUNK_BYTES — reassemble, never print raw.
-            chunks[resp.seq] = resp.result
-            if resp.is_final:
-                data = b"".join(chunks[i] for i in sorted(chunks))
-                return json.loads(data) if data else {}
+            # one JSON payload split by the server's RESPONSE_CHUNK_BYTES.
+            # reassemble_result joins AND enforces completeness — a stream
+            # cut short before is_final must error, not return {}.
+            chunked.append(resp)
             continue
         if resp.is_final:
             return json.loads(resp.result) if resp.result else {}
@@ -77,6 +77,12 @@ def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
             # Delta chunks are raw UTF-8 text (result_mime text/plain);
             # only the final response is JSON.
             print(resp.result.decode("utf-8", errors="replace"), end="", flush=True)
+    if chunked:
+        try:
+            data, _mime, _meta = reassemble_result(chunked)
+        except ServiceError as e:
+            raise SystemExit(f"server error [{e.code}]: {e}") from e
+        return json.loads(data) if data else {}
     return {}
 
 

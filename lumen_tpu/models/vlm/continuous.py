@@ -7,9 +7,11 @@ longest row finishes. This scheduler removes that cliff:
 
 - a fixed pool of ``slots`` decode rows advances together in ``block``-step
   compiled programs (``Generator._step_block_impl``);
-- new requests prefill at batch 1 and are ADMITTED into free slots between
-  blocks — they start decoding immediately next block, regardless of what
-  the other slots are doing or which prompt bucket they used;
+- new requests are ADMITTED into free slots between blocks — a burst of
+  same-shaped arrivals prefills as ONE batched forward (``ADMIT_BUCKETS``
+  groups, so admission cost under load is ~1 prefill per bucket, not one
+  per request) — and start decoding immediately next block, regardless of
+  what the other slots are doing;
 - rows retire on EOS / per-request cap without stopping the others.
 
 This is the slot half of TPU continuous batching (the "ragged batch" of
@@ -108,8 +110,12 @@ class ContinuousScheduler:
         self.pool = generator.init_pool(slots)
         # Decode sampling draws from one scheduler-level stream (sample()
         # takes a single key per batched step); entropy-seeded so sampled
-        # continuations differ across processes. Per-request keys seed each
-        # request's prefill sample.
+        # continuations differ across processes. An admission group's
+        # prefill sample is seeded from its FIRST request's key (one key
+        # per batched sample call — the same group-granular semantics as
+        # the coalescing batcher, which fuses mixed requests into one
+        # generate under one key). Greedy requests are unaffected; a
+        # sampled request's draw depends on its admission group.
         self._rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
         self._slots: dict[int, _Slot] = {}  # slot idx -> live request
         self._pending: list[_Request] = []
@@ -210,27 +216,33 @@ class ContinuousScheduler:
                     for req in admit:
                         _fail(req, err)
                     return
-                for pos, req in enumerate(admit):
+                live = []
+                for req in admit:
                     if req.cancelled:
                         # Stream consumer disconnected while queued: retire
                         # without wasting a prefill dispatch on a dead row.
                         _retire(req, [], eos=False)
-                        continue
+                    else:
+                        live.append(req)
+                groups = self._admit_groups(live)
+                for gpos, group in enumerate(groups):
                     try:
-                        self._admit(req)
-                    except Exception as e:  # noqa: BLE001 - fail ONE request
-                        _fail(req, e)
+                        self._admit_group(group)
+                    except Exception as e:  # noqa: BLE001 - fail ONE group
+                        for req in group:
+                            _fail(req, e)
                         if self._pool_invalid():
                             # The failure hit the donation-based _admit call
                             # after self.pool's buffers were consumed: the
                             # other slots' KV state is gone, so "fail one
-                            # request" is impossible — escalate to the
+                            # group" is impossible — escalate to the
                             # fail-everything handler below. That handler
                             # sweeps only _pending + _slots and this batch
                             # is already off _pending, so fail its
                             # unprocessed tail here first.
-                            for later in admit[pos + 1 :]:
-                                _fail(later, e)
+                            for later_group in groups[gpos + 1 :]:
+                                for req in later_group:
+                                    _fail(req, e)
                             raise RuntimeError(
                                 "slot pool invalidated by failed admission"
                             ) from e
@@ -260,23 +272,77 @@ class ContinuousScheduler:
                 return i
         raise RuntimeError("no free slot (scheduler bug: admission overran pool)")
 
-    def _admit(self, req: _Request) -> None:
+    #: Admission batch buckets: a burst of same-shaped arrivals prefills
+    #: as ONE batched forward instead of K sequential batch-1 forwards
+    #: (round-4 verdict: batch-1 admission serializes full-prompt prefills
+    #: between decode blocks and starves the slot pool under load).
+    #: Power-of-2 buckets bound the number of compiled prefill shapes.
+    ADMIT_BUCKETS = (1, 2, 4, 8)
+
+    def _admit_groups(self, reqs: list[_Request]) -> list[list[_Request]]:
+        """Split admissible requests into batched-prefill groups: same
+        (embeds len, prompt len) bucket, chunked to ADMIT_BUCKETS sizes."""
+        by_shape: dict[tuple, list[_Request]] = {}
+        for req in reqs:
+            by_shape.setdefault(req.key, []).append(req)
+        groups: list[list[_Request]] = []
+        cap = max(self.ADMIT_BUCKETS)
+        for group in by_shape.values():
+            while group:
+                # Largest bucket <= len(group), so a burst of 8 runs as one
+                # prefill and a straggler of 3 runs as 2 + 1, not 8-padded.
+                k = max(b for b in self.ADMIT_BUCKETS if b <= min(len(group), cap))
+                groups.append(group[:k])
+                group = group[k:]
+        return groups
+
+    def _admit_group(self, reqs: list[_Request]) -> None:
+        """One batched prefill for the group, then per-row slot admission.
+        The group shares one sampling key (same semantics as the
+        coalescing batcher, which fuses mixed requests into one generate
+        with one key); per-request generation params stay per-row."""
         import jax.numpy as jnp
 
-        slot = self._free_slot()
-        sub = jax.random.fold_in(req.rng, 0)
-        caches1, tok0, seen1 = self.gen._prefill(
-            self.params, req.embeds, req.positions, req.length, req.prompt_ids, sub,
-            jnp.float32(req.temperature), jnp.float32(req.top_p),
-            jnp.asarray(req.do_sample), jnp.float32(req.repetition_penalty),
+        k = len(reqs)
+        sub = jax.random.fold_in(reqs[0].rng, 0)
+        if k == 1:
+            req = reqs[0]
+            embeds, positions = req.embeds, req.positions
+            lengths, prompt_ids = req.length, req.prompt_ids
+        else:
+            embeds = jnp.concatenate([r.embeds for r in reqs], axis=0)
+            positions = jnp.concatenate([r.positions for r in reqs], axis=0)
+            lengths = jnp.concatenate([r.length for r in reqs], axis=0)
+            prompt_ids = jnp.concatenate([r.prompt_ids for r in reqs], axis=0)
+        # Right-size the admission prefill cache to the PROMPT span only:
+        # decode happens in the pool's full-size per-slot cache, so the
+        # prefill buffer never needs max_seq. Without this, a burst of 8
+        # would transiently allocate a second pool-sized KV buffer
+        # (8 x max_seq) — an OOM spike on exactly the load batched
+        # admission exists for.
+        kv_len = next(
+            (b for b in self.gen.seq_buckets if b >= embeds.shape[1]),
+            self.gen.max_seq,
         )
-        self.pool = self.gen._admit(
-            self.pool, slot, caches1, tok0, seen1, req.length,
-            req.max_new, req.temperature, req.top_p, req.do_sample,
-            req.repetition_penalty,
+        caches, tok0, seen = self.gen._prefill(
+            self.params, embeds, positions, lengths, prompt_ids, sub,
+            jnp.asarray([r.temperature for r in reqs], jnp.float32),
+            jnp.asarray([r.top_p for r in reqs], jnp.float32),
+            jnp.asarray([r.do_sample for r in reqs]),
+            jnp.asarray([r.repetition_penalty for r in reqs], jnp.float32),
+            kv_len=kv_len,
         )
-        self._slots[slot] = _Slot(request=req)
-        self.admitted += 1
+        for i, req in enumerate(reqs):
+            slot = self._free_slot()
+            row = slice(i, i + 1)
+            caches1 = jax.tree.map(lambda c, r=row: c[r], caches)
+            self.pool = self.gen._admit(
+                self.pool, slot, caches1, tok0[row], seen[row], lengths[row],
+                req.max_new, req.temperature, req.top_p, req.do_sample,
+                req.repetition_penalty,
+            )
+            self._slots[slot] = _Slot(request=req)
+            self.admitted += 1
 
     def _run_block(self) -> None:
         cancelled = [

@@ -50,14 +50,25 @@ class Generator:
         max_seq: int = 2048,
         max_new_cap: int = 512,
         cache_dtype=jnp.bfloat16,
+        seq_buckets: tuple[int, ...] | None = None,
     ):
         self.model = model
         self.cfg = cfg
         self.max_seq = max_seq
         self.max_new_cap = max_new_cap
         self.cache_dtype = cache_dtype
-        self._generate = jax.jit(self._generate_impl)
-        self._prefill = jax.jit(self._prefill_impl)
+        # KV right-sizing (round-4 verdict): the fused path allocates its
+        # cache at the smallest bucket >= prompt + budget instead of
+        # worst-case max_seq — a 32-token caption request in a
+        # max_seq=2048 deployment gets a 32x smaller KV buffer AND a
+        # proportionally cheaper decode attention. One compiled program
+        # per bucket actually used.
+        buckets = sorted(set(b for b in (seq_buckets or ()) if b <= max_seq))
+        if not buckets or buckets[-1] != max_seq:
+            buckets.append(max_seq)
+        self.seq_buckets = tuple(buckets)
+        self._generate = jax.jit(self._generate_impl, static_argnames=("kv_len",))
+        self._prefill = jax.jit(self._prefill_impl, static_argnames=("kv_len",))
         self._step = jax.jit(self._step_impl)
         # The continuous pool (slots x max_seq KV) is the dominant buffer;
         # donating it lets XLA update in place instead of holding two
@@ -97,9 +108,9 @@ class Generator:
         logits = apply_repetition_penalty(logits, seen, rep_penalty)
         return sample(rng, logits, temperature, top_p, do_sample)
 
-    def _prefill_core(self, params, embeds, positions, lengths):
+    def _prefill_core(self, params, embeds, positions, lengths, kv_len: int | None = None):
         b = embeds.shape[0]
-        caches = init_kv_cache(self.cfg, b, self.max_seq, self.cache_dtype)
+        caches = init_kv_cache(self.cfg, b, kv_len or self.max_seq, self.cache_dtype)
         logits, caches = self._decode(
             params, embeds, positions, caches, jnp.zeros((), jnp.int32), lengths
         )
@@ -121,10 +132,11 @@ class Generator:
         top_p,
         do_sample,
         repetition_penalty,
+        kv_len: int | None = None,  # static: KV bucket (defaults to max_seq)
     ):
         cfg = self.cfg
         b = embeds.shape[0]
-        caches, last_logits = self._prefill_core(params, embeds, positions, lengths)
+        caches, last_logits = self._prefill_core(params, embeds, positions, lengths, kv_len)
         seen = self._seen_from_prompt(prompt_ids, lengths)
         rng, sub = jax.random.split(rng)
         tok0 = self._sample_next(
@@ -211,6 +223,12 @@ class Generator:
         configs — the capability the reference's one-request-at-a-time
         backend lacks, ``onnxrt_backend.py:298-356``)."""
         cap = np.minimum(np.asarray(max_new_tokens, np.int32), self.max_new_cap)
+        # KV bucket: smallest configured size covering prompt + budget.
+        # embeds may be right-padded past the live length, and the decode
+        # loop indexes the cache at cur_len positions that started from
+        # lengths — the bucket must cover the PADDED prompt span.
+        need = int(embeds.shape[1]) + int(np.max(cap))
+        kv_len = next((b for b in self.seq_buckets if b >= need), self.max_seq)
         buf, n_gen, eos = self._generate(
             params,
             embeds,
@@ -223,6 +241,7 @@ class Generator:
             jnp.asarray(top_p, jnp.float32),
             jnp.asarray(do_sample, bool),
             jnp.asarray(repetition_penalty, jnp.float32),
+            kv_len=kv_len,
         )
         return GenerateOutput(tokens=buf, n_generated=n_gen, stopped_eos=eos)
 
@@ -231,8 +250,13 @@ class Generator:
     def _prefill_impl(
         self, params, embeds, positions, lengths, prompt_ids, rng,
         temperature, top_p, do_sample, repetition_penalty,
+        kv_len: int | None = None,  # static KV bucket; None = max_seq.
+        # The streaming path decodes INTO this cache, so it must keep the
+        # full max_seq; continuous admission only needs the prompt span
+        # (decode happens in the pool's own full-size cache) and passes
+        # the smallest bucket covering the prompt.
     ):
-        caches, last_logits = self._prefill_core(params, embeds, positions, lengths)
+        caches, last_logits = self._prefill_core(params, embeds, positions, lengths, kv_len)
         seen = self._seen_from_prompt(prompt_ids, lengths)
         tok0 = self._sample_next(
             rng, last_logits, seen, temperature, top_p, do_sample, repetition_penalty

@@ -453,8 +453,18 @@ class VLMManager:
                 f"(+{v} vision tokens, +{self.max_new_cap} decode budget)"
             )
         compute = self.policy.compute_dtype
+        # One KV bucket per prompt bucket (merged length + decode budget,
+        # rounded up to 64): a short caption request allocates a cache
+        # sized for ITS prompt bucket, not worst-case max_seq — the KV
+        # right-sizing half of the memory story (the continuous pool is
+        # fixed-size by design; this covers the fused/coalescing path).
+        seq_buckets = tuple(
+            min(self.max_seq, -((b - 1 + v + self.max_new_cap + 1) // -64) * 64)
+            for b in self.prefill_buckets
+        )
         self.generator = Generator(
-            self.model, self.cfg, self.max_seq, self.max_new_cap, cache_dtype=compute
+            self.model, self.cfg, self.max_seq, self.max_new_cap,
+            cache_dtype=compute, seq_buckets=seq_buckets,
         )
 
         vis_cfg = self.cfg.vision

@@ -245,3 +245,71 @@ class TestBatchedGeneration:
         mgr.close()
         with pytest.raises(RuntimeError):
             mgr.generate([ChatMessage(role="user", content="hi")], max_new_tokens=1)
+
+
+class TestKvRightSizing:
+    """The fused path allocates its KV cache at the smallest seq bucket
+    covering prompt + budget, not worst-case max_seq (round-4 verdict:
+    worst-case per-slot KV blocks scaling batch/slots)."""
+
+    def test_bucket_selection(self):
+        import jax.numpy as jnp
+
+        from lumen_tpu.models.vlm.generate import Generator
+        from lumen_tpu.models.vlm.modeling import VLMConfig, VLMModel
+
+        cfg = VLMConfig.tiny()
+        gen = Generator(
+            VLMModel(cfg), cfg, max_seq=512, max_new_cap=16,
+            cache_dtype=jnp.float32, seq_buckets=(64, 128),
+        )
+        assert gen.seq_buckets == (64, 128, 512)
+
+    def test_small_request_uses_small_cache_same_tokens(self):
+        """Same request through seq_buckets=(64,) vs max_seq-only -> same
+        tokens, and the bucketed path's cache is provably smaller (watch
+        the kv_len the compiled call receives)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from lumen_tpu.models.vlm.generate import Generator
+        from lumen_tpu.models.vlm.modeling import VLMConfig, VLMModel
+
+        cfg = VLMConfig.tiny()
+        model = VLMModel(cfg)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32),
+            jnp.zeros((1, cfg.vision.image_size, cfg.vision.image_size, 3)),
+        )["params"]
+
+        rng = np.random.RandomState(3)
+        ids = rng.randint(3, 200, size=(1, 12)).astype(np.int32)
+
+        def run(gen):
+            embeds = model.apply({"params": params}, jnp.asarray(ids), method=VLMModel.embed_tokens)
+            positions = jnp.broadcast_to(jnp.arange(12), (1, 12))
+            out = gen.generate(
+                params, embeds, positions, jnp.asarray([12], jnp.int32),
+                jnp.asarray(ids), jax.random.PRNGKey(0), max_new_tokens=8,
+            )
+            n = int(out.n_generated[0])
+            return [int(t) for t in np.asarray(out.tokens[0][:n])]
+
+        big = Generator(model, cfg, max_seq=512, max_new_cap=16, cache_dtype=jnp.float32)
+        small = Generator(
+            model, cfg, max_seq=512, max_new_cap=16, cache_dtype=jnp.float32,
+            seq_buckets=(64,),
+        )
+        # capture the kv_len actually passed to the compiled program
+        seen_kv = []
+        orig = small._generate
+
+        def spy(*a, **kw):
+            seen_kv.append(kw.get("kv_len"))
+            return orig(*a, **kw)
+
+        small._generate = spy
+        assert run(big) == run(small)
+        assert seen_kv == [64]

@@ -279,3 +279,65 @@ class TestPoolInvalidationEscalation:
                 sched.submit(make_req())
         finally:
             mgr.close()
+
+
+class TestBatchedAdmission:
+    """A burst of same-bucket arrivals admits via batched prefills
+    (ADMIT_BUCKETS), not one batch-1 prefill per request (round-4 verdict:
+    serialized admission starves the slot pool under load)."""
+
+    def test_burst_prefill_count_and_parity(self, model_dir):
+        mgr = VLMManager(
+            model_dir,
+            dtype="float32",
+            max_seq=128,
+            max_new_cap=16,
+            prefill_buckets=(16,),
+            scheduler="continuous",
+            gen_slots=8,
+            gen_block=4,
+        )
+        mgr.initialize()
+        try:
+            sched = mgr._continuous
+            prompts = [f"prompt number {i}" for i in range(8)]
+            serial = [
+                mgr.generate([ChatMessage(role="user", content=p)], max_new_tokens=6)
+                for p in prompts
+            ]
+
+            calls = []
+            real_prefill = sched.gen._prefill
+
+            def counting_prefill(params, embeds, *a, **kw):
+                calls.append(int(embeds.shape[0]))
+                return real_prefill(params, embeds, *a, **kw)
+
+            sched.gen._prefill = counting_prefill
+            try:
+                # Build all 8 requests up front and enqueue them under the
+                # scheduler lock with ONE notify: the backlog is fully
+                # formed before the scheduler thread wakes, so grouping is
+                # deterministic (submitting from threads would race the
+                # admit loop and flake on slow machines).
+                reqs = []
+                for p in prompts:
+                    e, pos, ln, ids, _n = mgr._prepare_inputs(
+                        [ChatMessage(role="user", content=p)], None, True
+                    )
+                    reqs.append(mgr._make_gen_request(e, pos, ln, ids, 6, 0.0, 1.0, False, 1.0))
+                with sched._cond:
+                    sched._pending.extend(reqs)
+                    sched._cond.notify()
+                results = [r.future.result(timeout=120) for r in reqs]
+            finally:
+                sched.gen._prefill = real_prefill
+
+            for i, want in enumerate(serial):
+                tokens, n_gen, _eos = results[i]
+                assert [int(t) for t in tokens[:n_gen]] == want.tokens, (i, want.text)
+            # 8 same-bucket requests, fully backlogged, 8 free slots ->
+            # exactly one ADMIT_BUCKETS group of 8, one batched prefill.
+            assert calls == [8], calls
+        finally:
+            mgr.close()
