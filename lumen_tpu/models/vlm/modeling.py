@@ -495,15 +495,12 @@ class VisionEncoder(nn.Module):
     @nn.compact
     def __call__(self, pixel_values: jax.Array) -> jax.Array:
         v = self.cfg.vision
-        x = nn.Conv(
-            v.width,
-            kernel_size=(v.patch_size, v.patch_size),
-            strides=(v.patch_size, v.patch_size),
-            name="patch_embed",
-            dtype=pixel_values.dtype,
-        )(pixel_values)
+        from ..clip.modeling import PatchEmbed  # reshape+matmul, MXU-shaped
+
+        x = PatchEmbed(v.width, v.patch_size, use_bias=True, name="patch_embed")(
+            pixel_values
+        )
         b = x.shape[0]
-        x = x.reshape(b, -1, v.width)
         pos = self.param("position_embedding", nn.initializers.normal(0.02), (v.num_tokens, v.width))
         x = x + pos.astype(x.dtype)
         from ..clip.modeling import Block  # same pre-LN transformer block

@@ -29,22 +29,113 @@ import jax  # noqa: E402
 if not _ON_CHIP:
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache shared across the whole suite and across
+# runs (round-4 verdict item 8: >10 min of repeated CPU compiles).
+# XLA:CPU AOT-loads cached executables; the loader logs noisy E-level
+# warnings about the two `prefer-no-*` pseudo-features not appearing in
+# host detection — same machine, benign. Opt out with
+# LUMEN_TEST_NO_COMPILE_CACHE=1 if a cache entry is ever suspect.
+if not os.environ.get("LUMEN_TEST_NO_COMPILE_CACHE"):
+    _cache_dir = os.environ.get(
+        "LUMEN_TEST_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "lumen_tpu_test_xla"),
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 # Repo root on sys.path so `import lumen_tpu` works without installation.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 
+# Compile-heavy tests (>~15s each on this 1-core host, measured full-suite
+# run 2026-08-01: 511 tests, 13:47 hot-cache) are auto-marked ``slow`` so
+# the default verification tier — ``pytest -m "not slow" tests/`` — stays
+# under 3 minutes (round-4 verdict item 8). Everything here still runs in
+# the full suite (plain ``pytest tests/``) and nothing it covers is
+# default-tier-only: each entry's fast counterpart is noted.
+_SLOW = (
+    # full-size torch-parity forwards; arch-level parity is gated by
+    # tests/test_arch_parity.py's artifact checks (fast)
+    "test_clip.py::TestTorchParity",
+    "test_clip.py::TestMeshServing",
+    "test_clip_cn.py::TestChineseClipParity",
+    # hypothesis property sweeps; example-based oracles run in test_parallel
+    "test_parallel_props.py",
+    # multi-step browserless UI flows; asset/module checks stay default
+    "test_web.py::TestWizardFlow",
+    "test_web.py::TestConfigYamlEditing",
+    "test_app.py::TestHardwareApi::test_detect_reports_preset",
+    "test_app.py::TestServerManagerApi",
+    # full-res / full-pipeline model forwards; bucket-sized paths stay
+    "test_face.py::TestDecodeMath::test_decode_detections_shapes",
+    "test_ocr.py::TestModeling::test_dbnet_full_res_prob_map",
+    "test_training.py",
+    "test_multihost.py",
+    "test_soak_grpc.py",
+    "test_ingest_cli.py",
+    "test_parallel.py::TestLogitScaleClamp",
+    "test_parallel.py::TestMoE",
+    # MoE sharded-forward coverage also lives in the driver's
+    # dryrun_multichip gate, which exercises ep rules every round
+    "test_parallel.py::TestMoEModelSharding",
+    "test_serving_tp.py::TestVlmTensorParallelInt8",
+    "test_serving_tp.py::TestVlmExpertParallel",
+    "test_vlm_quant.py::TestQuantServing",
+    # second pass (hot-cache tier profile, 4:42 -> target <3:00): heavy
+    # manager fixtures and full-model parity forwards; each family keeps
+    # a fast graph/service smoke in the default tier
+    "test_clip.py::TestManager",
+    "test_ocr.py::TestManager",
+    "test_pipeline.py::TestPhotoCaptioning",
+    "test_face.py::TestIResNet",
+    "test_face.py::TestManagerPipeline",
+    "test_vlm.py::TestGenerate",
+    "test_vlm.py::TestDecodeParity",
+    "test_golden.py::TestFaceDecodeGolden",
+    "test_vlm_continuous.py::TestBatchedAdmission",
+    "test_face_graph.py::TestGraphFacePipeline::test_decode_golden_parity_vs_numpy_reference",
+    "test_parallel.py::TestUlyssesAttention",
+    "test_parallel.py::TestRingAttention",
+    "test_parallel.py::TestPipelineParallel",
+    "test_vlm_quant.py::TestUntiedLmHead",
+    "test_vlm_moe.py",
+    "test_app.py::TestInstallOrchestrator",
+    "test_app.py::TestRestParityEndpoints",
+)
+
+
 def pytest_collection_modifyitems(config, items):
-    """On-chip sessions run ONLY the @pytest.mark.tpu subset: everything
-    else was recorded/toleranced for CPU numerics (golden fixtures, exact
-    NMS masks) and would fail spuriously on TPU matmul precision — skip it
-    rather than let `LUMEN_TPU_TESTS=1 pytest tests/` look like regressions."""
-    if not _ON_CHIP:
-        return
+    """Two jobs: (1) on-chip sessions run ONLY the @pytest.mark.tpu subset
+    — everything else was recorded/toleranced for CPU numerics (golden
+    fixtures, exact NMS masks) and would fail spuriously on TPU matmul
+    precision; (2) off-chip, auto-mark the ``_SLOW`` list so the default
+    tier (``-m "not slow"``) stays fast."""
     import pytest
 
-    skip = pytest.mark.skip(reason="LUMEN_TPU_TESTS=1 runs only -m tpu tests")
+    if _ON_CHIP:
+        skip = pytest.mark.skip(reason="LUMEN_TPU_TESTS=1 runs only -m tpu tests")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+        return
+    slow = pytest.mark.slow
+    matched = set()
     for item in items:
-        if "tpu" not in item.keywords:
-            item.add_marker(skip)
+        nodeid = item.nodeid.split("tests/")[-1]
+        for pat in _SLOW:
+            # Segment-exact: "TestMoE" must not also catch
+            # "TestMoEModelSharding" (prefix matching silently dropped the
+            # fast MoE sharding coverage from the default tier).
+            if nodeid == pat or nodeid.startswith(pat + "::"):
+                item.add_marker(slow)
+                matched.add(pat)
+                break
+    # A stale pattern (renamed/deleted test) must fail collection loudly,
+    # not silently stop tiering anything. Guard only full runs: a file- or
+    # node-scoped invocation legitimately collects a subset.
+    unmatched = set(_SLOW) - matched
+    if len(items) > 400 and unmatched:
+        raise pytest.UsageError(f"stale _SLOW patterns in conftest: {sorted(unmatched)}")
