@@ -61,18 +61,22 @@ def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
 
     responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
     chunked: list = []
+    saw_deltas = False
     for resp in responses:
         if resp.error.message:
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
-        if resp.total > 1 or chunked:
-            # Chunked unary result (seq/total/offset on InferResponse):
-            # one JSON payload split by the server's RESPONSE_CHUNK_BYTES.
+        # Disambiguate the two total>1 shapes on the wire: a STREAMING
+        # final message also carries total=n_deltas+1, but its deltas
+        # arrived first with total=0 — only a result split by the
+        # server's RESPONSE_CHUNK_BYTES starts chunked (total>1, seq 0).
+        if (resp.total > 1 and not saw_deltas) or chunked:
             # reassemble_result joins AND enforces completeness — a stream
             # cut short before is_final must error, not return {}.
             chunked.append(resp)
             continue
         if resp.is_final:
             return json.loads(resp.result) if resp.result else {}
+        saw_deltas = True
         if stream and resp.result:
             # Delta chunks are raw UTF-8 text (result_mime text/plain);
             # only the final response is JSON.

@@ -332,17 +332,36 @@ class ContinuousScheduler:
             jnp.asarray([r.repetition_penalty for r in reqs], jnp.float32),
             kv_len=kv_len,
         )
-        for i, req in enumerate(reqs):
-            slot = self._free_slot()
-            row = slice(i, i + 1)
-            caches1 = jax.tree.map(lambda c, r=row: c[r], caches)
-            self.pool = self.gen._admit(
-                self.pool, slot, caches1, tok0[row], seen[row], lengths[row],
-                req.max_new, req.temperature, req.top_p, req.do_sample,
-                req.repetition_penalty,
-            )
-            self._slots[slot] = _Slot(request=req)
-            self.admitted += 1
+        group_slots: list[int] = []
+        try:
+            for i, req in enumerate(reqs):
+                slot = self._free_slot()
+                row = slice(i, i + 1)
+                caches1 = jax.tree.map(lambda c, r=row: c[r], caches)
+                self.pool = self.gen._admit(
+                    self.pool, slot, caches1, tok0[row], seen[row], lengths[row],
+                    req.max_new, req.temperature, req.top_p, req.do_sample,
+                    req.repetition_penalty,
+                )
+                self._slots[slot] = _Slot(request=req)
+                group_slots.append(slot)
+                self.admitted += 1
+        except Exception:
+            # Mid-group failure with earlier rows already admitted: the
+            # caller fails EVERY request in the group, so rows already in
+            # _slots must be evicted too — otherwise they keep decoding to
+            # max_new for futures that already errored, burning slots. If
+            # the pool was invalidated (donation consumed), skip the
+            # device write; the caller escalates to fail-everything.
+            if group_slots and not self._pool_invalid():
+                import jax.numpy as jnp
+
+                idx = jnp.asarray(group_slots, jnp.int32)
+                self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
+            with self._cond:
+                for slot in group_slots:
+                    self._slots.pop(slot, None)
+            raise
 
     def _run_block(self) -> None:
         cancelled = [

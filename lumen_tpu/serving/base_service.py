@@ -35,6 +35,16 @@ from .registry import TaskRegistry
 logger = logging.getLogger(__name__)
 
 
+def _response_chunk_bytes() -> int:
+    """LUMEN_RESPONSE_CHUNK_BYTES, clamped to [1 MB, 60 MB]; malformed
+    values fall back to the 48 MB default (degrade, not crash)."""
+    try:
+        v = int(os.environ.get("LUMEN_RESPONSE_CHUNK_BYTES", 48 * 1024 * 1024))
+    except ValueError:
+        return 48 * 1024 * 1024
+    return min(60 * 1024 * 1024, max(1 << 20, v))
+
+
 def reassemble_result(responses) -> tuple[bytes, str, dict[str, str]]:
     """Client-side inverse of the server's chunked unary response: join
     ``seq``/``total``/``offset`` chunks back into (result, mime, meta).
@@ -191,11 +201,11 @@ class BaseService(InferenceServicer):
 
     #: Split unary results larger than this into seq/total/offset chunks
     #: (the proto carries the fields on InferResponse for exactly this,
-    #: reference ``ml_service.proto:60-73``). Must stay under the 64 MB
-    #: gRPC message cap (``server.GRPC_OPTIONS``) with protobuf headroom.
-    RESPONSE_CHUNK_BYTES = int(
-        os.environ.get("LUMEN_RESPONSE_CHUNK_BYTES", 48 * 1024 * 1024)
-    )
+    #: reference ``ml_service.proto:60-73``). Clamped under the 64 MB
+    #: gRPC message cap (``server.GRPC_OPTIONS``) with protobuf headroom;
+    #: a malformed override degrades to the default instead of crashing
+    #: the import (same policy as LUMEN_FLASH_BLOCK_Q/K).
+    RESPONSE_CHUNK_BYTES = _response_chunk_bytes()
 
     def _chunked_response(
         self, cid: str, result: bytes, mime: str, meta: dict[str, str]

@@ -302,3 +302,67 @@ class TestOcrServiceGrpc:
         cap = stub.GetCapabilities(empty_pb2.Empty())
         names = [t.name for t in cap.tasks]
         assert "ocr" in names
+
+
+class TestNativeAngleCls:
+    """Native-checkpoint (Flax) route of the textline-orientation
+    classifier: discovery, batched-call shape, threshold semantics. The
+    upright-vs-flipped decision quality is covered by the ONNX-graph
+    route in test_ocr_graph.py (crafted weights); this pins the
+    classification.safetensors loading path."""
+
+    @pytest.fixture()
+    def cls_mgr(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        from lumen_tpu.models.ocr import ClsConfig, OcrManager, TextlineClassifier, flatten_variables
+
+        model_dir = make_ocr_model_dir(tmp_path)
+        cls_cfg = ClsConfig.tiny()
+        cls_vars = TextlineClassifier(cls_cfg).init(
+            jax.random.PRNGKey(2), jnp.zeros((1, cls_cfg.height, cls_cfg.width, 3))
+        )
+        import os
+        save_file(
+            flatten_variables(dict(cls_vars)),
+            os.path.join(model_dir, "classification.safetensors"),
+        )
+        info_path = os.path.join(model_dir, "model_info.json")
+        info = json.loads(open(info_path).read())
+        info["extra_metadata"]["classifier"] = {
+            "height": cls_cfg.height, "width": cls_cfg.width,
+            "channels": list(cls_cfg.channels),
+        }
+        open(info_path, "w").write(json.dumps(info))
+        mgr = OcrManager(model_dir, dtype="float32")
+        mgr.initialize()
+        yield mgr
+        mgr.close()
+
+    def test_discovered_and_deterministic(self, cls_mgr):
+        assert cls_mgr.has_angle_cls
+        rng = np.random.RandomState(0)
+        crops = [rng.randint(0, 255, (20, 60, 3), np.uint8) for _ in range(3)]
+        a = cls_mgr.classify_angles(crops)
+        b = cls_mgr.classify_angles(crops)
+        assert a == b
+        assert len(a) == 3 and all(isinstance(x, bool) for x in a)
+
+    def test_threshold_gates_flips(self, cls_mgr):
+        # cls_thresh above any softmax prob -> never flip, whatever the
+        # random weights say (PaddleOCR semantics: below-threshold 180
+        # predictions leave the crop alone).
+        cls_mgr.spec.cls_thresh = 1.1
+        rng = np.random.RandomState(1)
+        crops = [rng.randint(0, 255, (20, 60, 3), np.uint8) for _ in range(4)]
+        assert cls_mgr.classify_angles(crops) == [False] * 4
+
+    def test_absent_without_checkpoint(self, tmp_path):
+        from lumen_tpu.models.ocr import OcrManager
+
+        mgr = OcrManager(make_ocr_model_dir(tmp_path), dtype="float32")
+        mgr.initialize()
+        try:
+            assert not mgr.has_angle_cls
+        finally:
+            mgr.close()
