@@ -94,6 +94,7 @@ PHASE_EST_S = {
     # small compiles, see _clip_breakdown).
     "clip": 480,
     "flash_ab": 180,
+    "clip_q8": 300,
     "vlm": 420,
     "vlm_q8": 360,
     "face": 300,
@@ -844,6 +845,76 @@ def phase_ocr(det_batch: int = 8, rec_batch: int = 64, iters: int = 10) -> dict:
     }
 
 
+def phase_clip_q8(iters: int = 20) -> dict:
+    """W8A8 int8 CLIP image embed vs bf16, same shapes (A/B). Batch
+    embedding is MXU-compute-bound; TPU int8 peak is ~2x bf16 (v5e:
+    394.7 TOPS vs 197.1 TFLOP/s), so the dynamic kernel (per-token
+    activation quant + native int8 dot) can beat bf16 outright — this
+    phase decides whether int8 becomes the serving default for CLIP.
+    Embedding fidelity is pinned by tests/test_clip_quant.py; this
+    measures speed only."""
+    _apply_platform_env()
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lumen_tpu.models.clip.convert import quantize_clip_int8
+    from lumen_tpu.models.clip.modeling import CLIPConfig, CLIPModel
+
+    on_cpu = jax.default_backend() == "cpu"
+    batch, iters = (8, 4) if on_cpu else (256, iters)
+
+    cfg = CLIPConfig()  # ViT-B/32
+    model = CLIPModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32),
+        jnp.zeros((1, cfg.context_length), jnp.int32),
+    )["params"]
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+    qparams = quantize_clip_int8(jax.tree.map(np.asarray, params))
+    qcfg = dataclasses.replace(cfg, weight_quant="int8", weight_quant_kernel="dynamic")
+    qmodel = CLIPModel(qcfg)
+
+    pixels = jax.device_put(
+        np.random.default_rng(0).integers(
+            0, 255, (batch, cfg.image_size, cfg.image_size, 3), np.uint8
+        )
+    )
+
+    def bench_one(m, p, tag):
+        @jax.jit
+        def embed(p_, px):
+            x = px.astype(jnp.float32) / 255.0
+            return m.apply(
+                {"params": p_}, x.astype(jnp.bfloat16),
+                method=lambda mm, v: mm.encode_image(v),
+            )
+
+        _state(f"clip_q8:compile:{tag}")
+        jax.block_until_ready(embed(p, pixels))
+        _state(f"clip_q8:measure:{tag}")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = embed(p, pixels)
+        jax.block_until_ready(out)
+        return batch * iters / (time.perf_counter() - t0)
+
+    bf16 = bench_one(model, params, "bf16")
+    q8 = bench_one(qmodel, jax.device_put(qparams), "int8")
+    return {
+        "images_per_sec_bf16": round(bf16, 1),
+        "images_per_sec_int8_dynamic": round(q8, 1),
+        "int8_speedup": round(q8 / bf16, 3),
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def phase_flash_ab(iters: int = 20) -> dict:
     """A/B: XLA reference attention vs the Pallas flash kernel on a
     VLM-prefill-shaped causal problem (the workload SURVEY.md §7 step 7
@@ -1570,6 +1641,7 @@ PHASES = {
     "ocr": phase_ocr,
     "ingest": phase_ingest,
     "flash_ab": phase_flash_ab,
+    "clip_q8": phase_clip_q8,
     "bench_grpc": phase_bench_grpc,
     "bench_grpc_ref": phase_bench_grpc_ref,
     "baseline": phase_baseline_torch,
@@ -1973,8 +2045,8 @@ def main(args) -> None:
     names = (
         ["probe", "clip"]
         if light
-        else ["probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
-              "face", "ocr", "ingest", "tpu_tests"]
+        else ["probe", "clip", "flash_ab", "clip_q8", "vlm", "vlm_q8",
+              "bench_grpc", "face", "ocr", "ingest", "tpu_tests"]
     )
 
     # --- Startup backfill line, printed within seconds of process start
@@ -2188,6 +2260,11 @@ def _assemble(
         extras["flash_ab_flash_ms"] = flash_ab.get("flash_ms")
         extras["flash_ab_speedup"] = flash_ab.get("flash_speedup")
         extras["flash_ab_platform"] = flash_ab.get("platform")
+    clip_q8 = results.get("clip_q8")
+    if clip_q8:
+        extras["clip_q8_images_per_sec"] = clip_q8.get("images_per_sec_int8_dynamic")
+        extras["clip_q8_speedup"] = clip_q8.get("int8_speedup")
+        extras["clip_q8_platform"] = clip_q8.get("platform")
 
     value = clip.get("images_per_sec", 0.0) if clip else 0.0
     platform = clip.get("platform", "none") if clip else "none"

@@ -207,3 +207,30 @@ def convert_clip_checkpoint(state: dict[str, np.ndarray], init_params: dict | No
     if init_params is not None:
         assert_tree_shapes(params, init_params)
     return params
+
+
+#: block projections QDense replaces when ``weight_quant="int8"`` — one
+#: template, parameterized by tower, so the projection set can't drift
+#: between the full and vision-only variants (must stay in sync with
+#: ``modeling._block_dense`` call sites).
+def _clip_quant_pattern(towers: str) -> "re.Pattern":
+    import re
+
+    return re.compile(
+        rf"^({towers})/blocks_\d+/(attn/(q|k|v|out)_proj|mlp/fc[12])/kernel$"
+    )
+
+
+_CLIP_QUANT_KERNEL = _clip_quant_pattern("vision|text")
+_CLIP_QUANT_KERNEL_VISION_ONLY = _clip_quant_pattern("vision")
+
+
+def quantize_clip_int8(params: dict, include_text: bool = True) -> dict:
+    """W8A8-ready int8 tree for the CLIP towers' block projections
+    (per-output-channel scales; see ``CLIPConfig.weight_quant``).
+    ``include_text=False`` for BERT-text models (ChineseCLIP) whose text
+    tower stays full precision."""
+    from ...ops.quant import quantize_tree_int8
+
+    pat = _CLIP_QUANT_KERNEL if include_text else _CLIP_QUANT_KERNEL_VISION_ONLY
+    return quantize_tree_int8(params, pat, "clip block")

@@ -86,7 +86,11 @@ class CLIPManager:
         mesh_axes: dict[str, int] | None = None,
         classify_mode: Literal["softmax", "cosine"] = "softmax",
         warmup: bool = False,
+        quantize: str | None = None,  # None | "int8" (W8A8 tower blocks)
     ):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+        self.quantize = quantize
         self.model_dir = model_dir
         self.dataset_name = dataset
         self.classify_mode = classify_mode
@@ -108,6 +112,20 @@ class CLIPManager:
             import dataclasses
 
             self.cfg = dataclasses.replace(self.cfg, text_serving_length=int(tsl))
+        if self.quantize:
+            import dataclasses
+
+            from ...ops.quant import resolve_q8_kernel
+
+            # Unlike the VLM decoder (bandwidth-bound -> dequant default),
+            # batch embedding is MXU-compute-bound: default to the W8A8
+            # "dynamic" kernel, which runs a native int8 dot at ~2x the
+            # bf16 MXU rate. Same env knob for on-chip A/Bs.
+            self.cfg = dataclasses.replace(
+                self.cfg,
+                weight_quant=self.quantize,
+                weight_quant_kernel=resolve_q8_kernel("dynamic"),
+            )
         self.model = CLIPModel(self.cfg)
         self.model_id = self.info.name
         self._initialized = False
@@ -212,10 +230,28 @@ class CLIPManager:
             raise FileNotFoundError(
                 f"clip_backend=graph but no vision/text onnx in {self.model_dir}"
             )
+        if state is None and self.quantize:
+            # Covers EVERY graph-served route (export-only dirs probed at
+            # config build, clip_backend=graph, and the no-checkpoint
+            # fallback above): an operator who set int8 must not attribute
+            # full-precision ONNX numbers to the quantized path.
+            logger.warning(
+                "quantize=%s ignored: the ONNX graph path runs the exported "
+                "precision as-is", self.quantize,
+            )
 
         if state is not None:
+            # The shape gate runs against the UNQUANTIZED module tree
+            # (checkpoints carry kernels); quantization rewrites matching
+            # kernels to (q, scale) afterwards, on the cast weights.
+            import dataclasses
+
+            gate_model = (
+                CLIPModel(dataclasses.replace(self.cfg, weight_quant=None))
+                if self.quantize else self.model
+            )
             init = jax.eval_shape(
-                lambda: self.model.init(
+                lambda: gate_model.init(
                     jax.random.PRNGKey(0),
                     jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32),
                     jnp.zeros((1, self.cfg.context_length), jnp.int32),
@@ -223,6 +259,12 @@ class CLIPManager:
             )
             params = convert_clip_checkpoint(state, init)
             params = self.policy.cast_params(params)
+            if self.quantize == "int8":
+                from .convert import quantize_clip_int8
+
+                params = quantize_clip_int8(
+                    params, include_text=self.cfg.text_arch != "bert"
+                )
             # DP serving: params replicated over the mesh; micro-batches are
             # data-sharded so one batched call spreads across every device
             # (trivial placement on a 1-device mesh). A mesh with a
@@ -230,9 +272,14 @@ class CLIPManager:
             # (both towers are standard transformers, so the shared TP
             # rules apply — SURVEY §2.8).
             if dict(self.mesh.shape).get("model", 1) > 1:
-                from ...parallel.sharding import TRANSFORMER_TP_RULES, shard_params
+                from ...parallel.sharding import (
+                    INT8_TP_RULES,
+                    TRANSFORMER_TP_RULES,
+                    shard_params,
+                )
 
-                self.params = shard_params(params, self.mesh, TRANSFORMER_TP_RULES)
+                rules = (INT8_TP_RULES if self.quantize else []) + TRANSFORMER_TP_RULES
+                self.params = shard_params(params, self.mesh, rules)
             else:
                 self.params = replicate(params, self.mesh)
 

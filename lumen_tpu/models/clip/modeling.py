@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.attention import attention
+from ...ops.quant import QDense
 
 
 @dataclass(frozen=True)
@@ -58,6 +59,17 @@ class CLIPConfig:
     #: attention FLOPs. The tower slices positions to the actual input
     #: length, so the tokenizer/batcher pad to this instead.
     text_serving_length: int | None = None
+    #: W8A8 int8 for the transformer blocks' projections (q/k/v/out,
+    #: fc1/fc2): batch image embedding is MXU-compute-bound, and TPU int8
+    #: peak is ~2x bf16 (v5e: 394.7 TOPS vs 197.1 TFLOP/s) — unlike the
+    #: VLM decoder, where int8 buys bandwidth, here it buys FLOPs. Patch
+    #: embed, position/class embeddings, layernorms, and the final
+    #: projection stay full precision. Set by the serving layer
+    #: (backend_settings.quantize); ``quantize_clip_int8`` in convert.py
+    #: builds the (q, scale) tree. The BERT text tower (ChineseCLIP) is
+    #: not quantized (its text encode is a tiny fraction of serve cost).
+    weight_quant: str | None = None  # None | "int8"
+    weight_quant_kernel: str = "dynamic"  # "dynamic" (W8A8 MXU) | "dequant"
 
     @property
     def serving_text_length(self) -> int:
@@ -131,9 +143,18 @@ def _act(name: str):
     return getattr(jax.nn, name)
 
 
+def _block_dense(width: int, name: str, dtype, quant: str | None, quant_kernel: str):
+    """Projection factory for transformer blocks: QDense when int8."""
+    if quant == "int8":
+        return QDense(width, kernel_mode=quant_kernel, name=name)
+    return nn.Dense(width, name=name, dtype=dtype)
+
+
 class Attention(nn.Module):
     width: int
     heads: int
+    quant: str | None = None
+    quant_kernel: str = "dynamic"
 
     @nn.compact
     def __call__(
@@ -141,24 +162,32 @@ class Attention(nn.Module):
     ) -> jax.Array:
         b, s, _ = x.shape
         head_dim = self.width // self.heads
-        dense = lambda name: nn.Dense(self.width, name=name, dtype=x.dtype)
+        dense = lambda name: _block_dense(
+            self.width, name, x.dtype, self.quant, self.quant_kernel
+        )
         q = dense("q_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         k = dense("k_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         v = dense("v_proj")(x).reshape(b, s, self.heads, head_dim).transpose(0, 2, 1, 3)
         out = attention(q, k, v, causal=causal, mask=mask)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, self.width)
-        return nn.Dense(self.width, name="out_proj", dtype=x.dtype)(out)
+        return dense("out_proj")(out)
 
 
 class Mlp(nn.Module):
     width: int
     hidden_act: str
+    quant: str | None = None
+    quant_kernel: str = "dynamic"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = nn.Dense(self.width * 4, name="fc1", dtype=x.dtype)(x)
+        h = _block_dense(
+            self.width * 4, "fc1", x.dtype, self.quant, self.quant_kernel
+        )(x)
         h = _act(self.hidden_act)(h)
-        return nn.Dense(self.width, name="fc2", dtype=x.dtype)(h)
+        return _block_dense(
+            self.width, "fc2", x.dtype, self.quant, self.quant_kernel
+        )(h)
 
 
 class Block(nn.Module):
@@ -166,14 +195,18 @@ class Block(nn.Module):
     heads: int
     hidden_act: str
     eps: float
+    quant: str | None = None
+    quant_kernel: str = "dynamic"
 
     @nn.compact
     def __call__(self, x: jax.Array, causal: bool = False) -> jax.Array:
         # Pre-LN residual blocks (CLIP layout).
-        x = x + Attention(self.width, self.heads, name="attn")(
+        x = x + Attention(
+            self.width, self.heads, self.quant, self.quant_kernel, name="attn"
+        )(
             nn.LayerNorm(epsilon=self.eps, name="ln1", dtype=x.dtype)(x), causal=causal
         )
-        x = x + Mlp(self.width, self.hidden_act, name="mlp")(
+        x = x + Mlp(self.width, self.hidden_act, self.quant, self.quant_kernel, name="mlp")(
             nn.LayerNorm(epsilon=self.eps, name="ln2", dtype=x.dtype)(x)
         )
         return x
@@ -280,7 +313,8 @@ class VisionTower(nn.Module):
         x = x + pos.astype(x.dtype)
         x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="pre_ln", dtype=x.dtype)(x)
         for i in range(v.layers):
-            x = Block(v.width, v.heads, c.hidden_act, c.layer_norm_eps, name=f"blocks_{i}")(x)
+            x = Block(v.width, v.heads, c.hidden_act, c.layer_norm_eps,
+                      c.weight_quant, c.weight_quant_kernel, name=f"blocks_{i}")(x)
         pooled = x[:, 0]
         pooled = nn.LayerNorm(epsilon=c.layer_norm_eps, name="post_ln", dtype=x.dtype)(pooled)
         return nn.Dense(c.embed_dim, use_bias=False, name="projection", dtype=x.dtype)(pooled)
@@ -302,7 +336,8 @@ class TextTower(nn.Module):
         s = input_ids.shape[1]
         x = x + pos[:s].astype(x.dtype)
         for i in range(t.layers):
-            x = Block(t.width, t.heads, c.hidden_act, c.layer_norm_eps, name=f"blocks_{i}")(
+            x = Block(t.width, t.heads, c.hidden_act, c.layer_norm_eps,
+                      c.weight_quant, c.weight_quant_kernel, name=f"blocks_{i}")(
                 x, causal=True
             )
         x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="final_ln", dtype=x.dtype)(x)

@@ -146,28 +146,12 @@ _QUANT_KERNEL = re.compile(
 
 
 def quantize_decoder_int8(params: dict) -> dict:
-    """Weight-only int8: replace each matching ``.../kernel`` leaf with
-    ``.../q`` (int8, symmetric) + ``.../scale`` (fp32 per output channel).
-    Apply AFTER the dtype-policy cast so the quantization grid is computed
-    from the weights serving would otherwise use."""
-    from ...runtime.weights import flatten, unflatten
+    """Weight-only int8 for the decoder projections (see
+    ``ops.quant.quantize_tree_int8`` for the mechanics; apply AFTER the
+    dtype-policy cast so the grid is computed from serving weights)."""
+    from ...ops.quant import quantize_tree_int8
 
-    flat = flatten(params)
-    out: dict = {}
-    n_quant = 0
-    for path, leaf in flat.items():
-        if _QUANT_KERNEL.match(path):
-            w = np.asarray(leaf, np.float32)
-            scale = np.maximum(np.abs(w).max(axis=0) / 127.0, 1e-8)  # [out]
-            q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
-            prefix = path[: -len("kernel")]
-            out[prefix + "q"] = q
-            out[prefix + "scale"] = scale.astype(np.float32)
-            n_quant += 1
-        else:
-            out[path] = leaf
-    logger.info("int8 weight-only quantization: %d decoder projections", n_quant)
-    return unflatten(out)
+    return quantize_tree_int8(params, _QUANT_KERNEL, "decoder")
 
 
 def convert_vlm_checkpoint(

@@ -245,14 +245,12 @@ class VLMManager:
         if self.quantize:
             import dataclasses
 
+            from ...ops.quant import resolve_q8_kernel
+
             # Kernel formulation for the int8 projections; "dynamic"
             # (W8A8, native MXU int8 dot) is the fallback for stacks where
             # the dequant convert doesn't fuse (see DecoderConfig).
-            q8_kernel = os.environ.get("LUMEN_Q8_KERNEL", "dequant")
-            if q8_kernel not in ("dequant", "dynamic"):
-                raise ValueError(
-                    f"LUMEN_Q8_KERNEL must be 'dequant' or 'dynamic', got {q8_kernel!r}"
-                )
+            q8_kernel = resolve_q8_kernel("dequant")
             self.cfg = dataclasses.replace(
                 self.cfg,
                 decoder=dataclasses.replace(

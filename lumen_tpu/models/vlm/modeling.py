@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.attention import attention, attention_cached, repeat_kv
+from ...ops.quant import QDense
 
 
 @dataclass(frozen=True)
@@ -199,59 +200,6 @@ def init_kv_cache(cfg: VLMConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) 
 
 
 # -- modules ----------------------------------------------------------------
-
-
-class QDense(nn.Module):
-    """Int8 linear over weight-only quantized params (``q: [in, out]
-    int8`` + per-output-channel fp32 ``scale``), two execution modes:
-
-    - ``dequant``: ``y = (x @ q.astype(x.dtype)) * scale`` — one byte per
-      weight element of HBM traffic IF XLA fuses the convert into the
-      dot's operand read.
-    - ``dynamic``: quantize activations per token (symmetric, abs-max)
-      and run a native ``int8 x int8 -> int32`` dot on the MXU —
-      ``y = (qx @ q) * sx * scale`` — no weight convert anywhere. Adds
-      ~0.4% relative activation-rounding error; decode quality impact is
-      negligible next to the int8 weight grid itself.
-    """
-
-    features: int
-    use_bias: bool = True
-    kernel_mode: str = "dequant"
-
-    @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
-        d = x.shape[-1]
-        q = self.param(
-            "q", lambda key, shape: jnp.zeros(shape, jnp.int8), (d, self.features)
-        )
-        scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
-        if self.kernel_mode == "dynamic":
-            sx = jnp.maximum(
-                jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32) / 127.0,
-                1e-8,
-            )
-            qx = jnp.clip(
-                jnp.round(x.astype(jnp.float32) / sx), -127, 127
-            ).astype(jnp.int8)
-            acc = jax.lax.dot_general(
-                qx, q,
-                dimension_numbers=(((qx.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )
-            y = (acc.astype(jnp.float32) * sx * scale).astype(x.dtype)
-        elif self.kernel_mode == "dequant":
-            y = jnp.dot(x, q.astype(x.dtype)) * scale.astype(x.dtype)
-        else:
-            # A typo'd mode silently running the wrong kernel would
-            # mis-attribute every benchmark/serving number it produces.
-            raise ValueError(
-                f"kernel_mode must be 'dequant' or 'dynamic', got {self.kernel_mode!r}"
-            )
-        if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
-            y = y + bias.astype(x.dtype)
-        return y
 
 
 def _dense(cfg: DecoderConfig, features: int, name: str, use_bias: bool, dtype):

@@ -32,9 +32,10 @@ TRANSFORMER_TP_RULES: list[ShardingRule] = [
     (r".*embedding$", P(None, "model")),
 ]
 
-# Tensor-parallel rules for int8 weight-only-quantized projections
-# (``models/vlm/modeling.QDense``: ``q [in, out] int8`` + per-output-channel
-# ``scale [out]``). Same Megatron layout as the kernel rules above — the
+# Tensor-parallel rules for int8-quantized projections (``ops/quant.QDense``:
+# ``q [in, out] int8`` + per-output-channel ``scale [out]``), shared by the
+# VLM decoder and the CLIP towers (their projection names match the same
+# patterns). Same Megatron layout as the kernel rules above — the
 # scale vector shards along the SAME output axis as its q matrix, and an
 # input-sharded projection's scale/bias stay replicated (their dim is the
 # unsharded output). Token-identity of the TP decode vs replicated int8

@@ -114,6 +114,7 @@ class ClipService(BaseService):
                 mesh_axes=bs.mesh.axes if bs.mesh else None,
                 classify_mode="cosine" if key == "bioclip" else "softmax",
                 warmup=bs.warmup,
+                quantize=bs.quantize,
             )
         svc = cls(managers)
         for mgr in managers.values():

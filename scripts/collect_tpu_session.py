@@ -37,7 +37,7 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402  (the harness exports the claim-retry loop)
 
 NAMES = [
-    "probe", "clip", "flash_ab", "vlm", "vlm_q8", "bench_grpc",
+    "probe", "clip", "flash_ab", "clip_q8", "vlm", "vlm_q8", "bench_grpc",
     "face", "ocr", "ingest", "tpu_tests",
 ]
 _ROUND = bench.current_round()
