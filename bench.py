@@ -845,6 +845,17 @@ def phase_ocr(det_batch: int = 8, rec_batch: int = 64, iters: int = 10) -> dict:
     }
 
 
+def _cosine_min(a, b) -> float:
+    """Worst-row cosine between two [B, D] embedding matrices."""
+    import numpy as np
+
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    num = (a * b).sum(-1)
+    den = np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1) + 1e-30
+    return round(float((num / den).min()), 5)
+
+
 def phase_clip_q8(iters: int = 20) -> dict:
     """W8A8 int8 CLIP image embed vs bf16, same shapes (A/B). Batch
     embedding is MXU-compute-bound; TPU int8 peak is ~2x bf16 (v5e:
@@ -886,7 +897,7 @@ def phase_clip_q8(iters: int = 20) -> dict:
         )
     )
 
-    def bench_one(m, p, tag):
+    def make_embed(m):
         @jax.jit
         def embed(p_, px):
             x = px.astype(jnp.float32) / 255.0
@@ -895,6 +906,9 @@ def phase_clip_q8(iters: int = 20) -> dict:
                 method=lambda mm, v: mm.encode_image(v),
             )
 
+        return embed
+
+    def bench_one(embed, p, tag):
         _state(f"clip_q8:compile:{tag}")
         jax.block_until_ready(embed(p, pixels))
         _state(f"clip_q8:measure:{tag}")
@@ -904,12 +918,22 @@ def phase_clip_q8(iters: int = 20) -> dict:
         jax.block_until_ready(out)
         return batch * iters / (time.perf_counter() - t0)
 
-    bf16 = bench_one(model, params, "bf16")
-    q8 = bench_one(qmodel, jax.device_put(qparams), "int8")
+    embed_bf16, embed_q8 = make_embed(model), make_embed(qmodel)
+    qparams_dev = jax.device_put(qparams)
+    bf16 = bench_one(embed_bf16, params, "bf16")
+    q8 = bench_one(embed_q8, qparams_dev, "int8")
+
+    # Fidelity through the SAME jitted programs the benchmark timed (an
+    # eager pass would validate a different lowering than the one being
+    # vouched for): cosine between the two embeddings, worst row.
+    a = np.asarray(embed_bf16(params, pixels), np.float64)
+    b = np.asarray(embed_q8(qparams_dev, pixels), np.float64)
+    cos = _cosine_min(a, b)
     return {
         "images_per_sec_bf16": round(bf16, 1),
         "images_per_sec_int8_dynamic": round(q8, 1),
         "int8_speedup": round(q8 / bf16, 3),
+        "int8_embed_cosine_min": cos,
         "batch": batch,
         "platform": jax.devices()[0].platform,
     }
