@@ -54,6 +54,7 @@ class PhotoIngestPipeline:
         batch_size: int = 64,
         classify_top_k: int = 0,
         ocr_det_size: int | None = None,
+        ocr_use_angle_cls: bool = False,
         caption: bool = False,
         caption_prompt: str = "Describe this photo in one sentence.",
         caption_max_tokens: int = 32,
@@ -77,6 +78,7 @@ class PhotoIngestPipeline:
                 mgr._ensure_ready()  # stages reach into post-initialize state
         self.clip, self.face, self.ocr, self.vlm = clip, face, ocr, vlm
         self.ocr_det_size = ocr_det_size
+        self.ocr_use_angle_cls = ocr_use_angle_cls
         # The per-request and ingest paths must share ONE device copy of
         # each family's weights (a second copy could evict HBM needed for
         # activations), and the managers' micro-batchers keep sharding
@@ -231,7 +233,9 @@ class PhotoIngestPipeline:
             )
             if not found:
                 return []
-            return mgr.recognize_boxes(img, found)
+            return mgr.recognize_boxes(
+                img, found, use_angle_cls=self.ocr_use_angle_cls
+            )
 
         return Stage("ocr", preprocess, device_fn, postprocess)
 

@@ -50,6 +50,11 @@ def main(argv: list[str] | None = None) -> int:
         help="comma list from {clip,face,ocr,vlm} (families must be in the "
         "config; vlm adds a caption per image)",
     )
+    parser.add_argument(
+        "--ocr-angle-cls", action="store_true",
+        help="run the textline-orientation classifier on OCR crops "
+        "(needs a cls model in the OCR pack; no-op otherwise)",
+    )
     parser.add_argument("--caption-prompt", default="Describe this photo in one sentence.")
     parser.add_argument("--caption-max-tokens", type=int, default=32)
     parser.add_argument("--limit", type=int, default=None)
@@ -122,6 +127,7 @@ def main(argv: list[str] | None = None) -> int:
         caption_max_tokens=args.caption_max_tokens,
         batch_size=args.batch_size,
         classify_top_k=args.classify_top_k,
+        ocr_use_angle_cls=args.ocr_angle_cls,
         # One corrupt file must not abort a multi-hour library index; bad
         # images come out as {"path", "error"} rows instead.
         on_decode_error="record",
