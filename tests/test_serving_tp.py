@@ -371,3 +371,63 @@ class TestServiceMeshConfig:
             assert len(out.tokens) == 8
         finally:
             svc.close()
+
+
+class TestClipTensorParallelInt8:
+    """TP x W8A8 on the CLIP towers (round 5): the shared INT8_TP_RULES
+    cover the tower projections, and the sharded quantized embed must
+    match the replicated quantized embed. (bf16 CLIP TP parity lives in
+    test_clip.py TestMeshServing; this pins the int8 tree.)"""
+
+    @pytest.fixture(scope="class")
+    def clip_dir(self, tmp_path_factory):
+        from tests.clip_fixtures import make_clip_model_dir
+
+        return make_clip_model_dir(tmp_path_factory.mktemp("clip_tp_q8"))
+
+    @pytest.mark.parametrize("kernel", ["dynamic", "dequant"])
+    def test_tp_int8_embed_matches_replicated(self, clip_dir, kernel, monkeypatch):
+        import numpy as np
+
+        from lumen_tpu.models.clip import CLIPManager
+        from tests.clip_fixtures import png_bytes
+
+        monkeypatch.setenv("LUMEN_Q8_KERNEL", kernel)
+        repl = CLIPManager(clip_dir, dtype="float32", quantize="int8")
+        repl.initialize()
+        try:
+            want = repl.encode_image(png_bytes(0))
+        finally:
+            repl.close()
+        tp = CLIPManager(
+            clip_dir, dtype="float32", quantize="int8",
+            mesh_axes={"data": 4, "model": 2},
+        )
+        tp.initialize()
+        try:
+            got = tp.encode_image(png_bytes(0))
+        finally:
+            tp.close()
+        # dynamic: int32 accumulation is exact under contraction sharding;
+        # dequant: float re-association, empirically tight on this mesh.
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_tp_int8_tower_params_sharded(self, clip_dir):
+        from lumen_tpu.models.clip import CLIPManager
+
+        tp = CLIPManager(
+            clip_dir, dtype="float32", quantize="int8",
+            mesh_axes={"data": 4, "model": 2},
+        )
+        tp.initialize()
+        try:
+            specs = _leaf_sharding_specs(tp.params)
+        finally:
+            tp.close()
+        assert specs["vision/blocks_0/attn/q_proj/q"] == (None, "model")
+        assert specs["vision/blocks_0/attn/q_proj/scale"] == ("model",)
+        assert specs["vision/blocks_0/attn/out_proj/q"] == ("model",)
+        assert specs["vision/blocks_0/attn/out_proj/scale"] == ()
+        assert specs["vision/blocks_0/mlp/fc1/q"] == (None, "model")
+        assert specs["vision/blocks_0/mlp/fc2/q"] == ("model",)
+        assert specs["text/blocks_0/mlp/fc1/q"] == (None, "model")
