@@ -104,6 +104,12 @@ _SLOW = (
     "test_vlm_moe.py",
     "test_app.py::TestInstallOrchestrator",
     "test_app.py::TestRestParityEndpoints",
+    # round-5 additions: TP-mesh compiles and double manager inits; the
+    # fast QDense/pattern coverage stays default
+    "test_serving_tp.py::TestClipTensorParallelInt8",
+    "test_clip_quant.py::TestQuantizedManager",
+    "test_clip_quant.py::TestQuantizedTowers",
+    "test_ocr.py::TestNativeAngleCls",
 )
 
 
