@@ -305,3 +305,24 @@ class TestChunkedResponses:
         assert len(resps) == 4
         with pytest.raises(ServiceError, match="incomplete"):
             reassemble_result(resps[:-1])  # stream cut short before is_final
+
+    @pytest.mark.parametrize("size", [0, 1, 15, 16, 17, 31, 48, 512])
+    def test_roundtrip_at_boundary_sizes(self, hub, size):
+        """Chunk-boundary sweep: payloads at, below, and above multiples
+        of the chunk size all reassemble byte-identically."""
+        from lumen_tpu.serving import reassemble_result
+
+        stub, router = hub
+        svc = router.services["echo"]
+        old = svc.RESPONSE_CHUNK_BYTES
+        svc.RESPONSE_CHUNK_BYTES = 16
+        try:
+            payload = bytes(i % 251 for i in range(size))
+            resps = list(stub.Infer(iter([one_request("echo_echo", payload=payload)])))
+        finally:
+            svc.RESPONSE_CHUNK_BYTES = old
+        data, _mime, meta = reassemble_result(resps)
+        assert data == payload
+        assert resps[-1].is_final
+        expect_msgs = max(1, -(-size // 16))
+        assert len(resps) == expect_msgs
