@@ -1,6 +1,10 @@
 """Core config-layer tests (offline, no jax needed)."""
 
+import os
+
 import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from lumen_tpu.core.config import (
     LumenConfig,
@@ -178,3 +182,20 @@ class TestRknnPlaceholder:
         from lumen_tpu.runtime.rknn import require_executable_runtime
 
         require_executable_runtime(ModelConfig(model="ViT-B-32", runtime="jax"))
+
+
+class TestShippedExamples:
+    """Every YAML in examples/ must load through the real config loader —
+    a schema change that breaks a shipped example fails here, not in a
+    user's first copy-paste."""
+
+    @pytest.mark.parametrize(
+        "name", sorted(os.listdir(os.path.join(REPO_ROOT, "examples")))
+    )
+    def test_example_loads(self, name):
+        if not name.endswith(".yaml"):
+            pytest.skip("not a config")
+        from lumen_tpu.core.config import load_config
+
+        cfg = load_config(os.path.join(REPO_ROOT, "examples", name))
+        assert cfg.enabled_services()
