@@ -7,6 +7,29 @@ import json
 import numpy as np
 
 
+def random_variables(init_fn, scale=0.05, seed=0):
+    """Shape-only flax init: ``eval_shape`` the init, fill host-side.
+
+    Tests only need plausibly-random weights with the right tree structure;
+    skipping the real ``Module.init`` avoids an XLA compile (~10s each on
+    CPU). BatchNorm/LayerNorm ``var``/``scale`` leaves are filled with ones —
+    a random variance can be ≤0 and would NaN the normalizer.
+    """
+    import jax
+
+    rng = np.random.default_rng(seed)
+
+    def fill(path, a):
+        name = jax.tree_util.keystr(path)
+        if not np.issubdtype(a.dtype, np.floating):
+            return np.zeros(a.shape, a.dtype)
+        if name.endswith("'var']") or name.endswith("'scale']"):
+            return np.ones(a.shape, a.dtype)
+        return (rng.standard_normal(a.shape) * scale).astype(a.dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, jax.eval_shape(init_fn))
+
+
 def make_tiny_hf_clip(seed: int = 0):
     import torch
     from transformers import CLIPConfig as HFCLIPConfig, CLIPModel as HFCLIPModel

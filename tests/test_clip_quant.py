@@ -126,12 +126,18 @@ class TestInt8TpRulesCoverClip:
         from lumen_tpu.parallel.sharding import INT8_TP_RULES
         from lumen_tpu.runtime.weights import flatten
 
+        from tests.clip_fixtures import random_variables
+
         cfg = CLIPConfig.tiny()
-        params = CLIPModel(cfg).init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
-            jnp.zeros((1, cfg.context_length), jnp.int32),
-        )["params"]
+        # Shape-only init: the test only checks the quantized tree's *paths*
+        # against the TP rules, so concrete weight values are irrelevant.
+        params = random_variables(
+            lambda: CLIPModel(cfg).init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+                jnp.zeros((1, cfg.context_length), jnp.int32),
+            )["params"]
+        )
         flat = flatten(quantize_clip_int8(params))
         q_paths = [p for p in flat if p.endswith("/q")]
         assert q_paths

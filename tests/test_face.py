@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tests.clip_fixtures import png_bytes
+from tests.clip_fixtures import png_bytes, random_variables as _random_variables
 
 
 def make_face_model_dir(tmp_path, det_size=64, rec_size=32):
@@ -27,8 +27,16 @@ def make_face_model_dir(tmp_path, det_size=64, rec_size=32):
     model_dir.mkdir(parents=True, exist_ok=True)
     det_cfg = DetectorConfig(input_size=det_size, width=8, fpn_width=8)
     rec_cfg = IResNetConfig(layers=(1, 1, 1, 1), width=8, input_size=rec_size, embed_dim=64)
-    det_vars = FaceDetector(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, det_size, det_size, 3)))
-    rec_vars = IResNet(rec_cfg).init(jax.random.PRNGKey(1), jnp.zeros((1, rec_size, rec_size, 3)))
+    # eval_shape + host-side random fill instead of a real flax init: the
+    # tests only need plausibly-random weights of the right structure, and
+    # skipping the two init compiles saves ~20s of fixture setup on CPU.
+    det_vars = _random_variables(
+        lambda: FaceDetector(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, det_size, det_size, 3)))
+    )
+    rec_vars = _random_variables(
+        lambda: IResNet(rec_cfg).init(jax.random.PRNGKey(1), jnp.zeros((1, rec_size, rec_size, 3))),
+        seed=1,
+    )
     save_file(flatten_variables(dict(det_vars)), str(model_dir / "detection.safetensors"))
     save_file(flatten_variables(dict(rec_vars)), str(model_dir / "recognition.safetensors"))
     info = {

@@ -26,9 +26,16 @@ def make_ocr_model_dir(tmp_path, vocab_chars="0123456789abcdef"):
     det_cfg = DBNetConfig.tiny()
     vocab_size = 1 + len(vocab_chars) + 1  # blank + chars + space
     rec_cfg = SVTRConfig.tiny(vocab_size=vocab_size)
-    det_vars = DBNet(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
-    rec_vars = SVTRRecognizer(rec_cfg).init(
-        jax.random.PRNGKey(1), jnp.zeros((1, rec_cfg.height, 32, 3))
+    from tests.clip_fixtures import random_variables
+
+    det_vars = random_variables(
+        lambda: DBNet(det_cfg).init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    )
+    rec_vars = random_variables(
+        lambda: SVTRRecognizer(rec_cfg).init(
+            jax.random.PRNGKey(1), jnp.zeros((1, rec_cfg.height, 32, 3))
+        ),
+        seed=1,
     )
     save_file(flatten_variables(dict(det_vars)), str(model_dir / "detection.safetensors"))
     save_file(flatten_variables(dict(rec_vars)), str(model_dir / "recognition.safetensors"))
