@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from .quant_matmul import pallas_usable, w8a16_matmul
+
 logger = logging.getLogger(__name__)
 
 
@@ -51,7 +53,16 @@ class QDense(nn.Module):
             "q", lambda key, shape: jnp.zeros(shape, jnp.int8), (d, self.features)
         )
         scale = self.param("scale", nn.initializers.ones, (self.features,), jnp.float32)
-        if self.kernel_mode == "dynamic":
+        rows = 1
+        for dim in x.shape[:-1]:
+            rows *= dim
+        if self.kernel_mode == "dequant" and pallas_usable(rows, d, self.features):
+            # Decode-shape dequant: XLA lowers dot(x, convert(s8)) at tiny
+            # row counts to a VPU broadcast-multiply-reduce (measured 34x
+            # slower than bf16 on v5e — see ops/quant_matmul.py); the
+            # Pallas kernel streams s8 tiles and feeds the MXU instead.
+            y = w8a16_matmul(x, q, scale)
+        elif self.kernel_mode == "dynamic":
             sx = jnp.maximum(
                 jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32) / 127.0,
                 1e-8,

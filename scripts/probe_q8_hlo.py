@@ -1,0 +1,123 @@
+"""Dump optimized TPU HLO for the fused decode step, bf16 vs int8-dequant.
+
+No timing — compile-side evidence only: what does XLA emit inside the
+while-loop body for the quantized decoder? Greps the optimized module for
+the ops that could explain a 30x in-program slowdown (unhoisted converts,
+layout copies/transposes of the int8 operands, scalarized loops).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lumen_tpu.models.vlm.generate import Generator
+from lumen_tpu.models.vlm.modeling import (
+    DecoderConfig,
+    VisionTowerConfig,
+    VLMConfig,
+    VLMModel,
+)
+
+BATCH, PROMPT, NEW = 8, 64, 64
+
+
+def build(quantize, kernel):
+    dec = DecoderConfig(
+        vocab_size=32768, hidden_size=896, intermediate_size=4864,
+        layers=12, heads=14, kv_heads=2,
+    )
+    cfg = VLMConfig(
+        decoder=dec,
+        vision=VisionTowerConfig(image_size=224, patch_size=32, width=256, layers=2, heads=4),
+        image_token_id=dec.vocab_size - 1, bos_token_id=1, eos_token_id=2, pad_token_id=0,
+    )
+    model = VLMModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+    if quantize:
+        from lumen_tpu.models.vlm.convert import quantize_decoder_int8
+
+        cfg = dataclasses.replace(
+            cfg, decoder=dataclasses.replace(
+                cfg.decoder, weight_quant="int8", weight_quant_kernel=kernel
+            )
+        )
+        model = VLMModel(cfg)
+        params = quantize_decoder_int8(jax.tree.map(np.asarray, params))
+        params = jax.tree.map(jnp.asarray, params)
+    return model, cfg, params
+
+
+def lower_generate(model, cfg, params):
+    gen = Generator(model, cfg, max_seq=PROMPT + NEW, max_new_cap=NEW)
+    rng0 = np.random.default_rng(0)
+    embeds = jnp.asarray(rng0.normal(size=(BATCH, PROMPT, cfg.decoder.hidden_size)), jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(PROMPT)[None, :], (BATCH, PROMPT))
+    lengths = jnp.full((BATCH,), PROMPT, jnp.int32)
+    prompt_ids = jnp.ones((BATCH, PROMPT), jnp.int32)
+    lowered = gen._generate.lower(
+        params, embeds, positions, lengths, prompt_ids,
+        jax.random.PRNGKey(1),
+        jnp.asarray(NEW, jnp.int32), jnp.asarray(0.0, jnp.float32),
+        jnp.asarray(1.0, jnp.float32), jnp.asarray(False, bool),
+        jnp.asarray(1.0, jnp.float32),
+        kv_len=PROMPT + NEW,
+    )
+    return lowered.compile()
+
+
+def summarize(tag, compiled):
+    txt = compiled.as_text()
+    with open(f"/tmp/hlo_{tag}.txt", "w") as f:
+        f.write(txt)
+    # find the while body computation(s) and histogram ops inside
+    ops = collections.Counter()
+    big_converts = []
+    copies = []
+    for line in txt.splitlines():
+        m = re.search(r"=\s+(\w+)\(", line)
+        m2 = re.search(r"=\s+\S+\s+(\w+)", line)
+        op = None
+        if m2:
+            op = m2.group(1)
+        if op:
+            ops[op] += 1
+        if "convert" in line and ("s8[" in line or "bf16[" in line):
+            m3 = re.search(r"bf16\[([\d,]+)\]", line)
+            if m3:
+                dims = [int(d) for d in m3.group(1).split(",") if d]
+                n = int(np.prod(dims)) if dims else 0
+                if n >= 1_000_000:
+                    big_converts.append(line.strip()[:160])
+        if re.search(r"=\s+\S+\s+copy\(", line) and ("s8[" in line):
+            copies.append(line.strip()[:160])
+    print(json.dumps({
+        "tag": tag,
+        "n_lines": len(txt.splitlines()),
+        "top_ops": ops.most_common(15),
+        "big_converts": big_converts[:10],
+        "n_big_converts": len(big_converts),
+        "s8_copies": copies[:10],
+    }, indent=1), flush=True)
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("both", "bf16"):
+        summarize("bf16", lower_generate(*build(None, "dequant")))
+    if which in ("both", "q8"):
+        summarize("q8_dequant", lower_generate(*build("int8", "dequant")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Fifth int8-decode probe: bisect the real DecoderLayer.
+
+probe_q8_model reproduced the pathology on the real model (0.35ms bf16 vs
+11ms int8 per step). This isolates WHICH sub-structure triggers it:
+
+  layers_only   12 real DecoderLayers, no lm_head/embed (bf16 vs q8)
+  one_layer     a single real DecoderLayer step (bf16 vs q8)
+  mlponly       the layer's MLP path alone with distinct weights x12, 3D acts
+  head_only     final_norm + tied lm_head on its own
+
+All single jitted programs, timed with settle + 3 reps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lumen_tpu.models.vlm.modeling import (
+    DecoderConfig,
+    DecoderLayer,
+    VLMConfig,
+    VisionTowerConfig,
+    VLMModel,
+    init_kv_cache,
+)
+
+B, H, KVLEN = 8, 896, 128
+
+
+def mk_cfgs(kernel="dequant"):
+    dec = DecoderConfig(
+        vocab_size=32768, hidden_size=896, intermediate_size=4864,
+        layers=12, heads=14, kv_heads=2,
+    )
+    dec_q = dataclasses.replace(dec, weight_quant="int8", weight_quant_kernel=kernel)
+    return dec, dec_q
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return round((time.perf_counter() - t0) / reps * 1e3, 3)  # ms
+
+
+def quant(params):
+    from lumen_tpu.models.vlm.convert import quantize_decoder_int8
+
+    q = quantize_decoder_int8(jax.tree.map(np.asarray, params))
+    return jax.tree.map(jnp.asarray, q)
+
+
+def bf16_tree(params):
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
+
+
+def main() -> None:
+    dec, dec_q = mk_cfgs()
+    res = {}
+    rng = np.random.default_rng(0)
+
+    # --- one real DecoderLayer, decode shapes -----------------------------
+    layer = DecoderLayer(dec, layer_idx=0)
+    layer_q = DecoderLayer(dec_q, layer_idx=0)
+    x1 = jnp.asarray(rng.normal(size=(B, 1, H)), jnp.bfloat16)
+    pos = jnp.full((B, 1), 64, jnp.int32)
+    cache = {
+        "k": jnp.zeros((B, dec.kv_heads, KVLEN, dec.dim_per_head), jnp.bfloat16),
+        "v": jnp.zeros((B, dec.kv_heads, KVLEN, dec.dim_per_head), jnp.bfloat16),
+    }
+    offset = jnp.full((B,), 64, jnp.int32)
+    valid = offset + 1
+
+    p_layer = bf16_tree(
+        layer.init(jax.random.PRNGKey(0), x1, pos, cache, offset, valid)["params"]
+    )
+    p_layer_q = quant({"decoder": {"layers_0": p_layer}})["decoder"]["layers_0"]
+
+    @jax.jit
+    def run_layer(p, xx):
+        y, c = layer.apply({"params": p}, xx, pos, cache, offset, valid)
+        return y
+
+    @jax.jit
+    def run_layer_q(p, xx):
+        y, c = layer_q.apply({"params": p}, xx, pos, cache, offset, valid)
+        return y
+
+    res["one_layer_bf16_ms"] = timeit(run_layer, p_layer, x1)
+    res["one_layer_q8_ms"] = timeit(run_layer_q, p_layer_q, x1)
+    print(json.dumps({k: v for k, v in res.items()}), flush=True)
+
+    # --- 12 distinct QDense MLP stacks, 3D activations --------------------
+    from lumen_tpu.ops.quant import QDense
+
+    qd = QDense(4864, use_bias=False, kernel_mode="dequant")
+    qd2 = QDense(896, use_bias=False, kernel_mode="dequant")
+    ups, downs = [], []
+    for i in range(12):
+        pu = qd.init(jax.random.PRNGKey(2 * i), x1)["params"]
+        pu = {
+            "q": jnp.asarray(rng.integers(-127, 128, (H, 4864)), jnp.int8),
+            "scale": jnp.asarray(np.abs(rng.normal(size=(4864,))) * 0.01 + 1e-3, jnp.float32),
+        }
+        pd = {
+            "q": jnp.asarray(rng.integers(-127, 128, (4864, H)), jnp.int8),
+            "scale": jnp.asarray(np.abs(rng.normal(size=(H,))) * 0.01 + 1e-3, jnp.float32),
+        }
+        ups.append(pu)
+        downs.append(pd)
+
+    @jax.jit
+    def run_mlp12(ups, downs, xx):
+        h = xx
+        for pu, pd in zip(ups, downs):
+            y = qd.apply({"params": pu}, h)
+            h = h + qd2.apply({"params": pd}, jax.nn.silu(y))
+        return h
+
+    res["mlp12_distinct_q8_ms"] = timeit(run_mlp12, ups, downs, x1)
+
+    wu = [jnp.asarray(rng.normal(size=(H, 4864)) * 0.02, jnp.bfloat16) for _ in range(12)]
+    wd = [jnp.asarray(rng.normal(size=(4864, H)) * 0.02, jnp.bfloat16) for _ in range(12)]
+
+    @jax.jit
+    def run_mlp12_bf16(wu, wd, xx):
+        h = xx
+        for a, b2 in zip(wu, wd):
+            h = h + jnp.dot(jax.nn.silu(jnp.dot(h, a)), b2)
+        return h
+
+    res["mlp12_distinct_bf16_ms"] = timeit(run_mlp12_bf16, wu, wd, x1)
+    print(json.dumps({k: res[k] for k in ("mlp12_distinct_q8_ms", "mlp12_distinct_bf16_ms")}), flush=True)
+
+    # --- full 12-layer real decoder, no head ------------------------------
+    cfgv = VLMConfig(
+        decoder=dec,
+        vision=VisionTowerConfig(image_size=224, patch_size=32, width=256, layers=2, heads=4),
+        image_token_id=dec.vocab_size - 1, bos_token_id=1, eos_token_id=2, pad_token_id=0,
+    )
+    model = VLMModel(cfgv)
+    params = bf16_tree(model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+    cfgq = dataclasses.replace(cfgv, decoder=dec_q)
+    model_q = VLMModel(cfgq)
+    params_q = quant(params)
+
+    caches = init_kv_cache(cfgv, B, KVLEN, jnp.bfloat16)
+    cur_len = jnp.full((B,), 64, jnp.int32)
+
+    def mk_run(m):
+        @jax.jit
+        def go(p, xx):
+            logits, c = m.apply(
+                {"params": p}, xx, cur_len[:, None], caches, cur_len, cur_len + 1,
+                method=VLMModel.decode,
+            )
+            return logits
+
+        return go
+
+    res["decode_bf16_ms"] = timeit(mk_run(model), params, x1)
+    res["decode_q8_ms"] = timeit(mk_run(model_q), params_q, x1)
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "results": res,
+    }))
+
+
+if __name__ == "__main__":
+    main()

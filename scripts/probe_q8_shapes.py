@@ -1,0 +1,112 @@
+"""Per-projection-shape follow-up to probe_q8_decode: which decoder
+projection makes int8 decode 34x slower than bf16?
+
+probe_q8_decode found bf16 == dequant == dynamic at [8,896]->[*,4864], so
+the QDense formulation itself is fine at MLP shape. The fused decode's
+actual shapes differ two ways: activations are 3D ([batch, 1, hidden]
+inside the while_loop step) and the projections span 896->128 (kv),
+896->896 (qo), 896->4864 / 4864->896 (mlp), 896->32768 (lm_head).
+Times every (shape x mode x 2D/3D) cell, us/step.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, STEPS = 8, 20
+SHAPES = {
+    "kv_896_128": (896, 128),
+    "qo_896_896": (896, 896),
+    "up_896_4864": (896, 4864),
+    "down_4864_896": (4864, 896),
+    "lmhead_896_32768": (896, 32768),
+}
+
+
+def bench(fn, *args):
+    fn(*args)
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return round((time.perf_counter() - t0) / (reps * STEPS) * 1e6, 1)
+
+
+def chain(proj, din):
+    def step(x, _):
+        y = proj(x)
+        return x + jnp.tanh(y.mean(axis=-1, keepdims=True)), ()
+
+    @jax.jit
+    def run(x):
+        out, _ = jax.lax.scan(step, x, None, length=STEPS)
+        return out
+
+    return run
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    out: dict[str, dict[str, float]] = {}
+    for name, (din, dout) in SHAPES.items():
+        w = jnp.asarray(rng.normal(size=(din, dout)) * 0.02, jnp.bfloat16)
+        scale = jnp.asarray(np.abs(rng.normal(size=(dout,))) * 0.01 + 1e-3, jnp.float32)
+        q = jnp.asarray(rng.integers(-127, 128, size=(din, dout)), jnp.int8)
+        row: dict[str, float] = {}
+        for tag, mk in {
+            "2d": lambda: jnp.asarray(rng.normal(size=(B, din)), jnp.bfloat16),
+            "3d": lambda: jnp.asarray(rng.normal(size=(B, 1, din)), jnp.bfloat16),
+        }.items():
+            x = mk()
+
+            row[f"bf16_{tag}"] = bench(chain(lambda xx: jnp.dot(xx, w), din), x)
+            row[f"deq_{tag}"] = bench(
+                chain(
+                    lambda xx: jnp.dot(xx, q.astype(jnp.bfloat16))
+                    * scale.astype(jnp.bfloat16),
+                    din,
+                ),
+                x,
+            )
+
+            def dyn(xx):
+                sx = jnp.maximum(
+                    jnp.max(jnp.abs(xx), axis=-1, keepdims=True).astype(jnp.float32)
+                    / 127.0,
+                    1e-8,
+                )
+                qx = jnp.clip(jnp.round(xx.astype(jnp.float32) / sx), -127, 127).astype(
+                    jnp.int8
+                )
+                acc = jax.lax.dot_general(
+                    qx,
+                    q,
+                    dimension_numbers=(((xx.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+                return (acc.astype(jnp.float32) * sx * scale).astype(jnp.bfloat16)
+
+            row[f"dyn_{tag}"] = bench(chain(dyn, din), x)
+        out[name] = row
+        print(json.dumps({name: row}), flush=True)
+
+    print(
+        json.dumps(
+            {
+                "platform": jax.devices()[0].platform,
+                "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+                "us_per_step": out,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

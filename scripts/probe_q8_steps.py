@@ -1,0 +1,165 @@
+"""Third int8-decode probe: resolve per-step cost above tunnel dispatch.
+
+probe_q8_shapes was dominated by a ~20ms per-dispatch overhead through the
+axon tunnel, hiding per-step kernel time. Here every variant runs STEPS
+scan iterations in ONE jit call (so dispatch amortizes to noise), with a
+null chain subtracted. Variants reproduce the real fused-decode step at
+its true shapes: a composite 12-layer x 7-projection step (bf16 vs
+dequant vs dynamic QDense), plus single-projection cells for attribution.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, H, I, V, KV, LAYERS, STEPS = 8, 896, 4864, 32768, 128, 12, 400
+
+
+def bench(run, x):
+    run(x)
+    jax.block_until_ready(run(x))
+    t0 = time.perf_counter()
+    out = run(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / STEPS * 1e6  # us/step
+
+
+def chain(step_fn):
+    @jax.jit
+    def run(x):
+        out, _ = jax.lax.scan(
+            lambda c, _: (step_fn(c), ()), x, None, length=STEPS
+        )
+        return out
+
+    return run
+
+
+def deq(xx, q, scale):
+    return jnp.dot(xx, q.astype(jnp.bfloat16)) * scale.astype(jnp.bfloat16)
+
+
+def dyn(xx, q, scale):
+    sx = jnp.maximum(
+        jnp.max(jnp.abs(xx), axis=-1, keepdims=True).astype(jnp.float32) / 127.0, 1e-8
+    )
+    qx = jnp.clip(jnp.round(xx.astype(jnp.float32) / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qx, q, dimension_numbers=(((xx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * sx * scale).astype(jnp.bfloat16)
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, H)), jnp.bfloat16)
+
+    def mk_w(din, dout):
+        return jnp.asarray(rng.normal(size=(din, dout)) * 0.02, jnp.bfloat16)
+
+    def mk_q(din, dout):
+        return (
+            jnp.asarray(rng.integers(-127, 128, size=(din, dout)), jnp.int8),
+            jnp.asarray(np.abs(rng.normal(size=(dout,))) * 0.01 + 1e-3, jnp.float32),
+        )
+
+    # per-layer params (shared across layers is fine for perf: same HLO
+    # per step either way, and sharing keeps VMEM/HBM modest)
+    shapes = [(H, H), (H, KV), (H, KV), (H, H), (H, I), (H, I), (I, H)]
+    ws = [mk_w(a, b) for a, b in shapes]
+    qs = [mk_q(a, b) for a, b in shapes]
+    w_head = mk_w(H, V)
+    q_head = mk_q(H, V)
+
+    def layer_bf16(xx):
+        qp = jnp.dot(xx, ws[0])
+        k = jnp.dot(xx, ws[1])
+        v = jnp.dot(xx, ws[2])
+        o = jnp.dot(qp, ws[3]) + k.sum() * 0 + v.sum() * 0
+        g = jnp.dot(o, ws[4])
+        u = jnp.dot(o, ws[5])
+        return jnp.dot(jax.nn.silu(g) * u, ws[6])
+
+    def layer_q(xx, f):
+        qp = f(xx, *qs[0])
+        k = f(xx, *qs[1])
+        v = f(xx, *qs[2])
+        o = f(qp, *qs[3]) + k.sum() * 0 + v.sum() * 0
+        g = f(o, *qs[4])
+        u = f(o, *qs[5])
+        return f(jax.nn.silu(g) * u, *qs[6])
+
+    def full_bf16(xx):
+        h = xx
+        for _ in range(LAYERS):
+            h = h + layer_bf16(h)
+        logits = jnp.dot(h, w_head)
+        return h + jnp.tanh(logits.max(axis=-1, keepdims=True))
+
+    def full_deq(xx):
+        h = xx
+        for _ in range(LAYERS):
+            h = h + layer_q(h, deq)
+        logits = deq(h, *q_head)
+        return h + jnp.tanh(logits.max(axis=-1, keepdims=True))
+
+    def full_dyn(xx):
+        h = xx
+        for _ in range(LAYERS):
+            h = h + layer_q(h, dyn)
+        logits = dyn(h, *q_head)
+        return h + jnp.tanh(logits.max(axis=-1, keepdims=True))
+
+    res: dict[str, float] = {}
+    res["null"] = bench(chain(lambda c: c + 1.0), x)
+    for name, fn in [
+        ("full_bf16", full_bf16),
+        ("full_deq", full_deq),
+        ("full_dyn", full_dyn),
+    ]:
+        res[name] = round(bench(chain(fn), x), 1)
+        print(json.dumps({name: res[name]}), flush=True)
+
+    # attribution cells: one projection per step, net of null
+    cells = {
+        "qo_bf16": lambda c: c + jnp.dot(c, ws[0]).mean() * 0 + jnp.dot(c, ws[0]).sum() * 1e-9,
+    }
+    del cells  # composite cells below are cleaner
+
+    for nm, (a, b) in {
+        "qo": (H, H), "kv": (H, KV), "up": (H, I), "head": (H, V)
+    }.items():
+        w = mk_w(a, b)
+        qq = mk_q(a, b)
+        pad = jnp.zeros((B, a - H), jnp.bfloat16) if a != H else None
+
+        def widen(c):
+            return jnp.concatenate([c, jnp.broadcast_to(c.mean(), (B, a - H))], -1) if a != H else c
+
+        res[f"{nm}_bf16"] = round(
+            bench(chain(lambda c: c + jnp.tanh(jnp.dot(widen(c), w).mean(-1, keepdims=True))), x), 1
+        )
+        res[f"{nm}_deq"] = round(
+            bench(chain(lambda c: c + jnp.tanh(deq(widen(c), *qq).mean(-1, keepdims=True))), x), 1
+        )
+        res[f"{nm}_dyn"] = round(
+            bench(chain(lambda c: c + jnp.tanh(dyn(widen(c), *qq).mean(-1, keepdims=True))), x), 1
+        )
+        print(json.dumps({nm: {k: v for k, v in res.items() if k.startswith(nm)}}), flush=True)
+
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        "steps": STEPS,
+        "us_per_step": res,
+    }))
+
+
+if __name__ == "__main__":
+    main()
