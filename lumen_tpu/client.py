@@ -55,15 +55,76 @@ def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
         )
 
 
+_RETRYABLE_RPC = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.RESOURCE_EXHAUSTED)
+
+
+class _InbandUnavailable(Exception):
+    """An in-band ERROR_CODE_UNAVAILABLE response: a load shed or degraded
+    service that answered BEFORE dispatching the task, so re-sending is
+    explicitly safe (the server's own detail says to retry with backoff)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _transient_rpc(exc: BaseException) -> bool:
+    """Retry transport-level failures a backoff can fix: server not up yet,
+    connection dropped during stream setup, or an overloaded backend
+    shedding load. Anything the server *answered* (INVALID_ARGUMENT,
+    INTERNAL, in-band Error responses) is not retried."""
+    return isinstance(exc, grpc.RpcError) and exc.code() in _RETRYABLE_RPC
+
+
+def _client_retry_policy():
+    from lumen_tpu.utils.retry import RetryPolicy, policy_from_env
+
+    return policy_from_env(
+        "CLIENT", RetryPolicy(attempts=3, base_delay_s=0.5, max_delay_s=5.0)
+    )
+
+
 def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
            timeout: float, stream: bool = False):
+    """One Infer attempt with stream-setup retries: an attempt that dies on
+    a transient RpcError *before any response arrived* is retried with
+    backoff (re-sending the request stream is safe then — the server never
+    dispatched it to a handler we saw output from); after first byte the
+    error propagates, since blind re-dispatch could double-run a task."""
+    from lumen_tpu.utils.retry import retry_call
+
+    state = {"responded": False}
+
+    def attempt():
+        return _infer_once(stub, task, payload, mime, meta, timeout, stream, state)
+
+    try:
+        return retry_call(
+            attempt,
+            policy=_client_retry_policy(),
+            retryable=lambda e: isinstance(e, _InbandUnavailable)
+            or (not state["responded"] and _transient_rpc(e)),
+            scope="client_infer",
+        )
+    except _InbandUnavailable as e:
+        raise SystemExit(f"server error [{e.code}]: {e}") from e
+
+
+def _infer_once(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
+                timeout: float, stream: bool, state: dict):
     from lumen_tpu.serving import ServiceError, reassemble_result
 
+    state["responded"] = False
     responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
     chunked: list = []
     saw_deltas = False
     for resp in responses:
+        state["responded"] = True
         if resp.error.message:
+            if resp.error.code == pb.ERROR_CODE_UNAVAILABLE:
+                # Shed / degraded-service answer: retryable by contract
+                # (the server refused before dispatch; see _InbandUnavailable).
+                raise _InbandUnavailable(resp.error.code, resp.error.message)
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
         # Disambiguate the two total>1 shapes on the wire: a STREAMING
         # final message also carries total=n_deltas+1, but its deltas
@@ -117,8 +178,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--stream", action="store_true")
     args = ap.parse_args(argv)
 
+    from lumen_tpu.utils.retry import retry_call
+
     chan = grpc.insecure_channel(args.addr)
-    grpc.channel_ready_future(chan).result(timeout=min(args.timeout, 30))
+    # Channel establishment retries: a server mid-restart (or mid-recovery)
+    # comes up within a few backoff steps; a genuinely absent one still
+    # fails fast enough to be usable interactively.
+    retry_call(
+        lambda: grpc.channel_ready_future(chan).result(timeout=min(args.timeout, 10)),
+        policy=_client_retry_policy(),
+        retryable=(grpc.FutureTimeoutError,),
+        scope="client_connect",
+    )
     stub = pbg.InferenceStub(chan)
 
     if args.cmd == "caps":

@@ -22,12 +22,30 @@ import os
 import shutil
 from dataclasses import dataclass, field
 
+from ..utils.retry import RetryPolicy, policy_from_env, retry_call
 from .config import LumenConfig, ModelConfig
 from .exceptions import DownloadError, ResourceError
 from .model_info import ModelInfo, load_model_info
 from .platform import Platform
 
 logger = logging.getLogger(__name__)
+
+#: Transient fetch failures worth a capped backoff-retry: hub/network
+#: errors surface as DownloadError or OS-level errno; config/manifest
+#: problems (ConfigError, ModelInfoError) do not get better by waiting.
+#: FaultInjected (a plain ResourceError) is included so the test harness
+#: exercises the same retry path real flakiness takes.
+def _retryable_fetch(exc: BaseException) -> bool:
+    from ..testing.faults import FaultInjected
+
+    return isinstance(exc, (DownloadError, FaultInjected, OSError, ConnectionError, TimeoutError))
+
+
+def download_retry_policy() -> RetryPolicy:
+    """``LUMEN_DOWNLOAD_RETRIES`` / ``_BACKOFF_S`` / ``_BACKOFF_MAX_S``."""
+    return policy_from_env(
+        "DOWNLOAD", RetryPolicy(attempts=3, base_delay_s=0.5, max_delay_s=10.0)
+    )
 
 # Patterns always fetched: manifest, tokenizer + model configs.
 _COMMON_PATTERNS = [
@@ -121,9 +139,25 @@ class Downloader:
         failures are reported per model (callers decide whether to abort,
         as the reference hub does at ``src/lumen/server.py:168-175``)."""
         report = DownloadReport()
-        for svc_name, svc in self.config.enabled_services().items():
-            for alias, model_cfg in svc.models.items():
-                report.results.append(self._download_one(svc_name, alias, model_cfg))
+        for svc_name in self.config.enabled_services():
+            report.results.extend(self.download_service(svc_name).results)
+        return report
+
+    def download_service(self, svc_name: str) -> DownloadReport:
+        """Per-service variant of :meth:`download_all` (the degraded-service
+        recovery path re-fetches only the broken service's models)."""
+        report = DownloadReport()
+        svc = self.config.enabled_services().get(svc_name)
+        if svc is None:
+            report.results.append(
+                DownloadResult(
+                    service=svc_name, alias="", model="", ok=False,
+                    error=f"service {svc_name!r} is not enabled by the deployment config",
+                )
+            )
+            return report
+        for alias, model_cfg in svc.models.items():
+            report.results.append(self._download_one(svc_name, alias, model_cfg))
         return report
 
     def check_all(self) -> DownloadReport:
@@ -185,10 +219,25 @@ class Downloader:
             res.error = str(e)
         return res
 
-    def _fetch_and_validate(self, model_cfg: ModelConfig, update: bool = False) -> str:
-        path = self.platform.download(
-            model_cfg.model, allow_patterns=allow_patterns_for(model_cfg), update=update
+    def _fetch(self, model_cfg: ModelConfig, patterns: list[str], update: bool) -> str:
+        """One snapshot fetch, with the ``download`` fault point inside the
+        retried unit (so an injected fault is retried exactly like a real
+        transient failure) and capped exponential-backoff retries."""
+        from ..testing.faults import faults
+
+        def attempt() -> str:
+            faults.check("download", model_cfg.model)
+            return self.platform.download(model_cfg.model, allow_patterns=patterns, update=update)
+
+        return retry_call(
+            attempt,
+            policy=download_retry_policy(),
+            retryable=_retryable_fetch,
+            scope="download",
         )
+
+    def _fetch_and_validate(self, model_cfg: ModelConfig, update: bool = False) -> str:
+        path = self._fetch(model_cfg, allow_patterns_for(model_cfg), update)
         info = load_model_info(path)
         self._download_datasets(path, info, model_cfg)
         self.validate_files(path, info, model_cfg)
@@ -209,7 +258,7 @@ class Downloader:
         if missing:
             # update=True: the model dir already exists from phase one, so a
             # plain download() would be a cache-hit no-op.
-            self.platform.download(model_cfg.model, allow_patterns=missing, update=True)
+            self._fetch(model_cfg, missing, update=True)
 
     def _resolve_runtime_entry(self, info: ModelInfo, model_cfg: ModelConfig):
         """Runtime entry to validate against; ``jax`` falls back to the

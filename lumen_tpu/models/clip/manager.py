@@ -98,6 +98,11 @@ class CLIPManager:
         self.batch_size = batch_size
         self.max_batch_latency_ms = max_batch_latency_ms
         self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        from ...ops.quant_matmul import note_mesh_model_axis
+
+        # TP x int8: pl.pallas_call has no GSPMD sharding rule, so a
+        # model-axis mesh must keep QDense on the XLA dequant fallback.
+        note_mesh_model_axis(dict(self.mesh.shape).get("model", 1))
         self.warmup = warmup
         self.info: ModelInfo = load_model_info(model_dir)
         # (vision, text) ClipTowerGraph when graph-served; the probed flag

@@ -228,6 +228,11 @@ class VLMManager:
         # ``expert`` axis shards MoE expert banks (SURVEY §2.8); without
         # either the mesh is the trivial data mesh and weights replicate.
         self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        from ...ops.quant_matmul import note_mesh_model_axis
+
+        # TP x int8: pl.pallas_call has no GSPMD sharding rule, so a
+        # model-axis mesh must keep decode on the XLA dequant fallback.
+        note_mesh_model_axis(dict(self.mesh.shape).get("model", 1))
         self.policy = get_policy(dtype)
         self.warmup = warmup
         self.max_seq = max_seq

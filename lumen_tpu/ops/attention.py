@@ -23,10 +23,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width; scratch stats are padded to this
 
+from .pallas_compat import CompilerParams
+
 #: batch*heads and q-block axes carry no state between steps, so megacore
 #: chips (v4/v5p: two TensorCores per chip) may split them; the k axis is
 #: the online-softmax accumulation and must stay sequential.
-_DIM_SEMANTICS = pltpu.CompilerParams(
+_DIM_SEMANTICS = CompilerParams(
     dimension_semantics=("parallel", "parallel", "arbitrary")
 )
 

@@ -1,0 +1,16 @@
+"""jax version-skew shim for the Pallas TPU kernels.
+
+``TPUCompilerParams`` was renamed ``CompilerParams`` across jax 0.4 -> 0.5;
+every pallas kernel module imports the resolved name from here so the ops
+package imports — and its CPU interpret-mode tests run — on both sides of
+the skew (the pinned CI image and the TPU runtime image are rarely the
+same jax). Counterpart of ``lumen_tpu/parallel/compat.py`` (shard_map).
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+__all__ = ["CompilerParams"]

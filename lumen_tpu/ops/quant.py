@@ -34,7 +34,12 @@ class QDense(nn.Module):
 
     - ``dequant``: ``y = (x @ q.astype(x.dtype)) * scale`` — one byte per
       weight element of HBM traffic IF XLA fuses the convert into the
-      dot's operand read.
+      dot's operand read. At decode-sized row counts with bf16
+      activations this routes to the Pallas w8a16 kernel, which computes
+      the dot in bf16 with f32 scale (the bf16 compute contract —
+      ``ops/quant_matmul.pallas_usable`` keeps f32 callers and
+      tensor-parallel meshes on the XLA fallback, which computes in the
+      caller's dtype and shards under GSPMD).
     - ``dynamic``: quantize activations per token (symmetric, abs-max)
       and run a native ``int8 x int8 -> int32`` dot on the MXU —
       ``y = (qx @ q) * sx * scale`` — no weight convert anywhere. Adds
@@ -56,7 +61,7 @@ class QDense(nn.Module):
         rows = 1
         for dim in x.shape[:-1]:
             rows *= dim
-        if self.kernel_mode == "dequant" and pallas_usable(rows, d, self.features):
+        if self.kernel_mode == "dequant" and pallas_usable(rows, d, self.features, x.dtype):
             # Decode-shape dequant: XLA lowers dot(x, convert(s8)) at tiny
             # row counts to a VPU broadcast-multiply-reduce (measured 34x
             # slower than bf16 on v5e — see ops/quant_matmul.py); the

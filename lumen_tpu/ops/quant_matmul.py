@@ -32,11 +32,31 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_compat import CompilerParams as _compiler_params
 
 #: Max rows routed to this kernel: decode/serving matvec-ish shapes. Larger
 #: row counts (batch embedding) already lower to the MXU via XLA.
 MAX_PALLAS_ROWS = 64
+
+#: Largest ``model``-axis size any serving mesh in this process has
+#: reported (see :func:`note_mesh_model_axis`). ``pl.pallas_call`` inside a
+#: GSPMD-jitted program has no sharding rule: under tensor parallelism
+#: (INT8_TP_RULES shard ``q`` along ``model``) the kernel would fail to
+#: partition or silently all-gather/replicate the weights it exists to
+#: stream — so TP disables this route entirely and decode falls back to
+#: the XLA dequant dot, which shards fine.
+_MESH_MODEL_AXIS = 1
+
+
+def note_mesh_model_axis(size: int) -> None:
+    """Serving managers report their mesh's ``model``-axis size here at
+    construction. Sticky maximum: one TP manager anywhere in the process
+    disables the Pallas route for everyone — conservative, because a
+    replicated sibling sharing the process cannot be told apart at trace
+    time, and the fallback is merely slower, not wrong."""
+    global _MESH_MODEL_AXIS
+    _MESH_MODEL_AXIS = max(_MESH_MODEL_AXIS, int(size))
 
 _SUBLANE_S8 = 32  # s8 VMEM tile is (32, 128): K must divide into sublanes
 _LANES = 128
@@ -68,17 +88,30 @@ def _w8a16_2d(x, q, scale, *, block_n: int, interpret: bool):
         ],
         out_specs=pl.BlockSpec((b, block_n), lambda j: (0, j)),
         out_shape=jax.ShapeDtypeStruct((b, n), x.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        compiler_params=_compiler_params(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x, q, scale.reshape(1, n))
 
 
-def pallas_usable(rows: int, k: int, n: int) -> bool:
+def pallas_usable(rows: int, k: int, n: int, dtype=None) -> bool:
     """Route through the Pallas kernel? TPU backend (or forced interpret),
-    decode-sized row count, tile-aligned dims."""
+    decode-sized row count, tile-aligned dims, bf16 activations, and no
+    tensor-parallel serving mesh in the process.
+
+    The dtype gate is a precision contract: the kernel computes the dot in
+    bf16 (weights convert s8->bf16 in-register) and applies scale in f32 —
+    correct for the bf16 serving policy, but an f32 caller routed here
+    would silently lose activation mantissa vs. the XLA dequant fallback,
+    which computes in the caller's dtype. Both correctness gates sit BEFORE
+    the ``LUMEN_Q8_PALLAS=1`` force knob: the knob forces interpret-mode
+    execution off-TPU, never an unsound routing."""
     if os.environ.get("LUMEN_Q8_PALLAS") == "0":
         return False
+    if _MESH_MODEL_AXIS > 1:
+        return False
     if rows > MAX_PALLAS_ROWS or k % _SUBLANE_S8 or n % _LANES:
+        return False
+    if dtype is not None and jnp.dtype(dtype) != jnp.bfloat16:
         return False
     if os.environ.get("LUMEN_Q8_PALLAS") == "1":
         return True

@@ -1,0 +1,17 @@
+"""jax version-skew shims for the parallel package.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace (and pallas-TPU renamed ``TPUCompilerParams``
+to ``CompilerParams``) across jax 0.4 -> 0.5. The serving stack must
+import — and its CPU test tier must run — on both sides of that skew:
+the pinned CI image and the TPU runtime image are rarely the same jax.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5
+    from jax import shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+__all__ = ["shard_map"]

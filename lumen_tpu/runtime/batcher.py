@@ -24,12 +24,13 @@ import queue
 import threading
 import time
 import weakref
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError as futures_InvalidState, TimeoutError as FuturesTimeout
 from typing import Any, Callable
 
 import jax
 import numpy as np
 
+from ..utils.deadline import DeadlineExpired, QueueFull, get_deadline, remaining
 from ..utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -88,6 +89,34 @@ def batch_wait_timeout() -> float:
         return 300.0
 
 
+def batch_queue_depth() -> int:
+    """Default queue-depth limit for admission control:
+    ``LUMEN_BATCH_QUEUE_DEPTH`` (0 / unset / malformed = unbounded, the
+    pre-resilience behavior)."""
+    try:
+        return max(0, int(os.environ.get("LUMEN_BATCH_QUEUE_DEPTH", "0")))
+    except ValueError:
+        return 0
+
+
+def _settle(fut: Future, result: Any = None, exception: BaseException | None = None) -> bool:
+    """Resolve a caller future, tolerating the cancel race: a
+    deadline-bounded caller may cancel() between the collector's state
+    check and its set — set_result/set_exception on a cancelled Future
+    raises InvalidStateError, which must not kill the collector thread.
+    Returns True when the future was actually settled."""
+    if fut.cancelled():
+        return False
+    try:
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+        return True
+    except futures_InvalidState:
+        return False
+
+
 def bucket_for(n: int, buckets: list[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -110,6 +139,7 @@ class MicroBatcher:
         max_latency_ms: float = 5.0,
         buckets: list[int] | None = None,
         name: str = "batcher",
+        max_queue: int | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -120,14 +150,18 @@ class MicroBatcher:
         if self.buckets[-1] < max_batch:
             self.buckets.append(max_batch)
         self.name = name
-        self._queue: queue.Queue[tuple[Any, Future] | None] = queue.Queue()
+        # Admission control: bound the number of waiting items so overload
+        # becomes explicit shed errors (callers can back off) instead of an
+        # unbounded queue whose latency grows without limit. 0 = unbounded.
+        self.max_queue = batch_queue_depth() if max_queue is None else max(0, max_queue)
+        self._queue: queue.Queue[tuple[Any, Future, float | None] | None] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._closed = threading.Event()
         # Guards the closed-check + enqueue pair in submit() against a
         # concurrent close() draining the queue in between.
         self._submit_lock = threading.Lock()
         # Telemetry for capability metadata / benchmarks.
-        self.stats = {"batches": 0, "items": 0, "padded": 0}
+        self.stats = {"batches": 0, "items": 0, "padded": 0, "shed": 0, "expired": 0}
 
     # -- lifecycle --------------------------------------------------------
 
@@ -168,12 +202,36 @@ class MicroBatcher:
 
     # -- client side ------------------------------------------------------
 
-    def submit(self, item: Any) -> Future:
+    def submit(self, item: Any, deadline: float | None = None) -> Future:
+        """Enqueue one item. ``deadline`` is an absolute ``time.monotonic()``
+        instant; unset, it is inherited from the ambient request context
+        (:func:`lumen_tpu.utils.deadline.get_deadline`, installed by the
+        gRPC layer from ``context.time_remaining()``). Expired entries are
+        dropped before the device call instead of burning a batch slot.
+
+        Raises :class:`QueueFull` when ``max_queue`` items are already
+        waiting (load shed — the caller should surface a retryable
+        RESOURCE_EXHAUSTED-style error) and :class:`DeadlineExpired` when
+        the deadline has already passed at submit time."""
+        if deadline is None:
+            deadline = get_deadline()
+        if deadline is not None and time.monotonic() >= deadline:
+            self.stats["expired"] += 1
+            metrics.count("deadline_drops")
+            metrics.count(f"deadline_drops:{self.name}")
+            raise DeadlineExpired(f"{self.name}: request deadline already expired at submit")
         fut: Future = Future()
         with self._submit_lock:
             if self._closed.is_set():
                 raise RuntimeError(f"{self.name} is closed")
-            self._queue.put((item, fut))
+            if self.max_queue and self._queue.qsize() >= self.max_queue:
+                self.stats["shed"] += 1
+                metrics.count("sheds")
+                metrics.count(f"sheds:{self.name}")
+                raise QueueFull(
+                    f"{self.name}: admission queue full ({self.max_queue} waiting); request shed"
+                )
+            self._queue.put((item, fut, deadline))
         return fut
 
     def __call__(self, item: Any, timeout: float | None = None) -> Any:
@@ -181,10 +239,32 @@ class MicroBatcher:
         compile of a new bucket THROUGH the axon tunnel (observed >60s on
         a v5e: the first on-chip gRPC bench died on exactly this) — the
         client's own RPC deadline, not this timeout, bounds user-visible
-        latency. ``LUMEN_BATCH_TIMEOUT_S`` overrides; unset → 300s."""
+        latency. ``LUMEN_BATCH_TIMEOUT_S`` overrides; unset → 300s. An
+        ambient request deadline, when sooner, bounds the wait instead
+        (no point blocking a gRPC thread past its caller's hangup)."""
         if timeout is None:
             timeout = batch_wait_timeout()
-        return self.submit(item).result(timeout=timeout)
+        rem = remaining()
+        deadline_bounded = rem is not None and rem < timeout
+        if deadline_bounded:
+            timeout = max(rem, 0.0)
+        fut = self.submit(item)
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeout:
+            if not deadline_bounded:
+                raise
+            # The caller's deadline — not the batch-wait budget — expired.
+            # Cancel so the collector skips the dead entry (when it hasn't
+            # started) and surface the wire-mappable deadline error, not a
+            # generic timeout that reads as a handler crash.
+            if fut.cancel():
+                self.stats["expired"] += 1
+                metrics.count("deadline_drops")
+                metrics.count(f"deadline_drops:{self.name}")
+            raise DeadlineExpired(
+                f"{self.name}: request deadline expired while waiting for a batch slot"
+            ) from None
 
     # -- collector thread -------------------------------------------------
 
@@ -215,29 +295,57 @@ class MicroBatcher:
             except queue.Empty:
                 break
             if entry is not None:
-                entry[1].set_exception(RuntimeError(f"{self.name} closed"))
+                _settle(entry[1], exception=RuntimeError(f"{self.name} closed"))
 
-    def _process(self, batch: list[tuple[Any, Future]]) -> None:
-        items = [b[0] for b in batch]
-        futures = [b[1] for b in batch]
+    def _process(self, batch: list[tuple[Any, Future, float | None]]) -> None:
+        # Deadline gate: entries whose caller deadline passed while they
+        # queued are failed here — BEFORE stacking and the device call — so
+        # an overloaded server does not spend TPU time computing answers
+        # nobody is waiting for (their gRPC stream is already torn down).
+        live: list[tuple[Any, Future]] = []
+        now = time.monotonic()
+        for item, fut, deadline in batch:
+            if fut.cancelled():
+                # The waiting caller already gave up (and accounted the
+                # drop); counting here too would double-book the event.
+                continue
+            if deadline is not None and now >= deadline:
+                if _settle(
+                    fut,
+                    exception=DeadlineExpired(
+                        f"{self.name}: deadline expired while queued"
+                    ),
+                ):
+                    self.stats["expired"] += 1
+                    metrics.count("deadline_drops")
+                    metrics.count(f"deadline_drops:{self.name}")
+            else:
+                live.append((item, fut))
+        if not live:
+            return
+        items = [b[0] for b in live]
+        futures = [b[1] for b in live]
         n = len(items)
         size = bucket_for(n, self.buckets)
         try:
+            from ..testing.faults import faults
+
+            # No-op unless a test/harness armed the point; lets the suite
+            # exercise the fan-out-failure path below deterministically.
+            faults.check("batch_execute", self.name)
             stacked = stack_and_pad(items, size)
             result = self.fn(stacked, n)
             rows = unstack(result, n)
         except Exception as e:  # noqa: BLE001 - fan the failure out to callers
             logger.exception("%s: batched call failed (n=%d)", self.name, n)
             for f in futures:
-                if not f.cancelled():
-                    f.set_exception(e)
+                _settle(f, exception=e)
             return
         self.stats["batches"] += 1
         self.stats["items"] += n
         self.stats["padded"] += size - n
         for f, row in zip(futures, rows):
-            if not f.cancelled():
-                f.set_result(row)
+            _settle(f, result=row)
 
 
 # -- pytree stacking helpers ------------------------------------------------

@@ -6,12 +6,15 @@ per-package service scaffolding it duplicates.
 
 from .base_service import (
     BaseService,
+    DeadlineExceeded,
     InvalidArgument,
+    ResourceExhausted,
     ServiceError,
     Unavailable,
     reassemble_result,
 )
 from .registry import TaskDefinition, TaskRegistry
+from .resilience import DegradedService, RecoveryManager
 from .router import HubRouter
 
 __all__ = [
@@ -19,6 +22,10 @@ __all__ = [
     "ServiceError",
     "InvalidArgument",
     "Unavailable",
+    "ResourceExhausted",
+    "DeadlineExceeded",
+    "DegradedService",
+    "RecoveryManager",
     "TaskDefinition",
     "TaskRegistry",
     "HubRouter",

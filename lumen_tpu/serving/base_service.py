@@ -27,6 +27,8 @@ from typing import Iterator
 import grpc
 from google.protobuf import empty_pb2
 
+from ..utils import deadline as request_deadline
+from ..utils.deadline import DeadlineExpired, QueueFull
 from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
 from .proto.ml_service_pb2_grpc import InferenceServicer
@@ -90,6 +92,24 @@ class Unavailable(ServiceError):
         super().__init__(pb.ERROR_CODE_UNAVAILABLE, message, detail)
 
 
+class ResourceExhausted(ServiceError):
+    """Load shed by admission control. The wire enum has no dedicated
+    RESOURCE_EXHAUSTED value, so this rides UNAVAILABLE with an explicit
+    retry hint — retryable-with-backoff is exactly the client contract."""
+
+    def __init__(self, message: str, detail: str = ""):
+        super().__init__(
+            pb.ERROR_CODE_UNAVAILABLE,
+            message,
+            detail or "server overloaded; retry with exponential backoff",
+        )
+
+
+class DeadlineExceeded(ServiceError):
+    def __init__(self, message: str, detail: str = ""):
+        super().__init__(pb.ERROR_CODE_DEADLINE_EXCEEDED, message, detail)
+
+
 def first_meta_key(meta: dict[str, str], *keys: str) -> str | None:
     """First present key among ``keys`` — shared alias resolution so every
     service treats reference-client meta names (e.g. the face service's
@@ -144,6 +164,13 @@ class BaseService(InferenceServicer):
     def healthy(self) -> bool:
         return True
 
+    def status(self) -> str:
+        """One-word state for the hub's per-service health report:
+        ``healthy``, ``unhealthy`` (unexpected — fails hub health), or
+        ``degraded``/``recovering`` (known-broken with background recovery
+        — reported, but healthy siblings keep the hub serving)."""
+        return "healthy" if self.healthy() else "unhealthy"
+
     # -- Inference rpc implementation ------------------------------------
 
     def Infer(self, request_iterator, context) -> Iterator[pb.InferResponse]:
@@ -155,9 +182,23 @@ class BaseService(InferenceServicer):
             if not asm.complete:
                 continue
             del buffers[cid]
-            yield from self._dispatch(cid, asm)
+            yield from self._dispatch(cid, asm, context)
 
-    def _dispatch(self, cid: str, asm: _Assembly) -> Iterator[pb.InferResponse]:
+    @staticmethod
+    def _context_deadline(context) -> float | None:
+        """Absolute monotonic deadline from a gRPC context, or None when the
+        client set no deadline (or the context is a test stub without
+        ``time_remaining``)."""
+        tr = getattr(context, "time_remaining", None)
+        if not callable(tr):
+            return None
+        try:
+            rem = tr()
+        except Exception:  # noqa: BLE001 - a stub context must not break dispatch
+            return None
+        return None if rem is None else time.monotonic() + rem
+
+    def _dispatch(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
         task = self.registry.get(asm.task)
         if task is None:
             yield self._error(
@@ -175,29 +216,54 @@ class BaseService(InferenceServicer):
                 f"payload exceeds limit ({len(payload)} > {task.max_payload_bytes} bytes)",
             )
             return
+        # Deadline propagation (L2 -> L4): expired requests are answered
+        # without touching the model, and the remaining budget rides a
+        # contextvar so the micro-batcher can drop entries that expire
+        # while queued — before the device call burns a batch slot.
+        deadline = self._context_deadline(context)
+        if deadline is not None and time.monotonic() >= deadline:
+            metrics.count("deadline_drops")
+            metrics.count_error(asm.task)
+            yield self._error(
+                cid,
+                pb.ERROR_CODE_DEADLINE_EXCEEDED,
+                f"deadline expired before dispatch of {asm.task!r}",
+            )
+            return
         t0 = time.perf_counter()
+        # The token scope covers streaming output too: a lazy handler's
+        # body runs inside _stream_out's iteration, and its batcher
+        # submits must still see the request deadline.
+        token = request_deadline.set_deadline(deadline)
         try:
-            out = task.handler(payload, asm.payload_mime, asm.meta)
-        except ServiceError as e:
-            metrics.count_error(asm.task)
-            yield self._error(cid, e.code, str(e), e.detail)
-            return
-        except Exception as e:  # noqa: BLE001 - handler crash -> INTERNAL
-            logger.exception("task %s failed", asm.task)
-            metrics.count_error(asm.task)
-            yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
-            return
+            try:
+                out = task.handler(payload, asm.payload_mime, asm.meta)
+            except ServiceError as e:
+                metrics.count_error(asm.task)
+                yield self._error(cid, e.code, str(e), e.detail)
+                return
+            except (QueueFull, DeadlineExpired) as e:
+                metrics.count_error(asm.task)
+                yield self._overload_error(cid, asm.task, e)
+                return
+            except Exception as e:  # noqa: BLE001 - handler crash -> INTERNAL
+                logger.exception("task %s failed", asm.task)
+                metrics.count_error(asm.task)
+                yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
+                return
 
-        if isinstance(out, tuple):
-            result, mime, meta = out
-            meta = dict(meta)
-            lat_ms = (time.perf_counter() - t0) * 1e3
-            metrics.observe(asm.task, lat_ms)
-            meta["lat_ms"] = f"{lat_ms:.2f}"
-            yield from self._chunked_response(cid, result, mime, meta)
-        else:
-            # Streaming handler: iterator of (bytes, mime, meta) chunks.
-            yield from self._stream_out(cid, asm.task, out, t0)
+            if isinstance(out, tuple):
+                result, mime, meta = out
+                meta = dict(meta)
+                lat_ms = (time.perf_counter() - t0) * 1e3
+                metrics.observe(asm.task, lat_ms)
+                meta["lat_ms"] = f"{lat_ms:.2f}"
+                yield from self._chunked_response(cid, result, mime, meta)
+            else:
+                # Streaming handler: iterator of (bytes, mime, meta) chunks.
+                yield from self._stream_out(cid, asm.task, out, t0)
+        finally:
+            request_deadline.reset(token)
 
     #: Split unary results larger than this into seq/total/offset chunks
     #: (the proto carries the fields on InferResponse for exactly this,
@@ -261,6 +327,10 @@ class BaseService(InferenceServicer):
             metrics.count_error(task_name)
             yield self._error(cid, e.code, str(e), e.detail)
             return
+        except (QueueFull, DeadlineExpired) as e:
+            metrics.count_error(task_name)
+            yield self._overload_error(cid, task_name, e)
+            return
         except Exception as e:  # noqa: BLE001
             logger.exception("streaming task %s failed", task_name)
             metrics.count_error(task_name)
@@ -284,6 +354,19 @@ class BaseService(InferenceServicer):
             seq=seq,
             total=seq + 1,
         )
+
+    @classmethod
+    def _overload_error(cls, cid: str, task_name: str, e: Exception) -> pb.InferResponse:
+        """One source of truth for the overload exceptions' wire mapping:
+        a batcher :class:`QueueFull` is a :class:`ResourceExhausted`
+        (UNAVAILABLE + backoff hint), a :class:`DeadlineExpired` is a
+        :class:`DeadlineExceeded` — the same ServiceError subclasses a
+        handler may raise directly."""
+        if isinstance(e, QueueFull):
+            err: ServiceError = ResourceExhausted(f"{task_name}: {e}")
+        else:
+            err = DeadlineExceeded(f"{task_name}: {e}")
+        return cls._error(cid, err.code, str(err), err.detail)
 
     @staticmethod
     def _error(cid: str, code: int, message: str, detail: str = "") -> pb.InferResponse:

@@ -3,14 +3,30 @@
 Same role as the reference ``src/lumen/router.py:10-87``: a routing table
 from task key -> child service is built from each child's registry; ``Infer``
 peeks at the first message of the stream to pick the child and then forwards
-the whole stream zero-copy; capabilities aggregate; health is the AND of all
-children.
+the whole stream zero-copy; capabilities aggregate.
+
+Resilience semantics on top of the reference:
+
+- services can be hot-swapped (:meth:`replace_service`) — the background
+  recovery loop promotes a ``DegradedService`` placeholder to the real
+  service without restarting the server; the route table rebuilds
+  atomically under a lock;
+- ``Health`` reports per-service status in trailing metadata
+  (``lumen-service-status``: JSON ``{name: state}``). A *degraded* service
+  (known-broken, recovering) does NOT fail hub health — healthy siblings
+  keep serving; an *unhealthy* one (unexpected) still aborts UNAVAILABLE,
+  as does a hub with no working service at all;
+- an unknown task while some service is degraded answers UNAVAILABLE with
+  the degraded-service hint, not INVALID_ARGUMENT — the task may well
+  belong to the broken service, and "client bug" is the wrong message.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
 import logging
+import threading
 from typing import Iterable, Iterator
 
 import grpc
@@ -25,19 +41,60 @@ logger = logging.getLogger(__name__)
 
 class HubRouter(InferenceServicer):
     def __init__(self, services: dict[str, BaseService]):
-        self.services = services
+        self.services = dict(services)
+        self._lock = threading.Lock()
         self._route_table: dict[str, BaseService] = {}
-        for name, svc in services.items():
+        self._rebuild_routes()
+
+    def _rebuild_routes(self) -> None:
+        table: dict[str, BaseService] = {}
+        owner: dict[str, str] = {}
+        for name, svc in self.services.items():
             for task in svc.registry.task_names():
-                if task in self._route_table:
+                if task in table:
                     raise ValueError(
                         f"task {task!r} registered by multiple services "
-                        f"(second: {name!r})"
+                        f"(first: {owner[task]!r}, second: {name!r})"
                     )
-                self._route_table[task] = svc
+                table[task] = svc
+                owner[task] = name
+        self._route_table = table
         logger.info(
-            "hub routing table: %s", {t: s.registry.service_name for t, s in self._route_table.items()}
+            "hub routing table: %s",
+            {t: s.registry.service_name for t, s in table.items()},
         )
+
+    def replace_service(self, name: str, svc: BaseService) -> None:
+        """Atomically swap a child service (degraded -> recovered) and
+        rebuild the route table. The old service's in-flight streams keep
+        their reference; new streams route to the replacement. A duplicate
+        task in the replacement rolls the swap back."""
+        with self._lock:
+            old = self.services.get(name)
+            self.services[name] = svc
+            try:
+                self._rebuild_routes()
+            except ValueError:
+                if old is None:
+                    self.services.pop(name, None)
+                else:
+                    self.services[name] = old
+                self._rebuild_routes()
+                raise
+        close = getattr(old, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - best-effort teardown of the placeholder
+                logger.exception("closing replaced service %r failed", name)
+
+    def _route(self, task: str) -> BaseService | None:
+        with self._lock:
+            return self._route_table.get(task)
+
+    def _statuses(self) -> dict[str, str]:
+        with self._lock:
+            return {name: svc.status() for name, svc in sorted(self.services.items())}
 
     def attach_to_server(self, server: grpc.Server) -> None:
         from .proto.ml_service_pb2_grpc import add_InferenceServicer_to_server
@@ -51,8 +108,26 @@ class HubRouter(InferenceServicer):
             first = next(iter(request_iterator))
         except StopIteration:
             return
-        target = self._route_table.get(first.task)
+        target = self._route(first.task)
         if target is None:
+            degraded = {n: s for n, s in self._statuses().items() if s in ("degraded", "failed")}
+            if degraded:
+                # The task may belong to a service that failed to load and
+                # could not even declare its tasks — answer "broken
+                # backend", not "client bug".
+                yield pb.InferResponse(
+                    correlation_id=first.correlation_id,
+                    is_final=True,
+                    error=pb.Error(
+                        code=pb.ERROR_CODE_UNAVAILABLE,
+                        message=(
+                            f"no healthy service handles task {first.task!r}; "
+                            f"degraded services: {sorted(degraded)}"
+                        ),
+                        detail="recovery is retrying in the background; retry later",
+                    ),
+                )
+                return
             yield pb.InferResponse(
                 correlation_id=first.correlation_id,
                 is_final=True,
@@ -74,7 +149,9 @@ class HubRouter(InferenceServicer):
             runtime="jax-tpu",
             protocol_version="1.0.0",
         )
-        for svc in self.services.values():
+        with self._lock:
+            services = list(self.services.values())
+        for svc in services:
             cap = svc.capability()
             agg.model_ids.extend(cap.model_ids)
             agg.tasks.extend(cap.tasks)
@@ -85,11 +162,32 @@ class HubRouter(InferenceServicer):
         return agg
 
     def StreamCapabilities(self, request, context) -> Iterator[pb.Capability]:
-        for svc in self.services.values():
+        with self._lock:
+            services = list(self.services.values())
+        for svc in services:
             yield svc.capability()
 
     def Health(self, request, context):
-        for name, svc in self.services.items():
-            if not svc.healthy():
-                context.abort(grpc.StatusCode.UNAVAILABLE, f"service {name!r} unhealthy")
+        statuses = self._statuses()
+        if context is not None:
+            try:
+                context.set_trailing_metadata(
+                    (("lumen-service-status", json.dumps(statuses)),)
+                )
+            except Exception:  # noqa: BLE001 - test stubs may lack metadata support
+                pass
+        unhealthy = [n for n, s in statuses.items() if s == "unhealthy"]
+        broken = [n for n, s in statuses.items() if s != "healthy"]
+        if unhealthy:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"service(s) unhealthy: {sorted(unhealthy)}",
+            )
+        if statuses and len(broken) == len(statuses):
+            # Nothing left serving: a hub of only degraded placeholders is
+            # not healthy, however gracefully it boots.
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"all services degraded: {sorted(broken)}",
+            )
         return empty_pb2.Empty()

@@ -2,19 +2,28 @@
 
 Startup sequence mirrors the reference hub runner
 (``src/lumen/server.py:188-385``): load+validate config -> ensure model
-artifacts (abort if any download fails) -> instantiate services from their
-configured ``registry_class`` dotted paths -> bind gRPC (with OS-assigned
-port fallback) -> advertise over mDNS -> serve until SIGINT/SIGTERM.
+artifacts -> instantiate services from their configured ``registry_class``
+dotted paths -> bind gRPC (with OS-assigned port fallback) -> advertise
+over mDNS -> serve until SIGINT/SIGTERM.
 
 Unlike the reference, ``single`` and ``hub`` modes share this one entry
 point (the reference duplicates a per-package server runner in each of the
 four model packages); single mode is simply a hub with one service.
+
+Unlike the reference (and this repo's seed), startup failure of ONE
+service no longer aborts the hub: a failed download or ``from_config``
+boots that service as a :class:`~lumen_tpu.serving.resilience.DegradedService`
+(tasks answer UNAVAILABLE with a recovery hint) while a background
+:class:`~lumen_tpu.serving.resilience.RecoveryManager` retries the load
+with exponential backoff and hot-swaps the real service in on success.
+``LUMEN_STRICT_BOOT=1`` restores the old abort-on-any-failure behavior.
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import sys
 import threading
@@ -24,10 +33,12 @@ import grpc
 
 from ..core.config import LumenConfig, load_config
 from ..core.downloader import Downloader
+from ..core.exceptions import DownloadError
 from ..utils.logger import setup_logging
 from .base_service import BaseService
 from .loader import resolve
 from .mdns import MdnsAdvertiser
+from .resilience import DegradedService, RecoveryManager, expected_tasks_for
 from .router import HubRouter
 
 logger = logging.getLogger(__name__)
@@ -38,25 +49,70 @@ GRPC_OPTIONS = [
 ]
 
 
-def build_services(config: LumenConfig) -> dict[str, BaseService]:
-    """Instantiate every enabled service via its ``import_info.registry_class``
-    factory (``from_config(service_config, cache_dir)`` classmethod contract,
-    reference: ``src/lumen/service.py:12-49``)."""
+def build_one_service(config: LumenConfig, name: str) -> BaseService:
+    """Load exactly one service via its ``import_info.registry_class``
+    factory (``from_config(service_config, cache_dir)`` classmethod
+    contract, reference: ``src/lumen/service.py:12-49``). Shared by first
+    boot and background recovery so both exercise the identical path
+    (including the ``model_load`` fault point)."""
+    from ..testing.faults import faults
+
+    svc_cfg = config.services[name]
+    faults.check("model_load", name)
+    cls = resolve(svc_cfg.import_info.registry_class)
+    logger.info("loading service %r via %s", name, svc_cfg.import_info.registry_class)
+    return cls.from_config(svc_cfg, config.metadata.cache_path)
+
+
+def build_services(
+    config: LumenConfig, failed: dict[str, str] | None = None
+) -> dict[str, BaseService]:
+    """Instantiate every enabled service; services named in ``failed`` (or
+    whose construction raises) become :class:`DegradedService` placeholders
+    instead of killing their healthy siblings."""
     services: dict[str, BaseService] = {}
-    cache_dir = config.metadata.cache_path
     for name, svc_cfg in config.enabled_services().items():
-        cls = resolve(svc_cfg.import_info.registry_class)
-        logger.info("loading service %r via %s", name, svc_cfg.import_info.registry_class)
-        services[name] = cls.from_config(svc_cfg, cache_dir)
+        error = (failed or {}).get(name)
+        if error is None:
+            try:
+                services[name] = build_one_service(config, name)
+                continue
+            except Exception as e:  # noqa: BLE001 - degrade, don't kill siblings
+                logger.exception("service %r failed to load; booting degraded", name)
+                error = f"{type(e).__name__}: {e}"
+        services[name] = DegradedService(
+            name, error, tasks=expected_tasks_for(name, svc_cfg)
+        )
     return services
 
 
-def ensure_models(config: LumenConfig) -> None:
+def ensure_models(config: LumenConfig, strict: bool | None = None) -> dict[str, str]:
+    """Fetch every enabled model; returns ``{service: error}`` for the
+    services whose artifacts could not be made ready. With ``strict``
+    (``LUMEN_STRICT_BOOT=1``) any failure aborts, the seed behavior."""
+    if strict is None:
+        strict = os.environ.get("LUMEN_STRICT_BOOT") == "1"
     report = Downloader(config).download_all()
-    if not report.ok:
-        for r in report.failures():
-            logger.error("model fetch failed: %s/%s (%s): %s", r.service, r.alias, r.model, r.error)
+    failures: dict[str, str] = {}
+    for r in report.failures():
+        logger.error("model fetch failed: %s/%s (%s): %s", r.service, r.alias, r.model, r.error)
+        msg = f"{r.alias} ({r.model}): {r.error}"
+        failures[r.service] = f"{failures[r.service]}; {msg}" if r.service in failures else msg
+    if failures and strict:
         raise SystemExit(1)
+    return failures
+
+
+def rebuild_service(config: LumenConfig, name: str, skip_download: bool = False) -> BaseService:
+    """Recovery path for one degraded service: re-fetch its artifacts and
+    reconstruct it. Raises on any failure (the RecoveryManager backs off
+    and retries)."""
+    if not skip_download:
+        report = Downloader(config).download_service(name)
+        if not report.ok:
+            errs = "; ".join(f"{r.alias}: {r.error}" for r in report.failures())
+            raise DownloadError(f"model fetch failed for {name!r}: {errs}")
+    return build_one_service(config, name)
 
 
 class ServerHandle:
@@ -70,15 +126,23 @@ class ServerHandle:
         mdns: MdnsAdvertiser | None,
         metrics_server=None,
         services: dict | None = None,
+        recovery: RecoveryManager | None = None,
     ):
         self.server = server
         self.port = port
         self.mdns = mdns
         self.metrics_server = metrics_server
-        self.services = services or {}
+        # Live view: recovery hot-swaps promoted services into this dict
+        # (it is the router's), so teardown closes what is actually running.
+        self.services = services if services is not None else {}
+        self.recovery = recovery
         self._stopped = threading.Event()
 
     def stop(self, grace: float = 5.0) -> None:
+        if self.recovery:
+            # First: a recovery attempt finishing mid-shutdown would swap a
+            # fresh service in after the close pass below already ran.
+            self.recovery.stop()
         if self.mdns:
             self.mdns.stop()
         if self.metrics_server:
@@ -90,7 +154,7 @@ class ServerHandle:
         # stragglers at t=grace, so the wait needs margin past the grace
         # window or close() can race still-running handlers.
         self.server.stop(grace).wait(grace + 5.0)
-        for name, svc in self.services.items():
+        for name, svc in list(self.services.items()):
             close = getattr(svc, "close", None)
             if close is not None:
                 try:
@@ -112,13 +176,30 @@ def serve(
     from ..runtime import enable_persistent_cache
 
     enable_persistent_cache()  # warm restarts hit compiled buckets on disk
+    failed: dict[str, str] = {}
     if not skip_download:
-        ensure_models(config)
-    services = build_services(config)
+        failed = ensure_models(config)
+    services = build_services(config, failed=failed)
     if not services:
         logger.error("no enabled services selected by deployment config")
         raise SystemExit(1)
     router = HubRouter(services)
+
+    degraded = sorted(n for n, s in services.items() if isinstance(s, DegradedService))
+    recovery = None
+    if degraded:
+        logger.warning(
+            "booting with %d degraded service(s): %s — healthy siblings keep "
+            "serving; background recovery is retrying the failed loads",
+            len(degraded), degraded,
+        )
+        recovery = RecoveryManager(
+            router,
+            rebuild=lambda n: rebuild_service(config, n, skip_download=skip_download),
+        )
+        for name in degraded:
+            recovery.register(name)
+        recovery.start()
 
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=10, thread_name_prefix="grpc"),
@@ -152,7 +233,7 @@ def serve(
 
     logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
     for name, svc in services.items():
-        logger.info("  %s tasks: %s", name, svc.registry.task_names())
+        logger.info("  %s [%s] tasks: %s", name, svc.status(), svc.registry.task_names())
 
     mdns = None
     mdns_cfg = config.server.mdns
@@ -163,7 +244,9 @@ def serve(
             properties={"tasks": ",".join(t for s in services.values() for t in s.registry.task_names())},
         )
         mdns.start()
-    return ServerHandle(server, bound, mdns, metrics_server, services=services)
+    return ServerHandle(
+        server, bound, mdns, metrics_server, services=router.services, recovery=recovery
+    )
 
 
 def main(argv: list[str] | None = None) -> int:

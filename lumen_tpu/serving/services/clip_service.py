@@ -98,6 +98,33 @@ class ClipService(BaseService):
     # -- factory ----------------------------------------------------------
 
     @classmethod
+    def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:
+        """Tasks this service would register for the given config — mirrors
+        the alias/dataset selection in ``__init__`` so a degraded
+        placeholder exposes the same routes the live service would."""
+        by_key = {}
+        for alias, mc in service_config.models.items():
+            by_key["bioclip" if "bioclip" in alias.lower() else "clip"] = mc
+
+        def tasks(prefix: str, mc, scene: bool) -> list[str]:
+            out = [f"{prefix}_text_embed", f"{prefix}_image_embed"]
+            if mc.dataset:
+                out.append(f"{prefix}_classify")
+            if scene:
+                out.append(f"{prefix}_scene_classify")
+            return out
+
+        expected: list[str] = []
+        if "clip" in by_key:
+            expected += tasks("clip", by_key["clip"], scene=True)
+        if "bioclip" in by_key:
+            expected += tasks("bioclip", by_key["bioclip"], scene=False)
+        if "clip" in by_key and "bioclip" in by_key:
+            expected += tasks("smartclip", by_key["clip"], scene=True)
+            expected.append("smartclip_bioclassify")
+        return expected
+
+    @classmethod
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "ClipService":
         bs = service_config.backend_settings
         managers: dict[str, CLIPManager] = {}

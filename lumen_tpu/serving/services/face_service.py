@@ -62,6 +62,13 @@ class FaceService(BaseService):
         super().__init__(registry)
 
     @classmethod
+    def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:  # noqa: ARG003
+        """Tasks this service would register — used by the hub to build a
+        degraded placeholder when the real load fails, so the routes answer
+        UNAVAILABLE instead of vanishing."""
+        return ["face_detect", "face_embed", "face_detect_and_embed"]
+
+    @classmethod
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "FaceService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))

@@ -38,6 +38,11 @@ class OcrService(BaseService):
         super().__init__(registry)
 
     @classmethod
+    def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:  # noqa: ARG003
+        """Tasks this service would register (degraded-placeholder routes)."""
+        return ["ocr"]
+
+    @classmethod
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "OcrService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))

@@ -53,6 +53,11 @@ class VlmService(BaseService):
         super().__init__(registry)
 
     @classmethod
+    def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:  # noqa: ARG003
+        """Tasks this service would register (degraded-placeholder routes)."""
+        return ["vlm_generate", "vlm_generate_stream"]
+
+    @classmethod
     def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "VlmService":
         bs = service_config.backend_settings
         alias, mc = next(iter(service_config.models.items()))

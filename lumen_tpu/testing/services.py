@@ -1,8 +1,10 @@
-"""Built-in diagnostic echo service.
+"""Importable stand-in services for integration tests.
 
-Lets a deployment smoke-test the full wire path (routing, chunk reassembly,
-streaming, capabilities, health) before any model weights exist — point a
-config's ``registry_class`` at ``lumen_tpu.serving.echo.EchoService``.
+The built-in :class:`~lumen_tpu.serving.echo.EchoService` hard-codes its
+task names, so a hub config with two echo-backed services would collide on
+the route table. :class:`SecondaryEchoService` is the same diagnostic
+service under distinct task keys — resilience tests point one config
+service at each and fault-inject only one of them.
 """
 
 from __future__ import annotations
@@ -10,16 +12,18 @@ from __future__ import annotations
 import json
 
 from ..core.config import ServiceConfig
-from .base_service import BaseService
-from .registry import TaskDefinition, TaskRegistry
+from ..serving.base_service import BaseService
+from ..serving.registry import TaskDefinition, TaskRegistry
 
 
-class EchoService(BaseService):
-    def __init__(self, service_name: str = "echo"):
+class SecondaryEchoService(BaseService):
+    """Echo semantics under ``echo2*`` task names (see module docstring)."""
+
+    def __init__(self, service_name: str = "echo2"):
         registry = TaskRegistry(service_name)
         registry.register(
             TaskDefinition(
-                name="echo",
+                name="echo2",
                 handler=self._echo,
                 description="return the payload unchanged",
                 input_mimes=("application/octet-stream", "text/plain"),
@@ -28,7 +32,7 @@ class EchoService(BaseService):
         )
         registry.register(
             TaskDefinition(
-                name="echo_meta",
+                name="echo2_meta",
                 handler=self._echo_meta,
                 description="return request meta as JSON",
                 output_mime="application/json",
@@ -38,17 +42,16 @@ class EchoService(BaseService):
 
     @classmethod
     def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:  # noqa: ARG003
-        """Tasks this service would register (degraded-placeholder routes)."""
-        return ["echo", "echo_meta"]
+        return ["echo2", "echo2_meta"]
 
     @classmethod
-    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "EchoService":  # noqa: ARG003
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "SecondaryEchoService":  # noqa: ARG003
         return cls()
 
     def capability(self):
-        return self.registry.build_capability(model_ids=["echo"], runtime="none")
+        return self.registry.build_capability(model_ids=["echo2"], runtime="none")
 
-    def _echo(self, payload: bytes, mime: str, meta: dict[str, str]):
+    def _echo(self, payload: bytes, mime: str, meta: dict[str, str]):  # noqa: ARG002
         return payload, mime or "application/octet-stream", {}
 
     def _echo_meta(self, payload: bytes, mime: str, meta: dict[str, str]):  # noqa: ARG002

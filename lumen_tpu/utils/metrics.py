@@ -80,6 +80,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._hist: dict[str, LatencyHistogram] = {}
         self._errors: dict[str, int] = {}
+        self._counters: dict[str, int] = {}
         self._gauges: dict[str, Callable[[], dict]] = {}
         self.started_at = time.time()
 
@@ -115,10 +116,23 @@ class MetricsRegistry:
         with self._lock:
             self._errors[task] = self._errors.get(task, 0) + 1
 
+    def count(self, name: str, n: int = 1) -> None:
+        """Bump a named event counter (monotonic). The resilience layer
+        records load sheds, deadline drops, retries, and degraded-service
+        recoveries here — overload behavior must be observable, not
+        inferred from latency percentiles after the fact."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter_value(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def snapshot(self) -> dict:
         with self._lock:
             hists = dict(self._hist)
             errors = dict(self._errors)
+            counters = dict(self._counters)
             providers = dict(self._gauges)
         tasks = {
             name: {**h.snapshot(), "errors": errors.get(name, 0)}
@@ -148,6 +162,8 @@ class MetricsRegistry:
             "uptime_s": round(time.time() - self.started_at, 1),
             "tasks": dict(sorted(tasks.items())),
         }
+        if counters:
+            out["counters"] = dict(sorted(counters.items()))
         if gauges:
             out["gauges"] = gauges
         return out
@@ -218,6 +234,10 @@ class MetricsRegistry:
                 yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
             yield f'lumen_task_latency_ms_sum{{task="{name}"}} {s["sum_ms"]}'
             yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
+        if snap.get("counters"):
+            yield "# TYPE lumen_events_total counter"
+            for name, val in snap["counters"].items():
+                yield f'lumen_events_total{{event="{name}"}} {val}'
         if snap.get("gauges"):
             yield "# TYPE lumen_component_gauge gauge"
             for provider, vals in snap["gauges"].items():

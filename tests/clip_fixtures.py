@@ -7,23 +7,54 @@ import json
 import numpy as np
 
 
+#: Normalizer stat leaves get deterministic fills: a random ``var`` or
+#: ``scale`` can be ≤ 0 and would NaN the normalizer's rsqrt/division.
+#: ``mean``/``bias`` are sign-safe and stay random (keeping the rng draw
+#: order — and therefore every downstream fixture weight — stable).
+_ONES_LEAVES = frozenset({"var", "scale"})
+#: Leaf names allowed under a stats collection (``batch_stats`` etc.).
+#: Anything else fails loudly: a future stat leaf silently filled with
+#: random (possibly ≤ 0) values is exactly the bug this guard prevents.
+_KNOWN_STAT_LEAVES = frozenset({"var", "scale", "mean", "bias"})
+_STATS_COLLECTIONS = frozenset({"batch_stats"})
+
+
+def _path_keys(path) -> list:
+    """Concrete key names along a tree_map_with_path keypath."""
+    keys = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                keys.append(getattr(entry, attr))
+                break
+    return keys
+
+
 def random_variables(init_fn, scale=0.05, seed=0):
     """Shape-only flax init: ``eval_shape`` the init, fill host-side.
 
     Tests only need plausibly-random weights with the right tree structure;
     skipping the real ``Module.init`` avoids an XLA compile (~10s each on
-    CPU). BatchNorm/LayerNorm ``var``/``scale`` leaves are filled with ones —
-    a random variance can be ≤0 and would NaN the normalizer.
+    CPU). Normalizer stats are matched by explicit leaf name (``var`` /
+    ``scale`` -> ones) rather than a string-suffix heuristic, and an
+    unrecognized leaf under a stats collection raises instead of silently
+    receiving values that could be ≤ 0 and NaN the normalizer.
     """
     import jax
 
     rng = np.random.default_rng(seed)
 
     def fill(path, a):
-        name = jax.tree_util.keystr(path)
+        keys = _path_keys(path)
+        leaf = keys[-1] if keys else ""
+        if any(k in _STATS_COLLECTIONS for k in keys[:-1]) and leaf not in _KNOWN_STAT_LEAVES:
+            raise ValueError(
+                f"unknown normalizer stat leaf {leaf!r} at {jax.tree_util.keystr(path)}; "
+                f"add it to clip_fixtures with a sign-safe fill"
+            )
         if not np.issubdtype(a.dtype, np.floating):
             return np.zeros(a.shape, a.dtype)
-        if name.endswith("'var']") or name.endswith("'scale']"):
+        if leaf in _ONES_LEAVES:
             return np.ones(a.shape, a.dtype)
         return (rng.standard_normal(a.shape) * scale).astype(a.dtype)
 

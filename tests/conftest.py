@@ -141,7 +141,19 @@ def pytest_collection_modifyitems(config, items):
                 break
     # A stale pattern (renamed/deleted test) must fail collection loudly,
     # not silently stop tiering anything. Guard only full runs: a file- or
-    # node-scoped invocation legitimately collects a subset.
-    unmatched = set(_SLOW) - matched
+    # node-scoped invocation legitimately collects a subset. One excuse: a
+    # pattern whose file EXISTS on disk but yielded no items at all is an
+    # import-broken module running under --continue-on-collection-errors
+    # (e.g. a jax version missing shard_map) — pytest reports that error
+    # itself, and aborting the tolerated run here would hide it. A file
+    # absent from disk (deleted/renamed) is still flagged stale.
+    collected_files = {item.nodeid.split("tests/")[-1].split("::")[0] for item in items}
+    here = os.path.dirname(__file__)
+    unmatched = {
+        p
+        for p in set(_SLOW) - matched
+        if p.split("::")[0] in collected_files
+        or not os.path.exists(os.path.join(here, p.split("::")[0]))
+    }
     if len(items) > 400 and unmatched:
         raise pytest.UsageError(f"stale _SLOW patterns in conftest: {sorted(unmatched)}")

@@ -1,0 +1,154 @@
+"""MicroBatcher admission control + deadline semantics: bounded queue sheds
+with a counter, expired entries drop before the device call, and the
+``batch_execute`` fault point fans out to waiting callers."""
+
+import time
+
+import numpy as np
+import pytest
+
+from lumen_tpu.runtime.batcher import MicroBatcher, batch_queue_depth
+from lumen_tpu.testing import FaultInjected, faults
+from lumen_tpu.utils import deadline as request_deadline
+from lumen_tpu.utils.deadline import DeadlineExpired, QueueFull
+from lumen_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def identity(tree, n):
+    return tree
+
+
+class TestAdmissionControl:
+    def test_depth_limit_sheds_next_submit(self):
+        b = MicroBatcher(identity, max_batch=4, max_queue=2)  # NOT started: queue holds
+        before = metrics.counter_value("sheds")
+        b.submit(np.zeros(1))
+        b.submit(np.zeros(1))
+        with pytest.raises(QueueFull) as ei:
+            b.submit(np.zeros(1))
+        assert "request shed" in str(ei.value)
+        assert b.stats["shed"] == 1
+        assert metrics.counter_value("sheds") == before + 1
+        assert metrics.counter_value("sheds:batcher") >= 1
+        b.close()
+
+    def test_queue_drains_admit_again(self):
+        b = MicroBatcher(identity, max_batch=4, max_latency_ms=1, max_queue=2)
+        f1, f2 = b.submit(np.zeros(1)), b.submit(np.zeros(1))  # at the limit
+        b.start()
+        f1.result(timeout=5), f2.result(timeout=5)
+        # Drained: admission opens again.
+        assert np.asarray(b(np.zeros(1), timeout=5)).shape == (1,)
+        b.close()
+
+    def test_unbounded_by_default(self):
+        b = MicroBatcher(identity, max_batch=2)
+        assert b.max_queue == 0
+        for _ in range(64):
+            b.submit(np.zeros(1))
+        b.close()
+
+    def test_env_default_depth(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_BATCH_QUEUE_DEPTH", "7")
+        assert batch_queue_depth() == 7
+        assert MicroBatcher(identity).max_queue == 7
+        monkeypatch.setenv("LUMEN_BATCH_QUEUE_DEPTH", "nope")
+        assert batch_queue_depth() == 0
+
+
+class TestDeadlineDrops:
+    def test_expired_at_submit_rejected(self):
+        b = MicroBatcher(identity, max_batch=2)
+        before = metrics.counter_value("deadline_drops")
+        with pytest.raises(DeadlineExpired):
+            b.submit(np.zeros(1), deadline=time.monotonic() - 0.1)
+        assert metrics.counter_value("deadline_drops") == before + 1
+        b.close()
+
+    def test_expired_while_queued_dropped_before_device_call(self):
+        device_calls = []
+
+        def fn(tree, n):
+            device_calls.append(n)
+            return tree
+
+        b = MicroBatcher(fn, max_batch=4, max_latency_ms=1, name="dl-t")
+        # Enqueue while the collector is not running, so expiry is
+        # deterministic: one doomed entry, one live entry.
+        doomed = b.submit(np.zeros(1), deadline=time.monotonic() + 0.01)
+        live = b.submit(np.zeros(1))
+        time.sleep(0.05)
+        before = metrics.counter_value("deadline_drops")
+        b.start()
+        assert np.asarray(live.result(timeout=5)).shape == (1,)
+        with pytest.raises(DeadlineExpired):
+            doomed.result(timeout=5)
+        # The batch ran once, with only the live row.
+        assert device_calls == [1]
+        assert b.stats["expired"] == 1
+        assert metrics.counter_value("deadline_drops") == before + 1
+        assert metrics.counter_value("deadline_drops:dl-t") >= 1
+        b.close()
+
+    def test_all_expired_skips_device_call(self):
+        device_calls = []
+
+        def fn(tree, n):
+            device_calls.append(n)
+            return tree
+
+        b = MicroBatcher(fn, max_batch=2, max_latency_ms=1)
+        f1 = b.submit(np.zeros(1), deadline=time.monotonic() + 0.01)
+        f2 = b.submit(np.zeros(1), deadline=time.monotonic() + 0.01)
+        time.sleep(0.05)
+        b.start()
+        for f in (f1, f2):
+            with pytest.raises(DeadlineExpired):
+                f.result(timeout=5)
+        b.close()
+        assert device_calls == []
+
+    def test_ambient_context_deadline_inherited(self):
+        b = MicroBatcher(identity, max_batch=2)
+        token = request_deadline.set_deadline(time.monotonic() - 0.1)
+        try:
+            with pytest.raises(DeadlineExpired):
+                b.submit(np.zeros(1))  # no explicit deadline: reads contextvar
+        finally:
+            request_deadline.reset(token)
+        b.close()
+
+    def test_call_timeout_bounded_by_ambient_deadline(self):
+        b = MicroBatcher(identity, max_batch=1, max_latency_ms=1).start()
+        token = request_deadline.set_deadline(time.monotonic() + 30.0)
+        try:
+            out = b(np.zeros(2))  # plenty of budget: normal result
+        finally:
+            request_deadline.reset(token)
+        assert np.asarray(out).shape == (2,)
+        b.close()
+
+
+class TestBatchExecuteFault:
+    def test_fault_fans_out_to_callers(self):
+        faults.configure("batch_execute", times=1, match="flaky")
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=1, name="flaky").start()
+        fut = b.submit(np.zeros(1))
+        with pytest.raises(FaultInjected):
+            fut.result(timeout=5)
+        # Fault exhausted: next batch succeeds (the batcher survives).
+        assert np.asarray(b(np.zeros(1), timeout=5)).shape == (1,)
+        b.close()
+
+    def test_unmatched_batcher_unaffected(self):
+        faults.configure("batch_execute", match="other-batcher")
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=1, name="steady").start()
+        assert np.asarray(b(np.zeros(1), timeout=5)).shape == (1,)
+        b.close()

@@ -1,0 +1,136 @@
+"""CPU-CI coverage for the Pallas w8a16 dequant-matmul (interpret mode) and
+its routing gates: ``LUMEN_Q8_PALLAS=1`` forces interpret execution off-TPU
+and the kernel must match the XLA dequant reference exactly for aligned and
+row-padded shapes; tensor-parallel meshes and non-bf16 activations must
+never route to it."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lumen_tpu.ops import quant_matmul as qm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_model_axis(monkeypatch):
+    # The TP gate is a sticky process-global (any earlier test that built a
+    # model-axis mesh would otherwise disable routing here).
+    monkeypatch.setattr(qm, "_MESH_MODEL_AXIS", 1)
+
+
+def _case(rows, k, n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((rows, k)) * 0.1, jnp.bfloat16)
+    q = jnp.asarray(rng.integers(-127, 128, size=(k, n), dtype=np.int8))
+    scale = jnp.asarray((rng.uniform(0.5, 1.5, size=n) / 127.0).astype(np.float32))
+    return x, q, scale
+
+
+def _reference(x, q, scale):
+    """(x @ q.astype(f32)) * scale, rounded to the kernel's output dtype."""
+    acc = np.asarray(x, np.float32) @ np.asarray(q, np.float32)
+    return jnp.asarray(acc * np.asarray(scale), x.dtype)
+
+
+class TestW8A16Interpret:
+    @pytest.mark.parametrize("rows,k,n", [(8, 64, 256), (16, 128, 128), (32, 96, 384)])
+    def test_matches_reference_aligned(self, monkeypatch, rows, k, n):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        x, q, scale = _case(rows, k, n)
+        assert qm.pallas_usable(rows, k, n, x.dtype)
+        y = qm.w8a16_matmul(x, q, scale)
+        assert y.dtype == x.dtype and y.shape == (rows, n)
+        np.testing.assert_array_equal(
+            np.asarray(y, np.float32), np.asarray(_reference(x, q, scale), np.float32)
+        )
+
+    @pytest.mark.parametrize("rows", [1, 3, 5])
+    def test_matches_reference_row_padded(self, monkeypatch, rows):
+        # rows not a multiple of the f32/bf16 sublane (8): the kernel pads
+        # internally and must slice the pad rows back off.
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        x, q, scale = _case(rows, 64, 128, seed=rows)
+        y = qm.w8a16_matmul(x, q, scale)
+        assert y.shape == (rows, 128)
+        np.testing.assert_array_equal(
+            np.asarray(y, np.float32), np.asarray(_reference(x, q, scale), np.float32)
+        )
+
+    def test_leading_dims_flattened(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        x2, q, scale = _case(6, 64, 128, seed=42)
+        x3 = x2.reshape(2, 3, 64)
+        y3 = qm.w8a16_matmul(x3, q, scale)
+        assert y3.shape == (2, 3, 128)
+        np.testing.assert_array_equal(
+            np.asarray(y3, np.float32).reshape(6, 128),
+            np.asarray(qm.w8a16_matmul(x2, q, scale), np.float32),
+        )
+
+
+class TestRoutingGates:
+    def test_forced_on_for_bf16(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        assert qm.pallas_usable(8, 64, 128, jnp.bfloat16)
+
+    def test_f32_activations_never_route(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        assert not qm.pallas_usable(8, 64, 128, jnp.float32)
+
+    def test_dtype_unknown_is_permissive(self, monkeypatch):
+        # Legacy call sites without a dtype keep the old behavior.
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        assert qm.pallas_usable(8, 64, 128)
+
+    def test_tp_model_axis_disables_route(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        monkeypatch.setattr(qm, "_MESH_MODEL_AXIS", 2)
+        assert not qm.pallas_usable(8, 64, 128, jnp.bfloat16)
+
+    def test_note_mesh_model_axis_sticky_max(self, monkeypatch):
+        monkeypatch.setattr(qm, "_MESH_MODEL_AXIS", 1)
+        qm.note_mesh_model_axis(4)
+        qm.note_mesh_model_axis(1)  # a later replicated mesh must not re-enable
+        assert qm._MESH_MODEL_AXIS == 4
+
+    def test_alignment_and_row_gates(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        assert not qm.pallas_usable(qm.MAX_PALLAS_ROWS + 1, 64, 128, jnp.bfloat16)
+        assert not qm.pallas_usable(8, 60, 128, jnp.bfloat16)  # K % 32
+        assert not qm.pallas_usable(8, 64, 100, jnp.bfloat16)  # N % 128
+
+    def test_kill_switch_wins(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "0")
+        assert not qm.pallas_usable(8, 64, 128, jnp.bfloat16)
+
+    def test_qdense_f32_falls_back_to_xla(self, monkeypatch):
+        # End-to-end: an f32 caller with pallas forced on must take the XLA
+        # dequant path (same math, caller's dtype) without touching pallas.
+        from lumen_tpu.ops.quant import QDense
+
+        monkeypatch.setenv("LUMEN_Q8_PALLAS", "1")
+        called = []
+        orig = qm.w8a16_matmul
+
+        def spy(*a, **kw):
+            called.append(1)
+            return orig(*a, **kw)
+
+        monkeypatch.setattr("lumen_tpu.ops.quant.w8a16_matmul", spy)
+        layer = QDense(features=128, use_bias=False, kernel_mode="dequant")
+        x32 = jnp.asarray(np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
+        params = {
+            "params": {
+                "q": jnp.asarray(
+                    np.random.default_rng(1).integers(-127, 128, (64, 128), np.int8)
+                ),
+                "scale": jnp.ones((128,), jnp.float32),
+            }
+        }
+        y = layer.apply(params, x32)
+        assert y.shape == (4, 128) and called == []
+
+        xbf = x32.astype(jnp.bfloat16)
+        y = layer.apply(params, xbf)
+        assert y.shape == (4, 128) and called == [1]

@@ -50,7 +50,8 @@ class TestMesh:
     def test_data_parallel_psum(self):
         # Sanity: a shard_map psum over the data axis actually reduces.
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+
+        from lumen_tpu.parallel.compat import shard_map
 
         mesh = build_mesh({"data": -1})
         x = np.arange(8, dtype=np.float32)

@@ -78,3 +78,34 @@ class TestCompileCache:
             assert jax.config.jax_compilation_cache_dir == str(target)
         finally:
             jax.config.update("jax_compilation_cache_dir", before)
+
+
+class TestRandomVariablesGuards:
+    """tests/clip_fixtures.random_variables: normalizer stats are matched by
+    explicit leaf name, and unknown stat leaves fail loudly instead of
+    receiving random (possibly <= 0) fills that would NaN the normalizer."""
+
+    def _tree(self, leaves):
+        import jax.numpy as jnp
+
+        return lambda: {
+            "params": {"proj": {"kernel": jnp.zeros((4, 4))}},
+            "batch_stats": {"norm": {k: jnp.ones((4,)) for k in leaves}},
+        }
+
+    def test_var_scale_filled_with_ones(self):
+        from tests.clip_fixtures import random_variables
+
+        tree = random_variables(self._tree(["var", "mean"]))
+        import numpy as np
+
+        assert np.all(np.asarray(tree["batch_stats"]["norm"]["var"]) == 1.0)
+        assert np.any(np.asarray(tree["params"]["proj"]["kernel"]) != 0.0)
+
+    def test_unknown_stat_leaf_raises(self):
+        import pytest
+
+        from tests.clip_fixtures import random_variables
+
+        with pytest.raises(ValueError, match="unknown normalizer stat leaf"):
+            random_variables(self._tree(["var", "running_median"]))
