@@ -696,7 +696,10 @@ def phase_ingest(n_images: int = 256) -> dict:
     result = {
         "images_per_sec": round(n_images / dt, 1),
         # Lane telemetry: is the end-to-end number decode(host)-bound or
-        # device-bound? Decides where round-4 effort goes.
+        # device-bound? Decides where round-4 effort goes. stage_stats now
+        # carries max_inflight (device lane) and the decode-pool gauges
+        # under "pool" (host lane: workers / queue_depth / wait_ms_p50) so
+        # future rounds can see which of the three lanes binds.
         "stage_stats": pipe.stats.as_dict(),
         "platform": jax.devices()[0].platform,
     }
@@ -1379,6 +1382,16 @@ def phase_bench_grpc() -> dict:
             out["clip_image_embed_c10"] = _grpc_measure(
                 stub, pb, "clip_image_embed", jpeg, "image/jpeg", {}, n, 10
             )
+            # Lane telemetry while the components are still live (gauges
+            # unregister on close): did c10 traffic actually pipeline
+            # (batcher inflight) and queue on decode (pool wait p50)?
+            from lumen_tpu.utils.metrics import metrics as _metrics
+
+            gauges = _metrics.snapshot().get("gauges", {})
+            out["lane_telemetry"] = {
+                "batcher_clip_image": gauges.get("batcher:clip-image", {}),
+                "decode_pool": gauges.get("decode_pool", {}),
+            }
         finally:
             channel.close()
             server.stop(0)

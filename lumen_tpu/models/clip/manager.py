@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Literal
 
@@ -37,9 +38,11 @@ from ...ops.image import (
     decode_image_bytes,
 )
 from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
+from ...runtime.decode_pool import get_decode_pool
 from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_state_dict
+from ...utils.metrics import metrics
 from .convert import convert_clip_checkpoint
 from .modeling import CLIPConfig, CLIPModel
 from .tokenizer import ClipTokenizer
@@ -133,6 +136,11 @@ class CLIPManager:
             )
         self.model = CLIPModel(self.cfg)
         self.model_id = self.info.name
+        # Serving route actually in use ("bf16" | "int8"): int8 is opt-in
+        # via `quantize` AND verified — BENCH_r05 measured q8 at 0.923x
+        # bf16 on v5e, so a warmup-timed A/B may fall the route back.
+        self.quant_route = "bf16"
+        self.quant_speedup: float | None = None  # measured q8/bf16, when timed
         self._initialized = False
         self._image_batcher: MicroBatcher | None = None
         self._text_batcher: MicroBatcher | None = None
@@ -251,12 +259,12 @@ class CLIPManager:
             # kernels to (q, scale) afterwards, on the cast weights.
             import dataclasses
 
-            gate_model = (
+            base_model = (
                 CLIPModel(dataclasses.replace(self.cfg, weight_quant=None))
                 if self.quantize else self.model
             )
             init = jax.eval_shape(
-                lambda: gate_model.init(
+                lambda: base_model.init(
                     jax.random.PRNGKey(0),
                     jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), jnp.float32),
                     jnp.zeros((1, self.cfg.context_length), jnp.int32),
@@ -264,47 +272,71 @@ class CLIPManager:
             )
             params = convert_clip_checkpoint(state, init)
             params = self.policy.cast_params(params)
+            qparams = None
             if self.quantize == "int8":
-                from .convert import quantize_clip_int8
+                # A bf16 route pin skips quantization entirely — an
+                # operator who pinned away from the q8 regression must not
+                # pay a full-checkpoint quantization at every boot just to
+                # discard it.
+                if os.environ.get("LUMEN_CLIP_Q8_ROUTE", "auto").lower() == "bf16":
+                    logger.info(
+                        "CLIP quantize=int8 overridden to bf16 "
+                        "(LUMEN_CLIP_Q8_ROUTE); skipping quantization"
+                    )
+                else:
+                    from .convert import quantize_clip_int8
 
-                params = quantize_clip_int8(
-                    params, include_text=self.cfg.text_arch != "bert"
-                )
-            # DP serving: params replicated over the mesh; micro-batches are
-            # data-sharded so one batched call spreads across every device
-            # (trivial placement on a 1-device mesh). A mesh with a
-            # ``model`` axis additionally tensor-parallelizes the towers
-            # (both towers are standard transformers, so the shared TP
-            # rules apply — SURVEY §2.8).
-            if dict(self.mesh.shape).get("model", 1) > 1:
-                from ...parallel.sharding import (
-                    INT8_TP_RULES,
-                    TRANSFORMER_TP_RULES,
-                    shard_params,
-                )
+                    qparams = quantize_clip_int8(
+                        params, include_text=self.cfg.text_arch != "bert"
+                    )
 
-                rules = (INT8_TP_RULES if self.quantize else []) + TRANSFORMER_TP_RULES
-                self.params = shard_params(params, self.mesh, rules)
+            def place(p, quantized: bool):
+                # DP serving: params replicated over the mesh; micro-batches
+                # are data-sharded so one batched call spreads across every
+                # device (trivial placement on a 1-device mesh). A mesh with
+                # a ``model`` axis additionally tensor-parallelizes the
+                # towers (both towers are standard transformers, so the
+                # shared TP rules apply — SURVEY §2.8).
+                if dict(self.mesh.shape).get("model", 1) > 1:
+                    from ...parallel.sharding import (
+                        INT8_TP_RULES,
+                        TRANSFORMER_TP_RULES,
+                        shard_params,
+                    )
+
+                    rules = (INT8_TP_RULES if quantized else []) + TRANSFORMER_TP_RULES
+                    return shard_params(p, self.mesh, rules)
+                return replicate(p, self.mesh)
+
+            def make_encoders(model):
+                @jax.jit
+                def encode_images(params, pixels_u8):
+                    # pixels_u8: [B, S, S, 3] uint8 (resized on host or
+                    # device-resized upstream); normalize + cast on device.
+                    x = pixels_u8.astype(jnp.float32) / 255.0
+                    x = (x - jnp.asarray(mean)) / jnp.asarray(std)
+                    z = model.apply(
+                        {"params": params},
+                        x.astype(compute_dtype),
+                        method=lambda m, px: m.encode_image(px),
+                    )
+                    return z  # fp32 unit-norm
+
+                @jax.jit
+                def encode_texts(params, ids):
+                    return model.apply(
+                        {"params": params}, ids, method=lambda m, i: m.encode_text(i)
+                    )
+
+                return encode_images, encode_texts
+
+            if qparams is None:
+                self.model = base_model
+                self.params = place(params, quantized=False)
+                encode_images, encode_texts = make_encoders(base_model)
             else:
-                self.params = replicate(params, self.mesh)
-
-            @jax.jit
-            def encode_images(params, pixels_u8):
-                # pixels_u8: [B, S, S, 3] uint8 (resized on host or device-
-                # resized upstream); normalize + cast on device.
-                x = pixels_u8.astype(jnp.float32) / 255.0
-                x = (x - jnp.asarray(mean)) / jnp.asarray(std)
-                z = self.model.apply(
-                    {"params": params},
-                    x.astype(compute_dtype),
-                    method=lambda m, px: m.encode_image(px),
-                )
-                return z  # fp32 unit-norm
-
-            @jax.jit
-            def encode_texts(params, ids):
-                return self.model.apply(
-                    {"params": params}, ids, method=lambda m, i: m.encode_text(i)
+                encode_images, encode_texts = self._pick_quant_route(
+                    base_model, params, qparams, place, make_encoders
                 )
 
         else:
@@ -371,9 +403,13 @@ class CLIPManager:
 
         dp = self.mesh.shape.get("data", 1)
         buckets = mesh_buckets(self.batch_size, dp)
+        # Batcher fns DISPATCH and return the un-fetched device array: the
+        # MicroBatcher's fetch worker does the one blocking device->host
+        # transfer per batch, so the next batch stacks/transfers/dispatches
+        # while this one computes (the pipelined serving data path).
         self._image_batcher = MicroBatcher(
             mesh_sharded(
-                lambda pixels, n: np.asarray(self._encode_images(self.params, pixels)),
+                lambda pixels, n: self._encode_images(self.params, pixels),
                 self.mesh,
             ),
             max_batch=buckets[-1],
@@ -383,7 +419,7 @@ class CLIPManager:
         ).start()
         self._text_batcher = MicroBatcher(
             mesh_sharded(
-                lambda ids, n: np.asarray(self._encode_texts(self.params, ids)),
+                lambda ids, n: self._encode_texts(self.params, ids),
                 self.mesh,
             ),
             max_batch=buckets[-1],
@@ -395,6 +431,24 @@ class CLIPManager:
         self._load_label_embeddings()
         if self.warmup:
             self._warmup(buckets)
+        if self.quantize:
+            # The chosen route is operator-visible state, not a log line:
+            # "is this deployment actually serving int8?" must be
+            # answerable from /metrics (gauge ``int8_active``, plus the
+            # measured ``q8_speedup_pct`` when the warmup A/B ran).
+            ref = weakref.ref(self)
+
+            def _route_gauges() -> dict:
+                m = ref()
+                if m is None:
+                    return {}
+                out = {"int8_active": 1 if m.quant_route == "int8" else 0}
+                if m.quant_speedup is not None:
+                    out["q8_speedup_pct"] = round(m.quant_speedup * 100, 1)
+                return out
+
+            self._route_gauge_fn = _route_gauges
+            metrics.register_gauges(f"clip-quant:{self.model_id}", _route_gauges)
         self._initialized = True
         logger.info(
             "CLIP ready: %s embed_dim=%d labels=%d",
@@ -421,7 +475,95 @@ class CLIPManager:
             self._image_batcher.close()
         if self._text_batcher:
             self._text_batcher.close()
+        if fn := getattr(self, "_route_gauge_fn", None):
+            metrics.unregister_gauges(f"clip-quant:{self.model_id}", fn)
         self._initialized = False
+
+    # -- quantization route ------------------------------------------------
+
+    def _pick_quant_route(self, base_model, params, qparams, place, make_encoders):
+        """Decide whether the explicit int8 opt-in actually serves int8.
+
+        BENCH_r05 measured the W8A8 dynamic kernel at 0.923x bf16 on v5e —
+        a *regression* the operator opting into "int8" almost certainly
+        did not want. So when warmup is on, the two routes run a one-shot
+        timed A/B at the top serving bucket and the loser's params are
+        dropped; int8 only survives when it measures at least even. With
+        warmup off there is nothing to time against, so the explicit
+        config wins as-is. ``LUMEN_CLIP_Q8_ROUTE=int8|bf16`` pins the
+        route (skips the A/B); ``auto`` (default) is the behavior above.
+        Returns the chosen ``(encode_images, encode_texts)`` pair and sets
+        ``self.model`` / ``self.params`` / ``self.quant_route``.
+        """
+        q_model = self.model  # built with weight_quant in __init__
+        # A "bf16" pin never reaches here — initialize() skips the
+        # quantization entirely in that case, so qparams is None and the
+        # non-quantized path runs instead.
+        route = os.environ.get("LUMEN_CLIP_Q8_ROUTE", "auto").lower()
+        if route not in ("auto", "int8"):
+            logger.warning("ignoring malformed LUMEN_CLIP_Q8_ROUTE=%r", route)
+            route = "auto"
+        if route == "auto" and not self.warmup:
+            route = "int8"  # no warmup pass to time against: honor the opt-in
+        if route == "int8":
+            self.quant_route = "int8"
+            self.params = place(qparams, quantized=True)
+            return make_encoders(q_model)
+
+        # One-shot warmup A/B, timed SEQUENTIALLY so peak HBM stays at one
+        # tower set plus activations — memory-tight deployments quantize
+        # precisely because bf16 barely fits, and a transient 2x at boot
+        # would OOM exactly them. The loser's placement is freed before
+        # the winner's (the q8 measurement's placement is reused when q8
+        # wins; a bf16 win pays one extra host->device transfer).
+        enc_bf16 = make_encoders(base_model)
+        enc_q8 = make_encoders(q_model)
+        params_bf16 = place(params, quantized=False)
+        t_bf16 = self._time_image_encode(enc_bf16[0], params_bf16)
+        del params_bf16  # free the bf16 placement before placing q8
+        params_q8 = place(qparams, quantized=True)
+        t_q8 = self._time_image_encode(enc_q8[0], params_q8)
+        self.quant_speedup = t_bf16 / max(t_q8, 1e-9)
+        if self.quant_speedup >= 1.0:
+            logger.info(
+                "CLIP int8 route confirmed: %.3fx bf16 at batch bucket",
+                self.quant_speedup,
+            )
+            self.quant_route = "int8"
+            self.params = params_q8
+            return enc_q8
+        logger.warning(
+            "CLIP int8 route DISABLED: warmup A/B measured q8 at %.3fx bf16 "
+            "(a regression); serving bf16 instead. Pin LUMEN_CLIP_Q8_ROUTE="
+            "int8 to force.",
+            self.quant_speedup,
+        )
+        metrics.count("clip_q8_fallbacks")
+        self.quant_route = "bf16"
+        self.model = base_model
+        del params_q8
+        self.params = place(params, quantized=False)
+        return enc_bf16
+
+    def _time_image_encode(self, encode, placed_params) -> float:
+        """Best-of-3 wall time for one image-encode at the top serving
+        bucket, inputs placed exactly like serving traffic (data-sharded)
+        so the compiles land in the same cache the batcher warmup hits."""
+        from ...runtime.mesh import data_sharding
+
+        dp = self.mesh.shape.get("data", 1)
+        bucket = mesh_buckets(self.batch_size, dp)[-1]
+        size = self.cfg.image_size
+        x = jax.device_put(
+            np.zeros((bucket, size, size, 3), np.uint8), data_sharding(self.mesh)
+        )
+        jax.block_until_ready(encode(placed_params, x))  # compile off the clock
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(encode(placed_params, x))
+            times.append(time.perf_counter() - t0)
+        return min(times)
 
     # -- datasets ---------------------------------------------------------
 
@@ -482,15 +624,21 @@ class CLIPManager:
 
     def encode_image(self, image_bytes: bytes) -> np.ndarray:
         """Single image bytes -> unit-norm fp32 embedding (batched under the
-        hood with concurrent callers)."""
+        hood with concurrent callers). Decode+resize run on the shared
+        decode pool — the calling (gRPC handler) thread only waits, so
+        decode concurrency is bounded by ``LUMEN_DECODE_WORKERS``, not by
+        however many handler threads pile in."""
         self._ensure_ready()
+        resized = get_decode_pool().run(self._decode_resize, image_bytes)
+        vec = self._image_batcher(resized)
+        return self._check_vector(vec)
+
+    def _decode_resize(self, image_bytes: bytes) -> np.ndarray:
         import cv2
 
         img = decode_image_bytes(image_bytes, color="rgb")
         size = self.cfg.image_size
-        resized = cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
-        vec = self._image_batcher(resized)
-        return self._check_vector(vec)
+        return cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
 
     def encode_text(self, text: str) -> np.ndarray:
         self._ensure_ready()

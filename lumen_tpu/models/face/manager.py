@@ -35,6 +35,7 @@ from ...runtime.batcher import (
     mesh_sharded,
     warmup_batcher,
 )
+from ...runtime.decode_pool import get_decode_pool
 from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
@@ -251,11 +252,12 @@ class FaceManager:
         dp = self.mesh.shape.get("data", 1)
         det_buckets = mesh_buckets(self.batch_size, dp)
         rec_buckets = mesh_buckets(max(self.batch_size, 16), dp)
+        # Batcher fns dispatch async and return un-fetched device trees;
+        # the MicroBatcher fetch worker makes the one blocking transfer
+        # per batch (pipelined executor — batch k+1 stacks while k runs).
         self._det_batcher = MicroBatcher(
             mesh_sharded(
-                lambda imgs, n: jax.tree_util.tree_map(
-                    np.asarray, self._run_detector(self.det_vars, imgs)
-                ),
+                lambda imgs, n: self._run_detector(self.det_vars, imgs),
                 self.mesh,
             ),
             max_batch=det_buckets[-1],
@@ -265,7 +267,7 @@ class FaceManager:
         ).start()
         self._rec_batcher = MicroBatcher(
             mesh_sharded(
-                lambda crops, n: np.asarray(self._run_embedder(self.rec_vars, crops)),
+                lambda crops, n: self._run_embedder(self.rec_vars, crops),
                 self.mesh,
             ),
             max_batch=rec_buckets[-1],
@@ -304,7 +306,7 @@ class FaceManager:
     ) -> list[FaceDetection]:
         self._ensure_ready()
         img = (
-            decode_image_bytes(image, color="rgb")
+            get_decode_pool().run(decode_image_bytes, image, color="rgb")
             if isinstance(image, (bytes, bytearray))
             else np.asarray(image)
         )
@@ -428,7 +430,7 @@ class FaceManager:
     ) -> np.ndarray:
         self._ensure_ready()
         img = (
-            decode_image_bytes(face_image, color="rgb")
+            get_decode_pool().run(decode_image_bytes, face_image, color="rgb")
             if isinstance(face_image, (bytes, bytearray))
             else np.asarray(face_image)
         )
@@ -440,8 +442,9 @@ class FaceManager:
     def detect_and_extract(
         self, image_bytes: bytes, max_faces: int | None = None, **det_kw
     ) -> list[FaceDetection]:
-        # Decode once; detection and cropping share the array.
-        img = decode_image_bytes(image_bytes, color="rgb")
+        # Decode once (on the shared pool — never on the gRPC handler
+        # thread); detection and cropping share the array.
+        img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
         faces = self.detect_faces(img, max_faces=max_faces, **det_kw)
         if not faces:
             return faces
@@ -489,7 +492,7 @@ class FaceManager:
 
     @staticmethod
     def crop_face(image_bytes: bytes, bbox: np.ndarray, margin: float = 0.0) -> np.ndarray:
-        img = decode_image_bytes(image_bytes, color="rgb")
+        img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
         h, w = img.shape[:2]
         x1, y1, x2, y2 = bbox
         mw, mh = (x2 - x1) * margin, (y2 - y1) * margin

@@ -30,6 +30,7 @@ from ...core.model_info import dataclass_from_extra, load_model_info
 from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...runtime.batcher import bucket_for
+from ...runtime.decode_pool import get_decode_pool
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
 from .convert import convert_ocr_checkpoint
@@ -487,8 +488,10 @@ class OcrManager:
         use_angle_cls: bool = False,
     ) -> list[OcrResult]:
         """Full pipeline on raw image bytes (reference ``predict`` contract,
-        ``lumen_ocr/backends/base.py:63-136``, including ``use_angle_cls``)."""
-        img = decode_image_bytes(image_bytes, color="rgb")
+        ``lumen_ocr/backends/base.py:63-136``, including ``use_angle_cls``).
+        Decode runs on the shared pool, keeping the gRPC handler thread out
+        of CPU-bound image work."""
+        img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
         boxes = self.detect(
             img,
             det_threshold=det_threshold,

@@ -26,6 +26,7 @@ import numpy as np
 
 from ...core.model_info import ModelInfo, load_model_info
 from ...ops.image import decode_image_bytes
+from ...runtime.decode_pool import get_decode_pool
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_state_dict
 from ...utils.metrics import metrics
@@ -570,9 +571,23 @@ class VLMManager:
                 return b
         raise ValueError(f"prompt of {n} tokens exceeds the largest bucket {self.prefill_buckets[-1]}")
 
-    def _prepare_inputs(self, messages, image_bytes, add_generation_prompt: bool = True):
+    def _decode_canvas(self, image_bytes: bytes) -> np.ndarray:
+        """Decode + pad-to-square letterbox (reference
+        ``_run_vision_encoder:661-729``); runs on the shared decode pool so
+        gRPC handler threads never do CPU-bound image work inline."""
         import cv2
 
+        img = decode_image_bytes(image_bytes, color="rgb")
+        size = self.cfg.vision.image_size
+        h, w = img.shape[:2]
+        scale = size / max(h, w)
+        nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+        resized = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
+        canvas = np.zeros((size, size, 3), np.uint8)
+        canvas[:nh, :nw] = resized
+        return canvas
+
+    def _prepare_inputs(self, messages, image_bytes, add_generation_prompt: bool = True):
         has_image = bool(image_bytes)
         ids = self._encode_prompt(messages, has_image, add_generation_prompt)
         n = len(ids)
@@ -581,15 +596,7 @@ class VLMManager:
         padded[0, :n] = ids
         length = jnp.asarray([n], jnp.int32)
         if has_image:
-            img = decode_image_bytes(image_bytes, color="rgb")
-            size = self.cfg.vision.image_size
-            # Pad-to-square letterbox, reference ``_run_vision_encoder:661-729``.
-            h, w = img.shape[:2]
-            scale = size / max(h, w)
-            nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
-            resized = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
-            canvas = np.zeros((size, size, 3), np.uint8)
-            canvas[:nh, :nw] = resized
+            canvas = get_decode_pool().run(self._decode_canvas, image_bytes)
             embeds, positions, lengths = self._prepare(
                 self.params, jnp.asarray(canvas[None]), jnp.asarray(padded), length
             )

@@ -24,18 +24,17 @@ ONNX session call per payload (``SURVEY.md`` §3.2).
 from __future__ import annotations
 
 import logging
-import os
 import queue
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
 
 from lumen_tpu.runtime.batcher import stack_and_pad, unstack
+from lumen_tpu.runtime.decode_pool import DecodePool, get_decode_pool
 from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
 
 logger = logging.getLogger(__name__)
@@ -66,13 +65,15 @@ class IngestStats:
     decode_s: float = 0.0  # producer-lane time (decode + preprocess + transfer)
     device_s: float = 0.0  # consumer time blocked on device fetches
     post_s: float = 0.0
+    max_inflight: int = 0  # high-water mark of dispatched-unfetched batches
+    pool: dict = field(default_factory=dict)  # decode-pool gauges at run end
 
     @property
     def items_per_sec(self) -> float:
         return self.items / self.wall_s if self.wall_s > 0 else 0.0
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "items": self.items,
             "batches": self.batches,
             "wall_s": round(self.wall_s, 4),
@@ -80,7 +81,11 @@ class IngestStats:
             "decode_s": round(self.decode_s, 4),
             "device_s": round(self.device_s, 4),
             "post_s": round(self.post_s, 4),
+            "max_inflight": self.max_inflight,
         }
+        if self.pool:
+            out["pool"] = self.pool
+        return out
 
 
 class _Batch:
@@ -124,24 +129,49 @@ class IngestPipeline:
         self.batch_size = batch_size
         self.prefetch = max(prefetch, 1)
         self.inflight = max(inflight, 1)
-        self.workers = workers or min(os.cpu_count() or 4, 16)
+        # Host decode/preprocess lane: the process-wide shared pool
+        # (LUMEN_DECODE_WORKERS) by default, so concurrent pipelines and
+        # the serving managers contend for one sized set of decode
+        # threads instead of each spawning their own. An explicit
+        # ``workers`` pins a private pool instead — created per run() and
+        # torn down with it, so a dropped pipeline object leaks neither
+        # threads nor metrics-gauge registrations.
+        self._pinned_workers = max(0, workers or 0)
         #: optional per-item record enrichment from the decoded value (e.g.
         #: surfacing decode-failure markers set by a fault-tolerant decode)
         self.annotate = annotate
         self._sharding = data_sharding(mesh)
         self.stats = IngestStats()  # stats of the most recent run()
+        self._run_pool_tasks = 0
+
+    @property
+    def pool(self) -> DecodePool | None:
+        """The shared pool, resolved at use time (a `shutdown_decode_pool`
+        + rebuild between runs must not strand this pipeline on a closed
+        executor); ``None`` when ``workers`` pins a run-scoped private
+        pool."""
+        return None if self._pinned_workers else get_decode_pool()
+
+    @property
+    def workers(self) -> int:
+        pool = self.pool
+        return pool.workers if pool is not None else self._pinned_workers
 
     # -- producer lane ----------------------------------------------------
 
-    def _prepare(self, pool: ThreadPoolExecutor, raw_items: list) -> _Batch:
-        decoded = list(pool.map(self.decode, raw_items))
+    def _prepare(self, pool: DecodePool, raw_items: list) -> _Batch:
+        decoded = pool.map(self.decode, raw_items)
         inputs: dict[str, Any] = {}
         for stage in self.stages:
-            trees = list(pool.map(stage.preprocess, decoded))
+            trees = pool.map(stage.preprocess, decoded)
             stacked = stack_and_pad(trees, self.batch_size)
             inputs[stage.name] = jax.tree_util.tree_map(
                 lambda leaf: jax.device_put(leaf, self._sharding), stacked
             )
+        # Producer-side count (only the producer thread writes): the pool's
+        # own `tasks` gauge is process-wide, so THIS run's decode work has
+        # to be tallied where it is submitted.
+        self._run_pool_tasks += len(raw_items) * (1 + len(self.stages))
         return _Batch(decoded, inputs, len(raw_items))
 
     @staticmethod
@@ -156,30 +186,48 @@ class IngestPipeline:
                 continue
         return False
 
-    def _producer(self, items: Iterable[Any], out: queue.Queue, stop: threading.Event) -> None:
+    def _producer(
+        self,
+        items: Iterable[Any],
+        out: queue.Queue,
+        stop: threading.Event,
+        pool: DecodePool | None,
+    ) -> None:
+        # ``pool`` is run()'s single resolve of the shared pool (None when
+        # ``workers`` is pinned) — resolving again here could land on a
+        # different pool if the shared one is rebuilt mid-run, and the
+        # finally-block gauge snapshot would describe the wrong pool.
+        private: DecodePool | None = None
         try:
-            with ThreadPoolExecutor(self.workers, thread_name_prefix="ingest-prep") as pool:
-                chunk: list = []
-                for item in items:
-                    if stop.is_set():
-                        return
-                    chunk.append(item)
-                    if len(chunk) == self.batch_size:
-                        t0 = time.perf_counter()
-                        batch = self._prepare(pool, chunk)
-                        self.stats.decode_s += time.perf_counter() - t0
-                        if not self._offer(out, batch, stop):
-                            return
-                        chunk = []
-                if chunk and not stop.is_set():
+            if pool is None:  # workers pinned: run-scoped private pool
+                pool = private = DecodePool(
+                    self._pinned_workers, name=f"ingest-prep:{id(self) & 0xFFFF:04x}"
+                )
+            chunk: list = []
+            for item in items:
+                if stop.is_set():
+                    return
+                chunk.append(item)
+                if len(chunk) == self.batch_size:
                     t0 = time.perf_counter()
                     batch = self._prepare(pool, chunk)
                     self.stats.decode_s += time.perf_counter() - t0
                     if not self._offer(out, batch, stop):
                         return
+                    chunk = []
+            if chunk and not stop.is_set():
+                t0 = time.perf_counter()
+                batch = self._prepare(pool, chunk)
+                self.stats.decode_s += time.perf_counter() - t0
+                if not self._offer(out, batch, stop):
+                    return
             self._offer(out, None, stop)
         except BaseException as e:  # noqa: BLE001 - surface in the consumer
             self._offer(out, e, stop)
+        finally:
+            if private is not None:
+                self.stats.pool = private.gauges()
+                private.close()
 
     # -- consumer ---------------------------------------------------------
 
@@ -187,11 +235,17 @@ class IngestPipeline:
         """Yield one record dict per input item, in input order. Record keys
         are stage names plus ``_index``."""
         self.stats = IngestStats()  # fresh stats per run
+        self._run_pool_tasks = 0  # producer-side tally of this run's tasks
+        # One resolve for the whole run: the shared pool must not be
+        # swapped (shutdown_decode_pool + rebuild) between the producer's
+        # submissions and the finally-block snapshot.
+        run_pool = self.pool
         start = time.perf_counter()
         ready: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(
-            target=self._producer, args=(items, ready, stop), name="ingest-producer", daemon=True
+            target=self._producer, args=(items, ready, stop, run_pool),
+            name="ingest-producer", daemon=True
         )
         producer.start()
         pending: deque[_Batch] = deque()
@@ -216,6 +270,7 @@ class IngestPipeline:
                     for stage in self.stages:
                         got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     pending.append(got)
+                    self.stats.max_inflight = max(self.stats.max_inflight, len(pending))
                 if not pending:
                     break
                 batch = pending.popleft()
@@ -247,6 +302,14 @@ class IngestPipeline:
                     pass
                 producer.join(timeout=0.05)
             self.stats.wall_s = time.perf_counter() - start
+            if run_pool is not None:  # private pools snapshot at teardown
+                g = run_pool.gauges()
+                # `tasks` is this run's own submissions (exact, counted at
+                # the producer); the other gauges are pool-level context —
+                # on the SHARED pool, wait_ms_p50 and queue_depth include
+                # concurrent users by design (that contention is real).
+                g["tasks"] = self._run_pool_tasks
+                self.stats.pool = g
 
     def run_all(self, items: Iterable[Any]) -> list[dict]:
         return list(self.run(items))

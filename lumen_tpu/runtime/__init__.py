@@ -6,6 +6,7 @@ backends (`SURVEY.md` §2 "native compute" note).
 
 from .compile_cache import enable_persistent_cache
 from .batcher import MicroBatcher, bucket_for, default_buckets
+from .decode_pool import DecodePool, get_decode_pool, shutdown_decode_pool
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -33,6 +34,9 @@ __all__ = [
     "MicroBatcher",
     "bucket_for",
     "default_buckets",
+    "DecodePool",
+    "get_decode_pool",
+    "shutdown_decode_pool",
     "build_mesh",
     "resolve_axes",
     "data_sharding",

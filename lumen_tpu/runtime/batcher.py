@@ -8,8 +8,20 @@ sits between gRPC worker threads and a jit-compiled model function:
 - a collector thread drains the queue until ``max_batch`` items or
   ``max_latency_ms`` elapsed since the first item,
 - items are stacked, padded to a static *bucket* size (so XLA compiles one
-  program per bucket, not per batch size), run as ONE device call, and the
-  results are scattered back to the callers.
+  program per bucket, not per batch size), and DISPATCHED as one device
+  call — JAX dispatch is async, so the collector hands the un-fetched
+  result to a bounded in-flight deque and immediately goes back to
+  collecting,
+- a fetch/settle worker drains the deque in dispatch order: ONE blocking
+  device->host transfer per batch (``jax.device_get`` on the whole result
+  tree), then the rows are scattered back to the callers.
+
+The two lanes overlap: batch *k+1* is being collected, stacked, and
+dispatched while batch *k* computes on device and its transfer completes.
+``LUMEN_BATCH_INFLIGHT`` (default 2) bounds how many dispatched-but-
+unfetched batches may pile up — enough to hide the transfer latency,
+small enough that a slow consumer exerts backpressure on collection
+instead of queueing unbounded device results in HBM.
 
 Shape buckets default to powers of two up to ``max_batch``; a warmup call
 per bucket at startup turns the reference's "model load time" into our
@@ -24,6 +36,7 @@ import queue
 import threading
 import time
 import weakref
+from collections import deque
 from concurrent.futures import Future, InvalidStateError as futures_InvalidState, TimeoutError as FuturesTimeout
 from typing import Any, Callable
 
@@ -59,7 +72,9 @@ def mesh_buckets(max_batch: int, dp: int) -> list[int]:
 def mesh_sharded(fn, mesh):
     """Wrap a ``fn(batched_tree, n)`` so the stacked batch is placed with a
     ``data``-axis sharding before the device call (serving-side DP: one
-    micro-batch spreads across all mesh devices)."""
+    micro-batch spreads across all mesh devices). Both the ``device_put``
+    and the wrapped call dispatch async — the wrapper returns un-fetched
+    results, which is exactly what the pipelined collector wants."""
     from .mesh import data_sharding
 
     sharding = data_sharding(mesh)
@@ -74,9 +89,11 @@ def mesh_sharded(fn, mesh):
 def warmup_batcher(batcher: "MicroBatcher", make_dummy: Callable[[int], Any]) -> None:
     """Compile every bucket through the batcher's OWN callable — the same
     code path real traffic takes, so the compile cache is guaranteed to hit
-    (a hand-rolled warmup twin could silently drift from the serving fn)."""
+    (a hand-rolled warmup twin could silently drift from the serving fn).
+    Batcher fns dispatch async (the fetch worker owns the blocking
+    transfer), so block here: warmup must not return with compiles queued."""
     for b in batcher.buckets:
-        batcher.fn(make_dummy(b), b)
+        jax.block_until_ready(batcher.fn(make_dummy(b), b))
 
 
 def batch_wait_timeout() -> float:
@@ -97,6 +114,16 @@ def batch_queue_depth() -> int:
         return max(0, int(os.environ.get("LUMEN_BATCH_QUEUE_DEPTH", "0")))
     except ValueError:
         return 0
+
+
+def batch_inflight() -> int:
+    """Default bound on dispatched-but-unfetched batches:
+    ``LUMEN_BATCH_INFLIGHT`` (default 2 — one computing, one settling;
+    1 = no dispatch pipelining, malformed = default)."""
+    try:
+        return max(1, int(os.environ.get("LUMEN_BATCH_INFLIGHT", "2")))
+    except ValueError:
+        return 2
 
 
 def _settle(fut: Future, result: Any = None, exception: BaseException | None = None) -> bool:
@@ -124,12 +151,31 @@ def bucket_for(n: int, buckets: list[int]) -> int:
     return buckets[-1]
 
 
+class _Inflight:
+    """One dispatched-but-unfetched batch riding the in-flight deque."""
+
+    __slots__ = ("futures", "result", "n", "size")
+
+    def __init__(self, futures: list[Future], result: Any, n: int, size: int):
+        self.futures = futures
+        self.result = result  # un-fetched device result tree
+        self.n = n
+        self.size = size
+
+
 class MicroBatcher:
     """Batch single-item pytrees through a batched function.
 
     ``fn(batched_tree, n_valid) -> batched_result_tree`` where every leaf of
     ``batched_tree`` has a leading bucket-size dim; the result's leaves must
     share that leading dim (rows past ``n_valid`` are padding and dropped).
+
+    ``fn`` should DISPATCH and return without fetching (return the jax
+    arrays as-is — no ``np.asarray``): the fetch/settle worker performs the
+    one blocking device->host transfer per batch, so up to ``inflight``
+    batches compute while the collector stacks the next one. A blocking
+    ``fn`` still works (numpy trees pass through the fetch untouched); it
+    just forfeits the overlap.
     """
 
     def __init__(
@@ -140,6 +186,7 @@ class MicroBatcher:
         buckets: list[int] | None = None,
         name: str = "batcher",
         max_queue: int | None = None,
+        inflight: int | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -154,12 +201,20 @@ class MicroBatcher:
         # becomes explicit shed errors (callers can back off) instead of an
         # unbounded queue whose latency grows without limit. 0 = unbounded.
         self.max_queue = batch_queue_depth() if max_queue is None else max(0, max_queue)
+        self.inflight = batch_inflight() if inflight is None else max(1, inflight)
         self._queue: queue.Queue[tuple[Any, Future, float | None] | None] = queue.Queue()
         self._thread: threading.Thread | None = None
+        self._fetch_thread: threading.Thread | None = None
         self._closed = threading.Event()
         # Guards the closed-check + enqueue pair in submit() against a
         # concurrent close() draining the queue in between.
         self._submit_lock = threading.Lock()
+        # Dispatched-but-unfetched batches, FIFO (dispatch order == settle
+        # order); the condition variable carries both the bound (collector
+        # waits when full) and the fetch hand-off (worker waits when empty).
+        self._inflight: deque[_Inflight] = deque()
+        self._inflight_cv = threading.Condition()
+        self._fetch_stop = False
         # Telemetry for capability metadata / benchmarks.
         self.stats = {"batches": 0, "items": 0, "padded": 0, "shed": 0, "expired": 0}
 
@@ -167,7 +222,11 @@ class MicroBatcher:
 
     def start(self) -> "MicroBatcher":
         self._thread = threading.Thread(target=self._run, name=self.name, daemon=True)
+        self._fetch_thread = threading.Thread(
+            target=self._fetch_loop, name=f"{self.name}-fetch", daemon=True
+        )
         self._thread.start()
+        self._fetch_thread.start()
         # Live state on /metrics: queue depth + batch/padding telemetry
         # (latency histograms can't show a backed-up or waste-heavy queue).
         # The provider closes over a weakref so the global registry never
@@ -178,7 +237,12 @@ class MicroBatcher:
             b = ref()
             if b is None:
                 return {}
-            return {**b.stats, "queue_depth": b._queue.qsize()}
+            return {
+                **b.stats,
+                "queue_depth": b._queue.qsize(),
+                "inflight": len(b._inflight),
+                "inflight_limit": b.inflight,
+            }
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"batcher:{self.name}", _gauges)
@@ -194,6 +258,33 @@ class MicroBatcher:
             self._queue.put(None)
         if self._thread:
             self._thread.join(timeout=10)
+        # Stop the fetch worker only AFTER the collector exits: every batch
+        # it dispatched must still settle (in-flight results drain; the
+        # worker's loop runs until the deque is empty AND stop is set).
+        with self._inflight_cv:
+            self._fetch_stop = True
+            self._inflight_cv.notify_all()
+        if self._fetch_thread:
+            self._fetch_thread.join(timeout=60)
+            # A fetch worker killed by an escaping BaseException leaves its
+            # in-flight batches unsettled, and after close() nothing else
+            # will ever settle them — drain here so close() upholds the
+            # "every dispatched batch settles" contract even when the
+            # settling lane itself died. Guarded on death: a merely-slow
+            # worker (join timed out) keeps ownership of its entries.
+            if not self._fetch_thread.is_alive():
+                with self._inflight_cv:
+                    stranded = list(self._inflight)
+                    self._inflight.clear()
+                if stranded:
+                    err = RuntimeError(
+                        f"{self.name}: fetch worker died; batcher closed "
+                        "with unsettled in-flight batches"
+                    )
+                    logger.error("%s", err)
+                    for entry in stranded:
+                        for f in entry.futures:
+                            _settle(f, exception=err)
         # Ownership-guarded: a newer same-name batcher keeps its gauges.
         # A never-started instance has no _gauge_fn — it must not pass
         # None (= unconditional) and evict a live same-name batcher's.
@@ -287,7 +378,7 @@ class MicroBatcher:
                     self._closed.set()
                     break
                 batch.append(nxt)
-            self._process(batch)
+            self._dispatch(batch)
         # Drain anything left after close.
         while True:
             try:
@@ -297,11 +388,36 @@ class MicroBatcher:
             if entry is not None:
                 _settle(entry[1], exception=RuntimeError(f"{self.name} closed"))
 
-    def _process(self, batch: list[tuple[Any, Future, float | None]]) -> None:
+    def _dispatch(self, batch: list[tuple[Any, Future, float | None]]) -> None:
+        # Reserve an in-flight slot FIRST: this wait is where the collector
+        # blocks under backpressure (possibly for a full device-batch
+        # latency), so it must come before the deadline gate — an entry
+        # whose deadline expires while we wait here still gets dropped
+        # below instead of burning the batch it no longer wants. Exactness:
+        # at most `inflight` un-fetched device results exist at any instant
+        # (the HBM bound an operator sizes against), and inflight=1 really
+        # does serialize dispatch. Only this thread appends, so reserving
+        # by waiting for space cannot race another producer.
+        dead = False
+        with self._inflight_cv:
+            while len(self._inflight) >= self.inflight:
+                # A dead fetch worker can never drain the deque: fail
+                # loudly instead of wedging the collector (and every
+                # caller) in a silent 300s-timeout limbo.
+                if self._fetch_thread is not None and not self._fetch_thread.is_alive():
+                    dead = True
+                    break
+                self._inflight_cv.wait(timeout=1.0)
+        if dead:
+            self._abort_dead_fetch([fut for _, fut, _ in batch])
+            return
         # Deadline gate: entries whose caller deadline passed while they
         # queued are failed here — BEFORE stacking and the device call — so
         # an overloaded server does not spend TPU time computing answers
         # nobody is waiting for (their gRPC stream is already torn down).
+        # The gate runs per dispatch even with earlier batches still in
+        # flight: a deadline that expires while batch k computes still
+        # drops the k+1 entry it covers.
         live: list[tuple[Any, Future]] = []
         now = time.monotonic()
         for item, fut, deadline in batch:
@@ -332,20 +448,86 @@ class MicroBatcher:
 
             # No-op unless a test/harness armed the point; lets the suite
             # exercise the fan-out-failure path below deterministically.
+            # With inflight > 1 an injected failure lands on exactly this
+            # batch's callers — earlier in-flight batches settle normally.
             faults.check("batch_execute", self.name)
             stacked = stack_and_pad(items, size)
-            result = self.fn(stacked, n)
-            rows = unstack(result, n)
+            result = self.fn(stacked, n)  # async dispatch; fetch worker settles
         except Exception as e:  # noqa: BLE001 - fan the failure out to callers
-            logger.exception("%s: batched call failed (n=%d)", self.name, n)
+            logger.exception("%s: batched dispatch failed (n=%d)", self.name, n)
             for f in futures:
                 _settle(f, exception=e)
             return
-        self.stats["batches"] += 1
-        self.stats["items"] += n
-        self.stats["padded"] += size - n
-        for f, row in zip(futures, rows):
-            _settle(f, result=row)
+        with self._inflight_cv:
+            if self._fetch_thread is not None and not self._fetch_thread.is_alive():
+                dead = True  # nobody left to settle this result
+            else:
+                self._inflight.append(_Inflight(futures, result, n, size))
+                self._inflight_cv.notify_all()
+        if dead:
+            self._abort_dead_fetch(futures)
+
+    def _abort_dead_fetch(self, futures: list[Future]) -> None:
+        """The fetch worker died (a BaseException escaped its loop):
+        settle its stranded in-flight batches AND the current batch with a
+        loud error — callers must not ride out the full batch-wait timeout
+        for results that can never arrive."""
+        err = RuntimeError(
+            f"{self.name}: fetch worker died; batcher cannot settle results"
+        )
+        logger.error("%s", err)
+        with self._inflight_cv:
+            stranded = list(self._inflight)
+            self._inflight.clear()
+            self._inflight_cv.notify_all()
+        for entry in stranded:
+            for f in entry.futures:
+                _settle(f, exception=err)
+        for f in futures:
+            _settle(f, exception=err)
+
+    # -- fetch/settle worker ----------------------------------------------
+
+    def _fetch_loop(self) -> None:
+        """Drain the in-flight deque in dispatch order: one blocking
+        device->host transfer per batch, then settle that batch's futures
+        (submission order within the batch). Runs until close() has both
+        stopped the collector and set the stop flag — every dispatched
+        batch settles before close() returns."""
+        while True:
+            with self._inflight_cv:
+                while not self._inflight:
+                    # Exit only once close() asked AND the collector can no
+                    # longer dispatch (its thread is dead) — a collector
+                    # stuck past close()'s join timeout in a long compile
+                    # must still get its final batch settled, not orphaned.
+                    if self._fetch_stop:
+                        if not (self._thread and self._thread.is_alive()):
+                            return
+                        self._inflight_cv.wait(timeout=0.05)
+                    else:
+                        self._inflight_cv.wait()
+                # Peek — the entry leaves the deque only after its fetch
+                # completes, so the in-flight bound counts batches whose
+                # device work (or transfer) is genuinely outstanding.
+                entry = self._inflight[0]
+            try:
+                rows = unstack(entry.result, entry.n)
+            except Exception as e:  # noqa: BLE001 - fan out to THIS batch only
+                logger.exception(
+                    "%s: batched fetch failed (n=%d)", self.name, entry.n
+                )
+                for f in entry.futures:
+                    _settle(f, exception=e)
+            else:
+                self.stats["batches"] += 1
+                self.stats["items"] += entry.n
+                self.stats["padded"] += entry.size - entry.n
+                for f, row in zip(entry.futures, rows):
+                    _settle(f, result=row)
+            with self._inflight_cv:
+                self._inflight.popleft()
+                self._inflight_cv.notify_all()
 
 
 # -- pytree stacking helpers ------------------------------------------------
@@ -369,8 +551,12 @@ def stack_and_pad(items: list[Any], size: int) -> Any:
 
 def unstack(tree: Any, n: int) -> list[Any]:
     """Split a batched result tree back into ``n`` single-item trees (host
-    numpy; one device->host transfer for the whole batch)."""
-    tree = jax.tree_util.tree_map(np.asarray, tree)
+    numpy). ``jax.device_get`` on the WHOLE tree makes one blocking
+    transfer per batch (a per-leaf ``np.asarray`` loop would round-trip
+    the device once per leaf — the fetch worker calls this on every
+    settled batch, so the difference is on the serving hot path); numpy
+    and array-like leaves pass through as plain arrays."""
+    tree = jax.device_get(tree)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return [
         jax.tree_util.tree_unflatten(treedef, [leaf[i] for leaf in leaves])

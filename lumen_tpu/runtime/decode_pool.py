@@ -1,0 +1,179 @@
+"""Shared host-decode thread pool: the serving path's first lane.
+
+BENCH_r05 showed the device ~100x ahead of the serving path (CLIP embeds
+9k images/sec/chip device-only vs 77 rps through gRPC): the gap is host
+serialization, and the first serialized step is image decode. Every gRPC
+handler thread used to decode its own payload inline, so decode
+concurrency was whatever the RPC thread pool happened to be — unbounded
+CPU oversubscription under load, single-threaded decode under light
+concurrency, and always on the thread that should be going straight back
+to the batcher.
+
+This module owns ONE process-wide sized pool (``LUMEN_DECODE_WORKERS``;
+default ``min(cpu_count, 16)``) that all decode/preprocess work routes
+through: the four model managers' ``decode_image_bytes`` calls and the
+:class:`~lumen_tpu.pipeline.ingest.IngestPipeline` producer's per-item
+``decode``/``preprocess`` fan-out. PIL and cv2 release the GIL during
+decode and the native host-ops resize is GIL-free, so pool workers scale
+with cores. Queue-wait telemetry is exported as metrics gauges
+(``decode_pool`` provider: ``queue_depth``, ``wait_ms_p50``, ...), so an
+operator can see when the decode lane — not the device — binds.
+
+Deliberately jax-free: the pool is pure host plumbing and must stay
+importable from the serving layer without pulling in a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Iterable
+
+from ..utils.deadline import DeadlineExpired, get_deadline
+from ..utils.metrics import metrics
+
+DECODE_WORKERS_ENV = "LUMEN_DECODE_WORKERS"
+
+
+def decode_workers() -> int:
+    """Pool size: ``LUMEN_DECODE_WORKERS`` when set to a positive int,
+    else ``min(cpu_count, 16)`` (decode is CPU-bound; past the core count
+    extra workers only add context switches)."""
+    try:
+        n = int(os.environ.get(DECODE_WORKERS_ENV, "0"))
+    except ValueError:
+        n = 0
+    if n > 0:
+        return n
+    return min(os.cpu_count() or 4, 16)
+
+
+class DecodePool:
+    """Sized thread pool with queue-wait telemetry and nested-call safety.
+
+    ``run``/``map`` called FROM a pool worker execute inline — a pooled
+    task that fans out again (e.g. an ingest ``decode`` that itself calls
+    a manager helper) must not deadlock a fully-occupied pool waiting on
+    itself.
+    """
+
+    def __init__(self, workers: int | None = None, name: str = "decode-pool"):
+        self.workers = workers if workers and workers > 0 else decode_workers()
+        self.name = name
+        self._pool = ThreadPoolExecutor(self.workers, thread_name_prefix=name)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._pending = 0  # submitted, not yet started (queue depth)
+        self._tasks = 0
+        self._wait_ms: deque[float] = deque(maxlen=512)
+        # Gauges close over a weakref: the global metrics registry must not
+        # be what keeps a dropped pool's threads reachable.
+        ref = weakref.ref(self)
+
+        def _gauges() -> dict:
+            pool = ref()
+            return {} if pool is None else pool.gauges()
+
+        self._gauge_fn = _gauges
+        metrics.register_gauges(name, _gauges)
+
+    # -- task plumbing -----------------------------------------------------
+
+    def _task(
+        self,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        t_submit: float,
+        deadline: float | None,
+    ) -> Any:
+        self._local.in_pool = True
+        wait_ms = (time.perf_counter() - t_submit) * 1e3
+        with self._lock:
+            self._pending -= 1
+            self._tasks += 1
+            self._wait_ms.append(wait_ms)
+        # Same contract as the batcher's pre-dispatch gate, one stage
+        # earlier: a request whose deadline expired while it sat in the
+        # decode queue must not burn a pool worker decoding an image
+        # nobody is waiting for (under overload that's ALL the workers).
+        if deadline is not None and time.monotonic() >= deadline:
+            metrics.count("deadline_drops")
+            metrics.count(f"deadline_drops:{self.name}")
+            raise DeadlineExpired(
+                f"{self.name}: request deadline expired while queued for decode"
+            )
+        return fn(*args, **kwargs)
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        # The ambient deadline is a contextvar of the CALLING thread;
+        # capture it here, not in the worker.
+        deadline = get_deadline()
+        with self._lock:
+            self._pending += 1
+        return self._pool.submit(
+            self._task, fn, args, kwargs, time.perf_counter(), deadline
+        )
+
+    def run(self, fn: Callable, *args, **kwargs) -> Any:
+        """Run ``fn`` in the pool and wait for its result (exceptions
+        propagate unchanged). Inline when already on a pool thread."""
+        if getattr(self._local, "in_pool", False):
+            return fn(*args, **kwargs)
+        return self.submit(fn, *args, **kwargs).result()
+
+    def map(self, fn: Callable, items: Iterable) -> list:
+        """Parallel map preserving input order (inline on a pool thread)."""
+        if getattr(self._local, "in_pool", False):
+            return [fn(item) for item in items]
+        futs = [self.submit(fn, item) for item in items]
+        return [f.result() for f in futs]
+
+    # -- telemetry ---------------------------------------------------------
+
+    def wait_ms_p50(self) -> float:
+        with self._lock:
+            sample = sorted(self._wait_ms)
+        return sample[len(sample) // 2] if sample else 0.0
+
+    def gauges(self) -> dict:
+        with self._lock:
+            pending, tasks = self._pending, self._tasks
+        return {
+            "workers": self.workers,
+            "queue_depth": pending,
+            "tasks": tasks,
+            "wait_ms_p50": round(self.wait_ms_p50(), 3),
+        }
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        metrics.unregister_gauges(self.name, self._gauge_fn)
+
+
+_shared: DecodePool | None = None
+_shared_lock = threading.Lock()
+
+
+def get_decode_pool() -> DecodePool:
+    """The process-wide pool (lazily built from the env)."""
+    global _shared
+    if _shared is None:
+        with _shared_lock:
+            if _shared is None:
+                _shared = DecodePool(name="decode_pool")
+    return _shared
+
+
+def shutdown_decode_pool() -> None:
+    """Drop the shared pool (tests / clean process exit); the next
+    :func:`get_decode_pool` builds a fresh one from the current env."""
+    global _shared
+    with _shared_lock:
+        pool, _shared = _shared, None
+    if pool is not None:
+        pool.close()

@@ -150,12 +150,20 @@ class ClipService(BaseService):
 
     def capability(self):
         ids = [m.model_id for m in self.managers.values()]
+        # Routes reflect what initialize() actually chose — a manager that
+        # opted into int8 but fell back to bf16 (warmup A/B showed a
+        # regression) must not advertise int8.
+        routes = sorted({getattr(m, "quant_route", "bf16") for m in self.managers.values()})
+        precisions = ["bf16", "fp32"] + (["int8"] if "int8" in routes else [])
         return self.registry.build_capability(
             model_ids=ids,
             runtime=f"jax-{_backend_name()}",
             max_concurrency=max(m.batch_size for m in self.managers.values()),
-            precisions=["bf16", "fp32"],
-            extra={"embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values())},
+            precisions=precisions,
+            extra={
+                "embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values()),
+                "quant_routes": ",".join(routes),
+            },
         )
 
     def healthy(self) -> bool:

@@ -1,0 +1,19 @@
+"""Shared fakes for exercising the pipelined MicroBatcher without a device."""
+
+import time
+
+import numpy as np
+
+
+class SlowFetch:
+    """Stand-in for an un-fetched device result: the batcher's fetch worker
+    hits ``jax.device_get`` -> ``np.asarray`` -> ``__array__``, which is
+    where a real device->host transfer would block."""
+
+    def __init__(self, arr, delay: float):
+        self.arr = np.asarray(arr)
+        self.delay = delay
+
+    def __array__(self, dtype=None, copy=None):
+        time.sleep(self.delay)
+        return self.arr

@@ -117,6 +117,60 @@ class TestQuantizedManager:
             CLIPManager(make_clip_model_dir(tmp_path), quantize="int4")
 
 
+class TestQuantRouteSelection:
+    """int8 is opt-in AND verified: without a warmup pass the explicit
+    config wins; with warmup, a one-shot A/B may fall the route back to
+    bf16 (BENCH_r05: q8 at 0.923x bf16 on v5e was a regression); the
+    chosen route lands in a metrics gauge either way."""
+
+    def test_explicit_optin_without_warmup_serves_int8(self, tmp_path):
+        from lumen_tpu.models.clip import CLIPManager
+
+        q = CLIPManager(make_clip_model_dir(tmp_path), dtype="float32", quantize="int8")
+        q.initialize()
+        try:
+            assert q.quant_route == "int8"
+            assert q.quant_speedup is None  # nothing was timed
+        finally:
+            q.close()
+
+    def test_env_pin_bf16_overrides_optin(self, tmp_path, monkeypatch):
+        from lumen_tpu.models.clip import CLIPManager
+
+        monkeypatch.setenv("LUMEN_CLIP_Q8_ROUTE", "bf16")
+        q = CLIPManager(make_clip_model_dir(tmp_path), dtype="float32", quantize="int8")
+        q.initialize()
+        try:
+            assert q.quant_route == "bf16"
+            vec = q.encode_image(png_bytes(0))  # bf16 route actually serves
+            assert np.isfinite(vec).all()
+        finally:
+            q.close()
+
+    def test_warmup_ab_times_routes_and_registers_gauge(self, tmp_path):
+        from lumen_tpu.models.clip import CLIPManager
+        from lumen_tpu.utils.metrics import metrics
+
+        q = CLIPManager(
+            make_clip_model_dir(tmp_path), dtype="float32", quantize="int8",
+            batch_size=2, warmup=True,
+        )
+        q.initialize()
+        try:
+            # Which side wins on CPU is irrelevant — the contract is that
+            # the A/B RAN, picked a route, and exported it observably.
+            assert q.quant_route in ("int8", "bf16")
+            assert q.quant_speedup is not None and q.quant_speedup > 0
+            gauges = metrics.snapshot()["gauges"][f"clip-quant:{q.model_id}"]
+            assert gauges["int8_active"] == (1 if q.quant_route == "int8" else 0)
+            assert gauges["q8_speedup_pct"] == round(q.quant_speedup * 100, 1)
+            vec = q.encode_image(png_bytes(0))  # chosen route serves
+            assert np.isfinite(vec).all()
+        finally:
+            q.close()
+        assert f"clip-quant:{q.model_id}" not in metrics.snapshot().get("gauges", {})
+
+
 class TestInt8TpRulesCoverClip:
     def test_rules_match_tower_q_leaves(self):
         import re
