@@ -39,6 +39,7 @@ from ...ops.image import (
 )
 from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
 from ...runtime.decode_pool import get_decode_pool
+from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_state_dict
@@ -622,13 +623,38 @@ class CLIPManager:
 
     # -- inference API ----------------------------------------------------
 
+    def _cache_ns(self, task: str) -> str:
+        """Result-cache namespace (see
+        :func:`~lumen_tpu.runtime.result_cache.make_namespace`). Qualified
+        by the compute dtype AND the resolved quant route — the warmup A/B
+        can pick a different route across restarts, and disk-tier entries
+        from one precision must not answer for another."""
+        return make_namespace(
+            "clip", task, self.model_id, self.info.version,
+            jnp.dtype(self.policy.compute_dtype).name, self.quant_route,
+        )
+
     def encode_image(self, image_bytes: bytes) -> np.ndarray:
         """Single image bytes -> unit-norm fp32 embedding (batched under the
-        hood with concurrent callers). Decode+resize run on the shared
-        decode pool — the calling (gRPC handler) thread only waits, so
-        decode concurrency is bounded by ``LUMEN_DECODE_WORKERS``, not by
-        however many handler threads pile in."""
+        hood with concurrent callers). Content-addressed cache first: the
+        sha256 runs on the RAW bytes, so a hit (or a coalesced duplicate
+        in flight) skips decode pool AND batcher entirely — identical
+        re-index / duplicate-burst traffic costs one device call total.
+        On a miss, decode+resize run on the shared decode pool — the
+        calling (gRPC handler) thread only waits, so decode concurrency is
+        bounded by ``LUMEN_DECODE_WORKERS``, not by however many handler
+        threads pile in. Every hit returns a private copy: a caller
+        mutating "its" embedding in place must not poison the store."""
         self._ensure_ready()
+        return get_result_cache().get_or_compute(
+            self._cache_ns("image_embed"),
+            None,
+            bytes(image_bytes),
+            lambda: self._encode_image_uncached(image_bytes),
+            clone=np.copy,
+        )
+
+    def _encode_image_uncached(self, image_bytes: bytes) -> np.ndarray:
         resized = get_decode_pool().run(self._decode_resize, image_bytes)
         vec = self._image_batcher(resized)
         return self._check_vector(vec)
@@ -642,6 +668,15 @@ class CLIPManager:
 
     def encode_text(self, text: str) -> np.ndarray:
         self._ensure_ready()
+        return get_result_cache().get_or_compute(
+            self._cache_ns("text_embed"),
+            None,
+            text.encode("utf-8"),
+            lambda: self._encode_text_uncached(text),
+            clone=np.copy,
+        )
+
+    def _encode_text_uncached(self, text: str) -> np.ndarray:
         ids = self.tokenizer.encode_batch([text])[0]
         vec = self._text_batcher(ids)
         return self._check_vector(vec)

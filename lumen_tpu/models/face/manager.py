@@ -16,6 +16,7 @@ with the hot math moved on-device:
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 import time
@@ -37,6 +38,7 @@ from ...runtime.batcher import (
 )
 from ...runtime.decode_pool import get_decode_pool
 from ...runtime.mesh import build_mesh
+from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
 from .convert import convert_face_checkpoint
@@ -293,6 +295,16 @@ class FaceManager:
             self._rec_batcher.close()
             self._initialized = False
 
+    # -- caching ----------------------------------------------------------
+
+    def _cache_ns(self, task: str) -> str:
+        """Result-cache namespace, dtype-qualified (see
+        :func:`~lumen_tpu.runtime.result_cache.make_namespace`)."""
+        return make_namespace(
+            "face", task, self.model_id, self.info.version,
+            jnp.dtype(self.policy.compute_dtype).name,
+        )
+
     # -- detection --------------------------------------------------------
 
     def detect_faces(
@@ -304,12 +316,48 @@ class FaceManager:
         max_faces: int | None = None,
         nms_threshold: float | None = None,
     ) -> list[FaceDetection]:
+        """Detect faces in raw image bytes (or a pre-decoded array).
+
+        Byte inputs route through the content-addressed result cache
+        keyed on the raw payload + the detection options, BEFORE the
+        decode pool — a repeated image skips decode and the device batch
+        entirely. Array inputs (callers that already decoded, e.g.
+        :meth:`detect_and_extract`) are never cached here; the byte-level
+        caller owns the cache entry. Cached detections are deep-copied on
+        every hit so callers may mutate their results freely."""
         self._ensure_ready()
-        img = (
-            get_decode_pool().run(decode_image_bytes, image, color="rgb")
-            if isinstance(image, (bytes, bytearray))
-            else np.asarray(image)
+        if isinstance(image, (bytes, bytearray)):
+            options = {
+                "conf_threshold": conf_threshold,
+                "size_min": size_min,
+                "size_max": size_max,
+                "max_faces": max_faces,
+                "nms_threshold": nms_threshold,
+            }
+            return get_result_cache().get_or_compute(
+                self._cache_ns("detect"),
+                options,
+                bytes(image),
+                lambda: self._detect_faces_impl(
+                    get_decode_pool().run(decode_image_bytes, image, color="rgb"),
+                    conf_threshold, size_min, size_max, max_faces, nms_threshold,
+                ),
+                clone=copy.deepcopy,
+            )
+        return self._detect_faces_impl(
+            np.asarray(image), conf_threshold, size_min, size_max,
+            max_faces, nms_threshold,
         )
+
+    def _detect_faces_impl(
+        self,
+        img: np.ndarray,
+        conf_threshold: float | None,
+        size_min: float | None,
+        size_max: float | None,
+        max_faces: int | None,
+        nms_threshold: float | None,
+    ) -> list[FaceDetection]:
         h, w = img.shape[:2]
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, self.det_cfg.input_size)
         boxes, kps, scores, keep = self._det_batcher(boxed)
@@ -429,11 +477,29 @@ class FaceManager:
         self, face_image: bytes | np.ndarray, landmarks: np.ndarray | None = None
     ) -> np.ndarray:
         self._ensure_ready()
-        img = (
-            get_decode_pool().run(decode_image_bytes, face_image, color="rgb")
-            if isinstance(face_image, (bytes, bytearray))
-            else np.asarray(face_image)
-        )
+        if isinstance(face_image, (bytes, bytearray)):
+            # Cache on the raw crop bytes + landmarks, before the decode
+            # pool; hits return private copies (in-place caller mutation
+            # must not poison the store).
+            options = {
+                "landmarks": None if landmarks is None
+                else np.asarray(landmarks, np.float32).tolist()
+            }
+            return get_result_cache().get_or_compute(
+                self._cache_ns("embed"),
+                options,
+                bytes(face_image),
+                lambda: self._extract_embedding_impl(
+                    get_decode_pool().run(decode_image_bytes, face_image, color="rgb"),
+                    landmarks,
+                ),
+                clone=np.copy,
+            )
+        return self._extract_embedding_impl(np.asarray(face_image), landmarks)
+
+    def _extract_embedding_impl(
+        self, img: np.ndarray, landmarks: np.ndarray | None
+    ) -> np.ndarray:
         crop = self.align_crop(img, landmarks) if landmarks is not None else self._center_crop(img)
         if self.spec.rec_color == "bgr":
             crop = crop[:, :, ::-1]
@@ -441,6 +507,31 @@ class FaceManager:
 
     def detect_and_extract(
         self, image_bytes: bytes, max_faces: int | None = None, **det_kw
+    ) -> list[FaceDetection]:
+        # Whole-pipeline cache entry (detections WITH embeddings), keyed on
+        # the raw payload + every detection knob. Knobs are normalized to
+        # the full explicit set (same shape detect_faces keys with) so an
+        # omitted kwarg and an explicit None — identical semantics — hash
+        # to ONE entry instead of two.
+        self._ensure_ready()
+        options = {
+            "conf_threshold": None,
+            "size_min": None,
+            "size_max": None,
+            "nms_threshold": None,
+            **det_kw,
+            "max_faces": max_faces,
+        }
+        return get_result_cache().get_or_compute(
+            self._cache_ns("detect_and_embed"),
+            options,
+            bytes(image_bytes),
+            lambda: self._detect_and_extract_impl(image_bytes, max_faces, det_kw),
+            clone=copy.deepcopy,
+        )
+
+    def _detect_and_extract_impl(
+        self, image_bytes: bytes, max_faces: int | None, det_kw: dict
     ) -> list[FaceDetection]:
         # Decode once (on the shared pool — never on the gRPC handler
         # thread); detection and cropping share the array.

@@ -18,6 +18,7 @@ Business logic of the reference's ``OcrModelManager`` + ONNX backend
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 from dataclasses import dataclass
@@ -31,6 +32,7 @@ from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...runtime.batcher import bucket_for
 from ...runtime.decode_pool import get_decode_pool
+from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
 from .convert import convert_ocr_checkpoint
@@ -347,7 +349,11 @@ class OcrManager:
         h, w = img.shape[:2]
         bucket = bucket_for(max(h, w), list(s.det_buckets))
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, bucket)
-        prob = np.asarray(self._run_detector(self.det_vars, boxed[None]))[0]
+        # One jax.device_get per detector call (np.asarray on a jax array
+        # is also one transfer, but device_get is the batched-fetch idiom
+        # the clip/face fetch lane uses — and returns host numpy for the
+        # cv2 postprocess either way).
+        prob = jax.device_get(self._run_detector(self.det_vars, boxed[None]))[0]
         return self.boxes_from_det_output(
             prob,
             image_hw=(h, w),
@@ -427,10 +433,16 @@ class OcrManager:
                 for row, i in enumerate(chunk):
                     batch[row] = prepared[i][1]
                     widths[row] = prepared[i][2]
-                ids, conf = self._run_recognizer(self.rec_vars, batch, widths)
+                # ONE blocking device->host transfer for the whole (ids,
+                # conf) result tree — the old per-leaf np.asarray pair
+                # round-tripped the device once per leaf on the rec hot
+                # path (same fix PR 2 applied to the clip/face fetch lane).
+                ids, conf = jax.device_get(
+                    self._run_recognizer(self.rec_vars, batch, widths)
+                )
                 # Slice off batch-bucket padding rows before the host collapse.
-                ids = np.asarray(ids)[: len(chunk)]
-                conf = np.asarray(conf)[: len(chunk)]
+                ids = ids[: len(chunk)]
+                conf = conf[: len(chunk)]
                 collapsed = ctc_collapse_rows(ids, conf, self.vocab)
                 for row, i in enumerate(chunk):
                     results[i] = collapsed[row]
@@ -470,13 +482,21 @@ class OcrManager:
             bb = bucket_for(len(chunk), list(self.spec.rec_batch_buckets))
             batch = np.zeros((bb, h, w, 3), np.uint8)
             batch[: len(chunk)] = chunk
-            out = np.asarray(self._run_cls(self.cls_vars, batch))
+            out = jax.device_get(self._run_cls(self.cls_vars, batch))
             probs[start : start + len(chunk)] = out[: len(chunk)]
         # PaddleOCR semantics: rotate only when 180 wins the argmax AND
         # clears cls_thresh — below it, leaving the crop alone is safer.
         return [bool(p.argmax() == 1 and p[1] > self.spec.cls_thresh) for p in probs]
 
     # -- end-to-end -------------------------------------------------------
+
+    def _cache_ns(self, task: str) -> str:
+        """Result-cache namespace, dtype-qualified (see
+        :func:`~lumen_tpu.runtime.result_cache.make_namespace`)."""
+        return make_namespace(
+            "ocr", task, self.model_id, self.info.version,
+            jnp.dtype(self.policy.compute_dtype).name,
+        )
 
     def predict(
         self,
@@ -489,8 +509,40 @@ class OcrManager:
     ) -> list[OcrResult]:
         """Full pipeline on raw image bytes (reference ``predict`` contract,
         ``lumen_ocr/backends/base.py:63-136``, including ``use_angle_cls``).
-        Decode runs on the shared pool, keeping the gRPC handler thread out
-        of CPU-bound image work."""
+        Content-addressed result cache first — the sha256 runs on the raw
+        payload, so a repeated page skips decode, BOTH device programs and
+        all the contour/warp CV work; concurrent identical requests
+        coalesce onto one flight. On a miss, decode runs on the shared
+        pool, keeping the gRPC handler thread out of CPU-bound image
+        work."""
+        self._ensure_ready()
+        options = {
+            "det_threshold": det_threshold,
+            "rec_threshold": rec_threshold,
+            "box_threshold": box_threshold,
+            "unclip_ratio": unclip_ratio,
+            "use_angle_cls": use_angle_cls,
+        }
+        return get_result_cache().get_or_compute(
+            self._cache_ns("predict"),
+            options,
+            bytes(image_bytes),
+            lambda: self._predict_uncached(
+                image_bytes, det_threshold, rec_threshold, box_threshold,
+                unclip_ratio, use_angle_cls,
+            ),
+            clone=copy.deepcopy,
+        )
+
+    def _predict_uncached(
+        self,
+        image_bytes: bytes,
+        det_threshold: float | None,
+        rec_threshold: float | None,
+        box_threshold: float | None,
+        unclip_ratio: float | None,
+        use_angle_cls: bool,
+    ) -> list[OcrResult]:
         img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
         boxes = self.detect(
             img,

@@ -23,19 +23,21 @@ ONNX session call per payload (``SURVEY.md`` §3.2).
 
 from __future__ import annotations
 
+import copy
 import logging
 import queue
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Iterable, Iterator, Sequence
+from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
 
 import jax
 
 from lumen_tpu.runtime.batcher import stack_and_pad, unstack
 from lumen_tpu.runtime.decode_pool import DecodePool, get_decode_pool
 from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
+from lumen_tpu.runtime.result_cache import ResultCache, get_result_cache, make_key
 
 logger = logging.getLogger(__name__)
 
@@ -61,6 +63,7 @@ class Stage:
 class IngestStats:
     items: int = 0
     batches: int = 0
+    cache_hits: int = 0  # items answered from the result cache (no decode)
     wall_s: float = 0.0
     decode_s: float = 0.0  # producer-lane time (decode + preprocess + transfer)
     device_s: float = 0.0  # consumer time blocked on device fetches
@@ -72,10 +75,16 @@ class IngestStats:
     def items_per_sec(self) -> float:
         return self.items / self.wall_s if self.wall_s > 0 else 0.0
 
+    @property
+    def cache_hit_rate(self) -> float:
+        return self.cache_hits / self.items if self.items else 0.0
+
     def as_dict(self) -> dict:
         out = {
             "items": self.items,
             "batches": self.batches,
+            "cache_hits": self.cache_hits,
+            "cache_hit_rate": round(self.cache_hit_rate, 4),
             "wall_s": round(self.wall_s, 4),
             "items_per_sec": round(self.items_per_sec, 2),
             "decode_s": round(self.decode_s, 4),
@@ -89,13 +98,25 @@ class IngestStats:
 
 
 class _Batch:
-    __slots__ = ("decoded", "inputs", "outputs", "n")
+    __slots__ = ("decoded", "inputs", "outputs", "n", "indices", "keys")
 
-    def __init__(self, decoded: list, inputs: dict[str, Any], n: int):
+    def __init__(
+        self,
+        decoded: list,
+        inputs: dict[str, Any],
+        n: int,
+        indices: list[int] | None = None,
+        keys: list[str | None] | None = None,
+    ):
         self.decoded = decoded
         self.inputs = inputs  # stage name -> sharded device tree
         self.outputs: dict[str, Any] = {}
         self.n = n
+        # Global item indices (cache hits skip batches, so batch rows are
+        # no longer contiguous) and per-row result-cache keys (None when
+        # the item is uncacheable or caching is off).
+        self.indices = indices if indices is not None else list(range(n))
+        self.keys = keys if keys is not None else [None] * n
 
 
 class IngestPipeline:
@@ -115,6 +136,8 @@ class IngestPipeline:
         inflight: int = 2,
         workers: int | None = None,
         annotate: Callable[[Any], dict] | None = None,
+        cache_namespace: str | None = None,
+        cache_options: Mapping[str, Any] | None = None,
     ):
         if not stages:
             raise ValueError("need at least one stage")
@@ -140,9 +163,28 @@ class IngestPipeline:
         #: optional per-item record enrichment from the decoded value (e.g.
         #: surfacing decode-failure markers set by a fault-tolerant decode)
         self.annotate = annotate
+        # Result-cache integration: when a namespace is set, every BYTES
+        # item is hashed and looked up in the process-wide cache BEFORE
+        # the decode pool — a hit skips decode, preprocess, transfer and
+        # every device stage (the host decode lane is the measured ingest
+        # bottleneck, BENCH_r05). Misses are stored after postprocess, so
+        # a warm re-ingest of the same library is pure cache traffic.
+        # Non-bytes items pass through untouched. Best-effort within one
+        # run: duplicates already in flight compute again (bulk ingest is
+        # offline; single-flight coalescing is for the serving path).
+        self.cache_namespace = cache_namespace
+        self.cache_options = dict(cache_options or {})
         self._sharding = data_sharding(mesh)
         self.stats = IngestStats()  # stats of the most recent run()
         self._run_pool_tasks = 0
+
+    def _cache(self) -> ResultCache | None:
+        """The shared cache, when this pipeline is configured to use it and
+        the env has not disabled it (resolved per run, like the pool)."""
+        if not self.cache_namespace:
+            return None
+        cache = get_result_cache()
+        return cache if cache.enabled else None
 
     @property
     def pool(self) -> DecodePool | None:
@@ -159,7 +201,8 @@ class IngestPipeline:
 
     # -- producer lane ----------------------------------------------------
 
-    def _prepare(self, pool: DecodePool, raw_items: list) -> _Batch:
+    def _prepare(self, pool: DecodePool, chunk: list[tuple[int, Any, str | None]]) -> _Batch:
+        raw_items = [item for _, item, _ in chunk]
         decoded = pool.map(self.decode, raw_items)
         inputs: dict[str, Any] = {}
         for stage in self.stages:
@@ -172,7 +215,13 @@ class IngestPipeline:
         # own `tasks` gauge is process-wide, so THIS run's decode work has
         # to be tallied where it is submitted.
         self._run_pool_tasks += len(raw_items) * (1 + len(self.stages))
-        return _Batch(decoded, inputs, len(raw_items))
+        return _Batch(
+            decoded,
+            inputs,
+            len(raw_items),
+            [idx for idx, _, _ in chunk],
+            [key for _, _, key in chunk],
+        )
 
     @staticmethod
     def _offer(out: queue.Queue, entry, stop: threading.Event) -> bool:
@@ -192,6 +241,7 @@ class IngestPipeline:
         out: queue.Queue,
         stop: threading.Event,
         pool: DecodePool | None,
+        cache: ResultCache | None,
     ) -> None:
         # ``pool`` is run()'s single resolve of the shared pool (None when
         # ``workers`` is pinned) — resolving again here could land on a
@@ -203,23 +253,58 @@ class IngestPipeline:
                 pool = private = DecodePool(
                     self._pinned_workers, name=f"ingest-prep:{id(self) & 0xFFFF:04x}"
                 )
-            chunk: list = []
-            for item in items:
-                if stop.is_set():
-                    return
-                chunk.append(item)
-                if len(chunk) == self.batch_size:
-                    t0 = time.perf_counter()
-                    batch = self._prepare(pool, chunk)
-                    self.stats.decode_s += time.perf_counter() - t0
-                    if not self._offer(out, batch, stop):
-                        return
-                    chunk = []
-            if chunk and not stop.is_set():
+            chunk: list[tuple[int, Any, str | None]] = []
+            hits: dict[int, dict] = {}
+            index = 0
+
+            def emit_hits() -> bool:
+                nonlocal hits
+                if not hits:
+                    return True
+                pending, hits = hits, {}
+                return self._offer(out, ("hits", pending), stop)
+
+            def emit_chunk() -> bool:
+                nonlocal chunk
                 t0 = time.perf_counter()
                 batch = self._prepare(pool, chunk)
                 self.stats.decode_s += time.perf_counter() - t0
-                if not self._offer(out, batch, stop):
+                chunk = []
+                return self._offer(out, batch, stop)
+
+            for item in items:
+                if stop.is_set():
+                    return
+                key = None
+                if cache is not None and isinstance(item, (bytes, bytearray)):
+                    # The pre-decode lookup: sha256 over the RAW bytes, so
+                    # a hit never touches the decode pool — the lane
+                    # BENCH_r05 measured as the ingest bottleneck.
+                    key = make_key(self.cache_namespace, self.cache_options, item)
+                    found, rec = cache.get(key, clone=copy.deepcopy)
+                    if found:
+                        self.stats.cache_hits += 1
+                        hits[index] = rec
+                        index += 1
+                        # Bound the consumer's reorder buffer: a long hit
+                        # run stuck behind a part-filled miss chunk flushes
+                        # that chunk (padded batch) instead of buffering
+                        # hit records without limit.
+                        if chunk and len(hits) >= self.batch_size:
+                            if not emit_chunk():
+                                return
+                        if not chunk and not emit_hits():
+                            return
+                        continue
+                chunk.append((index, item, key))
+                index += 1
+                if len(chunk) == self.batch_size:
+                    if not emit_hits() or not emit_chunk():
+                        return
+            if not emit_hits():
+                return
+            if chunk and not stop.is_set():
+                if not emit_chunk():
                     return
             self._offer(out, None, stop)
         except BaseException as e:  # noqa: BLE001 - surface in the consumer
@@ -233,33 +318,50 @@ class IngestPipeline:
 
     def run(self, items: Iterable[Any]) -> Iterator[dict]:
         """Yield one record dict per input item, in input order. Record keys
-        are stage names plus ``_index``."""
+        are stage names plus ``_index``.
+
+        With ``cache_namespace`` set, byte items found in the result cache
+        bypass the batches entirely (their records arrive as ``hits``
+        queue entries) and settled miss records are stored back — a small
+        reorder buffer re-serializes the two streams into input order."""
         self.stats = IngestStats()  # fresh stats per run
         self._run_pool_tasks = 0  # producer-side tally of this run's tasks
         # One resolve for the whole run: the shared pool must not be
         # swapped (shutdown_decode_pool + rebuild) between the producer's
-        # submissions and the finally-block snapshot.
+        # submissions and the finally-block snapshot. Same for the cache.
         run_pool = self.pool
+        cache = self._cache()
+        # Fence taken at run start: a namespace invalidation (model
+        # hot-swap) landing mid-run must stop this run's records — which
+        # were computed by the pre-swap managers — from being stored past
+        # it. Hits already served are the caller's to judge; persistence
+        # is what must stay clean.
+        fence = cache.current_fence() if cache is not None else 0
         start = time.perf_counter()
         ready: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(
-            target=self._producer, args=(items, ready, stop, run_pool),
+            target=self._producer, args=(items, ready, stop, run_pool, cache),
             name="ingest-producer", daemon=True
         )
         producer.start()
         pending: deque[_Batch] = deque()
-        index = 0
+        # Reorder buffer: index -> finished record. Cache hits land here
+        # directly from the queue; batch rows land when their batch
+        # settles. Bounded by the producer's chunk-flush rule (a hit run
+        # can outpace a part-filled miss chunk by at most batch_size).
+        finished: dict[int, dict] = {}
+        next_idx = 0
         try:
             done = False
-            while not done or pending:
+            while True:
                 # Dispatch up to `inflight` batches before fetching results.
-                # Only BLOCK for a new batch when none is pending; with a
-                # completed batch in hand, a slow producer must not delay its
-                # results (no head-of-line blocking on the item source).
+                # Only BLOCK when nothing else is actionable: no batch
+                # pending AND no record ready to yield (a slow producer
+                # must not delay results already in hand).
                 while not done and len(pending) < self.inflight:
                     try:
-                        got = ready.get(block=not pending)
+                        got = ready.get(block=not pending and next_idx not in finished)
                     except queue.Empty:
                         break
                     if got is None:
@@ -267,12 +369,28 @@ class IngestPipeline:
                         break
                     if isinstance(got, BaseException):
                         raise got
+                    if isinstance(got, tuple) and got and got[0] == "hits":
+                        for i, rec in got[1].items():
+                            rec["_index"] = i
+                            finished[i] = rec
+                        continue
                     for stage in self.stages:
                         got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     pending.append(got)
                     self.stats.max_inflight = max(self.stats.max_inflight, len(pending))
+                yielded = False
+                while next_idx in finished:
+                    record = finished.pop(next_idx)
+                    next_idx += 1
+                    self.stats.items += 1
+                    yielded = True
+                    yield record
+                if yielded:
+                    continue
                 if not pending:
-                    break
+                    if done:
+                        break
+                    continue  # block in the fill loop for more input
                 batch = pending.popleft()
                 t0 = time.perf_counter()
                 rows_by_stage = {
@@ -281,15 +399,25 @@ class IngestPipeline:
                 self.stats.device_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
                 for i in range(batch.n):
-                    record: dict[str, Any] = {"_index": index}
+                    record: dict[str, Any] = {"_index": batch.indices[i]}
                     for s in self.stages:
                         record[s.name] = s.postprocess(batch.decoded[i], rows_by_stage[s.name][i])
                     if self.annotate is not None:
                         record.update(self.annotate(batch.decoded[i]))
-                    index += 1
-                    yield record
+                    # Store back (deep-copied: the caller owns and may
+                    # mutate the yielded record) — except records flagged
+                    # by annotate() as errored (e.g. decode failures under
+                    # on_decode_error="record"): an error placeholder must
+                    # not become the cached truth for those bytes.
+                    if cache is not None and batch.keys[i] is not None and not record.get("_error"):
+                        cache.put(
+                            batch.keys[i],
+                            {k: v for k, v in record.items() if k != "_index"},
+                            clone=copy.deepcopy,
+                            fence=fence,
+                        )
+                    finished[batch.indices[i]] = record
                 self.stats.post_s += time.perf_counter() - t0
-                self.stats.items += batch.n
                 self.stats.batches += 1
         finally:
             stop.set()

@@ -114,6 +114,28 @@ class PhotoIngestPipeline:
             stages.append(self._face_stage(mesh))
         if ocr is not None:
             stages.append(self._ocr_stage(mesh))
+        # Content-addressed re-ingest cache: the namespace pins every model
+        # id@revision (and its compute precision — records from one
+        # numerics config must not answer for another, esp. across
+        # restarts via the disk tier) in the stage set; the options pin
+        # every knob that changes a record. A re-index pass over an
+        # unchanged library (or its duplicate-heavy tail) then skips
+        # decode AND all device programs per hit; `stats.cache_hit_rate`
+        # reports it.
+        import jax.numpy as jnp
+
+        def _sig(mgr) -> str:
+            parts = [jnp.dtype(mgr.policy.compute_dtype).name]
+            route = getattr(mgr, "quant_route", None)
+            if route:
+                parts.append(route)
+            return ":".join(parts)
+
+        models = ",".join(
+            f"{fam}={mgr.model_id}@{mgr.info.version}:{_sig(mgr)}"
+            for fam, mgr in (("clip", clip), ("face", face), ("ocr", ocr))
+            if mgr is not None
+        )
         self.engine = IngestPipeline(
             mesh,
             stages,
@@ -123,6 +145,12 @@ class PhotoIngestPipeline:
             inflight=inflight,
             workers=workers,
             annotate=lambda d: {"_error": d["error"]} if "error" in d else {},
+            cache_namespace=f"ingest/photo/{models}",
+            cache_options={
+                "classify_top_k": classify_top_k,
+                "ocr_det_size": ocr_det_size,
+                "ocr_use_angle_cls": ocr_use_angle_cls,
+            },
         )
 
     # -- decode -----------------------------------------------------------

@@ -1,0 +1,640 @@
+"""Process-wide content-addressed inference result cache + single-flight.
+
+BENCH_r05 measured the device lane ~100x ahead of the serving path (CLIP
+9,083 images/s/chip device-only vs 37.9 images/s end-to-end ingest and 77
+RPS gRPC c10): the binding resource is the *host* — decode (~100
+images/s/core) and per-request serialization. The cheapest throughput
+multiplier left is therefore not computing at all: photo-indexing traffic
+is full of byte-identical work (re-index passes over an unchanged library,
+burst duplicates, client retries after an admission shed), and every one
+of those requests used to pay decode + batcher + device again.
+
+This module is the answer, in two parts:
+
+- **content-addressed result cache** — results keyed by
+  ``(namespace, canonicalized request options, sha256(payload bytes))``
+  where the namespace is ``{service}/{task}/{model-id}@{revision}``. The
+  hash runs on the RAW bytes, so a hit is decided *before* the decode
+  pool and the micro-batcher ever see the request: it skips the host
+  decode bottleneck entirely and never counts against admission queues or
+  deadline gates. Two tiers: a byte-budgeted in-RAM LRU
+  (``LUMEN_CACHE_BYTES``, default 256 MiB, 0 disables) and an optional
+  pickle-on-disk tier (``LUMEN_CACHE_DIR``) that survives restarts.
+
+- **single-flight coalescing** — concurrent *identical* requests share one
+  in-flight future: the first caller computes, the rest wait on its
+  result, so a retry storm or duplicate burst costs ONE batcher
+  submission instead of N. Caller-specific overload failures
+  (:class:`~lumen_tpu.utils.deadline.DeadlineExpired` /
+  :class:`~lumen_tpu.utils.deadline.QueueFull` on the owner) are NOT fanned
+  out as final answers — a waiter whose owner was shed retries the compute
+  itself (one of the waiters becomes the new owner), because the owner's
+  deadline says nothing about the waiter's.
+
+Invalidation is namespace-prefix-based: the router's hot-swap path
+(:meth:`~lumen_tpu.serving.router.HubRouter.replace_service`, which the
+background :class:`~lumen_tpu.serving.resilience.RecoveryManager` drives)
+invalidates ``{service}/`` so a newly swapped-in model never serves a
+predecessor's results even when id+revision match.
+
+Deliberately jax-free (like :mod:`~lumen_tpu.runtime.decode_pool`): pure
+host plumbing, importable from the serving layer without a backend.
+
+Caching is only ever keyed on deterministic work: the VLM manager bypasses
+the cache when ``do_sample`` / ``temperature > 0`` — sampled generations
+must stay sampled.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+import weakref
+from collections import OrderedDict
+from concurrent.futures import Future, TimeoutError as FuturesTimeout
+from typing import Any, Callable, Mapping
+from urllib.parse import quote, unquote
+
+import numpy as np
+
+from ..utils.deadline import DeadlineExpired, QueueFull, remaining
+from ..utils.metrics import metrics
+from ..utils.request_notes import mark as _mark
+
+logger = logging.getLogger(__name__)
+
+CACHE_BYTES_ENV = "LUMEN_CACHE_BYTES"
+CACHE_DIR_ENV = "LUMEN_CACHE_DIR"
+
+DEFAULT_CACHE_BYTES = 256 * 1024 * 1024
+
+
+def cache_bytes() -> int:
+    """RAM-tier byte budget: ``LUMEN_CACHE_BYTES`` (0 disables the RAM
+    tier; unset/malformed -> 256 MiB default)."""
+    raw = os.environ.get(CACHE_BYTES_ENV)
+    if raw is None:
+        return DEFAULT_CACHE_BYTES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_CACHE_BYTES
+
+
+def cache_dir() -> str | None:
+    """Disk-tier root: ``LUMEN_CACHE_DIR`` (unset/empty = no disk tier)."""
+    return os.environ.get(CACHE_DIR_ENV) or None
+
+
+def canonical_options(options: Mapping[str, Any] | None) -> str:
+    """Canonical JSON for the request-options half of the key: sorted keys,
+    no whitespace, non-JSON values via repr — the SAME logical options must
+    hash identically regardless of dict insertion order."""
+    return json.dumps(
+        dict(options or {}), sort_keys=True, separators=(",", ":"), default=repr
+    )
+
+
+def make_namespace(
+    family: str, task: str, model_id: str, revision: str, *qualifiers: str
+) -> str:
+    """The ONE namespace format: ``{family}/{task}/{model-id}@{revision}``
+    plus any compute-path qualifiers (dtype policy, quant route, ...) that
+    change the numerics of a result — entries computed under different
+    precision must not answer for each other, especially across restarts
+    via the disk tier. The family prefix is load-bearing: the router's
+    hot-swap invalidation drops ``{family}/``, so every manager must build
+    namespaces through here."""
+    ns = f"{family}/{task}/{model_id}@{revision}"
+    quals = [q for q in qualifiers if q]
+    if quals:
+        ns += "/" + ",".join(quals)
+    return ns
+
+
+def make_key(namespace: str, options: Mapping[str, Any] | None, payload: bytes) -> str:
+    """``{namespace}:{sha256 digest}`` — the namespace stays in the clear so
+    prefix invalidation (model hot-swap) can drop a whole model's entries
+    without remembering its keys."""
+    h = hashlib.sha256()
+    h.update(namespace.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(canonical_options(options).encode("utf-8"))
+    h.update(b"\x00")
+    h.update(payload)
+    return f"{namespace}:{h.hexdigest()}"
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes")
+
+    def __init__(self, value: Any, nbytes: int):
+        self.value = value
+        self.nbytes = nbytes
+
+
+class ResultCache:
+    """Byte-budgeted LRU + optional disk tier + single-flight coalescing.
+
+    ``get_or_compute`` is the whole API surface the serving path uses; the
+    lower-level ``get``/``put``/``invalidate`` exist for the ingest
+    pipeline (bulk peek/store without single-flight) and the hot-swap hook.
+    """
+
+    def __init__(
+        self,
+        max_bytes: int | None = None,
+        disk_dir: str | None = None,
+        name: str = "result_cache",
+    ):
+        self.max_bytes = cache_bytes() if max_bytes is None else max(0, max_bytes)
+        self.disk_dir = disk_dir if disk_dir is not None else cache_dir()
+        if self.max_bytes == 0:
+            # LUMEN_CACHE_BYTES=0 is the ONE kill switch, as documented:
+            # it disables both tiers. A lingering LUMEN_CACHE_DIR must not
+            # silently keep a disk-backed cache (and single-flight) alive
+            # on a deployment that turned caching off.
+            self.disk_dir = None
+        self.name = name
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, _Entry] = OrderedDict()
+        self._bytes = 0
+        self._inflight: dict[str, Future] = {}
+        # Invalidation fence: a monotonic sequence bumped by invalidate(),
+        # with the last-invalidation seq per prefix. A computation that
+        # STARTED before an invalidation of its namespace must not store
+        # its (predecessor-model) result after it — get_or_compute captures
+        # the fence pre-compute and put() rejects anything stale. Bounded:
+        # one entry per distinct prefix (service families).
+        self._inval_seq = 0
+        self._inval_marks: dict[str, int] = {}
+        self._waiting = 0  # callers currently blocked on another's flight
+        # Local mirrors of the global event counters, for gauges/bench.
+        self.stats = {
+            "hits": 0,
+            "disk_hits": 0,
+            "misses": 0,
+            "coalesced": 0,
+            "evictions": 0,
+            "stores": 0,
+        }
+        self._pickle_warned = False
+        if self.disk_dir:
+            try:
+                os.makedirs(self.disk_dir, exist_ok=True)
+            except OSError as e:
+                logger.warning("cache disk tier disabled (%s): %s", self.disk_dir, e)
+                self.disk_dir = None
+        # Occupancy gauges next to the batcher/decode-pool providers; the
+        # weakref keeps the global registry from pinning a dropped cache.
+        ref = weakref.ref(self)
+
+        def _gauges() -> dict:
+            c = ref()
+            return {} if c is None else c.gauges()
+
+        self._gauge_fn = _gauges
+        metrics.register_gauges(name, _gauges)
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        """False when both tiers are off — callers then run compute()
+        directly (not even single-flight: an explicitly disabled cache
+        must leave the serving path byte-for-byte as before)."""
+        return self.max_bytes > 0 or self.disk_dir is not None
+
+    def gauges(self) -> dict:
+        with self._lock:
+            out = {
+                **self.stats,
+                "bytes": self._bytes,
+                "budget_bytes": self.max_bytes,
+                "entries": len(self._entries),
+                "inflight": len(self._inflight),
+                "waiting": self._waiting,
+            }
+        return out
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            hits = self.stats["hits"] + self.stats["disk_hits"]
+            total = hits + self.stats["misses"]
+        return hits / total if total else 0.0
+
+    # -- core lookup -------------------------------------------------------
+
+    def _count(self, stat: str, metric: str) -> None:
+        self.stats[stat] += 1  # caller holds no lock; int += is fine for telemetry
+        metrics.count(metric)
+
+    def get(self, key: str, clone: Callable[[Any], Any] | None = None) -> tuple[bool, Any]:
+        """RAM-then-disk probe. Returns ``(found, value)``; a disk hit is
+        promoted into the RAM tier. Marks the request-note scope on hit."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                value = entry.value
+            else:
+                value = None
+        if entry is not None:
+            self._count("hits", "cache_hits")
+            _mark("hit")
+            return True, clone(value) if clone else value
+        if self.disk_dir is not None:
+            # Fence the promotion: a disk read racing an invalidation's
+            # rmtree must neither serve nor re-promote the swept entry.
+            fence = self.current_fence()
+            found, value, nbytes = self._disk_read(key)
+            if found and not self._stale(key, fence):
+                self._store_ram(key, value, nbytes, fence=fence)
+                self._count("disk_hits", "cache_disk_hits")
+                _mark("hit")
+                return True, clone(value) if clone else value
+        return False, None
+
+    def current_fence(self) -> int:
+        """Snapshot of the invalidation sequence; pass to :meth:`put` to
+        guarantee a result computed before a later invalidation of its
+        namespace is never stored after it."""
+        with self._lock:
+            return self._inval_seq
+
+    def _stale_locked(self, key: str, fence: int) -> bool:
+        """Caller holds ``self._lock``."""
+        return any(
+            seq > fence and key.startswith(prefix)
+            for prefix, seq in self._inval_marks.items()
+        )
+
+    def _stale(self, key: str, fence: int) -> bool:
+        with self._lock:
+            return self._stale_locked(key, fence)
+
+    def put(
+        self,
+        key: str,
+        value: Any,
+        clone: Callable[[Any], Any] | None = None,
+        fence: int | None = None,
+    ) -> None:
+        """Store a computed value in both tiers. ``clone`` (when given) is
+        applied to the stored copy so the caller keeps exclusive ownership
+        of the object it just computed — later mutation must not corrupt
+        what other requests will be served. ``fence`` (from
+        :meth:`current_fence`, taken before the compute) drops the store
+        when the namespace was invalidated mid-compute — e.g. a model
+        hot-swap racing an in-flight request on the old instance."""
+        if fence is not None and self._stale(key, fence):
+            return  # fast reject; the tiers re-check authoritatively
+        blob = None
+        if self.disk_dir is not None:
+            blob = self._encode(value)
+            if blob is None:
+                return  # unpicklable: warned once, not cached
+            nbytes = len(blob)
+        else:
+            # RAM-only: a structural size estimate avoids paying a full
+            # pickle per store just to weigh the entry (the ingest settle
+            # loop stores every record — this is a hot path).
+            est = self._approx_nbytes(value)
+            if est is None:
+                return
+            nbytes = est
+        if clone is not None and blob is not None:
+            # The pickle round-trip IS a deep copy — don't traverse the
+            # value a second time (clone on hits still applies, giving
+            # VLM-style custom clones their marker semantics there).
+            stored = pickle.loads(blob)
+        else:
+            stored = clone(value) if clone else value
+        self._store_ram(key, stored, nbytes, fence=fence)
+        self._count("stores", "cache_stores")
+        if blob is not None:
+            self._disk_write(key, blob, fence=fence)
+
+    def _approx_nbytes(self, value: Any, _depth: int = 0) -> int | None:
+        """Structural RAM weight for common result shapes (arrays, bytes,
+        records, dataclasses); odd types fall back to one pickle."""
+        if _depth > 8:
+            blob = self._encode(value)
+            return None if blob is None else len(blob)
+        if isinstance(value, np.ndarray):
+            return value.nbytes + 128
+        if isinstance(value, (bytes, bytearray, str)):
+            return len(value) + 64
+        if value is None or isinstance(value, (bool, int, float, complex)):
+            return 32
+        if isinstance(value, (list, tuple, set, frozenset)):
+            total = 64
+            for v in value:
+                n = self._approx_nbytes(v, _depth + 1)
+                if n is None:
+                    return None
+                total += n
+            return total
+        if isinstance(value, dict):
+            total = 64
+            for k, v in value.items():
+                nk = self._approx_nbytes(k, _depth + 1)
+                nv = self._approx_nbytes(v, _depth + 1)
+                if nk is None or nv is None:
+                    return None
+                total += nk + nv
+            return total
+        inner = getattr(value, "__dict__", None)
+        if inner is not None:  # dataclass-style records (FaceDetection, ...)
+            return self._approx_nbytes(inner, _depth + 1)
+        blob = self._encode(value)
+        return None if blob is None else len(blob)
+
+    def get_or_compute(
+        self,
+        namespace: str,
+        options: Mapping[str, Any] | None,
+        payload: bytes,
+        compute: Callable[[], Any],
+        clone: Callable[[Any], Any] | None = None,
+    ) -> Any:
+        """The serving-path entry point: content-addressed lookup with
+        single-flight coalescing around ``compute``.
+
+        - **hit** (RAM or disk): the stored value (cloned when ``clone``)
+          returns immediately — no decode, no batcher, no admission or
+          deadline accounting.
+        - **miss, first caller**: computes, stores, resolves the shared
+          flight. Failures propagate to the caller and fan out to waiters
+          (never cached).
+        - **miss, concurrent duplicate**: waits on the owner's flight —
+          one batcher submission serves the whole burst. If the owner
+          failed with a *caller-specific* overload error (deadline/shed),
+          the waiter retries the compute itself instead of inheriting an
+          error that described someone else's budget.
+        """
+        if not self.enabled:
+            return compute()
+        key = make_key(namespace, options, payload)
+        while True:
+            found, value = self.get(key, clone=clone)
+            if found:
+                return value
+            with self._lock:
+                flight = self._inflight.get(key)
+                if flight is None:
+                    flight = Future()
+                    self._inflight[key] = flight
+                    owner = True
+                else:
+                    owner = False
+            if owner:
+                break
+            with self._lock:
+                self._waiting += 1
+            try:
+                # Bounded by the WAITER's own ambient request deadline
+                # (None = wait for the owner, whose resolution is
+                # guaranteed): the PR-1 deadline contract must survive
+                # coalescing — a 50ms-budget duplicate must not ride out
+                # the owner's multi-second queue wait on a gRPC thread.
+                # Clamped: a no-deadline request can surface as a HUGE
+                # time_remaining() on some gRPC stacks, and that number
+                # fed raw into Future.result overflows C time
+                # (_PyTime_t) — observed live as INTERNAL errors on a
+                # coalesced burst.
+                rem = remaining()
+                value = flight.result(
+                    timeout=None if rem is None else min(rem, 86400.0)
+                )
+            except FuturesTimeout:
+                metrics.count("deadline_drops")
+                metrics.count("deadline_drops:result_cache")
+                raise DeadlineExpired(
+                    "request deadline expired waiting on a coalesced "
+                    "identical request"
+                ) from None
+            except (DeadlineExpired, QueueFull):
+                # The OWNER was shed or ran out of ITS deadline budget —
+                # that verdict is not ours. Retire the failed flight (the
+                # owner's own cleanup may not have run yet) and loop:
+                # re-probe, then race to become the new owner.
+                with self._lock:
+                    if self._inflight.get(key) is flight:
+                        self._inflight.pop(key)
+                continue
+            else:
+                # Counted/marked only when the shared flight actually
+                # SERVED this request — a waiter that re-owns after an
+                # owner overload computes for itself and must not inflate
+                # the absorption telemetry (or its response meta).
+                self._count("coalesced", "cache_coalesced")
+                _mark("coalesced")
+                return clone(value) if clone else value
+            finally:
+                with self._lock:
+                    self._waiting -= 1
+        # -- owner path
+        self._count("misses", "cache_misses")
+        fence = self.current_fence()
+        try:
+            value = compute()
+        except BaseException as e:
+            flight.set_exception(e)
+            raise
+        else:
+            # Storing is best-effort and must never leave the flight
+            # unresolved: a clone/pickle failure inside put() would
+            # otherwise wedge every coalesced waiter on a Future nobody
+            # will ever complete. The flight is resolved with a PRIVATE
+            # copy when clone is set — the owner's caller owns `value` and
+            # may mutate it the instant we return, racing waiters that
+            # are still deep-copying the shared object.
+            shared = value
+            try:
+                self.put(key, value, clone=clone, fence=fence)
+                if clone is not None:
+                    shared = clone(value)
+            except Exception:  # noqa: BLE001 - caching must never break serving
+                logger.exception("cache store failed; serving uncached")
+            flight.set_result(shared)
+            return value
+        finally:
+            # Object-guarded: a waiter that recovered from this flight's
+            # overload failure may already own a NEW flight under the same
+            # key — popping blindly would orphan its waiters into a
+            # duplicate computation.
+            with self._lock:
+                if self._inflight.get(key) is flight:
+                    self._inflight.pop(key)
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, prefix: str) -> int:
+        """Drop every entry whose namespace starts with ``prefix`` (both
+        tiers) and return how many RAM entries went. ``prefix`` is matched
+        against the clear-text namespace half of the key, so
+        ``invalidate("clip/")`` after a hot-swap clears every task and
+        revision the swapped service ever served."""
+        with self._lock:
+            self._inval_seq += 1
+            self._inval_marks[prefix] = self._inval_seq
+            doomed = [k for k in self._entries if k.startswith(prefix)]
+            for k in doomed:
+                self._bytes -= self._entries.pop(k).nbytes
+            # Retire matching in-flight computations too: a caller
+            # arriving AFTER the invalidation must not coalesce onto a
+            # pre-swap flight and be served the predecessor model's
+            # output. Existing waiters keep their reference (they joined
+            # pre-swap; the owner still resolves them), and the owner's
+            # cleanup is object-guarded, so dropping the dict entry here
+            # is safe.
+            for k in [k for k in self._inflight if k.startswith(prefix)]:
+                self._inflight.pop(k)
+        if doomed:
+            metrics.count("cache_invalidations", len(doomed))
+        if self.disk_dir is not None:
+            self._disk_invalidate(prefix)
+        return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def close(self) -> None:
+        metrics.unregister_gauges(self.name, self._gauge_fn)
+
+    # -- RAM tier ----------------------------------------------------------
+
+    def _store_ram(
+        self, key: str, value: Any, nbytes: int, fence: int | None = None
+    ) -> None:
+        if self.max_bytes <= 0 or nbytes > self.max_bytes:
+            return  # RAM tier off, or a single value that outweighs it
+        evicted = 0
+        with self._lock:
+            # Authoritative fence check, under the same lock invalidate()
+            # sweeps with: either this insert lands before the sweep (and
+            # is swept) or after the bump (and is rejected) — no window.
+            if fence is not None and self._stale_locked(key, fence):
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = _Entry(value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                evicted += 1
+        if evicted:
+            self.stats["evictions"] += evicted
+            metrics.count("cache_evictions", evicted)
+
+    # -- disk tier ---------------------------------------------------------
+
+    def _encode(self, value: Any) -> bytes | None:
+        """Pickle once: the blob length is the (honest) RAM-tier weight and
+        the blob itself is the disk-tier payload."""
+        try:
+            return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:  # noqa: BLE001 - caching must never break serving
+            if not self._pickle_warned:
+                self._pickle_warned = True
+                logger.warning("unpicklable cache value (%s); not caching", e)
+            return None
+
+    def _disk_path(self, key: str) -> str:
+        namespace, _, digest = key.rpartition(":")
+        return os.path.join(self.disk_dir, quote(namespace, safe=""), digest + ".pkl")
+
+    def _disk_read(self, key: str) -> tuple[bool, Any, int]:
+        path = self._disk_path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            return True, pickle.loads(blob), len(blob)
+        except FileNotFoundError:
+            return False, None, 0
+        except Exception as e:  # noqa: BLE001 - a corrupt file is a miss, not a crash
+            logger.warning("cache disk read failed for %s: %s", path, e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False, None, 0
+
+    def _disk_write(self, key: str, blob: bytes, fence: int | None = None) -> None:
+        path = self._disk_path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+            # Post-replace fence: if an invalidation's rmtree swept this
+            # namespace between our pre-checks and the replace, the file
+            # just landed AFTER the sweep — undo it (the bump
+            # happens-before the sweep, so a stale fence is visible here).
+            if fence is not None and self._stale(key, fence):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        except OSError as e:
+            logger.warning("cache disk write failed for %s: %s", path, e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _disk_invalidate(self, prefix: str) -> None:
+        import shutil
+
+        try:
+            subdirs = os.listdir(self.disk_dir)
+        except OSError:
+            return
+        for sub in subdirs:
+            if unquote(sub).startswith(prefix):
+                shutil.rmtree(os.path.join(self.disk_dir, sub), ignore_errors=True)
+
+
+# -- process-wide instance ---------------------------------------------------
+
+_shared: ResultCache | None = None
+_shared_lock = threading.Lock()
+
+
+def get_result_cache() -> ResultCache:
+    """The process-wide cache (lazily built from the env)."""
+    global _shared
+    if _shared is None:
+        with _shared_lock:
+            if _shared is None:
+                _shared = ResultCache(name="result_cache")
+    return _shared
+
+
+def reset_result_cache() -> None:
+    """Drop the shared cache (tests / clean shutdown); the next
+    :func:`get_result_cache` rebuilds from the current env."""
+    global _shared
+    with _shared_lock:
+        cache, _shared = _shared, None
+    if cache is not None:
+        cache.close()
+
+
+def invalidate_namespace(prefix: str) -> int:
+    """Prefix-invalidate WITHOUT instantiating a cache that was never
+    used: the hot-swap hook calls this unconditionally, and a process that
+    never cached anything should not allocate one just to clear it."""
+    with _shared_lock:
+        cache = _shared
+    return cache.invalidate(prefix) if cache is not None else 0

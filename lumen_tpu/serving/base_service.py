@@ -27,7 +27,7 @@ from typing import Iterator
 import grpc
 from google.protobuf import empty_pb2
 
-from ..utils import deadline as request_deadline
+from ..utils import deadline as request_deadline, request_notes
 from ..utils.deadline import DeadlineExpired, QueueFull
 from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
@@ -235,6 +235,13 @@ class BaseService(InferenceServicer):
         # body runs inside _stream_out's iteration, and its batcher
         # submits must still see the request deadline.
         token = request_deadline.set_deadline(deadline)
+        # Cache-note scope: the result cache (layers below, in the manager)
+        # marks hit/coalesce here; unary responses surface the marks as
+        # trailing ``cache_hit`` / ``cache_coalesced`` meta. A hit is
+        # decided on the raw payload bytes before the decode pool and the
+        # batcher, so it is answered without touching deadline or
+        # admission accounting (no shed, no deadline_drop, no batch slot).
+        notes_token = request_notes.begin_notes()
         try:
             try:
                 out = task.handler(payload, asm.payload_mime, asm.meta)
@@ -258,11 +265,17 @@ class BaseService(InferenceServicer):
                 lat_ms = (time.perf_counter() - t0) * 1e3
                 metrics.observe(asm.task, lat_ms)
                 meta["lat_ms"] = f"{lat_ms:.2f}"
+                marks = request_notes.current()
+                if marks.get("hit"):
+                    meta["cache_hit"] = "1"
+                if marks.get("coalesced"):
+                    meta["cache_coalesced"] = "1"
                 yield from self._chunked_response(cid, result, mime, meta)
             else:
                 # Streaming handler: iterator of (bytes, mime, meta) chunks.
                 yield from self._stream_out(cid, asm.task, out, t0)
         finally:
+            request_notes.end_notes(notes_token)
             request_deadline.reset(token)
 
     #: Split unary results larger than this into seq/total/offset chunks

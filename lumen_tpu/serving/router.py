@@ -81,12 +81,43 @@ class HubRouter(InferenceServicer):
                     self.services[name] = old
                 self._rebuild_routes()
                 raise
+        # Hot-swap cache invalidation: result-cache namespaces lead with
+        # the service family name, so dropping the prefix guarantees the
+        # swapped-in model never serves a predecessor's cached results —
+        # even if id+revision happen to match (e.g. same model re-loaded
+        # after a recovery). Lazy import: the router must stay importable
+        # without the jax-importing runtime package.
+        from ..runtime.result_cache import invalidate_namespace
+
+        # Prefix = the service FAMILY (registry name: "clip"/"face"/...),
+        # which is what the managers key their namespaces with; the router
+        # key is a config alias that may differ. Ingest records embed
+        # model ids mid-namespace where a prefix can't reach them, so any
+        # hot-swap drops the whole (rebuildable) ingest cache too — swaps
+        # are rare, stale whole-photo records are not worth the risk.
+        prefixes = {getattr(svc.registry, "service_name", name), name, "ingest"}
+
+        def sweep() -> int:
+            return sum(invalidate_namespace(f"{p}/") for p in prefixes)
+
+        dropped = sweep()
         close = getattr(old, "close", None)
         if close is not None:
             try:
                 close()
             except Exception:  # noqa: BLE001 - best-effort teardown of the placeholder
                 logger.exception("closing replaced service %r failed", name)
+        # Sweep AGAIN after the old service is closed: a request that
+        # entered the old instance after the first sweep captured a
+        # post-invalidation fence, so the store-side fence cannot reject
+        # it — but it completed before close() finished, so this second
+        # sweep removes it. Anything starting later hits the old
+        # instance's closed batchers and produces nothing to cache.
+        dropped += sweep()
+        if dropped:
+            logger.info(
+                "hot-swap of %r invalidated %d cached result(s)", name, dropped
+            )
 
     def _route(self, task: str) -> BaseService | None:
         with self._lock:

@@ -49,6 +49,15 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
+# Result cache: OFF for the suite, unconditionally (a developer's exported
+# LUMEN_CACHE_BYTES must not leak in). Tests routinely drive identical
+# payload bytes at managers built from DIFFERENT random-init weights but
+# identical model_info name@version — a process-global content-addressed
+# cache would serve one test's results to another. Cache tests opt back in
+# explicitly (monkeypatched env + reset_result_cache()).
+os.environ["LUMEN_CACHE_BYTES"] = "0"
+os.environ.pop("LUMEN_CACHE_DIR", None)
+
 
 # Compile-heavy tests (>~15s each on this 1-core host, measured full-suite
 # run 2026-08-01: 511 tests, 13:47 hot-cache) are auto-marked ``slow`` so

@@ -1,0 +1,625 @@
+"""Result-cache tests: content-addressed keying, byte-budgeted LRU
+eviction, disk tier, single-flight coalescing (N concurrent identical
+requests -> exactly one device computation), hot-swap invalidation, the
+VLM sampling bypass, and the guard that a cache hit never reaches the
+MicroBatcher or the decode pool."""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lumen_tpu.runtime import result_cache as rc
+from lumen_tpu.runtime.result_cache import ResultCache, canonical_options, make_key
+from lumen_tpu.utils.deadline import DeadlineExpired
+
+
+@pytest.fixture
+def cache_on(monkeypatch):
+    """Enable the process-global cache (conftest defaults it OFF for suite
+    isolation) for one test; reset the shared instance both ways."""
+    monkeypatch.setenv("LUMEN_CACHE_BYTES", str(32 * 1024 * 1024))
+    monkeypatch.delenv("LUMEN_CACHE_DIR", raising=False)
+    rc.reset_result_cache()
+    yield rc.get_result_cache()
+    rc.reset_result_cache()
+
+
+def _wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestKeying:
+    def test_canonical_options_order_insensitive(self):
+        assert canonical_options({"a": 1, "b": 2}) == canonical_options({"b": 2, "a": 1})
+
+    def test_key_separates_namespace_options_payload(self):
+        base = make_key("svc/task/m@1", {"k": 1}, b"img")
+        assert make_key("svc/task/m@2", {"k": 1}, b"img") != base  # revision
+        assert make_key("svc/task/m@1", {"k": 2}, b"img") != base  # options
+        assert make_key("svc/task/m@1", {"k": 1}, b"IMG") != base  # payload
+        assert make_key("svc/task/m@1", {"k": 1}, b"img") == base
+        # The namespace rides in the clear so prefix invalidation works.
+        assert base.startswith("svc/task/m@1:")
+
+
+class TestLRUEviction:
+    def test_byte_budget_evicts_oldest(self):
+        cache = ResultCache(max_bytes=4096, disk_dir=None, name="t-lru")
+        try:
+            payload = b"x" * 1000  # pickled size slightly above 1000
+            for i in range(8):
+                cache.get_or_compute("ns/", {"i": i}, b"p", lambda: payload)
+            assert cache.stats["evictions"] > 0
+            g = cache.gauges()
+            assert 0 < g["bytes"] <= 4096
+            # The newest entry survives, the oldest was evicted.
+            hit_new, _ = cache.get(make_key("ns/", {"i": 7}, b"p"))
+            hit_old, _ = cache.get(make_key("ns/", {"i": 0}, b"p"))
+            assert hit_new and not hit_old
+        finally:
+            cache.close()
+
+    def test_recent_touch_survives_eviction(self):
+        cache = ResultCache(max_bytes=4096, disk_dir=None, name="t-lru2")
+        try:
+            blob = b"x" * 1500  # two fit, three don't
+            cache.get_or_compute("ns/", {"i": 0}, b"p", lambda: blob)
+            cache.get_or_compute("ns/", {"i": 1}, b"p", lambda: blob)
+            # Touch 0 so 1 becomes the LRU victim of the next insert.
+            assert cache.get(make_key("ns/", {"i": 0}, b"p"))[0]
+            cache.get_or_compute("ns/", {"i": 2}, b"p", lambda: blob)
+            assert cache.get(make_key("ns/", {"i": 0}, b"p"))[0]
+            assert not cache.get(make_key("ns/", {"i": 1}, b"p"))[0]
+        finally:
+            cache.close()
+
+    def test_value_larger_than_budget_not_stored(self):
+        cache = ResultCache(max_bytes=100, disk_dir=None, name="t-lru3")
+        try:
+            cache.get_or_compute("ns/", None, b"p", lambda: b"y" * 1000)
+            assert cache.gauges()["entries"] == 0
+        finally:
+            cache.close()
+
+    def test_disabled_cache_always_computes(self):
+        cache = ResultCache(max_bytes=0, disk_dir=None, name="t-off")
+        try:
+            assert not cache.enabled
+            calls = []
+            for _ in range(3):
+                cache.get_or_compute("ns/", None, b"p", lambda: calls.append(1))
+            assert len(calls) == 3
+        finally:
+            cache.close()
+
+    def test_bytes_zero_is_a_kill_switch_even_with_disk_dir(self, tmp_path):
+        """LUMEN_CACHE_BYTES=0 must disable BOTH tiers (as documented): a
+        lingering LUMEN_CACHE_DIR must not keep a disk cache alive on a
+        deployment (or bench phase) that turned caching off."""
+        cache = ResultCache(max_bytes=0, disk_dir=str(tmp_path), name="t-off2")
+        try:
+            assert not cache.enabled
+            calls = []
+            for _ in range(2):
+                cache.get_or_compute("ns/", None, b"p", lambda: calls.append(1))
+            assert len(calls) == 2
+        finally:
+            cache.close()
+
+
+class TestDiskTier:
+    def test_survives_restart_and_invalidates(self, tmp_path):
+        d = str(tmp_path / "cache")
+        first = ResultCache(max_bytes=1 << 20, disk_dir=d, name="t-disk1")
+        try:
+            first.get_or_compute(
+                "clip/image_embed/m@1", None, b"img", lambda: np.arange(4.0)
+            )
+        finally:
+            first.close()
+        # A fresh process-equivalent: empty RAM tier, same disk dir.
+        second = ResultCache(max_bytes=1 << 20, disk_dir=d, name="t-disk2")
+        try:
+            out = second.get_or_compute(
+                "clip/image_embed/m@1", None, b"img",
+                lambda: pytest.fail("disk tier should have answered"),
+            )
+            np.testing.assert_array_equal(out, np.arange(4.0))
+            assert second.stats["disk_hits"] == 1
+            # Prefix invalidation clears the disk tier too.
+            second.invalidate("clip/")
+        finally:
+            second.close()
+        third = ResultCache(max_bytes=1 << 20, disk_dir=d, name="t-disk3")
+        try:
+            calls = []
+            third.get_or_compute(
+                "clip/image_embed/m@1", None, b"img", lambda: calls.append(1) or 1
+            )
+            assert calls  # invalidated: computed again
+        finally:
+            third.close()
+
+
+class TestSingleFlight:
+    N = 6
+
+    def test_n_concurrent_identical_one_compute(self):
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-sf")
+        release = threading.Event()
+        computes = []
+
+        def compute():
+            computes.append(threading.get_ident())
+            assert release.wait(10), "test deadlock: release never set"
+            return 42
+
+        results, errors = [], []
+
+        def worker():
+            try:
+                results.append(cache.get_or_compute("ns/", None, b"p", compute))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=worker) for _ in range(self.N)]
+            for t in threads:
+                t.start()
+            # Every non-owner must be WAITING on the owner's flight BEFORE
+            # we let the owner finish — that makes the 1-compute assertion
+            # deterministic, not a race we usually win.
+            assert _wait_until(
+                lambda: cache.gauges()["waiting"] == self.N - 1
+            ), cache.gauges()
+            release.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert not errors
+            assert results == [42] * self.N
+            assert len(computes) == 1
+            assert cache.stats["misses"] == 1
+            # ...and each served waiter counted as absorbed exactly once.
+            assert cache.stats["coalesced"] == self.N - 1
+        finally:
+            cache.close()
+
+    def test_burst_costs_one_batcher_submission(self):
+        """Acceptance: N concurrent identical requests -> exactly ONE
+        device computation (one item through the MicroBatcher)."""
+        from lumen_tpu.runtime.batcher import MicroBatcher
+
+        gate = threading.Event()
+
+        def fn(tree, n):
+            assert gate.wait(10), "test deadlock: gate never set"
+            return tree * 2.0
+
+        batcher = MicroBatcher(fn, max_batch=8, max_latency_ms=1.0, name="t-sf-batch")
+        batcher.start()
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-sf2")
+        payload = b"image-bytes"
+        results = []
+
+        def request():
+            results.append(
+                cache.get_or_compute(
+                    "clip/image_embed/m@1", None, payload,
+                    lambda: batcher(np.ones(3, np.float32)),
+                )
+            )
+
+        try:
+            threads = [threading.Thread(target=request) for _ in range(self.N)]
+            for t in threads:
+                t.start()
+            assert _wait_until(lambda: cache.gauges()["waiting"] == self.N - 1)
+            gate.set()
+            for t in threads:
+                t.join(timeout=15)
+            assert len(results) == self.N
+            assert batcher.stats["items"] == 1  # the whole burst, one row
+            assert batcher.stats["batches"] == 1
+        finally:
+            gate.set()
+            batcher.close()
+            cache.close()
+
+    def test_waiter_retries_after_owner_overload_failure(self):
+        """An owner shed by admission control (or out of ITS deadline
+        budget) must not poison the waiters: one of them re-owns the
+        flight and computes."""
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-sf3")
+        owner_entered = threading.Event()
+        release_owner = threading.Event()
+        calls = []
+
+        def compute():
+            calls.append(1)
+            if len(calls) == 1:
+                owner_entered.set()
+                assert release_owner.wait(10)
+                raise DeadlineExpired("owner's budget, not yours")
+            return "fresh"
+
+        outcome = {}
+
+        def owner():
+            try:
+                cache.get_or_compute("ns/", None, b"p", compute)
+            except DeadlineExpired:
+                outcome["owner"] = "expired"
+
+        def waiter():
+            outcome["waiter"] = cache.get_or_compute("ns/", None, b"p", compute)
+
+        try:
+            t1 = threading.Thread(target=owner)
+            t1.start()
+            assert owner_entered.wait(10)
+            t2 = threading.Thread(target=waiter)
+            t2.start()
+            assert _wait_until(lambda: cache.gauges()["waiting"] == 1)
+            release_owner.set()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            assert outcome == {"owner": "expired", "waiter": "fresh"}
+            assert len(calls) == 2
+            # The re-owning waiter computed for itself: NOT absorbed.
+            assert cache.stats["coalesced"] == 0
+        finally:
+            release_owner.set()
+            cache.close()
+
+    def test_waiter_deadline_bounds_coalesced_wait(self):
+        """A duplicate with a short budget must not ride out the owner's
+        long compute on a handler thread — the PR-1 deadline contract
+        survives coalescing."""
+        from lumen_tpu.utils import deadline as request_deadline
+
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-sf5")
+        started = threading.Event()
+        release = threading.Event()
+
+        def compute():
+            started.set()
+            assert release.wait(10)
+            return "slow"
+
+        owner_out = {}
+        t1 = threading.Thread(
+            target=lambda: owner_out.setdefault(
+                "v", cache.get_or_compute("ns/", None, b"p", compute)
+            )
+        )
+        try:
+            t1.start()
+            assert started.wait(10)
+            token = request_deadline.set_deadline(time.monotonic() + 0.05)
+            try:
+                t0 = time.monotonic()
+                with pytest.raises(DeadlineExpired):
+                    cache.get_or_compute("ns/", None, b"p", compute)
+                assert time.monotonic() - t0 < 5.0  # failed fast, not at release
+            finally:
+                request_deadline.reset(token)
+            release.set()
+            t1.join(timeout=10)
+            assert owner_out["v"] == "slow"  # the owner itself was unaffected
+        finally:
+            release.set()
+            cache.close()
+
+    def test_non_overload_failure_fans_out_and_is_not_cached(self):
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-sf4")
+        try:
+            with pytest.raises(ValueError):
+                cache.get_or_compute(
+                    "ns/", None, b"p", lambda: (_ for _ in ()).throw(ValueError("bad"))
+                )
+            # Failure was not cached: the next call computes.
+            assert cache.get_or_compute("ns/", None, b"p", lambda: 7) == 7
+        finally:
+            cache.close()
+
+
+class TestGuardHitSkipsDeviceAndDecode:
+    """The load-bearing property: a cache hit must NEVER reach the
+    MicroBatcher or the decode pool — this test fails if the wiring ever
+    regresses to decode-then-lookup."""
+
+    def test_clip_encode_image_hit_path(self, cache_on):
+        from lumen_tpu.models.clip.manager import CLIPManager
+        from lumen_tpu.runtime.batcher import MicroBatcher
+        from lumen_tpu.runtime.decode_pool import get_decode_pool
+        from tests.clip_fixtures import png_bytes
+
+        # Skeleton manager: real encode_image/_decode_resize wiring over a
+        # counting batcher — no weights, no compile; the cache sits ABOVE
+        # everything this stub replaces, which is exactly what's under test.
+        from lumen_tpu.runtime.policy import get_policy
+
+        mgr = object.__new__(CLIPManager)
+        mgr._initialized = True
+        mgr.model_id = "GuardCLIP"
+        mgr.info = SimpleNamespace(version="1.0.0")
+        mgr.cfg = SimpleNamespace(image_size=8)
+        mgr.policy = get_policy("float32")
+        mgr.quant_route = "bf16"
+        batcher = MicroBatcher(
+            lambda tree, n: tree.reshape(tree.shape[0], -1).astype(np.float32) + 1.0,
+            max_batch=4,
+            max_latency_ms=1.0,
+            name="guard-clip",
+        ).start()
+        mgr._image_batcher = batcher
+        payload = png_bytes()
+        pool_tasks_before = get_decode_pool().gauges()["tasks"]
+        try:
+            cold = mgr.encode_image(payload)
+            warm = mgr.encode_image(payload)
+            np.testing.assert_array_equal(cold, warm)
+            # ONE decode, ONE batcher row for two requests: the hit
+            # touched neither lane.
+            assert batcher.stats["items"] == 1
+            assert get_decode_pool().gauges()["tasks"] - pool_tasks_before == 1
+            assert cache_on.stats["hits"] == 1
+        finally:
+            batcher.close()
+
+
+class TestHotSwapInvalidation:
+    def _stub_service(self, family: str, task: str):
+        from lumen_tpu.serving.base_service import BaseService
+        from lumen_tpu.serving.registry import TaskDefinition, TaskRegistry
+
+        reg = TaskRegistry(family)
+        reg.register(TaskDefinition(name=task, handler=lambda p, m, meta: (b"", "", {})))
+        return BaseService(reg)
+
+    def test_replace_service_drops_family_namespace(self, cache_on):
+        from lumen_tpu.serving.router import HubRouter
+
+        router = HubRouter({
+            "clip": self._stub_service("clip", "clip_image_embed"),
+            "face": self._stub_service("face", "face_detect"),
+        })
+        cache_on.get_or_compute("clip/image_embed/m@1", None, b"a", lambda: 1)
+        cache_on.get_or_compute("clip/text_embed/m@1", None, b"b", lambda: 2)
+        cache_on.get_or_compute("face/detect/m@1", None, b"a", lambda: 3)
+        # Hot-swap (the RecoveryManager promotion path calls exactly this):
+        # every clip/ entry must go; the face sibling's must survive.
+        router.replace_service("clip", self._stub_service("clip", "clip_image_embed"))
+        assert not cache_on.get(make_key("clip/image_embed/m@1", None, b"a"))[0]
+        assert not cache_on.get(make_key("clip/text_embed/m@1", None, b"b"))[0]
+        assert cache_on.get(make_key("face/detect/m@1", None, b"a"))[0]
+
+    def test_replace_service_drops_ingest_namespace(self, cache_on):
+        from lumen_tpu.serving.router import HubRouter
+
+        router = HubRouter({"clip": self._stub_service("clip", "clip_image_embed")})
+        # Ingest records embed model ids mid-namespace (unreachable by the
+        # family prefix), so ANY hot-swap must drop the whole ingest cache.
+        cache_on.get_or_compute("ingest/photo/clip=m@1", None, b"a", lambda: 1)
+        router.replace_service("clip", self._stub_service("clip", "clip_image_embed"))
+        assert not cache_on.get(make_key("ingest/photo/clip=m@1", None, b"a"))[0]
+
+    def test_invalidation_fences_in_flight_store(self, cache_on):
+        """A result computed by the PRE-swap model must not be stored
+        after the swap's invalidation — the caller is answered, but the
+        stale value never becomes the cached truth."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def compute():
+            started.set()
+            assert release.wait(10)
+            return "old-model-result"
+
+        out = {}
+
+        def request():
+            out["v"] = cache_on.get_or_compute(
+                "clip/image_embed/m@1", None, b"img", compute
+            )
+
+        t = threading.Thread(target=request)
+        t.start()
+        assert started.wait(10)
+        cache_on.invalidate("clip/")  # hot-swap lands mid-compute
+        release.set()
+        t.join(timeout=10)
+        assert out["v"] == "old-model-result"  # the caller still gets its answer
+        assert not cache_on.get(make_key("clip/image_embed/m@1", None, b"img"))[0]
+        # A compute STARTED after the invalidation stores normally.
+        cache_on.get_or_compute("clip/image_embed/m@1", None, b"img", lambda: "fresh")
+        assert cache_on.get(make_key("clip/image_embed/m@1", None, b"img"))[0]
+
+    def test_invalidation_retires_inflight_flights(self, cache_on):
+        """A caller arriving AFTER a hot-swap invalidation must not
+        coalesce onto a pre-swap flight — it computes against the new
+        model; the pre-swap result neither serves it nor persists."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def old_compute():
+            started.set()
+            assert release.wait(10)
+            return "old"
+
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault(
+                "old", cache_on.get_or_compute("clip/e/m@1", None, b"img", old_compute)
+            )
+        )
+        t.start()
+        assert started.wait(10)
+        cache_on.invalidate("clip/")  # hot-swap lands while "old" computes
+        fresh = cache_on.get_or_compute("clip/e/m@1", None, b"img", lambda: "new")
+        assert fresh == "new"  # did NOT join the pre-swap flight
+        release.set()
+        t.join(timeout=10)
+        assert out["old"] == "old"  # pre-swap caller still answered
+        # The persisted truth is the post-swap result (old store fenced).
+        assert cache_on.get(make_key("clip/e/m@1", None, b"img")) == (True, "new")
+
+
+class TestVlmSamplingBypass:
+    def _stub_vlm(self, counter: list):
+        from lumen_tpu.models.vlm.manager import GenerationResult, VLMManager
+        from lumen_tpu.runtime.policy import get_policy
+
+        mgr = object.__new__(VLMManager)
+        mgr._initialized = True
+        mgr.model_id = "StubVLM"
+        mgr.info = SimpleNamespace(version="1.0.0")
+        mgr.policy = get_policy("float32")
+        mgr.quantize = None
+
+        def fake_uncached(messages, image_bytes=None, *args, **kw):
+            counter.append(1)
+            return GenerationResult(
+                text=f"out-{len(counter)}",
+                tokens=[1, 2],
+                finish_reason="eos_token",
+                input_tokens=3,
+                metadata={"generation_time_ms": 1.0},
+            )
+
+        mgr._generate_uncached = fake_uncached
+        return mgr
+
+    def test_greedy_caches_sampled_bypasses(self, cache_on):
+        from lumen_tpu.models.vlm.chat import ChatMessage
+
+        calls: list = []
+        mgr = self._stub_vlm(calls)
+        msgs = [ChatMessage(role="user", content="describe")]
+
+        # Greedy (deterministic): second identical request is a hit.
+        r1 = mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8)
+        r2 = mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8)
+        assert len(calls) == 1
+        assert r2.text == r1.text
+        assert r2.metadata.get("cached") is True
+        assert "cached" not in r1.metadata  # the computing call is honest
+
+        # Different knobs / prompt / image -> different entries.
+        mgr.generate(msgs, image_bytes=b"img", max_new_tokens=9)
+        assert len(calls) == 2
+
+        # Sampling must BYPASS the cache entirely, both directions.
+        mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8, do_sample=True)
+        mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8, do_sample=True)
+        assert len(calls) == 4
+        mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8, temperature=0.7)
+        mgr.generate(msgs, image_bytes=b"img", max_new_tokens=8, temperature=0.7)
+        assert len(calls) == 6
+
+
+class TestServiceMetaFlag:
+    def test_dispatch_sets_cache_hit_meta(self, cache_on):
+        from lumen_tpu.serving.base_service import BaseService, _Assembly
+        from lumen_tpu.serving.registry import TaskDefinition, TaskRegistry
+
+        class StubSvc(BaseService):
+            def __init__(self):
+                reg = TaskRegistry("stub")
+                reg.register(TaskDefinition(name="embed", handler=self._h))
+                super().__init__(reg)
+
+            def _h(self, payload, mime, meta):
+                val = cache_on.get_or_compute(
+                    "stub/embed/m@1", None, payload, lambda: b"vec"
+                )
+                return val, "application/octet-stream", {}
+
+        svc = StubSvc()
+
+        def dispatch(cid):
+            asm = _Assembly()
+            asm.task = "embed"
+            asm.chunks[0] = b"payload"
+            return list(svc._dispatch(cid, asm, None))
+
+        first = dispatch("c1")
+        assert first[-1].result == b"vec"
+        assert "cache_hit" not in dict(first[-1].meta)
+        second = dispatch("c2")
+        assert dict(second[-1].meta).get("cache_hit") == "1"
+
+
+class TestIngestPipelineCache:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        return build_mesh({"data": -1})
+
+    def _pipe(self, mesh, device_calls):
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+
+        def device_fn(x):
+            device_calls.append(1)
+            return x * 2
+
+        stage = Stage(
+            name="double",
+            preprocess=lambda v: np.array([v], np.float32),
+            device_fn=device_fn,
+            postprocess=lambda decoded, row: float(row[0]),
+        )
+        return IngestPipeline(
+            mesh,
+            [stage],
+            decode=lambda b: int.from_bytes(b, "big"),
+            batch_size=8,
+            cache_namespace="ingest/test/m@1",
+        )
+
+    def test_warm_rerun_is_pure_cache_traffic(self, cache_on, mesh):
+        device_calls: list = []
+        pipe = self._pipe(mesh, device_calls)
+        items = [int(i).to_bytes(2, "big") for i in range(20)]
+        cold = pipe.run_all(items)
+        assert [r["double"] for r in cold] == [2.0 * i for i in range(20)]
+        assert pipe.stats.cache_hits == 0
+        cold_devices = len(device_calls)
+        assert cold_devices == 3  # 2 full batches + tail
+
+        warm = pipe.run_all(items)
+        # Identical records, input order, zero batches, zero device calls:
+        # the raw-bytes lookup ran BEFORE decode, so the whole host lane
+        # was skipped too.
+        assert [r["_index"] for r in warm] == list(range(20))
+        assert [r["double"] for r in warm] == [2.0 * i for i in range(20)]
+        assert pipe.stats.cache_hits == 20
+        assert pipe.stats.cache_hit_rate == 1.0
+        assert pipe.stats.batches == 0
+        assert len(device_calls) == cold_devices
+
+    def test_mixed_hits_and_misses_preserve_order(self, cache_on, mesh):
+        device_calls: list = []
+        pipe = self._pipe(mesh, device_calls)
+        old = [int(i).to_bytes(2, "big") for i in range(100, 110)]
+        pipe.run_all(old)
+        # Interleave cached and new items: every record must still come
+        # back in input order with the right value.
+        new = [int(i).to_bytes(2, "big") for i in range(200, 210)]
+        mixed = [v for pair in zip(old, new) for v in pair]
+        records = pipe.run_all(mixed)
+        expect = [v for pair in zip(range(100, 110), range(200, 210)) for v in pair]
+        assert [r["_index"] for r in records] == list(range(20))
+        assert [r["double"] for r in records] == [2.0 * v for v in expect]
+        assert pipe.stats.cache_hits == 10
