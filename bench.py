@@ -1891,6 +1891,163 @@ def phase_probe() -> dict:
     return out
 
 
+def phase_chaos() -> dict:
+    """Deterministic fault-containment chaos proof (CPU-safe, no model).
+
+    Drives the PR-4 acceptance claims end to end with a fake device fn and
+    asserts them hard — the phase FAILS if containment regresses:
+
+    - **bisection**: one poison item in a full batch of 8 → the 7
+      innocents get their real rows, only the poison fails;
+    - **quarantine**: resubmitting the poison is rejected before the
+      admission queue with ZERO additional batcher work (latency
+      measured);
+    - **breaker**: a tripped breaker sheds a request burst through the
+      full gRPC dispatch layer in <1 ms/request without touching the
+      handler (latency measured);
+    - **watchdog**: a hung batch fails its pending futures in ~budget
+      time and leaves the batcher closeable (time-to-fail measured).
+    """
+    import numpy as np
+
+    from lumen_tpu.runtime.batcher import MicroBatcher
+    from lumen_tpu.runtime.quarantine import QuarantineRegistry
+    from lumen_tpu.serving.breaker import CircuitBreaker
+    from lumen_tpu.testing import faults
+    from lumen_tpu.utils.deadline import PoisonInput, WatchdogTimeout
+
+    POISON = 666.0
+
+    def poison_fn(tree, n):
+        arr = np.asarray(tree)
+        if np.any(arr[:n] == POISON):
+            raise RuntimeError("device choked on poison row")
+        return tree
+
+    out: dict = {}
+
+    # -- bisection + quarantine ------------------------------------------
+    _state("chaos:bisect")
+    q = QuarantineRegistry(ttl_s=600)
+    b = MicroBatcher(poison_fn, max_batch=8, max_latency_ms=5,
+                     name="chaos", quarantine=q)
+    values = [0, 1, 2, POISON, 4, 5, 6, 7]
+    futs = [b.submit(np.array([float(v)]), fingerprint=f"fp-{i}")
+            for i, v in enumerate(values)]
+    t0 = time.perf_counter()
+    b.start()
+    innocents_ok = poison_failed = 0
+    for v, f in zip(values, futs):
+        try:
+            row = f.result(timeout=60)
+        except PoisonInput:
+            poison_failed += 1
+        else:
+            assert float(np.asarray(row)[0]) == float(v)
+            innocents_ok += 1
+    isolate_ms = (time.perf_counter() - t0) * 1e3
+    assert innocents_ok == 7 and poison_failed == 1, (innocents_ok, poison_failed)
+
+    batches_before = b.stats["batches"] + b.stats["bisects"]
+    t0 = time.perf_counter()
+    rejections = 0
+    for _ in range(100):
+        try:
+            b.submit(np.array([POISON]), fingerprint="fp-3")
+        except PoisonInput:
+            rejections += 1
+    reject_us = (time.perf_counter() - t0) / 100 * 1e6
+    assert rejections == 100
+    assert b.stats["batches"] + b.stats["bisects"] == batches_before  # zero device work
+    b.close()
+    out["bisect"] = {
+        "innocents_ok": innocents_ok,
+        "poison_failed": poison_failed,
+        "bisect_probes": b.stats["bisects"],
+        "isolate_ms": round(isolate_ms, 2),
+        "quarantine_reject_us": round(reject_us, 1),
+    }
+    q.close()
+
+    # -- breaker shed burst through the gRPC dispatch layer ---------------
+    _state("chaos:breaker")
+    from lumen_tpu.serving import BaseService, TaskDefinition, TaskRegistry
+    from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+    handler_calls = []
+
+    class Svc(BaseService):
+        def __init__(self):
+            reg = TaskRegistry("chaos")
+            reg.register(TaskDefinition(name="t", handler=self._fail))
+            super().__init__(reg)
+
+        def _fail(self, payload, mime, meta):
+            handler_calls.append(1)
+            raise RuntimeError("backend broken")
+
+        def capability(self):
+            return self.registry.build_capability(model_ids=[], runtime="none")
+
+    svc = Svc()
+    svc.breaker = CircuitBreaker("chaos", failures=1, reset_s=600)
+
+    def infer(cid):
+        req = pb.InferRequest(correlation_id=cid, task="t", payload=b"x")
+        (resp,) = svc.Infer(iter([req]), None)
+        return resp
+
+    infer("trip")  # one INTERNAL failure trips the breaker
+    assert svc.breaker.state() == "open"
+    n_burst = 500
+    t0 = time.perf_counter()
+    for i in range(n_burst):
+        resp = infer(str(i))
+        assert resp.meta.get("breaker_open") == "1"
+    shed_us = (time.perf_counter() - t0) / n_burst * 1e6
+    assert len(handler_calls) == 1  # the burst never touched the backend
+    assert shed_us < 1000, f"breaker shed {shed_us:.0f}us/request (>1ms)"
+    svc.breaker.close()
+    out["breaker"] = {
+        "burst": n_burst,
+        "shed_us_per_request": round(shed_us, 1),
+        "handler_calls_during_burst": len(handler_calls) - 1,
+    }
+
+    # -- watchdog on a hung batch ----------------------------------------
+    _state("chaos:watchdog")
+    faults.configure("batch_hang", match="chaos-wd")
+    wb = MicroBatcher(lambda t, n: t, max_batch=4, max_latency_ms=5,
+                      name="chaos-wd", watchdog_s=0.25,
+                      quarantine=QuarantineRegistry(ttl_s=600))
+    fut = wb.submit(np.zeros(1))
+    t0 = time.perf_counter()
+    wb.start()
+    try:
+        fut.result(timeout=60)
+        raise AssertionError("hung batch settled without the watchdog")
+    except WatchdogTimeout:
+        pass
+    fail_ms = (time.perf_counter() - t0) * 1e3
+    try:
+        wb.submit(np.zeros(1))
+        raise AssertionError("wedged batcher accepted new work")
+    except WatchdogTimeout:
+        pass
+    t0 = time.perf_counter()
+    wb.close()
+    close_ms = (time.perf_counter() - t0) * 1e3
+    faults.reset()
+    assert close_ms < 5000, f"close() on a wedged batcher took {close_ms:.0f}ms"
+    out["watchdog"] = {
+        "budget_s": 0.25,
+        "time_to_fail_ms": round(fail_ms, 1),
+        "close_ms": round(close_ms, 1),
+    }
+    out["platform"] = "host"  # containment is host-side logic: no device needed
+    return out
+
+
 def current_round() -> int:
     """The build round in progress, derived from the driver's own per-round
     artifacts (``BENCH_r{N}.json`` is written at the END of round N, so the
@@ -2020,6 +2177,7 @@ PHASES = {
     "bench_grpc_ref": phase_bench_grpc_ref,
     "baseline": phase_baseline_torch,
     "baseline_vlm": phase_baseline_vlm,
+    "chaos": phase_chaos,
     "tpu_tests": phase_tpu_tests,
 }
 

@@ -39,6 +39,7 @@ from ...ops.image import (
 )
 from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
 from ...runtime.decode_pool import get_decode_pool
+from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
@@ -640,23 +641,33 @@ class CLIPManager:
         sha256 runs on the RAW bytes, so a hit (or a coalesced duplicate
         in flight) skips decode pool AND batcher entirely — identical
         re-index / duplicate-burst traffic costs one device call total.
+        The same content address is the poison-quarantine gate: bytes that
+        previously made a batch fail are rejected HERE — before the decode
+        pool, admission queue and device — and it rides the batcher submit
+        as the fingerprint bisection quarantines on.
         On a miss, decode+resize run on the shared decode pool — the
         calling (gRPC handler) thread only waits, so decode concurrency is
         bounded by ``LUMEN_DECODE_WORKERS``, not by however many handler
         threads pile in. Every hit returns a private copy: a caller
         mutating "its" embedding in place must not poison the store."""
         self._ensure_ready()
+        payload = bytes(image_bytes)
+        ns = self._cache_ns("image_embed")
+        key = guarded_key(ns, None, payload)
         return get_result_cache().get_or_compute(
-            self._cache_ns("image_embed"),
+            ns,
             None,
-            bytes(image_bytes),
-            lambda: self._encode_image_uncached(image_bytes),
+            payload,
+            lambda: self._encode_image_uncached(image_bytes, fingerprint=key),
             clone=np.copy,
+            key=key,
         )
 
-    def _encode_image_uncached(self, image_bytes: bytes) -> np.ndarray:
+    def _encode_image_uncached(
+        self, image_bytes: bytes, fingerprint: str | None = None
+    ) -> np.ndarray:
         resized = get_decode_pool().run(self._decode_resize, image_bytes)
-        vec = self._image_batcher(resized)
+        vec = self._image_batcher(resized, fingerprint=fingerprint)
         return self._check_vector(vec)
 
     def _decode_resize(self, image_bytes: bytes) -> np.ndarray:
@@ -668,17 +679,21 @@ class CLIPManager:
 
     def encode_text(self, text: str) -> np.ndarray:
         self._ensure_ready()
+        payload = text.encode("utf-8")
+        ns = self._cache_ns("text_embed")
+        key = guarded_key(ns, None, payload)
         return get_result_cache().get_or_compute(
-            self._cache_ns("text_embed"),
+            ns,
             None,
-            text.encode("utf-8"),
-            lambda: self._encode_text_uncached(text),
+            payload,
+            lambda: self._encode_text_uncached(text, fingerprint=key),
             clone=np.copy,
+            key=key,
         )
 
-    def _encode_text_uncached(self, text: str) -> np.ndarray:
+    def _encode_text_uncached(self, text: str, fingerprint: str | None = None) -> np.ndarray:
         ids = self.tokenizer.encode_batch([text])[0]
-        vec = self._text_batcher(ids)
+        vec = self._text_batcher(ids, fingerprint=fingerprint)
         return self._check_vector(vec)
 
     def classify_image(self, image_bytes: bytes, top_k: int = 5) -> ClassifyResult:

@@ -38,6 +38,7 @@ from ...runtime.batcher import (
 )
 from ...runtime.decode_pool import get_decode_pool
 from ...runtime.mesh import build_mesh
+from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
@@ -334,15 +335,20 @@ class FaceManager:
                 "max_faces": max_faces,
                 "nms_threshold": nms_threshold,
             }
+            payload = bytes(image)
+            ns = self._cache_ns("detect")
+            key = guarded_key(ns, options, payload)
             return get_result_cache().get_or_compute(
-                self._cache_ns("detect"),
+                ns,
                 options,
-                bytes(image),
+                payload,
                 lambda: self._detect_faces_impl(
                     get_decode_pool().run(decode_image_bytes, image, color="rgb"),
                     conf_threshold, size_min, size_max, max_faces, nms_threshold,
+                    fingerprint=key,
                 ),
                 clone=copy.deepcopy,
+                key=key,
             )
         return self._detect_faces_impl(
             np.asarray(image), conf_threshold, size_min, size_max,
@@ -357,10 +363,11 @@ class FaceManager:
         size_max: float | None,
         max_faces: int | None,
         nms_threshold: float | None,
+        fingerprint: str | None = None,
     ) -> list[FaceDetection]:
         h, w = img.shape[:2]
         boxed, scale, pad_top, pad_left = letterbox_numpy(img, self.det_cfg.input_size)
-        boxes, kps, scores, keep = self._det_batcher(boxed)
+        boxes, kps, scores, keep = self._det_batcher(boxed, fingerprint=fingerprint)
         return self.detections_from_outputs(
             boxes, kps, scores, keep,
             scale=scale, pad_top=pad_top, pad_left=pad_left, image_hw=(h, w),
@@ -485,25 +492,30 @@ class FaceManager:
                 "landmarks": None if landmarks is None
                 else np.asarray(landmarks, np.float32).tolist()
             }
+            payload = bytes(face_image)
+            ns = self._cache_ns("embed")
+            key = guarded_key(ns, options, payload)
             return get_result_cache().get_or_compute(
-                self._cache_ns("embed"),
+                ns,
                 options,
-                bytes(face_image),
+                payload,
                 lambda: self._extract_embedding_impl(
                     get_decode_pool().run(decode_image_bytes, face_image, color="rgb"),
                     landmarks,
+                    fingerprint=key,
                 ),
                 clone=np.copy,
+                key=key,
             )
         return self._extract_embedding_impl(np.asarray(face_image), landmarks)
 
     def _extract_embedding_impl(
-        self, img: np.ndarray, landmarks: np.ndarray | None
+        self, img: np.ndarray, landmarks: np.ndarray | None, fingerprint: str | None = None
     ) -> np.ndarray:
         crop = self.align_crop(img, landmarks) if landmarks is not None else self._center_crop(img)
         if self.spec.rec_color == "bgr":
             crop = crop[:, :, ::-1]
-        return self._rec_batcher(np.ascontiguousarray(crop))
+        return self._rec_batcher(np.ascontiguousarray(crop), fingerprint=fingerprint)
 
     def detect_and_extract(
         self, image_bytes: bytes, max_faces: int | None = None, **det_kw
@@ -522,12 +534,16 @@ class FaceManager:
             **det_kw,
             "max_faces": max_faces,
         }
+        payload = bytes(image_bytes)
+        ns = self._cache_ns("detect_and_embed")
+        key = guarded_key(ns, options, payload)
         return get_result_cache().get_or_compute(
-            self._cache_ns("detect_and_embed"),
+            ns,
             options,
-            bytes(image_bytes),
+            payload,
             lambda: self._detect_and_extract_impl(image_bytes, max_faces, det_kw),
             clone=copy.deepcopy,
+            key=key,
         )
 
     def _detect_and_extract_impl(

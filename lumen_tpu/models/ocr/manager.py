@@ -32,6 +32,7 @@ from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
 from ...ops.image import decode_image_bytes, letterbox_numpy
 from ...runtime.batcher import bucket_for
 from ...runtime.decode_pool import get_decode_pool
+from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_safetensors
@@ -523,15 +524,23 @@ class OcrManager:
             "unclip_ratio": unclip_ratio,
             "use_angle_cls": use_angle_cls,
         }
+        payload = bytes(image_bytes)
+        ns = self._cache_ns("predict")
+        # Quarantine gate on the same content address the cache uses: a
+        # page that previously broke the OCR path (decode bomb, pathological
+        # contour explosion isolated by the ingest salvage) is rejected
+        # before the decode pool and both device programs.
+        key = guarded_key(ns, options, payload)
         return get_result_cache().get_or_compute(
-            self._cache_ns("predict"),
+            ns,
             options,
-            bytes(image_bytes),
+            payload,
             lambda: self._predict_uncached(
                 image_bytes, det_threshold, rec_threshold, box_threshold,
                 unclip_ratio, use_angle_cls,
             ),
             clone=copy.deepcopy,
+            key=key,
         )
 
     def _predict_uncached(

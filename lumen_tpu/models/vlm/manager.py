@@ -28,6 +28,7 @@ from ...core.model_info import ModelInfo, load_model_info
 from ...ops.image import decode_image_bytes
 from ...runtime.decode_pool import get_decode_pool
 from ...runtime.policy import get_policy
+from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.weights import load_state_dict
 from ...utils.metrics import metrics
@@ -740,16 +741,26 @@ class VLMManager:
                 metadata={**result.metadata, "cached": True},
             )
 
+        # Quarantine gate on the request's content address (image bytes +
+        # full prompt/knob set): a prompt+image pair that previously broke
+        # the generation path is rejected before vision encode and
+        # prefill. Sampled requests bypass the cache above and skip the
+        # gate too — their options differ per call, so no stable
+        # fingerprint exists to quarantine on.
+        ns = self._cache_ns()
+        payload = image_bytes or b""
+        key = guarded_key(ns, options, payload)
         return get_result_cache().get_or_compute(
-            self._cache_ns(),
+            ns,
             options,
-            image_bytes or b"",
+            payload,
             lambda: self._generate_uncached(
                 messages, image_bytes, max_new_tokens, temperature, top_p,
                 do_sample, repetition_penalty, stop_sequences,
                 add_generation_prompt,
             ),
             clone=clone,
+            key=key,
         )
 
     def _generate_uncached(

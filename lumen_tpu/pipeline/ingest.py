@@ -37,6 +37,7 @@ import jax
 from lumen_tpu.runtime.batcher import stack_and_pad, unstack
 from lumen_tpu.runtime.decode_pool import DecodePool, get_decode_pool
 from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
+from lumen_tpu.runtime.quarantine import QuarantineRegistry, get_quarantine
 from lumen_tpu.runtime.result_cache import ResultCache, get_result_cache, make_key
 
 logger = logging.getLogger(__name__)
@@ -64,6 +65,8 @@ class IngestStats:
     items: int = 0
     batches: int = 0
     cache_hits: int = 0  # items answered from the result cache (no decode)
+    errors: int = 0      # items that became per-item ``_error`` records
+    quarantined: int = 0  # items rejected up front by the poison quarantine
     wall_s: float = 0.0
     decode_s: float = 0.0  # producer-lane time (decode + preprocess + transfer)
     device_s: float = 0.0  # consumer time blocked on device fetches
@@ -85,6 +88,8 @@ class IngestStats:
             "batches": self.batches,
             "cache_hits": self.cache_hits,
             "cache_hit_rate": round(self.cache_hit_rate, 4),
+            "errors": self.errors,
+            "quarantined": self.quarantined,
             "wall_s": round(self.wall_s, 4),
             "items_per_sec": round(self.items_per_sec, 2),
             "decode_s": round(self.decode_s, 4),
@@ -242,6 +247,7 @@ class IngestPipeline:
         stop: threading.Event,
         pool: DecodePool | None,
         cache: ResultCache | None,
+        quarantine: QuarantineRegistry,
     ) -> None:
         # ``pool`` is run()'s single resolve of the shared pool (None when
         # ``workers`` is pinned) — resolving again here could land on a
@@ -276,26 +282,43 @@ class IngestPipeline:
                 if stop.is_set():
                     return
                 key = None
-                if cache is not None and isinstance(item, (bytes, bytearray)):
-                    # The pre-decode lookup: sha256 over the RAW bytes, so
-                    # a hit never touches the decode pool — the lane
-                    # BENCH_r05 measured as the ingest bottleneck.
+                record = None
+                if (
+                    self.cache_namespace
+                    and isinstance(item, (bytes, bytearray))
+                    and (cache is not None or quarantine.enabled)
+                ):
+                    # One sha256 over the RAW bytes serves both pre-decode
+                    # gates: the quarantine rejection and the cache lookup
+                    # — neither touches the decode pool (the lane
+                    # BENCH_r05 measured as the ingest bottleneck).
                     key = make_key(self.cache_namespace, self.cache_options, item)
-                    found, rec = cache.get(key, clone=copy.deepcopy)
-                    if found:
-                        self.stats.cache_hits += 1
-                        hits[index] = rec
-                        index += 1
-                        # Bound the consumer's reorder buffer: a long hit
-                        # run stuck behind a part-filled miss chunk flushes
-                        # that chunk (padded batch) instead of buffering
-                        # hit records without limit.
-                        if chunk and len(hits) >= self.batch_size:
-                            if not emit_chunk():
-                                return
-                        if not chunk and not emit_hits():
+                    reason = quarantine.reason(key)
+                    if reason is not None:
+                        # Poison containment: a known-bad item becomes a
+                        # per-item error record instead of wasting decode
+                        # + device work failing the same way again.
+                        self.stats.quarantined += 1
+                        self.stats.errors += 1
+                        record = {"_error": f"quarantined: {reason}"}
+                    elif cache is not None:
+                        found, rec = cache.get(key, clone=copy.deepcopy)
+                        if found:
+                            self.stats.cache_hits += 1
+                            record = rec
+                if record is not None:
+                    hits[index] = record
+                    index += 1
+                    # Bound the consumer's reorder buffer: a long hit
+                    # run stuck behind a part-filled miss chunk flushes
+                    # that chunk (padded batch) instead of buffering
+                    # hit records without limit.
+                    if chunk and len(hits) >= self.batch_size:
+                        if not emit_chunk():
                             return
-                        continue
+                    if not chunk and not emit_hits():
+                        return
+                    continue
                 chunk.append((index, item, key))
                 index += 1
                 if len(chunk) == self.batch_size:
@@ -331,6 +354,7 @@ class IngestPipeline:
         # submissions and the finally-block snapshot. Same for the cache.
         run_pool = self.pool
         cache = self._cache()
+        quarantine = get_quarantine()
         # Fence taken at run start: a namespace invalidation (model
         # hot-swap) landing mid-run must stop this run's records — which
         # were computed by the pre-swap managers — from being stored past
@@ -341,7 +365,8 @@ class IngestPipeline:
         ready: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         producer = threading.Thread(
-            target=self._producer, args=(items, ready, stop, run_pool, cache),
+            target=self._producer,
+            args=(items, ready, stop, run_pool, cache, quarantine),
             name="ingest-producer", daemon=True
         )
         producer.start()
@@ -374,8 +399,12 @@ class IngestPipeline:
                             rec["_index"] = i
                             finished[i] = rec
                         continue
-                    for stage in self.stages:
-                        got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
+                    try:
+                        for stage in self.stages:
+                            got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
+                    except Exception as e:  # noqa: BLE001 - contain, don't abort the run
+                        self._salvage_batch(got, e, cache, fence, quarantine, finished)
+                        continue
                     pending.append(got)
                     self.stats.max_inflight = max(self.stats.max_inflight, len(pending))
                 yielded = False
@@ -393,9 +422,14 @@ class IngestPipeline:
                     continue  # block in the fill loop for more input
                 batch = pending.popleft()
                 t0 = time.perf_counter()
-                rows_by_stage = {
-                    s.name: unstack(batch.outputs[s.name], batch.n) for s in self.stages
-                }
+                try:
+                    rows_by_stage = {
+                        s.name: unstack(batch.outputs[s.name], batch.n) for s in self.stages
+                    }
+                except Exception as e:  # noqa: BLE001 - async dispatch: errors often land at fetch
+                    self.stats.device_s += time.perf_counter() - t0
+                    self._salvage_batch(batch, e, cache, fence, quarantine, finished)
+                    continue
                 self.stats.device_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
                 for i in range(batch.n):
@@ -438,6 +472,85 @@ class IngestPipeline:
                 # concurrent users by design (that contention is real).
                 g["tasks"] = self._run_pool_tasks
                 self.stats.pool = g
+
+    def _salvage_batch(
+        self,
+        batch: _Batch,
+        error: Exception,
+        cache: ResultCache | None,
+        fence: int,
+        quarantine: QuarantineRegistry,
+        finished: dict[int, dict],
+    ) -> None:
+        """A batch's device work raised: contain instead of aborting the
+        run. Every item re-runs ALONE — its single-item tree padded to the
+        same static ``batch_size`` shape, so no new compile — and the
+        item(s) that still fail become per-item ``_error`` records with
+        their fingerprints quarantined (the next ingest pass rejects them
+        pre-decode); innocents keep their real records. Cost: up to
+        ``batch_size`` full-shape device calls for the one failing batch —
+        the rare-poison price, paid only on failure."""
+        logger.warning(
+            "ingest batch of %d failed (%s: %s); salvaging per-item",
+            batch.n, type(error).__name__, error,
+        )
+        t0 = time.perf_counter()
+        succeeded = 0
+        failed: list[tuple[int, Exception]] = []  # (batch row, its error)
+        for i in range(batch.n):
+            idx = batch.indices[i]
+            record: dict[str, Any] = {"_index": idx}
+            try:
+                for s in self.stages:
+                    tree = s.preprocess(batch.decoded[i])
+                    stacked = stack_and_pad([tree], self.batch_size)
+                    placed = jax.tree_util.tree_map(
+                        lambda leaf: jax.device_put(leaf, self._sharding), stacked
+                    )
+                    row = unstack(s.device_fn(placed), 1)[0]
+                    record[s.name] = s.postprocess(batch.decoded[i], row)
+            except Exception as e:  # noqa: BLE001 - candidate poison (pending sibling evidence)
+                record = {
+                    "_index": idx,
+                    "_error": f"poison: {type(e).__name__}: {e}",
+                }
+                self.stats.errors += 1
+                failed.append((i, e))
+            else:
+                succeeded += 1
+                if self.annotate is not None:
+                    record.update(self.annotate(batch.decoded[i]))
+                if cache is not None and batch.keys[i] is not None and not record.get("_error"):
+                    cache.put(
+                        batch.keys[i],
+                        {k: v for k, v in record.items() if k != "_index"},
+                        clone=copy.deepcopy,
+                        fence=fence,
+                    )
+            finished[idx] = record
+        # Same evidence rule as the batcher's bisection: a poison verdict
+        # (and quarantine registration) requires at least one sibling that
+        # ran clean. If EVERY item failed alone, the device — not the
+        # inputs — is broken: the records still carry their errors, but
+        # innocent photos must not be quarantined for the TTL window.
+        if succeeded:
+            for i, e in failed:
+                if batch.keys[i]:
+                    quarantine.add(
+                        batch.keys[i], f"ingest: {type(e).__name__}: {e}"
+                    )
+        elif failed:
+            logger.error(
+                "ingest salvage found no healthy item in a batch of %d; "
+                "treating as a device-level failure (nothing quarantined)",
+                batch.n,
+            )
+            for i, _ in failed:
+                finished[batch.indices[i]]["_error"] = (
+                    f"batch: {type(error).__name__}: {error}"
+                )
+        self.stats.post_s += time.perf_counter() - t0
+        self.stats.batches += 1
 
     def run_all(self, items: Iterable[Any]) -> list[dict]:
         return list(self.run(items))

@@ -7,6 +7,7 @@ backends (`SURVEY.md` §2 "native compute" note).
 from .compile_cache import enable_persistent_cache
 from .batcher import MicroBatcher, bucket_for, default_buckets
 from .decode_pool import DecodePool, get_decode_pool, shutdown_decode_pool
+from .quarantine import QuarantineRegistry, get_quarantine, reset_quarantine
 from .result_cache import ResultCache, get_result_cache, reset_result_cache
 from .mesh import (
     DATA_AXIS,
@@ -38,6 +39,9 @@ __all__ = [
     "DecodePool",
     "get_decode_pool",
     "shutdown_decode_pool",
+    "QuarantineRegistry",
+    "get_quarantine",
+    "reset_quarantine",
     "ResultCache",
     "get_result_cache",
     "reset_result_cache",

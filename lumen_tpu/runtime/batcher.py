@@ -26,11 +26,33 @@ instead of queueing unbounded device results in HBM.
 Shape buckets default to powers of two up to ``max_batch``; a warmup call
 per bucket at startup turns the reference's "model load time" into our
 "compile time" (SURVEY.md §7 hard part 2).
+
+Fault containment (three mechanisms, all per-batcher):
+
+- **batch bisection** — a failing batch of N no longer fails all N
+  callers: the two halves are re-dispatched (synchronously, bounded by
+  ``LUMEN_BISECT_DEPTH`` levels) until the offending item(s) are
+  isolated. Innocent co-batched requests get their real results; only the
+  poison items fail (:class:`~lumen_tpu.utils.deadline.PoisonInput`), and
+  their fingerprints land in the quarantine registry so repeats are
+  rejected before admission. When NO item in the failing batch succeeds,
+  the failure is the device's, not an input's — everyone gets the original
+  error and nothing is quarantined.
+- **quarantine rejection** — ``submit(fingerprint=...)`` consults
+  :mod:`~lumen_tpu.runtime.quarantine` before the admission queue: a
+  known-poison payload costs a dict lookup, never a batch slot.
+- **watchdog** — with ``LUMEN_BATCH_WATCHDOG_S`` set (>0; 0 = off, the
+  CPU/test default), a monitor thread fails any single device dispatch or
+  fetch that exceeds the budget: pending futures get
+  :class:`~lumen_tpu.utils.deadline.WatchdogTimeout`, queued and in-flight
+  work is drained loudly, and the batcher refuses new submits instead of
+  wedging — mirroring the dead-fetch-worker containment.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import os
 import queue
 import threading
@@ -38,13 +60,22 @@ import time
 import weakref
 from collections import deque
 from concurrent.futures import Future, InvalidStateError as futures_InvalidState, TimeoutError as FuturesTimeout
+from contextlib import contextmanager
 from typing import Any, Callable
 
 import jax
 import numpy as np
 
-from ..utils.deadline import DeadlineExpired, QueueFull, get_deadline, remaining
+from ..utils.deadline import (
+    DeadlineExpired,
+    PoisonInput,
+    QueueFull,
+    WatchdogTimeout,
+    get_deadline,
+    remaining,
+)
 from ..utils.metrics import metrics
+from .quarantine import QuarantineRegistry, get_quarantine
 
 logger = logging.getLogger(__name__)
 
@@ -126,6 +157,32 @@ def batch_inflight() -> int:
         return 2
 
 
+def bisect_depth_default(max_batch: int) -> int:
+    """Default batch-bisection depth: ``LUMEN_BISECT_DEPTH`` when set
+    (0 disables bisection — a failing batch fans out to every caller, the
+    pre-containment behavior); otherwise ``ceil(log2(max_batch))``, enough
+    to isolate a single poison item out of a full batch."""
+    raw = os.environ.get("LUMEN_BISECT_DEPTH")
+    if raw is not None:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return max(1, math.ceil(math.log2(max(2, max_batch))))
+
+
+def batch_watchdog_s() -> float:
+    """``LUMEN_BATCH_WATCHDOG_S``: seconds one device dispatch or fetch
+    may run before the watchdog fails the batch and disables the batcher
+    (0 / unset / malformed = off — the CPU/test default; on TPU, size it
+    above the worst warmed-bucket batch latency, and remember a cold
+    compile through a tunnel can take >60s: warm up first)."""
+    try:
+        return max(0.0, float(os.environ.get("LUMEN_BATCH_WATCHDOG_S", "0")))
+    except ValueError:
+        return 0.0
+
+
 def _settle(fut: Future, result: Any = None, exception: BaseException | None = None) -> bool:
     """Resolve a caller future, tolerating the cancel race: a
     deadline-bounded caller may cancel() between the collector's state
@@ -152,15 +209,26 @@ def bucket_for(n: int, buckets: list[int]) -> int:
 
 
 class _Inflight:
-    """One dispatched-but-unfetched batch riding the in-flight deque."""
+    """One dispatched-but-unfetched batch riding the in-flight deque.
+    ``entries`` keeps the (item, future, fingerprint) triples so a
+    fetch-time failure can still bisect (re-dispatching needs the host
+    items, which are tiny next to the device result they produced)."""
 
-    __slots__ = ("futures", "result", "n", "size")
+    __slots__ = ("futures", "result", "n", "size", "entries")
 
-    def __init__(self, futures: list[Future], result: Any, n: int, size: int):
+    def __init__(
+        self,
+        futures: list[Future],
+        result: Any,
+        n: int,
+        size: int,
+        entries: list[tuple] | None = None,
+    ):
         self.futures = futures
         self.result = result  # un-fetched device result tree
         self.n = n
         self.size = size
+        self.entries = entries or []
 
 
 class MicroBatcher:
@@ -187,6 +255,9 @@ class MicroBatcher:
         name: str = "batcher",
         max_queue: int | None = None,
         inflight: int | None = None,
+        bisect_depth: int | None = None,
+        watchdog_s: float | None = None,
+        quarantine: QuarantineRegistry | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -202,9 +273,18 @@ class MicroBatcher:
         # unbounded queue whose latency grows without limit. 0 = unbounded.
         self.max_queue = batch_queue_depth() if max_queue is None else max(0, max_queue)
         self.inflight = batch_inflight() if inflight is None else max(1, inflight)
-        self._queue: queue.Queue[tuple[Any, Future, float | None] | None] = queue.Queue()
+        # Containment: bisection depth (0 = off), watchdog budget (0 = off)
+        # and the quarantine registry isolated offenders land in (None =
+        # the process-wide one, resolved lazily so tests can reset it).
+        self.bisect_depth = (
+            bisect_depth_default(max_batch) if bisect_depth is None else max(0, bisect_depth)
+        )
+        self.watchdog_s = batch_watchdog_s() if watchdog_s is None else max(0.0, watchdog_s)
+        self._quarantine = quarantine
+        self._queue: queue.Queue[tuple[Any, Future, float | None, str | None] | None] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._fetch_thread: threading.Thread | None = None
+        self._watchdog_thread: threading.Thread | None = None
         self._closed = threading.Event()
         # Guards the closed-check + enqueue pair in submit() against a
         # concurrent close() draining the queue in between.
@@ -215,8 +295,24 @@ class MicroBatcher:
         self._inflight: deque[_Inflight] = deque()
         self._inflight_cv = threading.Condition()
         self._fetch_stop = False
+        # Watchdog state: lane (thread id) -> (start, futures) for every
+        # risky device call currently running, and the wedge verdict once
+        # the watchdog has fired (submit refuses new work from then on).
+        self._watch_lock = threading.Lock()
+        self._watching: dict[int, tuple[float, list[Future]]] = {}
+        self._wedged: WatchdogTimeout | None = None
         # Telemetry for capability metadata / benchmarks.
-        self.stats = {"batches": 0, "items": 0, "padded": 0, "shed": 0, "expired": 0}
+        self.stats = {
+            "batches": 0,
+            "items": 0,
+            "padded": 0,
+            "shed": 0,
+            "expired": 0,
+            "bisects": 0,
+            "poisoned": 0,
+            "quarantine_rejected": 0,
+            "watchdog": 0,
+        }
 
     # -- lifecycle --------------------------------------------------------
 
@@ -227,6 +323,11 @@ class MicroBatcher:
         )
         self._thread.start()
         self._fetch_thread.start()
+        if self.watchdog_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name=f"{self.name}-watchdog", daemon=True
+            )
+            self._watchdog_thread.start()
         # Live state on /metrics: queue depth + batch/padding telemetry
         # (latency histograms can't show a backed-up or waste-heavy queue).
         # The provider closes over a weakref so the global registry never
@@ -257,7 +358,10 @@ class MicroBatcher:
             # collector's drain pass sees them all.
             self._queue.put(None)
         if self._thread:
-            self._thread.join(timeout=10)
+            # A wedged batcher's collector may be parked inside the stuck
+            # device call forever — the watchdog already settled its
+            # futures, so close() must not ride out the full join budget.
+            self._thread.join(timeout=1.0 if self._wedged is not None else 10)
         # Stop the fetch worker only AFTER the collector exits: every batch
         # it dispatched must still settle (in-flight results drain; the
         # worker's loop runs until the deque is empty AND stop is set).
@@ -265,7 +369,7 @@ class MicroBatcher:
             self._fetch_stop = True
             self._inflight_cv.notify_all()
         if self._fetch_thread:
-            self._fetch_thread.join(timeout=60)
+            self._fetch_thread.join(timeout=1.0 if self._wedged is not None else 60)
             # A fetch worker killed by an escaping BaseException leaves its
             # in-flight batches unsettled, and after close() nothing else
             # will ever settle them — drain here so close() upholds the
@@ -293,17 +397,34 @@ class MicroBatcher:
 
     # -- client side ------------------------------------------------------
 
-    def submit(self, item: Any, deadline: float | None = None) -> Future:
+    @property
+    def quarantine(self) -> QuarantineRegistry:
+        """The registry isolated offenders land in (the process-wide one
+        unless the constructor pinned an explicit instance)."""
+        return self._quarantine if self._quarantine is not None else get_quarantine()
+
+    def submit(
+        self, item: Any, deadline: float | None = None, fingerprint: str | None = None
+    ) -> Future:
         """Enqueue one item. ``deadline`` is an absolute ``time.monotonic()``
         instant; unset, it is inherited from the ambient request context
         (:func:`lumen_tpu.utils.deadline.get_deadline`, installed by the
         gRPC layer from ``context.time_remaining()``). Expired entries are
         dropped before the device call instead of burning a batch slot.
 
+        ``fingerprint`` is the payload's content address (the result-cache
+        key) — it is both the quarantine gate (a known-poison payload is
+        rejected HERE, before the admission queue and the device) and the
+        identity that gets quarantined if bisection later isolates this
+        item as the one that fails its batch.
+
         Raises :class:`QueueFull` when ``max_queue`` items are already
         waiting (load shed — the caller should surface a retryable
-        RESOURCE_EXHAUSTED-style error) and :class:`DeadlineExpired` when
-        the deadline has already passed at submit time."""
+        RESOURCE_EXHAUSTED-style error), :class:`DeadlineExpired` when
+        the deadline has already passed at submit time,
+        :class:`PoisonInput` when the fingerprint is quarantined, and
+        :class:`WatchdogTimeout` when the watchdog has disabled the
+        batcher."""
         if deadline is None:
             deadline = get_deadline()
         if deadline is not None and time.monotonic() >= deadline:
@@ -311,8 +432,20 @@ class MicroBatcher:
             metrics.count("deadline_drops")
             metrics.count(f"deadline_drops:{self.name}")
             raise DeadlineExpired(f"{self.name}: request deadline already expired at submit")
+        if fingerprint is not None:
+            try:
+                self.quarantine.check(fingerprint)
+            except PoisonInput:
+                self.stats["quarantine_rejected"] += 1
+                raise
         fut: Future = Future()
         with self._submit_lock:
+            # Wedge check INSIDE the lock: _fire_watchdog sets _wedged and
+            # drains the queue under the same lock, so an entry can never
+            # slip in between the drain and this check and hang unsettled
+            # (same race the lock already closes for close()'s drain).
+            if self._wedged is not None:
+                raise WatchdogTimeout(str(self._wedged))
             if self._closed.is_set():
                 raise RuntimeError(f"{self.name} is closed")
             if self.max_queue and self._queue.qsize() >= self.max_queue:
@@ -322,10 +455,12 @@ class MicroBatcher:
                 raise QueueFull(
                     f"{self.name}: admission queue full ({self.max_queue} waiting); request shed"
                 )
-            self._queue.put((item, fut, deadline))
+            self._queue.put((item, fut, deadline, fingerprint))
         return fut
 
-    def __call__(self, item: Any, timeout: float | None = None) -> Any:
+    def __call__(
+        self, item: Any, timeout: float | None = None, fingerprint: str | None = None
+    ) -> Any:
         """Submit and wait. The default wait must tolerate a cold XLA
         compile of a new bucket THROUGH the axon tunnel (observed >60s on
         a v5e: the first on-chip gRPC bench died on exactly this) — the
@@ -339,7 +474,7 @@ class MicroBatcher:
         deadline_bounded = rem is not None and rem < timeout
         if deadline_bounded:
             timeout = max(rem, 0.0)
-        fut = self.submit(item)
+        fut = self.submit(item, fingerprint=fingerprint)
         try:
             return fut.result(timeout=timeout)
         except FuturesTimeout:
@@ -360,7 +495,7 @@ class MicroBatcher:
     # -- collector thread -------------------------------------------------
 
     def _run(self) -> None:
-        while not self._closed.is_set():
+        while not self._closed.is_set() and self._wedged is None:
             first = self._queue.get()
             if first is None:
                 break
@@ -388,7 +523,7 @@ class MicroBatcher:
             if entry is not None:
                 _settle(entry[1], exception=RuntimeError(f"{self.name} closed"))
 
-    def _dispatch(self, batch: list[tuple[Any, Future, float | None]]) -> None:
+    def _dispatch(self, batch: list[tuple[Any, Future, float | None, str | None]]) -> None:
         # Reserve an in-flight slot FIRST: this wait is where the collector
         # blocks under backpressure (possibly for a full device-batch
         # latency), so it must come before the deadline gate — an entry
@@ -407,9 +542,15 @@ class MicroBatcher:
                 if self._fetch_thread is not None and not self._fetch_thread.is_alive():
                     dead = True
                     break
+                if self._wedged is not None:
+                    break  # the watchdog drained the deque; abort below
                 self._inflight_cv.wait(timeout=1.0)
+        if self._wedged is not None:
+            for _, fut, _, _ in batch:
+                _settle(fut, exception=WatchdogTimeout(str(self._wedged)))
+            return
         if dead:
-            self._abort_dead_fetch([fut for _, fut, _ in batch])
+            self._abort_dead_fetch([fut for _, fut, _, _ in batch])
             return
         # Deadline gate: entries whose caller deadline passed while they
         # queued are failed here — BEFORE stacking and the device call — so
@@ -418,9 +559,9 @@ class MicroBatcher:
         # The gate runs per dispatch even with earlier batches still in
         # flight: a deadline that expires while batch k computes still
         # drops the k+1 entry it covers.
-        live: list[tuple[Any, Future]] = []
+        live: list[tuple[Any, Future, str | None]] = []
         now = time.monotonic()
-        for item, fut, deadline in batch:
+        for item, fut, deadline, fingerprint in batch:
             if fut.cancelled():
                 # The waiting caller already gave up (and accounted the
                 # drop); counting here too would double-book the event.
@@ -436,7 +577,7 @@ class MicroBatcher:
                     metrics.count("deadline_drops")
                     metrics.count(f"deadline_drops:{self.name}")
             else:
-                live.append((item, fut))
+                live.append((item, fut, fingerprint))
         if not live:
             return
         items = [b[0] for b in live]
@@ -444,28 +585,262 @@ class MicroBatcher:
         n = len(items)
         size = bucket_for(n, self.buckets)
         try:
-            from ..testing.faults import faults
-
-            # No-op unless a test/harness armed the point; lets the suite
-            # exercise the fan-out-failure path below deterministically.
-            # With inflight > 1 an injected failure lands on exactly this
-            # batch's callers — earlier in-flight batches settle normally.
-            faults.check("batch_execute", self.name)
-            stacked = stack_and_pad(items, size)
-            result = self.fn(stacked, n)  # async dispatch; fetch worker settles
-        except Exception as e:  # noqa: BLE001 - fan the failure out to callers
-            logger.exception("%s: batched dispatch failed (n=%d)", self.name, n)
-            for f in futures:
-                _settle(f, exception=e)
+            result = self._execute(live, n, size)
+        except Exception as e:  # noqa: BLE001 - contain, or fan out to callers
+            self._contain_failure(live, e)
             return
         with self._inflight_cv:
             if self._fetch_thread is not None and not self._fetch_thread.is_alive():
                 dead = True  # nobody left to settle this result
             else:
-                self._inflight.append(_Inflight(futures, result, n, size))
+                self._inflight.append(_Inflight(futures, result, n, size, entries=live))
                 self._inflight_cv.notify_all()
         if dead:
             self._abort_dead_fetch(futures)
+
+    def _execute(self, entries: list[tuple[Any, Future, str | None]], n: int, size: int):
+        """Fault checks + stack + dispatch for one (sub-)batch, watched by
+        the watchdog. Shared by the normal dispatch path and bisection
+        probes, so an armed fault point (or a real per-item failure, e.g. a
+        shape mismatch surfacing in ``stack_and_pad``) fires identically
+        for every sub-batch that still contains the offending item."""
+        from ..testing.faults import faults
+
+        with self._watched([e[1] for e in entries]):
+            # No-op unless a test/harness armed the point; lets the suite
+            # exercise the containment paths below deterministically.
+            # With inflight > 1 an injected failure lands on exactly this
+            # batch's callers — earlier in-flight batches settle normally.
+            faults.check("batch_execute", self.name)
+            for _, _, fingerprint in entries:
+                if fingerprint:
+                    faults.check("batch_poison", f"{self.name}:{fingerprint}")
+            if faults.fires("batch_hang", self.name):
+                self._hang()
+            stacked = stack_and_pad([e[0] for e in entries], size)
+            return self.fn(stacked, n)  # async dispatch; fetch worker settles
+
+    def _hang(self) -> None:
+        """Simulate a wedged device call (``batch_hang`` fault point):
+        park where the real stall would sit until the watchdog fires or
+        the batcher closes, then surface the corresponding error."""
+        logger.warning("%s: batch_hang fault armed; parking dispatch", self.name)
+        while not self._closed.is_set() and self._wedged is None:
+            time.sleep(0.005)
+        raise self._wedged or RuntimeError(f"{self.name}: closed while hung")
+
+    def _contain_failure(
+        self, entries: list[tuple[Any, Future, str | None]], error: Exception
+    ) -> None:
+        """A dispatched (sub-)batch raised: bisect when possible, otherwise
+        fan the failure out to every caller (single item, or bisection
+        disabled)."""
+        n = len(entries)
+        if n > 1 and self.bisect_depth > 0 and not isinstance(error, WatchdogTimeout):
+            logger.warning(
+                "%s: batch of %d failed (%s: %s); bisecting to isolate",
+                self.name, n, type(error).__name__, error,
+            )
+            self._bisect(entries, error)
+            return
+        logger.exception("%s: batched dispatch failed (n=%d)", self.name, n)
+        for _, fut, _ in entries:
+            _settle(fut, exception=error)
+
+    def _bisect(self, entries: list[tuple[Any, Future, str | None]], error: Exception) -> None:
+        """Isolate the item(s) that make a batch fail.
+
+        Runs SYNCHRONOUSLY on the calling thread (collector or fetch
+        worker — whichever observed the failure): each probe dispatches a
+        half and blocks on its fetch, so the pass costs at most
+        ``2 * bisect_depth`` sub-batch device calls. Sub-batch sizes round
+        up to existing buckets, so no new XLA compiles are triggered on a
+        warmed batcher. Containment verdicts:
+
+        - a group that succeeds settles its futures with real rows
+          (innocent co-batched callers lose latency, not their answers);
+        - a single item that fails while ANY sibling succeeded is poison:
+          :class:`PoisonInput` + quarantine registration;
+        - a failing group at the depth bound fails together with its
+          probe's error (isolation gave up — no quarantine on guesses);
+        - if NOTHING succeeded, the device (not an input) is broken: every
+          caller gets the original error and nothing is quarantined.
+        """
+        self.stats["bisects"] += 1
+        metrics.count("batch_bisects")
+        metrics.count(f"batch_bisects:{self.name}")
+        isolated: list[tuple[tuple[Any, Future, str | None], Exception]] = []
+        exhausted: list[tuple[list[tuple[Any, Future, str | None]], Exception]] = []
+        succeeded = 0
+        work: deque[tuple[list[tuple[Any, Future, str | None]], Exception, int]] = deque(
+            [(entries, error, self.bisect_depth)]
+        )
+        while work:
+            if self._wedged is not None:
+                # A probe tripped the watchdog mid-pass: EVERYTHING still
+                # unresolved — queued work, isolated candidates awaiting
+                # their verdict, and depth-exhausted groups awaiting their
+                # group error — fails with the wedge verdict, loudly.
+                # Nothing else will ever settle these futures (they are in
+                # neither the queue nor the in-flight deque).
+                for group, _, _ in work:
+                    for _, fut, _ in group:
+                        _settle(fut, exception=WatchdogTimeout(str(self._wedged)))
+                for entry, _ in isolated:
+                    _settle(entry[1], exception=WatchdogTimeout(str(self._wedged)))
+                for group, _ in exhausted:
+                    for _, fut, _ in group:
+                        _settle(fut, exception=WatchdogTimeout(str(self._wedged)))
+                return
+            group, err, depth = work.popleft()
+            group = [e for e in group if not e[1].cancelled()]
+            if not group:
+                continue
+            if len(group) == 1:
+                isolated.append((group[0], err))
+                continue
+            if depth <= 0:
+                exhausted.append((group, err))
+                continue
+            mid = (len(group) + 1) // 2
+            for half in (group[:mid], group[mid:]):
+                try:
+                    rows = self._probe(half)
+                except Exception as e:  # noqa: BLE001 - recurse into the half
+                    work.append((half, e, depth - 1))
+                else:
+                    # Sibling evidence = the probe ran CLEAN on device,
+                    # independent of whether its callers still wanted the
+                    # rows (_settle on a cancelled/expired future returns
+                    # False, but the device just proved these items
+                    # healthy — the poison verdict below relies on it).
+                    succeeded += len(half)
+                    for (item, fut, _), row in zip(half, rows):
+                        _settle(fut, result=row)
+                    self.stats["batches"] += 1
+                    self.stats["items"] += len(half)
+        for group, err in exhausted:
+            logger.error(
+                "%s: bisection depth exhausted with %d items still "
+                "co-failing; failing the group",
+                self.name, len(group),
+            )
+            for _, fut, _ in group:
+                _settle(fut, exception=err)
+        if not succeeded:
+            # NOTHING in the batch ran clean — that is a broken device
+            # call, not poison inputs. A poison verdict requires sibling
+            # evidence ("fails while others succeed"); without it, every
+            # isolated item gets the original batch error and nothing is
+            # quarantined. This holds at ANY depth: a depth-bounded pass
+            # whose groups all co-failed proves just as little about the
+            # one item it happened to isolate.
+            if isolated:
+                logger.error(
+                    "%s: bisection found no healthy item in a batch of %d; "
+                    "treating as a batch-level failure (%s)",
+                    self.name, len(entries), error,
+                )
+                for entry, _ in isolated:
+                    _settle(entry[1], exception=error)
+            return
+        for (item, fut, fingerprint), err in isolated:
+            poison = PoisonInput(
+                f"{self.name}: input isolated by batch bisection as the "
+                f"item that fails its batch ({type(err).__name__}: {err})"
+            )
+            self.stats["poisoned"] += 1
+            metrics.count("poison_isolated")
+            metrics.count(f"poison_isolated:{self.name}")
+            if fingerprint:
+                self.quarantine.add(
+                    fingerprint, f"{self.name}: {type(err).__name__}: {err}"
+                )
+            _settle(fut, exception=poison)
+
+    def _probe(self, entries: list[tuple[Any, Future, str | None]]) -> list[Any]:
+        """One synchronous bisection probe: dispatch the group and block on
+        its fetch. Returns per-item rows; raises what the group raises."""
+        n = len(entries)
+        result = self._execute(entries, n, bucket_for(n, self.buckets))
+        with self._watched([e[1] for e in entries]):
+            return unstack(result, n)
+
+    # -- watchdog ----------------------------------------------------------
+
+    @contextmanager
+    def _watched(self, futures: list[Future]):
+        """Register the enclosed device call with the watchdog: if it runs
+        past ``watchdog_s``, the monitor thread fails ``futures`` and
+        disables the batcher. Free when the watchdog is off."""
+        if self.watchdog_s <= 0:
+            yield
+            return
+        lane = threading.get_ident()
+        with self._watch_lock:
+            self._watching[lane] = (time.monotonic(), futures)
+        try:
+            yield
+        finally:
+            with self._watch_lock:
+                self._watching.pop(lane, None)
+
+    def _watchdog_loop(self) -> None:
+        interval = min(1.0, max(0.01, self.watchdog_s / 8))
+        while not self._closed.is_set() and self._wedged is None:
+            time.sleep(interval)
+            now = time.monotonic()
+            with self._watch_lock:
+                overdue = [
+                    futs
+                    for _, (t0, futs) in self._watching.items()
+                    if now - t0 > self.watchdog_s
+                ]
+            if overdue:
+                self._fire_watchdog([f for futs in overdue for f in futs])
+                return
+
+    def _fire_watchdog(self, futures: list[Future]) -> None:
+        """A device call blew its budget: presume the device stream is
+        wedged. Fail the stuck batch's callers, drain everything queued or
+        in flight (nothing downstream of a wedged lane will ever settle),
+        and refuse new work — an operator (or the circuit breaker's
+        recovery handoff) must reload the service."""
+        err = WatchdogTimeout(
+            f"{self.name}: batch execution exceeded the watchdog budget "
+            f"({self.watchdog_s:.1f}s); batcher disabled pending reload"
+        )
+        queued_entries = []
+        with self._submit_lock:
+            # Set the wedge flag and drain the queue under the submit lock
+            # (the same pairing close() uses): submit() re-checks _wedged
+            # inside the lock, so no entry can land after this drain and
+            # hang with nobody left to settle it.
+            self._wedged = err
+            while True:
+                try:
+                    queued = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if queued is not None:
+                    queued_entries.append(queued)
+        self.stats["watchdog"] += 1
+        metrics.count("watchdog_timeouts")
+        metrics.count(f"watchdog_timeouts:{self.name}")
+        logger.error("%s", err)
+        for f in futures:
+            _settle(f, exception=err)
+        with self._inflight_cv:
+            stranded = list(self._inflight)
+            self._inflight.clear()
+            self._inflight_cv.notify_all()
+        for entry in stranded:
+            for f in entry.futures:
+                _settle(f, exception=err)
+        # The collector is either the stuck thread or about to observe
+        # _wedged: queued entries would sit forever — fail them now.
+        for queued in queued_entries:
+            _settle(queued[1], exception=err)
 
     def _abort_dead_fetch(self, futures: list[Future]) -> None:
         """The fetch worker died (a BaseException escaped its loop):
@@ -502,7 +877,12 @@ class MicroBatcher:
                     # stuck past close()'s join timeout in a long compile
                     # must still get its final batch settled, not orphaned.
                     if self._fetch_stop:
-                        if not (self._thread and self._thread.is_alive()):
+                        # A wedged collector may be parked in a stuck
+                        # device call forever; its futures are settled, so
+                        # there is nothing left to wait for.
+                        if self._wedged is not None or not (
+                            self._thread and self._thread.is_alive()
+                        ):
                             return
                         self._inflight_cv.wait(timeout=0.05)
                     else:
@@ -512,13 +892,20 @@ class MicroBatcher:
                 # device work (or transfer) is genuinely outstanding.
                 entry = self._inflight[0]
             try:
-                rows = unstack(entry.result, entry.n)
-            except Exception as e:  # noqa: BLE001 - fan out to THIS batch only
-                logger.exception(
-                    "%s: batched fetch failed (n=%d)", self.name, entry.n
-                )
-                for f in entry.futures:
-                    _settle(f, exception=e)
+                with self._watched(entry.futures):
+                    rows = unstack(entry.result, entry.n)
+            except Exception as e:  # noqa: BLE001 - contain, or fan out to THIS batch only
+                # A device error often surfaces at the FETCH, not the
+                # dispatch (XLA dispatch is async): bisection runs here
+                # too, re-dispatching halves of the original items.
+                if entry.entries:
+                    self._contain_failure(entry.entries, e)
+                else:
+                    logger.exception(
+                        "%s: batched fetch failed (n=%d)", self.name, entry.n
+                    )
+                    for f in entry.futures:
+                        _settle(f, exception=e)
             else:
                 self.stats["batches"] += 1
                 self.stats["items"] += entry.n
@@ -526,7 +913,12 @@ class MicroBatcher:
                 for f, row in zip(entry.futures, rows):
                     _settle(f, result=row)
             with self._inflight_cv:
-                self._inflight.popleft()
+                # Identity-guarded: _fire_watchdog may have cleared the
+                # deque while this entry was being unstacked (it was only
+                # PEEKED, not popped) — a blind popleft would then raise
+                # on the empty deque, or eat a successor batch's entry.
+                if self._inflight and self._inflight[0] is entry:
+                    self._inflight.popleft()
                 self._inflight_cv.notify_all()
 
 

@@ -61,7 +61,7 @@ from urllib.parse import quote, unquote
 
 import numpy as np
 
-from ..utils.deadline import DeadlineExpired, QueueFull, remaining
+from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, remaining
 from ..utils.metrics import metrics
 from ..utils.request_notes import mark as _mark
 
@@ -361,6 +361,7 @@ class ResultCache:
         payload: bytes,
         compute: Callable[[], Any],
         clone: Callable[[Any], Any] | None = None,
+        key: str | None = None,
     ) -> Any:
         """The serving-path entry point: content-addressed lookup with
         single-flight coalescing around ``compute``.
@@ -370,16 +371,25 @@ class ResultCache:
           deadline accounting.
         - **miss, first caller**: computes, stores, resolves the shared
           flight. Failures propagate to the caller and fan out to waiters
-          (never cached).
+          (never cached — a poison verdict in particular can never be
+          served as a "result").
         - **miss, concurrent duplicate**: waits on the owner's flight —
           one batcher submission serves the whole burst. If the owner
-          failed with a *caller-specific* overload error (deadline/shed),
-          the waiter retries the compute itself instead of inheriting an
-          error that described someone else's budget.
+          failed with a *caller-specific* overload error (deadline/shed)
+          or a containment verdict (poison isolation/quarantine), the
+          waiter retries the compute itself instead of inheriting an
+          error shaped by someone else's flight; a poison retry then hits
+          the quarantine gate up front and earns its OWN properly-worded
+          rejection, not a secondhand cache error.
+
+        ``key`` skips the internal :func:`make_key` when the caller
+        already hashed the payload (e.g. for the quarantine gate) — the
+        sha256 over megabytes of image bytes should run once, not twice.
         """
         if not self.enabled:
             return compute()
-        key = make_key(namespace, options, payload)
+        if key is None:
+            key = make_key(namespace, options, payload)
         while True:
             found, value = self.get(key, clone=clone)
             if found:
@@ -418,11 +428,27 @@ class ResultCache:
                     "request deadline expired waiting on a coalesced "
                     "identical request"
                 ) from None
-            except (DeadlineExpired, QueueFull):
-                # The OWNER was shed or ran out of ITS deadline budget —
-                # that verdict is not ours. Retire the failed flight (the
-                # owner's own cleanup may not have run yet) and loop:
-                # re-probe, then race to become the new owner.
+            except (DeadlineExpired, QueueFull, PoisonInput) as e:
+                if isinstance(e, PoisonInput):
+                    from .quarantine import get_quarantine
+
+                    if not get_quarantine().enabled:
+                        # With quarantine disabled there is no up-front
+                        # gate to make the re-owned recompute cheap: each
+                        # waiter would serially re-run the poison batch
+                        # (plus a full bisection pass) at device cost. The
+                        # verdict is payload-determined — identical bytes,
+                        # identical poison — so share it instead.
+                        raise
+                # The OWNER was shed, ran out of ITS deadline budget, or
+                # had its item isolated/quarantined as poison — none of
+                # those verdicts are ours to replay as a cache answer.
+                # Retire the failed flight (the owner's own cleanup may
+                # not have run yet) and loop: re-probe, then race to
+                # become the new owner. For poison that recompute is
+                # cheap: the fingerprint is quarantined by now, so the
+                # re-owning waiter is rejected before admission with the
+                # real quarantine message.
                 with self._lock:
                     if self._inflight.get(key) is flight:
                         self._inflight.pop(key)

@@ -13,6 +13,7 @@ from .base_service import (
     Unavailable,
     reassemble_result,
 )
+from .breaker import CircuitBreaker
 from .registry import TaskDefinition, TaskRegistry
 from .resilience import DegradedService, RecoveryManager
 from .router import HubRouter
@@ -24,6 +25,7 @@ __all__ = [
     "Unavailable",
     "ResourceExhausted",
     "DeadlineExceeded",
+    "CircuitBreaker",
     "DegradedService",
     "RecoveryManager",
     "TaskDefinition",

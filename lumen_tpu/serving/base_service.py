@@ -28,7 +28,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from ..utils import deadline as request_deadline, request_notes
-from ..utils.deadline import DeadlineExpired, QueueFull
+from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
 from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
 from .proto.ml_service_pb2_grpc import InferenceServicer
@@ -153,6 +153,12 @@ class _Assembly:
 class BaseService(InferenceServicer):
     """Subclasses populate ``self.registry`` and implement ``capability()``."""
 
+    #: Per-service circuit breaker (attached by the server after
+    #: construction; None = no breaker, the default for tests and
+    #: hand-built services). When set, ``_dispatch`` gates every request
+    #: through it and records request outcomes.
+    breaker = None
+
     def __init__(self, registry: TaskRegistry):
         self.registry = registry
 
@@ -164,11 +170,39 @@ class BaseService(InferenceServicer):
     def healthy(self) -> bool:
         return True
 
+    def _record_outcome(self, e: BaseException | None) -> None:
+        """One source of truth for breaker accounting (shared by the unary
+        and streaming dispatch paths). ``None`` = success. Backend-health
+        verdicts: :class:`WatchdogTimeout` and INTERNAL-class crashes
+        count toward tripping; :class:`PoisonInput` is the payload's fault
+        (telemetry only); overload/deadline/client errors are *neutral* —
+        no verdict either way, but they release a half-open probe slot so
+        a probe that was itself shed cannot pin the breaker."""
+        if self.breaker is None:
+            return
+        if e is None:
+            self.breaker.record_success()
+        elif isinstance(e, WatchdogTimeout):
+            self.breaker.record_failure()
+        elif isinstance(e, PoisonInput):
+            self.breaker.record_poison()
+        elif isinstance(e, (QueueFull, DeadlineExpired, ServiceError)):
+            self.breaker.record_neutral()
+        else:
+            self.breaker.record_failure()
+
     def status(self) -> str:
         """One-word state for the hub's per-service health report:
-        ``healthy``, ``unhealthy`` (unexpected — fails hub health), or
+        ``healthy``, ``unhealthy`` (unexpected — fails hub health),
         ``degraded``/``recovering`` (known-broken with background recovery
-        — reported, but healthy siblings keep the hub serving)."""
+        — reported, but healthy siblings keep the hub serving), or
+        ``breaker_open``/``breaker_half_open`` (fast-failing after repeated
+        backend failures — reported like degraded: siblings keep the hub
+        up, but a hub that is ALL broken still fails health)."""
+        if self.breaker is not None:
+            state = self.breaker.state()
+            if state != "closed":
+                return f"breaker_{state}"
         return "healthy" if self.healthy() else "unhealthy"
 
     # -- Inference rpc implementation ------------------------------------
@@ -208,8 +242,34 @@ class BaseService(InferenceServicer):
                 f"supported: {self.registry.task_names()}",
             )
             return
+        # Circuit-breaker gate: an open breaker sheds HERE — before the
+        # payload is even assembled into the model path, before deadline
+        # and admission accounting, in O(1) — with the same retryable
+        # UNAVAILABLE shape a DegradedService answers, plus a retry-after
+        # hint and a ``breaker_open`` meta note so clients can tell
+        # shed-by-breaker (backend broken, back off hard) from
+        # shed-by-queue (overload, back off briefly).
+        if self.breaker is not None:
+            admitted, retry_after = self.breaker.allow()
+            if not admitted:
+                metrics.count("breaker_sheds")
+                metrics.count_error(asm.task)
+                yield self._error(
+                    cid,
+                    pb.ERROR_CODE_UNAVAILABLE,
+                    f"circuit breaker open for service "
+                    f"{self.registry.service_name!r}; request shed",
+                    f"backend failing repeatedly; retry after ~{retry_after:.1f}s",
+                    meta={"breaker_open": "1"},
+                )
+                return
         payload = asm.payload()
         if len(payload) > task.max_payload_bytes:
+            # Past the breaker gate but before the handler: this request
+            # may hold the half-open probe slot, and a client error is no
+            # verdict on backend health — release the slot (neutral), or
+            # the breaker keeps shedding for a full reset window.
+            self._record_outcome(InvalidArgument("payload exceeds limit"))
             yield self._error(
                 cid,
                 pb.ERROR_CODE_INVALID_ARGUMENT,
@@ -222,6 +282,9 @@ class BaseService(InferenceServicer):
         # while queued — before the device call burns a batch slot.
         deadline = self._context_deadline(context)
         if deadline is not None and time.monotonic() >= deadline:
+            # Same probe-release rule as the payload gate above: an
+            # expired deadline says nothing about backend health.
+            self._record_outcome(DeadlineExpired("expired before dispatch"))
             metrics.count("deadline_drops")
             metrics.count_error(asm.task)
             yield self._error(
@@ -246,20 +309,23 @@ class BaseService(InferenceServicer):
             try:
                 out = task.handler(payload, asm.payload_mime, asm.meta)
             except ServiceError as e:
+                self._record_outcome(e)
                 metrics.count_error(asm.task)
                 yield self._error(cid, e.code, str(e), e.detail)
                 return
-            except (QueueFull, DeadlineExpired) as e:
+            except (QueueFull, DeadlineExpired, PoisonInput, WatchdogTimeout) as e:
+                self._record_outcome(e)
                 metrics.count_error(asm.task)
                 yield self._overload_error(cid, asm.task, e)
                 return
             except Exception as e:  # noqa: BLE001 - handler crash -> INTERNAL
+                self._record_outcome(e)
                 logger.exception("task %s failed", asm.task)
                 metrics.count_error(asm.task)
                 yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
                 return
-
             if isinstance(out, tuple):
+                self._record_outcome(None)
                 result, mime, meta = out
                 meta = dict(meta)
                 lat_ms = (time.perf_counter() - t0) * 1e3
@@ -337,22 +403,29 @@ class BaseService(InferenceServicer):
                     seq += 1
                 pending = chunk
         except ServiceError as e:
+            self._record_outcome(e)
             metrics.count_error(task_name)
             yield self._error(cid, e.code, str(e), e.detail)
             return
-        except (QueueFull, DeadlineExpired) as e:
+        except (QueueFull, DeadlineExpired, PoisonInput, WatchdogTimeout) as e:
+            self._record_outcome(e)
             metrics.count_error(task_name)
             yield self._overload_error(cid, task_name, e)
             return
         except Exception as e:  # noqa: BLE001
+            self._record_outcome(e)
             logger.exception("streaming task %s failed", task_name)
             metrics.count_error(task_name)
             yield self._error(cid, pb.ERROR_CODE_INTERNAL, f"{type(e).__name__}: {e}")
             return
         if pending is None:
+            # INTERNAL-class backend symptom: must reach the breaker like
+            # any other crash (count toward tripping / resolve a probe).
+            self._record_outcome(RuntimeError("streaming handler yielded no chunks"))
             metrics.count_error(task_name)
             yield self._error(cid, pb.ERROR_CODE_INTERNAL, "streaming handler yielded no chunks")
             return
+        self._record_outcome(None)
         result, mime, meta = pending
         meta = dict(meta)
         lat_ms = (time.perf_counter() - t0) * 1e3
@@ -370,23 +443,50 @@ class BaseService(InferenceServicer):
 
     @classmethod
     def _overload_error(cls, cid: str, task_name: str, e: Exception) -> pb.InferResponse:
-        """One source of truth for the overload exceptions' wire mapping:
-        a batcher :class:`QueueFull` is a :class:`ResourceExhausted`
-        (UNAVAILABLE + backoff hint), a :class:`DeadlineExpired` is a
-        :class:`DeadlineExceeded` — the same ServiceError subclasses a
-        handler may raise directly."""
+        """One source of truth for the overload/containment exceptions'
+        wire mapping: a batcher :class:`QueueFull` is a
+        :class:`ResourceExhausted` (UNAVAILABLE + backoff hint), a
+        :class:`DeadlineExpired` is a :class:`DeadlineExceeded`, a
+        :class:`PoisonInput` is an :class:`InvalidArgument` (the PAYLOAD is
+        broken — retrying it is pointless; the message names the bisection
+        isolation or quarantine verdict, and the response meta carries
+        ``quarantined`` when the quarantine registry flagged it), and a
+        :class:`WatchdogTimeout` is an :class:`Unavailable` (backend
+        stalled; the breaker/recovery path is already on it)."""
+        meta = None
         if isinstance(e, QueueFull):
             err: ServiceError = ResourceExhausted(f"{task_name}: {e}")
+        elif isinstance(e, PoisonInput):
+            err = InvalidArgument(
+                f"{task_name}: {e}",
+                "this payload repeatedly fails its batch; fix the input "
+                "instead of retrying",
+            )
+            if request_notes.current().get("quarantined"):
+                meta = {"quarantined": "1"}
+        elif isinstance(e, WatchdogTimeout):
+            err = Unavailable(
+                f"{task_name}: {e}",
+                "backend stalled past its watchdog budget; retry after the "
+                "service reloads",
+            )
         else:
             err = DeadlineExceeded(f"{task_name}: {e}")
-        return cls._error(cid, err.code, str(err), err.detail)
+        return cls._error(cid, err.code, str(err), err.detail, meta=meta)
 
     @staticmethod
-    def _error(cid: str, code: int, message: str, detail: str = "") -> pb.InferResponse:
+    def _error(
+        cid: str,
+        code: int,
+        message: str,
+        detail: str = "",
+        meta: dict[str, str] | None = None,
+    ) -> pb.InferResponse:
         return pb.InferResponse(
             correlation_id=cid,
             is_final=True,
             error=pb.Error(code=code, message=message, detail=detail),
+            meta=meta or None,
         )
 
     # -- capability / health rpcs ----------------------------------------

@@ -160,29 +160,49 @@ class RecoveryManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._started = False
         self._idle = threading.Event()
         self._idle.set()
 
     # -- lifecycle --------------------------------------------------------
 
     def register(self, name: str) -> None:
-        """Track a degraded service; first attempt after one backoff step."""
+        """Track a degraded service; first attempt after one backoff step.
+
+        Safe to call at ANY point in the manager's life, not just before
+        :meth:`start`: the circuit-breaker handoff registers a service for
+        reload long after boot, when the original recovery thread (if any)
+        has already drained its queue and exited — a dead thread is
+        respawned here. Re-registering a service already pending resets
+        its backoff (the breaker just proved it broken again)."""
         with self._lock:
             self._pending[name] = [0, time.monotonic() + self.policy.delay(0)]
-        self._idle.clear()
+            self._idle.clear()
+            if self._started and not self._stop.is_set():
+                self._spawn_locked()
 
-    def start(self) -> "RecoveryManager":
-        if self._pending and self._thread is None:
+    def _spawn_locked(self) -> None:
+        """Caller holds ``self._lock``. (Re)start the worker thread when
+        none is alive — the loop exits whenever pending drains, so late
+        registrations need a fresh thread."""
+        if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, name="svc-recovery", daemon=True
             )
             self._thread.start()
+
+    def start(self) -> "RecoveryManager":
+        with self._lock:
+            self._started = True
+            if self._pending:
+                self._spawn_locked()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=10)
+        thread = self._thread  # _run may null the slot concurrently
+        if thread:
+            thread.join(timeout=10)
 
     def wait_idle(self, timeout: float) -> bool:
         """Block until no recoveries are pending (tests)."""
@@ -203,7 +223,15 @@ class RecoveryManager:
                 self._attempt(name)
             with self._lock:
                 if not self._pending:
+                    # Retire under the lock, clearing the thread slot
+                    # BEFORE returning: register() checks this slot under
+                    # the same lock, so a breaker-reload registration can
+                    # never race a thread that has decided to exit but
+                    # still reports is_alive() — either it lands before
+                    # this check (we keep looping) or after (the slot is
+                    # None and _spawn_locked starts a fresh thread).
                     self._idle.set()
+                    self._thread = None
                     return
             self._stop.wait(self._poll)
 

@@ -18,7 +18,12 @@ Resilience semantics on top of the reference:
   as does a hub with no working service at all;
 - an unknown task while some service is degraded answers UNAVAILABLE with
   the degraded-service hint, not INVALID_ARGUMENT — the task may well
-  belong to the broken service, and "client bug" is the wrong message.
+  belong to the broken service, and "client bug" is the wrong message;
+- containment state is first-class: per-service circuit-breaker states
+  ride ``Health`` trailing metadata (``lumen-breaker-status``) and each
+  ``StreamCapabilities`` record (``extra["breaker"]``), and the current
+  poison-quarantine size rides ``lumen-quarantine-size`` — a client can
+  tell "backend fast-failing" from "overloaded" without a failed Infer.
 """
 
 from __future__ import annotations
@@ -26,6 +31,7 @@ from __future__ import annotations
 import itertools
 import json
 import logging
+import sys
 import threading
 from typing import Iterable, Iterator
 
@@ -196,15 +202,50 @@ class HubRouter(InferenceServicer):
         with self._lock:
             services = list(self.services.values())
         for svc in services:
-            yield svc.capability()
+            cap = svc.capability()
+            breaker = getattr(svc, "breaker", None)
+            if breaker is not None:
+                # Live containment state rides the capability record so a
+                # client refreshing capabilities sees "backend fast-failing"
+                # without a failed Infer round-trip.
+                cap.extra["breaker"] = breaker.state()
+            yield cap
+
+    def _breaker_states(self) -> dict[str, str]:
+        with self._lock:
+            services = list(self.services.items())
+        return {
+            name: breaker.state()
+            for name, svc in services
+            if (breaker := getattr(svc, "breaker", None)) is not None
+        }
+
+    @staticmethod
+    def _quarantine_size() -> int | None:
+        """Entries currently quarantined, WITHOUT importing the runtime
+        package (which drags in jax — this router must stay importable and
+        health-checkable on jax-free deployments like the echo service):
+        only report when the runtime is already loaded in-process."""
+        mod = sys.modules.get("lumen_tpu.runtime.quarantine")
+        if mod is None:
+            return None
+        try:
+            return len(mod.get_quarantine())
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            return None
 
     def Health(self, request, context):
         statuses = self._statuses()
         if context is not None:
             try:
-                context.set_trailing_metadata(
-                    (("lumen-service-status", json.dumps(statuses)),)
-                )
+                trailing = [("lumen-service-status", json.dumps(statuses))]
+                breakers = self._breaker_states()
+                if breakers:
+                    trailing.append(("lumen-breaker-status", json.dumps(breakers)))
+                quarantined = self._quarantine_size()
+                if quarantined is not None:
+                    trailing.append(("lumen-quarantine-size", str(quarantined)))
+                context.set_trailing_metadata(tuple(trailing))
             except Exception:  # noqa: BLE001 - test stubs may lack metadata support
                 pass
         unhealthy = [n for n, s in statuses.items() if s == "unhealthy"]

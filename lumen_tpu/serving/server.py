@@ -36,6 +36,7 @@ from ..core.downloader import Downloader
 from ..core.exceptions import DownloadError
 from ..utils.logger import setup_logging
 from .base_service import BaseService
+from .breaker import CircuitBreaker, breaker_failures
 from .loader import resolve
 from .mdns import MdnsAdvertiser
 from .resilience import DegradedService, RecoveryManager, expected_tasks_for
@@ -115,6 +116,32 @@ def rebuild_service(config: LumenConfig, name: str, skip_download: bool = False)
     return build_one_service(config, name)
 
 
+def attach_breaker(
+    recovery: RecoveryManager, name: str, svc: BaseService
+) -> BaseService:
+    """Give one live service its circuit breaker (no-op for degraded
+    placeholders — they already fast-fail — and when
+    ``LUMEN_BREAKER_FAILURES=0`` disables breakers). With
+    ``LUMEN_BREAKER_RELOAD=1``, an opening breaker hands the service to
+    the RecoveryManager: the same full-reload path a degraded boot uses
+    (re-fetch + ``from_config`` + hot-swap), which also replaces any
+    wedged batchers the watchdog disabled. Without it, the breaker still
+    sheds and half-open-probes — reload stays an operator decision."""
+    if isinstance(svc, DegradedService) or breaker_failures() == 0:
+        return svc
+    reload_on_open = os.environ.get("LUMEN_BREAKER_RELOAD") == "1"
+
+    def on_open() -> None:
+        if reload_on_open:
+            logger.warning(
+                "breaker for %r opened: handing to recovery for a reload", name
+            )
+            recovery.register(name)
+
+    svc.breaker = CircuitBreaker(name, on_open=on_open)
+    return svc
+
+
 class ServerHandle:
     """A running gRPC server + its lifecycle helpers (returned by ``serve``
     for tests; the CLI blocks on ``wait``)."""
@@ -186,20 +213,31 @@ def serve(
     router = HubRouter(services)
 
     degraded = sorted(n for n, s in services.items() if isinstance(s, DegradedService))
-    recovery = None
+
+    def rebuild(n: str) -> BaseService:
+        # Recovered/reloaded services get a fresh breaker too: the swap
+        # replaces the instance whose breaker (and possibly watchdog-wedged
+        # batchers) tripped, and its gauge registration supersedes the old
+        # one (last-writer-wins in the metrics registry).
+        return attach_breaker(
+            recovery, n, rebuild_service(config, n, skip_download=skip_download)
+        )
+
+    # Always built (not only on a degraded boot): the per-service circuit
+    # breakers can hand a service over for reload at ANY point in the
+    # process's life (LUMEN_BREAKER_RELOAD=1).
+    recovery = RecoveryManager(router, rebuild=rebuild)
+    for name, svc in services.items():
+        attach_breaker(recovery, name, svc)
     if degraded:
         logger.warning(
             "booting with %d degraded service(s): %s — healthy siblings keep "
             "serving; background recovery is retrying the failed loads",
             len(degraded), degraded,
         )
-        recovery = RecoveryManager(
-            router,
-            rebuild=lambda n: rebuild_service(config, n, skip_download=skip_download),
-        )
         for name in degraded:
             recovery.register(name)
-        recovery.start()
+    recovery.start()
 
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=10, thread_name_prefix="grpc"),

@@ -6,8 +6,9 @@ kicks in once the fault clears" — is only as good as the test that forces
 the failure. Real downloads and device calls fail rarely and
 nondeterministically, so the failure-handling code is exactly the code a
 normal test run never executes. This module plants named *fault points* on
-those paths (``download``, ``model_load``, ``batch_execute``) that are free
-when disarmed and deterministic when armed.
+those paths (``download``, ``model_load``, ``batch_execute``,
+``batch_poison``, ``batch_hang``) that are free when disarmed and
+deterministic when armed.
 
 Usage (tests):
 
@@ -24,6 +25,22 @@ grammar ``point[:rate[:times]][@match]`` — ``rate`` is the per-check
 probability (default 1.0, drawn from a seeded RNG: ``LUMEN_FAULTS_SEED``),
 ``times`` caps total injections (unset = unlimited), ``@match`` restricts
 the rule to checks whose detail contains the substring.
+
+The containment points (per-item match support):
+
+- ``batch_poison`` — fails any dispatched batch CONTAINING a matching
+  item. The batcher checks it once per item with detail
+  ``{batcher}:{fingerprint}``, so ``@match`` on a payload fingerprint (the
+  result-cache sha256 key) simulates ONE poison input: every sub-batch
+  that still contains the item fails, every sub-batch without it
+  succeeds — exactly the signal batch bisection isolates on. Arm it
+  without ``times`` (bisection re-checks the point once per probe;
+  a capped rule reads as a transient fault that bisection retries away).
+  ``LUMEN_FAULTS="batch_poison@clip-image:<sha256-key>"``
+- ``batch_hang`` — consulted via :meth:`FaultInjector.fires` (no raise):
+  the batcher parks the dispatch where a wedged device call would block,
+  until its watchdog (``LUMEN_BATCH_WATCHDOG_S``) fires or the batcher
+  closes. ``LUMEN_FAULTS="batch_hang:1:1@vlm"`` hangs one VLM batch.
 
 Production hooks call :meth:`FaultInjector.check`; its disarmed fast path
 is one attribute read, so shipping the hooks costs nothing.
@@ -49,6 +66,8 @@ SEED_ENV = "LUMEN_FAULTS_SEED"
 DOWNLOAD = "download"
 MODEL_LOAD = "model_load"
 BATCH_EXECUTE = "batch_execute"
+BATCH_POISON = "batch_poison"
+BATCH_HANG = "batch_hang"
 
 
 class FaultInjected(ResourceError):
@@ -176,6 +195,17 @@ class FaultInjector:
             rule.fired += 1
         logger.warning("injecting fault at %r (%s)", point, detail or "no detail")
         raise FaultInjected(point, detail)
+
+    def fires(self, point: str, detail: str = "") -> bool:
+        """Like :meth:`check` but reports instead of raising — for fault
+        points whose production behavior is not an exception (e.g.
+        ``batch_hang`` parks the thread). Same rule semantics: rate,
+        times cap, ``@match`` on detail."""
+        try:
+            self.check(point, detail)
+        except FaultInjected:
+            return True
+        return False
 
     # -- introspection ----------------------------------------------------
 

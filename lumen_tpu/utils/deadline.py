@@ -34,6 +34,24 @@ class DeadlineExpired(RuntimeError):
     device slot; the batch executed without it."""
 
 
+class PoisonInput(RuntimeError):
+    """The input was isolated as the cause of a batch failure (batch
+    bisection), or its fingerprint is quarantined from a previous
+    isolation. Maps to an INVALID_ARGUMENT-style wire error: the payload —
+    not the server — is broken, and retrying it is pointless. Lives here
+    (not in the batcher or the quarantine registry) for the same reason as
+    :class:`QueueFull`: the jax-free serving base class must be able to
+    catch it."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A dispatched batch exceeded the batch watchdog budget
+    (``LUMEN_BATCH_WATCHDOG_S``): the device call (or its fetch) is
+    presumed wedged. Pending futures are failed with this, and the batcher
+    refuses new work — an operator (or the circuit breaker's recovery
+    handoff) must reload the service."""
+
+
 _deadline: contextvars.ContextVar[float | None] = contextvars.ContextVar(
     "lumen_request_deadline", default=None
 )

@@ -7,7 +7,10 @@ method, before the decode pool). Same pattern as
 cross-layer fact so no signature in between grows a flag. The serving base
 class opens a note scope around each task handler; the result cache marks
 ``hit`` / ``coalesced`` when it answers without a fresh computation; the
-service folds the marks into the response ``meta``.
+quarantine registry marks ``quarantined`` when it rejects a known-poison
+payload up front; the service folds the marks into the response ``meta``
+(including error responses — a quarantine rejection is an error that
+carries its ``quarantined`` note).
 
 Dependency-free on purpose — imported by ``serving.base_service``, which
 must not drag in the jax-importing ``runtime`` package.
@@ -29,7 +32,7 @@ def begin_notes() -> contextvars.Token:
 
 def end_notes(token: contextvars.Token) -> dict:
     """Close the scope and return the collected marks (``hit`` /
-    ``coalesced`` keys, present when they happened)."""
+    ``coalesced`` / ``quarantined`` keys, present when they happened)."""
     marks = _notes.get() or {}
     _notes.reset(token)
     return marks

@@ -58,6 +58,13 @@ if _ROOT not in sys.path:
 os.environ["LUMEN_CACHE_BYTES"] = "0"
 os.environ.pop("LUMEN_CACHE_DIR", None)
 
+# Circuit breakers: OFF for the suite (LUMEN_BREAKER_FAILURES=0). Several
+# tests drive deliberate failure bursts through serve()-built services; a
+# default-on breaker would flip their expected error codes to UNAVAILABLE
+# partway through. Breaker tests opt back in with explicit constructor
+# args or a monkeypatched env (tests/test_fault_containment.py).
+os.environ["LUMEN_BREAKER_FAILURES"] = "0"
+
 
 # Compile-heavy tests (>~15s each on this 1-core host, measured full-suite
 # run 2026-08-01: 511 tests, 13:47 hot-cache) are auto-marked ``slow`` so

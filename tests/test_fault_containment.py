@@ -1,0 +1,867 @@
+"""Fault containment: batch bisection + poison quarantine, per-service
+circuit breakers, and the batch watchdog (PR-4 acceptance paths).
+
+Everything runs on CPU with fake device fns. The poison fn fails any batch
+containing a marked row — exactly the signal a real poison input (NaN bomb,
+shape-breaking payload) produces on device — so bisection's isolation
+behavior is provable without hardware.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lumen_tpu.runtime.batcher import MicroBatcher, bisect_depth_default
+from lumen_tpu.runtime.quarantine import QuarantineRegistry
+from lumen_tpu.runtime.result_cache import ResultCache, make_key
+from lumen_tpu.serving.breaker import CircuitBreaker
+from lumen_tpu.testing import faults
+from lumen_tpu.utils.deadline import PoisonInput, WatchdogTimeout
+from lumen_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+POISON = 666.0
+
+
+def poison_fn(tree, n):
+    """Fake device call that chokes on any batch containing a poison row
+    (checked over the n valid rows only — padding repeats the last item)."""
+    arr = np.asarray(tree)
+    if np.any(arr[:n] == POISON):
+        raise RuntimeError("device choked on poison row")
+    return tree
+
+
+def make_batcher(fn=poison_fn, max_batch=8, quarantine=None, **kw):
+    q = quarantine if quarantine is not None else QuarantineRegistry(ttl_s=60)
+    return MicroBatcher(
+        fn, max_batch=max_batch, max_latency_ms=5, quarantine=q, **kw
+    ), q
+
+
+def submit_batch(b, values, fingerprints=None):
+    """Queue one batch atomically (batcher not started yet), then start."""
+    futs = []
+    for i, v in enumerate(values):
+        fp = fingerprints[i] if fingerprints else f"fp-{i}"
+        futs.append(b.submit(np.array([float(v)]), fingerprint=fp))
+    b.start()
+    return futs
+
+
+class TestBisection:
+    def test_one_poison_in_eight_isolated_innocents_succeed(self):
+        b, q = make_batcher(name="bisect-1")
+        values = [0, 1, 2, POISON, 4, 5, 6, 7]
+        before = metrics.counter_value("poison_isolated")
+        futs = submit_batch(b, values)
+        for i, (v, f) in enumerate(zip(values, futs)):
+            if v == POISON:
+                with pytest.raises(PoisonInput, match="isolated by batch bisection"):
+                    f.result(timeout=10)
+            else:
+                assert float(np.asarray(f.result(timeout=10))[0]) == float(v)
+        assert b.stats["poisoned"] == 1
+        assert b.stats["bisects"] == 1
+        assert metrics.counter_value("poison_isolated") == before + 1
+        # The offender's fingerprint is quarantined under its reason.
+        assert q.reason("fp-3") is not None
+        assert len(q) == 1  # innocents were NOT quarantined
+        b.close()
+
+    def test_two_poisons_in_eight_both_isolated(self):
+        b, q = make_batcher(name="bisect-2")
+        values = [0, POISON, 2, 3, 4, 5, POISON, 7]
+        futs = submit_batch(b, values)
+        poisoned, ok = 0, 0
+        for v, f in zip(values, futs):
+            if v == POISON:
+                with pytest.raises(PoisonInput):
+                    f.result(timeout=10)
+                poisoned += 1
+            else:
+                assert float(np.asarray(f.result(timeout=10))[0]) == float(v)
+                ok += 1
+        assert poisoned == 2 and ok == 6
+        assert b.stats["poisoned"] == 2
+        assert len(q) == 2
+        b.close()
+
+    def test_depth_bound_fails_group_without_quarantine(self):
+        # depth=1: one level of halving only — the poison's half of 4 can
+        # never be narrowed to one item, so that group fails together with
+        # the underlying error (no poison verdict on a guess).
+        b, q = make_batcher(name="bisect-depth", bisect_depth=1)
+        values = [0, 1, 2, POISON, 4, 5, 6, 7]
+        futs = submit_batch(b, values)
+        for i, (v, f) in enumerate(zip(values, futs)):
+            if i < 4:  # the poisoned half fails as a group
+                with pytest.raises(RuntimeError, match="device choked"):
+                    f.result(timeout=10)
+            else:  # the clean half still succeeds
+                assert float(np.asarray(f.result(timeout=10))[0]) == float(v)
+        assert b.stats["poisoned"] == 0
+        assert len(q) == 0
+        b.close()
+
+    def test_bisect_disabled_fans_out_old_behavior(self):
+        b, q = make_batcher(name="bisect-off", bisect_depth=0)
+        futs = submit_batch(b, [0, 1, POISON, 3])
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device choked"):
+                f.result(timeout=10)
+        assert b.stats["bisects"] == 0 and len(q) == 0
+        b.close()
+
+    def test_all_failing_batch_is_device_failure_not_poison(self):
+        def always_fails(tree, n):
+            raise RuntimeError("device dead")
+
+        b, q = make_batcher(fn=always_fails, name="bisect-dead")
+        futs = submit_batch(b, [0, 1, 2, 3])
+        for f in futs:
+            # Everyone gets the ORIGINAL error: N items "failing alone" is
+            # a broken device, not N coincidentally-poison inputs.
+            with pytest.raises(RuntimeError, match="device dead"):
+                f.result(timeout=10)
+        assert b.stats["poisoned"] == 0
+        assert len(q) == 0
+        b.close()
+
+    def test_depth_bounded_all_fail_does_not_misquarantine_singleton(self):
+        # Odd batch + depth 1 on a dead device: one half isolates down to
+        # a single item while the other half exhausts depth. With zero
+        # sibling successes, that singleton is NOT poison evidence — it
+        # must get the original error and stay out of quarantine.
+        def always_fails(tree, n):
+            raise RuntimeError("device dead")
+
+        b, q = make_batcher(fn=always_fails, max_batch=3, bisect_depth=1,
+                            name="bisect-odd-dead")
+        futs = submit_batch(b, [0, 1, 2])
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device dead"):
+                f.result(timeout=10)
+        assert b.stats["poisoned"] == 0
+        assert len(q) == 0
+        b.close()
+
+    def test_transient_batch_fault_retried_away_by_bisection(self):
+        # An armed batch_execute fault with times=1 fails the full batch
+        # once; the bisection probes re-dispatch clean — every caller
+        # still gets its result (bisection doubles as a free retry).
+        faults.configure("batch_execute", times=1, match="bisect-transient")
+        b, q = make_batcher(fn=lambda t, n: t, name="bisect-transient")
+        futs = submit_batch(b, [0, 1, 2, 3])
+        for v, f in zip([0, 1, 2, 3], futs):
+            assert float(np.asarray(f.result(timeout=10))[0]) == float(v)
+        assert b.stats["poisoned"] == 0
+        b.close()
+
+    def test_batch_poison_fault_point_matches_fingerprint(self):
+        # The batch_poison point fires for any (sub-)batch containing the
+        # matching fingerprint — the harness-level way to simulate one
+        # poison payload end to end (LUMEN_FAULTS spec in testing/faults).
+        b, q = make_batcher(fn=lambda t, n: t, name="fp-poison")
+        faults.configure("batch_poison", match="fp-poison:fp-2")
+        futs = submit_batch(b, [0, 1, 2, 3])
+        for i, f in enumerate(futs):
+            if i == 2:
+                with pytest.raises(PoisonInput):
+                    f.result(timeout=10)
+            else:
+                assert float(np.asarray(f.result(timeout=10))[0]) == float(i)
+        assert q.reason("fp-2") is not None
+        b.close()
+
+    def test_default_depth_is_log2_max_batch(self, monkeypatch):
+        assert bisect_depth_default(8) == 3
+        assert bisect_depth_default(64) == 6
+        assert bisect_depth_default(1) == 1
+        monkeypatch.setenv("LUMEN_BISECT_DEPTH", "2")
+        assert bisect_depth_default(64) == 2
+        monkeypatch.setenv("LUMEN_BISECT_DEPTH", "0")
+        assert bisect_depth_default(64) == 0
+        monkeypatch.setenv("LUMEN_BISECT_DEPTH", "junk")
+        assert bisect_depth_default(64) == 6
+
+
+class TestQuarantine:
+    def test_resubmit_rejected_before_device_zero_submissions(self):
+        """Acceptance: the same item is rejected pre-device on resubmission
+        — quarantine counter increments, zero batcher submissions."""
+        b, q = make_batcher(name="q-front")
+        futs = submit_batch(b, [0, 1, POISON, 3])
+        for f in futs[:2] + futs[3:]:
+            f.result(timeout=10)
+        with pytest.raises(PoisonInput):
+            futs[2].result(timeout=10)
+        rejections_before = q.stats["rejections"]
+        batches_before = b.stats["batches"]
+        bisects_before = b.stats["bisects"]
+        with pytest.raises(PoisonInput, match="quarantined"):
+            b.submit(np.array([POISON]), fingerprint="fp-2")
+        assert q.stats["rejections"] == rejections_before + 1
+        assert b.stats["quarantine_rejected"] == 1
+        assert b._queue.qsize() == 0  # never reached the admission queue
+        b.close()
+        # ... and the rejected submit drove NO batcher work at all.
+        assert b.stats["batches"] == batches_before
+        assert b.stats["bisects"] == bisects_before
+
+    def test_ttl_expiry_readmits(self):
+        q = QuarantineRegistry(ttl_s=0.15)
+        q.add("k1", "bad")
+        assert q.reason("k1") == "bad"
+        time.sleep(0.2)
+        assert q.reason("k1") is None  # expired: fresh verdict allowed
+        assert q.stats["expired"] == 1
+        q.check("k1")  # no raise
+        q.close()
+
+    def test_check_raises_with_quarantine_wording(self):
+        q = QuarantineRegistry(ttl_s=60)
+        q.add("k2", "device choked")
+        with pytest.raises(PoisonInput, match="quarantined"):
+            q.check("k2")
+        q.close()
+
+    def test_lru_cap_bounds_entries(self):
+        q = QuarantineRegistry(ttl_s=60, max_entries=4)
+        for i in range(10):
+            q.add(f"k{i}", "bad")
+        assert len(q) == 4
+        assert q.reason("k0") is None  # oldest evicted
+        assert q.reason("k9") is not None
+        q.close()
+
+    def test_disabled_ttl_never_quarantines(self):
+        q = QuarantineRegistry(ttl_s=0)
+        assert not q.enabled
+        assert q.add("k", "bad") is False
+        assert q.reason("k") is None
+        q.check("k")  # no raise
+        q.close()
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        kw.setdefault("failures", 3)
+        kw.setdefault("window_s", 5.0)
+        kw.setdefault("reset_s", 0.2)
+        return CircuitBreaker("t", **kw)
+
+    def test_closed_to_open_after_consecutive_failures(self):
+        br = self.make()
+        for _ in range(2):
+            br.record_failure()
+        assert br.state() == "closed"
+        br.record_failure()
+        assert br.state() == "open"
+        admitted, retry_after = br.allow()
+        assert not admitted and retry_after > 0
+        br.close()
+
+    def test_success_resets_streak(self):
+        br = self.make()
+        br.record_failure()
+        br.record_failure()
+        br.record_success()  # streak broken: not consecutive anymore
+        br.record_failure()
+        br.record_failure()
+        assert br.state() == "closed"
+        br.close()
+
+    def test_window_restarts_stale_streak(self):
+        br = self.make(failures=2, window_s=0.1)
+        br.record_failure()
+        time.sleep(0.15)
+        br.record_failure()  # first failure aged out of the window
+        assert br.state() == "closed"
+        br.record_failure()
+        assert br.state() == "open"
+        br.close()
+
+    def test_half_open_single_probe_then_close(self):
+        br = self.make()
+        for _ in range(3):
+            br.record_failure()
+        assert br.state() == "open"
+        time.sleep(0.25)
+        admitted, _ = br.allow()  # reset window elapsed: the probe
+        assert admitted and br.state() == "half_open"
+        admitted2, retry = br.allow()  # only ONE probe at a time
+        assert not admitted2 and retry > 0
+        br.record_success()
+        assert br.state() == "closed"
+        assert br.allow() == (True, 0.0)
+        br.close()
+
+    def test_half_open_probe_failure_reopens(self):
+        br = self.make()
+        for _ in range(3):
+            br.record_failure()
+        time.sleep(0.25)
+        assert br.allow()[0]  # probe admitted
+        br.record_failure()
+        assert br.state() == "open"
+        assert not br.allow()[0]
+        br.close()
+
+    def test_poison_never_trips(self):
+        br = self.make()
+        for _ in range(20):
+            br.record_poison()
+        assert br.state() == "closed"
+        assert br.stats["poison"] == 20
+        br.close()
+
+    def test_on_open_hook_fires_once_per_trip(self):
+        opens = []
+        br = self.make(on_open=lambda: opens.append(1))
+        for _ in range(3):
+            br.record_failure()
+        assert opens == [1]
+        br.close()
+
+    def test_neutral_outcome_releases_half_open_probe(self):
+        # A probe that is itself shed/deadline-dropped (no health verdict)
+        # must not pin the breaker half-open-and-shedding: the neutral
+        # record frees the slot for the next request to probe.
+        br = self.make()
+        for _ in range(3):
+            br.record_failure()
+        time.sleep(0.25)
+        assert br.allow()[0]  # the probe goes out...
+        br.record_neutral()   # ...and comes back with no verdict
+        assert br.allow()[0]  # next request probes immediately
+        br.record_success()
+        assert br.state() == "closed"
+        br.close()
+
+    def test_abandoned_probe_expires_after_reset_window(self):
+        # A probe whose stream was torn down (no outcome EVER recorded)
+        # must not shed traffic forever: after reset_s it is presumed
+        # lost and replaced.
+        br = self.make(reset_s=0.15)
+        for _ in range(3):
+            br.record_failure()
+        time.sleep(0.2)
+        assert br.allow()[0]      # probe goes out and is never heard from
+        assert not br.allow()[0]  # slot held meanwhile
+        time.sleep(0.2)
+        assert br.allow()[0]      # expired: a fresh probe is admitted
+        br.record_success()
+        assert br.state() == "closed"
+        br.close()
+
+    def test_service_layer_neutral_outcomes_reach_breaker(self):
+        # Through the dispatch layer: a QueueFull probe releases the slot.
+        from lumen_tpu.utils.deadline import QueueFull
+
+        br = CircuitBreaker("svc-neutral", failures=1, reset_s=0.15)
+        outcome = {"e": RuntimeError("broken")}
+
+        def handler(p, m, meta):
+            if outcome["e"] is not None:
+                raise outcome["e"]
+            return b"ok", "text/plain", {}
+
+        svc = _service(handler, breaker=br)
+        list(svc.Infer(iter([_req("task")]), _Ctx()))  # trips the breaker
+        assert br.state() == "open"
+        time.sleep(0.2)
+        outcome["e"] = QueueFull("admission queue full")
+        list(svc.Infer(iter([_req("task", cid="p1")]), _Ctx()))  # shed probe
+        assert br.state() == "half_open"
+        outcome["e"] = None
+        (resp,) = svc.Infer(iter([_req("task", cid="p2")]), _Ctx())
+        assert resp.result == b"ok"
+        assert br.state() == "closed"
+        br.close()
+
+    def test_pre_handler_client_error_releases_probe(self):
+        # A half-open probe consumed by a payload-too-large request (a
+        # pre-handler return) must still release the probe slot: a client
+        # error is no verdict on backend health.
+        from lumen_tpu.serving import TaskDefinition
+
+        br = CircuitBreaker("svc-prehandler", failures=1, reset_s=0.15)
+        svc = _service(lambda p, m, meta: (b"ok", "text/plain", {}), breaker=br)
+        svc.registry.register(
+            TaskDefinition(name="tiny", handler=lambda p, m, meta: (b"", "", {}),
+                           max_payload_bytes=1)
+        )
+        list(svc.Infer(iter([_req("task")]), _Ctx()))  # warm path sanity
+        br.record_failure()  # trip
+        assert br.state() == "open"
+        time.sleep(0.2)
+        # The probe request is oversized -> INVALID_ARGUMENT pre-handler.
+        (resp,) = svc.Infer(iter([_req("tiny", payload=b"too-big")]), _Ctx())
+        assert "exceeds limit" in resp.error.message
+        # Slot released: the next request probes immediately and closes.
+        (ok,) = svc.Infer(iter([_req("task", cid="p2")]), _Ctx())
+        assert ok.result == b"ok" and br.state() == "closed"
+        br.close()
+
+    def test_disabled_breaker_never_gates(self):
+        br = CircuitBreaker("off", failures=0)
+        for _ in range(50):
+            br.record_failure()
+        assert br.state() == "closed" and br.allow() == (True, 0.0)
+        br.close()
+
+
+class _Ctx:
+    def __init__(self, remaining=None):
+        self._remaining = remaining
+
+    def time_remaining(self):
+        return self._remaining
+
+
+def _req(task, cid="c1", payload=b"x"):
+    from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+    return pb.InferRequest(
+        correlation_id=cid, task=task, payload=payload, payload_mime="text/plain"
+    )
+
+
+def _service(handler, breaker=None, name="t", task="task"):
+    from lumen_tpu.serving import BaseService, TaskDefinition, TaskRegistry
+
+    class Svc(BaseService):
+        def __init__(self):
+            reg = TaskRegistry(name)
+            reg.register(TaskDefinition(name=task, handler=handler))
+            super().__init__(reg)
+
+        def capability(self):
+            return self.registry.build_capability(model_ids=[], runtime="none")
+
+    svc = Svc()
+    svc.breaker = breaker
+    return svc
+
+
+class TestServiceContainment:
+    """Wire-level shape of the containment verdicts + the breaker gate."""
+
+    def test_poison_maps_to_invalid_argument(self):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        def handler(p, m, meta):
+            raise PoisonInput("input isolated by batch bisection")
+
+        svc = _service(handler)
+        (resp,) = svc.Infer(iter([_req("task")]), _Ctx())
+        assert resp.error.code == pb.ERROR_CODE_INVALID_ARGUMENT
+        assert "bisection" in resp.error.message
+        assert "fix the input" in resp.error.detail
+
+    def test_quarantined_note_rides_error_meta(self):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        q = QuarantineRegistry(ttl_s=60)
+        q.add("k", "bad")
+
+        def handler(p, m, meta):
+            q.check("k")  # marks the request-note scope + raises
+
+        svc = _service(handler)
+        (resp,) = svc.Infer(iter([_req("task")]), _Ctx())
+        assert resp.error.code == pb.ERROR_CODE_INVALID_ARGUMENT
+        assert resp.meta.get("quarantined") == "1"
+        q.close()
+
+    def test_watchdog_maps_to_unavailable_and_trips_breaker(self):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        br = CircuitBreaker("svc-wd", failures=2, reset_s=60)
+
+        def handler(p, m, meta):
+            raise WatchdogTimeout("batcher disabled pending reload")
+
+        svc = _service(handler, breaker=br)
+        (r1,) = svc.Infer(iter([_req("task")]), _Ctx())
+        assert r1.error.code == pb.ERROR_CODE_UNAVAILABLE
+        assert "stalled" in r1.error.detail
+        (r2,) = svc.Infer(iter([_req("task", cid="c2")]), _Ctx())
+        assert br.state() == "open"  # two watchdog failures tripped it
+        br.close()
+
+    def test_breaker_open_sheds_with_note_and_poison_does_not_trip(self):
+        from lumen_tpu.serving.proto import ml_service_pb2 as pb
+
+        calls = []
+        br = CircuitBreaker("svc-br", failures=2, reset_s=60)
+
+        def handler(p, m, meta):
+            calls.append(1)
+            raise RuntimeError("backend broken")
+
+        svc = _service(handler, breaker=br)
+        for cid in ("a", "b"):
+            (resp,) = svc.Infer(iter([_req("task", cid=cid)]), _Ctx())
+            assert resp.error.code == pb.ERROR_CODE_INTERNAL
+        assert br.state() == "open"
+        (shed,) = svc.Infer(iter([_req("task", cid="c")]), _Ctx())
+        assert shed.error.code == pb.ERROR_CODE_UNAVAILABLE
+        assert shed.meta.get("breaker_open") == "1"
+        assert "retry after" in shed.error.detail
+        assert len(calls) == 2  # the shed request never reached the handler
+        br.close()
+
+    def test_breaker_shed_burst_under_1ms_per_request(self):
+        """Acceptance: with the breaker tripped, a burst sheds in <1 ms per
+        request without touching the handler (= the device path)."""
+        br = CircuitBreaker("svc-burst", failures=1, reset_s=60)
+        calls = []
+
+        def handler(p, m, meta):
+            calls.append(1)
+            raise RuntimeError("broken")
+
+        svc = _service(handler, breaker=br)
+        list(svc.Infer(iter([_req("task")]), _Ctx()))  # trip it
+        assert br.state() == "open"
+        n = 200
+        t0 = time.perf_counter()
+        for i in range(n):
+            (resp,) = svc.Infer(iter([_req("task", cid=str(i))]), _Ctx())
+            assert resp.meta.get("breaker_open") == "1"
+        per_request = (time.perf_counter() - t0) / n
+        assert per_request < 1e-3, f"shed cost {per_request * 1e3:.3f} ms/request"
+        assert len(calls) == 1  # only the tripping request touched the backend
+        br.close()
+
+    def test_status_reflects_breaker_state(self):
+        br = CircuitBreaker("svc-status", failures=1, reset_s=60)
+        svc = _service(lambda p, m, meta: (b"ok", "text/plain", {}), breaker=br)
+        assert svc.status() == "healthy"
+        br.record_failure()
+        assert svc.status() == "breaker_open"
+        br.close()
+
+    def test_router_health_carries_breaker_and_quarantine_metadata(self):
+        import json
+
+        from lumen_tpu.serving import HubRouter
+
+        br = CircuitBreaker("hub-svc", failures=1, reset_s=60)
+        good = _service(lambda p, m, meta: (b"ok", "text/plain", {}), name="good")
+        bad = _service(
+            lambda p, m, meta: (b"ok", "text/plain", {}),
+            breaker=br, name="bad", task="task2",
+        )
+        router = HubRouter({"good": good, "bad": bad})
+        br.record_failure()
+
+        trailing = {}
+
+        class Ctx:
+            def set_trailing_metadata(self, md):
+                trailing.update(dict(md))
+
+            def abort(self, code, msg):
+                raise AssertionError(f"unexpected abort: {msg}")
+
+        router.Health(None, Ctx())
+        statuses = json.loads(trailing["lumen-service-status"])
+        assert statuses["bad"] == "breaker_open" and statuses["good"] == "healthy"
+        breakers = json.loads(trailing["lumen-breaker-status"])
+        assert breakers == {"bad": "open"}
+        assert "lumen-quarantine-size" in trailing  # runtime is imported here
+        caps = {c.service_name: c for c in router.StreamCapabilities(None, None)}
+        assert caps["bad"].extra["breaker"] == "open"
+        assert "breaker" not in caps["good"].extra
+        br.close()
+
+
+class TestWatchdog:
+    def test_hung_batch_fails_futures_and_batcher_stays_closeable(self):
+        """Acceptance: a hung batch_execute fails pending futures with
+        WatchdogTimeout, refuses new work, and close() returns promptly."""
+        faults.configure("batch_hang", match="wd-hang")
+        b, _ = make_batcher(fn=lambda t, n: t, name="wd-hang", watchdog_s=0.15)
+        before = metrics.counter_value("watchdog_timeouts")
+        fut = b.submit(np.zeros(1), fingerprint=None)
+        b.start()
+        with pytest.raises(WatchdogTimeout, match="watchdog budget"):
+            fut.result(timeout=10)
+        assert b.stats["watchdog"] == 1
+        assert metrics.counter_value("watchdog_timeouts") == before + 1
+        # The batcher refuses new work instead of wedging...
+        with pytest.raises(WatchdogTimeout):
+            b.submit(np.zeros(1))
+        # ...and close() does not ride out any long join on the stuck lane.
+        t0 = time.perf_counter()
+        b.close()
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_watchdog_drains_queued_entries(self):
+        faults.configure("batch_hang", match="wd-drain")
+        b, _ = make_batcher(
+            fn=lambda t, n: t, name="wd-drain", max_batch=1, watchdog_s=0.15
+        )
+        b.start()
+        f1 = b.submit(np.zeros(1))  # hangs in dispatch
+        time.sleep(0.02)
+        f2 = b.submit(np.zeros(1))  # queued behind the hung batch
+        for f in (f1, f2):
+            with pytest.raises(WatchdogTimeout):
+                f.result(timeout=10)
+        b.close()
+
+    def test_slow_but_finite_batch_also_caught(self):
+        # No fault point: a genuinely slow fn (stuck collective, compile
+        # storm) trips the same path.
+        def slow(tree, n):
+            time.sleep(0.5)
+            return tree
+
+        b, _ = make_batcher(fn=slow, name="wd-slow", watchdog_s=0.1)
+        fut = b.submit(np.zeros(1))
+        b.start()
+        with pytest.raises(WatchdogTimeout):
+            fut.result(timeout=10)
+        b.close()
+
+    def test_watchdog_off_by_default(self):
+        b = MicroBatcher(lambda t, n: t, max_batch=2)
+        assert b.watchdog_s == 0.0
+        b.start()
+        assert b._watchdog_thread is None
+        assert np.asarray(b(np.zeros(1), timeout=5)).shape == (1,)
+        b.close()
+
+
+class TestCacheInteraction:
+    """Satellite regression: poison results never enter the result cache,
+    and a poisoned owner's failure is not replayed to coalesced waiters."""
+
+    def test_poison_result_never_stored(self):
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-poison-store")
+
+        def compute():
+            raise PoisonInput("isolated")
+
+        with pytest.raises(PoisonInput):
+            cache.get_or_compute("ns/t/m@1", None, b"payload", compute)
+        assert cache.stats["stores"] == 0
+        found, _ = cache.get(make_key("ns/t/m@1", None, b"payload"))
+        assert not found
+        cache.close()
+
+    def test_waiter_reowns_after_owner_poison(self):
+        """The owner's PoisonInput must NOT fan out to waiters as a cache
+        error: the waiter re-owns the flight and computes for itself
+        (where the quarantine gate then gives it a first-person verdict)."""
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-poison-flight")
+        calls = []
+        owner_started = threading.Event()
+        owner_err: list = []
+
+        def compute():
+            calls.append(threading.get_ident())
+            if len(calls) == 1:
+                owner_started.set()
+                time.sleep(0.2)  # keep the flight open for the waiter
+                raise PoisonInput("isolated by batch bisection")
+            return 42  # the re-owning waiter's own computation
+
+        def owner():
+            try:
+                cache.get_or_compute("ns/t/m@1", None, b"p", compute)
+            except BaseException as e:  # noqa: BLE001
+                owner_err.append(e)
+
+        t = threading.Thread(target=owner)
+        t.start()
+        assert owner_started.wait(5)
+        got = cache.get_or_compute("ns/t/m@1", None, b"p", compute)
+        t.join(timeout=5)
+        assert got == 42  # waiter re-owned; no secondhand cache error
+        assert len(calls) == 2
+        assert isinstance(owner_err[0], PoisonInput)  # owner kept its verdict
+        # The successful re-owned computation IS cached; the poison never was.
+        assert cache.stats["stores"] == 1
+        cache.close()
+
+
+    def test_poison_fans_out_to_waiters_when_quarantine_disabled(self, monkeypatch):
+        # With no quarantine to make the re-owned recompute cheap, the
+        # poison verdict (payload-determined) is SHARED with waiters
+        # instead of each one re-running the failing batch at device cost.
+        import lumen_tpu.runtime.quarantine as qmod
+
+        registry = QuarantineRegistry(ttl_s=0)  # disabled
+        monkeypatch.setattr(qmod, "_shared", registry)
+        cache = ResultCache(max_bytes=1 << 20, disk_dir=None, name="t-poison-noq")
+        calls = []
+        owner_started = threading.Event()
+
+        def compute():
+            calls.append(1)
+            owner_started.set()
+            time.sleep(0.2)
+            raise PoisonInput("isolated by batch bisection")
+
+        errs = []
+
+        def owner():
+            try:
+                cache.get_or_compute("ns/t/m@1", None, b"p", compute)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=owner)
+        t.start()
+        assert owner_started.wait(5)
+        with pytest.raises(PoisonInput):
+            cache.get_or_compute("ns/t/m@1", None, b"p", compute)
+        t.join(timeout=5)
+        assert len(calls) == 1  # ONE device-cost failure served the herd
+        assert isinstance(errs[0], PoisonInput)
+        cache.close()
+        registry.close()
+
+
+class TestIngestContainment:
+    @pytest.fixture()
+    def mesh(self):
+        import jax
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        return build_mesh({"data": -1}, devices=jax.devices()[:4])
+
+    def test_poison_item_becomes_error_record(self, mesh):
+        import jax.numpy as jnp
+
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+
+        def check_fn(batch):
+            # A poison row (666) breaks the whole device batch, like a
+            # NaN bomb tripping a checked collective.
+            if bool(jnp.any(batch[:, 0] == POISON)):
+                raise RuntimeError("device choked on poison row")
+            return batch.sum(-1)
+
+        pipe = IngestPipeline(
+            mesh,
+            [Stage("s", preprocess=lambda d: np.full((4,), float(d), np.float32),
+                   device_fn=check_fn)],
+            batch_size=4,
+            workers=1,
+        )
+        items = [0, 1, POISON, 3, 4, 5, 6, 7]
+        records = pipe.run_all(items)
+        assert [r["_index"] for r in records] == list(range(8))
+        errors = [r for r in records if r.get("_error")]
+        assert len(errors) == 1 and errors[0]["_index"] == 2
+        assert "poison" in errors[0]["_error"]
+        for r in records:
+            if not r.get("_error"):
+                assert r["s"] == pytest.approx(float(items[r["_index"]]) * 4)
+        assert pipe.stats.errors == 1
+        assert pipe.stats.items == 8
+
+    def test_all_fail_salvage_is_device_failure_nothing_quarantined(self, mesh, monkeypatch):
+        import lumen_tpu.runtime.quarantine as qmod
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+
+        registry = QuarantineRegistry(ttl_s=60)
+        monkeypatch.setattr(qmod, "_shared", registry)
+
+        def dead_device(batch):
+            raise RuntimeError("device dead")
+
+        pipe = IngestPipeline(
+            mesh,
+            [Stage("s", preprocess=lambda d: np.zeros((2,), np.float32),
+                   device_fn=dead_device)],
+            batch_size=4,
+            workers=1,
+            cache_namespace="ingest/dead",
+        )
+        records = pipe.run_all([b"a", b"b", b"c", b"d"])
+        # The run completes with per-item error records (not an abort)...
+        assert all("batch:" in r["_error"] for r in records)
+        # ...but NOTHING is quarantined: no item proved itself poison
+        # (zero sibling successes = device failure, the bisection rule).
+        assert len(registry) == 0
+        registry.close()
+
+    def test_quarantined_bytes_rejected_pre_decode(self, mesh, monkeypatch):
+        import lumen_tpu.runtime.quarantine as qmod
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+        from lumen_tpu.runtime.result_cache import make_key
+
+        registry = QuarantineRegistry(ttl_s=60)
+        monkeypatch.setattr(qmod, "_shared", registry)
+        decoded = []
+
+        pipe = IngestPipeline(
+            mesh,
+            [Stage("s", preprocess=lambda d: np.zeros((2,), np.float32),
+                   device_fn=lambda b: b.sum(-1))],
+            decode=lambda item: decoded.append(item) or 1.0,
+            batch_size=4,
+            workers=1,
+            cache_namespace="ingest/t",
+        )
+        bad = b"poison-bytes"
+        registry.add(make_key("ingest/t", {}, bad), "previously isolated")
+        records = pipe.run_all([b"ok-1", bad, b"ok-2", b"ok-3"])
+        assert [r["_index"] for r in records] == [0, 1, 2, 3]
+        assert "quarantined" in records[1]["_error"]
+        assert bad not in decoded  # never decoded, never batched
+        assert pipe.stats.quarantined == 1 and pipe.stats.errors == 1
+        registry.close()
+
+
+@pytest.mark.slow
+class TestContainmentSoak:
+    def test_soak_intermittent_poison_keeps_innocents_whole(self):
+        """Hundreds of requests with a recurring poison payload mixed in:
+        every innocent request must succeed with ITS row, the poison must
+        only ever fail as PoisonInput (first isolation) or quarantine
+        rejection (after), and the batcher must stay healthy throughout."""
+        b, q = make_batcher(name="soak", max_batch=8)
+        b.start()
+        innocents_ok = 0
+        poison_verdicts = 0
+        rejected_up_front = 0
+        for round_i in range(40):
+            futs = []
+            for j in range(8):
+                is_poison = j == 3 and round_i % 4 == 0
+                v = POISON if is_poison else float(round_i * 8 + j)
+                fp = "fp-poison" if is_poison else f"fp-{round_i}-{j}"
+                try:
+                    futs.append((v, b.submit(np.array([v]), fingerprint=fp)))
+                except PoisonInput:
+                    rejected_up_front += 1
+            for v, f in futs:
+                if v == POISON:
+                    with pytest.raises(PoisonInput):
+                        f.result(timeout=30)
+                    poison_verdicts += 1
+                else:
+                    assert float(np.asarray(f.result(timeout=30))[0]) == v
+                    innocents_ok += 1
+        b.close()
+        assert innocents_ok == 40 * 8 - 10  # every innocent answered
+        assert poison_verdicts == 1  # isolated exactly once...
+        assert rejected_up_front == 9  # ...then always rejected up front
+        assert q.stats["rejections"] >= 9
