@@ -16,6 +16,7 @@ Usage (server from `python -m lumen_tpu.serving.server --config ...`):
     python examples/client.py ocr scan.png
     python examples/client.py caption photo.jpg --prompt "Describe this photo."
     python examples/client.py caption photo.jpg --stream
+    python examples/client.py bulk clip_image_embed *.jpg
 
 Large payloads are chunked with the protocol's seq/total/offset framing —
 the same reassembly path reference clients use.
@@ -53,6 +54,61 @@ def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
             correlation_id="cli", task=task, payload=part, payload_mime=mime,
             meta=meta if i == 0 else {}, seq=i, total=total, offset=i * CHUNK,
         )
+
+
+def _bulk_requests(task: str, payloads, mime: str, meta: dict[str, str]):
+    """Chunked requests for N tagged items on ONE stream (correlation_id =
+    item index; ``bulk: 1`` meta switches the server onto the concurrent
+    fan-out lane)."""
+    tagged = {**meta, "bulk": "1"}
+    for i, payload in enumerate(payloads):
+        cid = str(i)
+        if len(payload) <= CHUNK:
+            yield pb.InferRequest(
+                correlation_id=cid, task=task, payload=payload,
+                payload_mime=mime, meta=tagged,
+            )
+            continue
+        total = (len(payload) + CHUNK - 1) // CHUNK
+        for j in range(total):
+            part = payload[j * CHUNK : (j + 1) * CHUNK]
+            yield pb.InferRequest(
+                correlation_id=cid, task=task, payload=part, payload_mime=mime,
+                meta=tagged if j == 0 else {}, seq=j, total=total, offset=j * CHUNK,
+            )
+
+
+def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream",
+               meta: dict[str, str] | None = None, timeout: float = 300.0):
+    """Run MANY payloads through ONE ``Infer`` stream (the server's bulk
+    fan-out lane): stream setup, admission and context bookkeeping are
+    paid once, and the server coalesces the items into full device
+    batches.
+
+    Yields ``(index, (result_bytes, mime, meta))`` per item AS RESPONSES
+    ARRIVE — out of submission order. A per-item failure yields
+    ``(index, ServiceError)`` instead; one poisoned payload never takes
+    down its streammates."""
+    from lumen_tpu.serving import ServiceError, reassemble_result
+
+    pending: dict[str, list] = {}
+    for resp in stub.Infer(_bulk_requests(task, payloads, mime, meta or {}), timeout=timeout):
+        cid = resp.correlation_id
+        if resp.HasField("error") and (resp.error.code or resp.error.message):
+            pending.pop(cid, None)
+            yield int(cid), ServiceError(resp.error.code, resp.error.message, resp.error.detail)
+            continue
+        chunks = pending.setdefault(cid, [])
+        chunks.append(resp)
+        if not resp.is_final:
+            continue
+        del pending[cid]
+        try:
+            data, mime_out, meta_out = reassemble_result(chunks)
+        except ServiceError as e:
+            yield int(cid), e
+            continue
+        yield int(cid), (data, mime_out, meta_out)
 
 
 _RETRYABLE_RPC = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.RESOURCE_EXHAUSTED)
@@ -176,6 +232,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prompt", default="Describe this photo in one sentence.")
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--stream", action="store_true")
+    p = sub.add_parser("bulk", help="many images down ONE stream (server bulk lane)")
+    p.add_argument("task"); p.add_argument("images", nargs="+")
     args = ap.parse_args(argv)
 
     from lumen_tpu.utils.retry import retry_call
@@ -205,6 +263,27 @@ def main(argv: list[str] | None = None) -> int:
         stub.Health(empty_pb2.Empty(), timeout=args.timeout)
         print("ok")
         return 0
+
+    if args.cmd == "bulk":
+        from lumen_tpu.serving import ServiceError
+
+        payloads, mimes = zip(*(_read(p) for p in args.images))
+        failed = 0
+        for idx, res in infer_bulk(
+            stub, args.task, list(payloads), mime=mimes[0], timeout=args.timeout
+        ):
+            name = args.images[idx]
+            if isinstance(res, ServiceError):
+                failed += 1
+                print(f"{name}: ERROR [{res.code}] {res}")
+                continue
+            data, _mime, meta = res
+            out = json.loads(data) if data else {}
+            if "vector" in out:
+                out["vector"] = f"[{len(out['vector'])} floats]"
+            hit = " (cache hit)" if meta.get("cache_hit") == "1" else ""
+            print(f"{name}{hit}: {json.dumps(out, ensure_ascii=False)}")
+        return 1 if failed else 0
 
     if args.cmd == "embed-text":
         out = _infer(stub, "clip_text_embed", args.text.encode(), "text/plain", {}, args.timeout)

@@ -624,15 +624,18 @@ class CLIPManager:
 
     # -- inference API ----------------------------------------------------
 
-    def _cache_ns(self, task: str) -> str:
+    def _cache_ns(self, task: str, *qualifiers: str) -> str:
         """Result-cache namespace (see
         :func:`~lumen_tpu.runtime.result_cache.make_namespace`). Qualified
         by the compute dtype AND the resolved quant route — the warmup A/B
         can pick a different route across restarts, and disk-tier entries
-        from one precision must not answer for another."""
+        from one precision must not answer for another. Image tasks add
+        the decode-policy qualifier (scaled decode changes resampling
+        numerics across deploy generations)."""
         return make_namespace(
             "clip", task, self.model_id, self.info.version,
             jnp.dtype(self.policy.compute_dtype).name, self.quant_route,
+            *qualifiers,
         )
 
     def encode_image(self, image_bytes: bytes) -> np.ndarray:
@@ -651,8 +654,10 @@ class CLIPManager:
         threads pile in. Every hit returns a private copy: a caller
         mutating "its" embedding in place must not poison the store."""
         self._ensure_ready()
+        from ...ops.image import DECODE_POLICY
+
         payload = bytes(image_bytes)
-        ns = self._cache_ns("image_embed")
+        ns = self._cache_ns("image_embed", DECODE_POLICY)
         key = guarded_key(ns, None, payload)
         return get_result_cache().get_or_compute(
             ns,
@@ -673,8 +678,12 @@ class CLIPManager:
     def _decode_resize(self, image_bytes: bytes) -> np.ndarray:
         import cv2
 
-        img = decode_image_bytes(image_bytes, color="rgb")
+        # Scaled decode: a >=2x-oversized JPEG decodes at 1/2..1/8 scale
+        # (both dims kept >= image_size, so this resize only downscales) —
+        # the decode worker's cost drops ~4x on typical photos while the
+        # device-side normalize path sees the same uint8 contract.
         size = self.cfg.image_size
+        img = decode_image_bytes(image_bytes, color="rgb", max_edge=size)
         return cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
 
     def encode_text(self, text: str) -> np.ndarray:

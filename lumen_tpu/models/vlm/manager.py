@@ -224,6 +224,12 @@ class VLMManager:
         if quantize not in (None, "int8"):
             raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
         self.quantize = quantize
+        # Decode route actually in use ("bf16" | "int8"): finalized at
+        # initialize() — a warmup A/B (LUMEN_VLM_Q8_ROUTE=auto) may fall an
+        # int8 opt-in back to bf16 when q8 measures slower (BENCH_r05:
+        # q8 decode at 0.03x bf16 on v5e).
+        self.quant_route = "int8" if quantize else "bf16"
+        self.quant_speedup: float | None = None  # measured q8/bf16 decode ratio
         self.model_dir = model_dir
         from ...runtime.mesh import build_mesh
 
@@ -324,14 +330,16 @@ class VLMManager:
 
     # -- initialization ----------------------------------------------------
 
-    def _place_params(self, params):
+    def _place_params(self, params, quantized: bool | None = None):
         """Place loaded weights on the serving mesh: TP rules when the mesh
         carries a ``model`` axis, EP rules first when it carries ``expert``
         (first-match-wins keeps expert banks on the expert axis), replicated
         otherwise. int8-quantized trees ship (q, scale) leaves with their
         own rules (``INT8_TP_RULES``: scales shard along the same output
         axis as their q matrices) — TP x int8 is the advertised deployment
-        shape for a quantized 2B on a multi-chip host."""
+        shape for a quantized 2B on a multi-chip host. ``quantized``
+        overrides the config-derived default (the warmup route A/B places
+        one tree of EACH kind)."""
         from ...parallel.sharding import (
             INT8_TP_RULES,
             MOE_EP_RULES,
@@ -339,12 +347,14 @@ class VLMManager:
             shard_params,
         )
 
+        if quantized is None:
+            quantized = bool(self.quantize)
         shape = dict(self.mesh.shape)
         rules = []
         if shape.get("expert", 1) > 1:
             rules += MOE_EP_RULES
         if shape.get("model", 1) > 1:
-            if self.quantize:
+            if quantized:
                 rules += INT8_TP_RULES
             rules += TRANSFORMER_TP_RULES
         if rules:
@@ -356,6 +366,141 @@ class VLMManager:
         # one call covers all cases.
         return shard_params(params, self.mesh, rules)
 
+    # -- quantization route -------------------------------------------------
+
+    def _resolve_q8_route(self, converted: dict) -> dict:
+        """Decide whether the int8 decode opt-in actually serves int8 —
+        the VLM twin of the CLIP route gate (PR 2). BENCH_r05 measured q8
+        decode at 135 tok/s vs 4,498 bf16 (0.03x) on v5e: an operator who
+        opted into "int8" for memory almost certainly did not want a 30x
+        decode regression. ``LUMEN_VLM_Q8_ROUTE``:
+
+        - ``bf16``  — pin: skip quantization entirely (no per-boot
+          quantize pass just to discard it);
+        - ``int8``  — pin: quantize and serve int8, no timing;
+        - ``auto``  (default) — with warmup on, run a one-shot timed
+          decode A/B (synthetic prompt through the real Generator path,
+          sequential placements so peak HBM stays at one decoder set) and
+          serve the winner; without warmup there is nothing to time
+          against, so the explicit opt-in wins.
+
+        Returns the route-matching decoder tree (decoder subtree cast to
+        the serving dtype on BOTH routes; vision subtree untouched) and
+        sets ``self.cfg``/``self.model``/``self.quant_route``; the verdict
+        is exported as the ``vlm-quant:<model>`` gauge provider
+        (``int8_active``, ``q8_speedup_pct``)."""
+        import dataclasses
+
+        from .convert import quantize_decoder_int8
+
+        route = os.environ.get("LUMEN_VLM_Q8_ROUTE", "auto").lower()
+        if route not in ("auto", "int8", "bf16"):
+            logger.warning("ignoring malformed LUMEN_VLM_Q8_ROUTE=%r", route)
+            route = "auto"
+        vision_sub = converted.pop("vision", None)
+        # Cast first so the int8 grid is computed from the bf16 weights
+        # serving would otherwise stream; scales stay fp32 (the later
+        # blanket cast is skipped for quantized trees). The vision subtree
+        # sits out: never quantized, and cast later only if kept.
+        cast = self.policy.cast_params(converted)
+        base_cfg = dataclasses.replace(
+            self.cfg,
+            decoder=dataclasses.replace(
+                self.cfg.decoder, weight_quant=None, weight_quant_kernel=None
+            ),
+        )
+        if route == "bf16":
+            logger.info(
+                "VLM quantize=int8 overridden to bf16 (LUMEN_VLM_Q8_ROUTE); "
+                "skipping quantization"
+            )
+            chosen, params = "bf16", cast
+        else:
+            qtree = quantize_decoder_int8(cast)
+            if route == "int8" or not self.warmup:
+                chosen, params = "int8", qtree
+            else:
+                chosen, params = self._q8_decode_ab(base_cfg, cast, qtree)
+        if chosen == "bf16":
+            self.cfg = base_cfg
+            self.model = VLMModel(self.cfg)
+        self.quant_route = chosen
+        ref = weakref.ref(self)
+
+        def _route_gauges() -> dict:
+            m = ref()
+            if m is None:
+                return {}
+            out = {"int8_active": 1 if m.quant_route == "int8" else 0}
+            if m.quant_speedup is not None:
+                out["q8_speedup_pct"] = round(m.quant_speedup * 100, 1)
+            return out
+
+        self._route_gauge_fn = _route_gauges
+        metrics.register_gauges(f"vlm-quant:{self.model_id}", _route_gauges)
+        if vision_sub is not None:
+            params["vision"] = vision_sub
+        return params
+
+    def _q8_decode_ab(self, base_cfg, cast: dict, qtree: dict):
+        """One-shot warmup decode A/B; returns ``(route, tree)``. Timed
+        SEQUENTIALLY (place bf16, time, free; place q8, time, free) so the
+        memory-tight deployments that quantize in the first place never
+        hold two decoder placements at once."""
+        tps_bf16 = self._time_decode_route(VLMModel(base_cfg), base_cfg, cast, quantized=False)
+        tps_q8 = self._time_decode_route(self.model, self.cfg, qtree, quantized=True)
+        self.quant_speedup = tps_q8 / max(tps_bf16, 1e-9)
+        if self.quant_speedup >= 1.0:
+            logger.info(
+                "VLM int8 decode route confirmed: %.3fx bf16 tokens/s",
+                self.quant_speedup,
+            )
+            return "int8", qtree
+        logger.warning(
+            "VLM int8 decode route DISABLED: warmup A/B measured q8 decode "
+            "at %.3fx bf16 tokens/s (a regression); serving bf16 instead. "
+            "Pin LUMEN_VLM_Q8_ROUTE=int8 to force.",
+            self.quant_speedup,
+        )
+        metrics.count("vlm_q8_fallbacks")
+        return "bf16", cast
+
+    def _time_decode_route(self, model, cfg, params: dict, quantized: bool) -> float:
+        """Decode tokens/sec for one route: a short synthetic prompt
+        through a small dedicated :class:`Generator` (the REAL decode
+        program — prefill + while_loop step — at a timing-sized KV), best
+        of 2 after a compile pass. The placement is freed before return."""
+        prompt_len, new_tokens = 16, 24
+        batch = max(1, min(4, self.gen_batch_size))
+        placed = self._place_params(params, quantized=quantized)
+        gen = Generator(
+            model, cfg,
+            max_seq=prompt_len + new_tokens + 8,
+            max_new_cap=new_tokens,
+            cache_dtype=self.policy.compute_dtype,
+        )
+        hidden = cfg.decoder.hidden_size
+        embeds = jnp.zeros((batch, prompt_len, hidden), self.policy.compute_dtype)
+        positions = jnp.broadcast_to(jnp.arange(prompt_len)[None, :], (batch, prompt_len))
+        lengths = jnp.full((batch,), prompt_len, jnp.int32)
+        prompt_ids = jnp.ones((batch, prompt_len), jnp.int32)
+
+        def run() -> int:
+            out = gen.generate(
+                placed, embeds, positions, lengths, prompt_ids,
+                jax.random.PRNGKey(0), max_new_tokens=new_tokens,
+            )
+            return int(np.asarray(out.n_generated).sum())
+
+        run()  # compile + settle off the clock
+        best = 0.0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            n = run()
+            best = max(best, max(n, 1) / (time.perf_counter() - t0))
+        del placed
+        return best
+
     def initialize(self) -> None:
         if self._initialized:
             return
@@ -363,15 +508,6 @@ class VLMManager:
 
         logger.info("loading VLM weights from %s", self.model_dir)
         state = load_state_dict(self.model_dir)
-        init = jax.eval_shape(
-            lambda: self.model.init(
-                jax.random.PRNGKey(0),
-                jnp.zeros((1, 4), jnp.int32),
-                jnp.zeros(
-                    (1, self.cfg.vision.image_size, self.cfg.vision.image_size, 3), jnp.float32
-                ),
-            )["params"]
-        )
         from ...runtime.weights import assert_tree_shapes
 
         # Vision backend selection. ``auto`` (default): prefer converted
@@ -386,18 +522,19 @@ class VLMManager:
             state, None, tie_word_embeddings=self.cfg.decoder.tie_word_embeddings
         )
         if self.quantize == "int8":
-            from .convert import quantize_decoder_int8
-
-            # Cast first so the int8 grid is computed from the bf16 weights
-            # serving would otherwise stream; scales stay fp32 (the later
-            # blanket cast is skipped for quantized trees). The vision
-            # subtree sits out: it is never quantized, and casting it here
-            # would waste a host pass on a tower the ONNX-graph path is
-            # about to discard — it's cast below only if kept.
-            vision_sub = converted.pop("vision", None)
-            converted = quantize_decoder_int8(self.policy.cast_params(converted))
-            if vision_sub is not None:
-                converted["vision"] = vision_sub
+            # Route resolution may rebuild self.cfg/self.model (bf16 pin or
+            # a warmup A/B fallback), so it runs BEFORE the eval_shape gate
+            # below — the gate must describe the tree actually served.
+            converted = self._resolve_q8_route(converted)
+        init = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 4), jnp.int32),
+                jnp.zeros(
+                    (1, self.cfg.vision.image_size, self.cfg.vision.image_size, 3), jnp.float32
+                ),
+            )["params"]
+        )
         has_native_vision = _subtree_matches(converted.get("vision"), init["vision"])
         vision_onnx = find_vision_onnx(self.model_dir) if backend != "native" else None
         vision_graph: VisionGraph | None = None
@@ -551,6 +688,8 @@ class VLMManager:
                 self._batcher.close()
             if self._continuous is not None:
                 self._continuous.close()
+        if fn := getattr(self, "_route_gauge_fn", None):
+            metrics.unregister_gauges(f"vlm-quant:{self.model_id}", fn)
         self._initialized = False
 
     # -- prompt prep -------------------------------------------------------
@@ -576,11 +715,13 @@ class VLMManager:
     def _decode_canvas(self, image_bytes: bytes) -> np.ndarray:
         """Decode + pad-to-square letterbox (reference
         ``_run_vision_encoder:661-729``); runs on the shared decode pool so
-        gRPC handler threads never do CPU-bound image work inline."""
+        gRPC handler threads never do CPU-bound image work inline. Scaled
+        decode: an oversized photo decodes at reduced scale (never below
+        the vision tower's input size) before the letterbox resize."""
         import cv2
 
-        img = decode_image_bytes(image_bytes, color="rgb")
         size = self.cfg.vision.image_size
+        img = decode_image_bytes(image_bytes, color="rgb", max_edge=size)
         h, w = img.shape[:2]
         scale = size / max(h, w)
         nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
@@ -685,11 +826,19 @@ class VLMManager:
 
     def _cache_ns(self) -> str:
         """Result-cache namespace, qualified by compute dtype and the
-        decoder quant config (see
-        :func:`~lumen_tpu.runtime.result_cache.make_namespace`)."""
+        RESOLVED decode route (see
+        :func:`~lumen_tpu.runtime.result_cache.make_namespace`): the
+        warmup A/B can pick a different route across restarts, and an
+        int8 generation must not answer for bf16 via the disk tier. A
+        bf16-fallback route shares the unquantized namespace — it runs
+        the identical program."""
+        from ...ops.image import DECODE_POLICY
+
         return make_namespace(
             "vlm", "generate", self.model_id, self.info.version,
-            jnp.dtype(self.policy.compute_dtype).name, self.quantize or "",
+            jnp.dtype(self.policy.compute_dtype).name,
+            "int8" if self.quant_route == "int8" else "",
+            DECODE_POLICY,
         )
 
     def generate(

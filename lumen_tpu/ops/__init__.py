@@ -6,6 +6,7 @@ from .attention import (
     attention_reference,
     flash_attention,
     flash_attention_cache,
+    record_flash_ab,
     flash_enabled,
     flash_for_seq,
     repeat_kv,
@@ -32,6 +33,7 @@ __all__ = [
     "attention_reference",
     "flash_attention",
     "flash_attention_cache",
+    "record_flash_ab",
     "flash_enabled",
     "flash_for_seq",
     "repeat_kv",

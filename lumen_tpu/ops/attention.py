@@ -390,15 +390,72 @@ def _min_flash_seq() -> int:
         return _MIN_FLASH_SEQ_DEFAULT
 
 
+#: fallback reasons already logged this process (log ONCE per distinct
+#: reason — the dispatch sits inside jitted-model call paths that run per
+#: request; a silent fallback is undebuggable but a log-per-call is worse)
+_FALLBACK_LOGGED: set[str] = set()
+
+
+def _log_fallback_once(reason: str) -> None:
+    if reason in _FALLBACK_LOGGED:
+        return
+    _FALLBACK_LOGGED.add(reason)
+    import logging
+
+    logging.getLogger(__name__).info(
+        "flash attention NOT selected: %s (XLA reference path serves this "
+        "shape; set LUMEN_FLASH=1 to force the kernel)", reason
+    )
+
+
 def _flash_usable(head_dim: int, mask, sq: int) -> bool:
     force = os.environ.get("LUMEN_FLASH")
     if force == "0":
+        _log_fallback_once("disabled by LUMEN_FLASH=0")
         return False
-    if mask is not None or head_dim > 256:
+    if mask is not None:
+        _log_fallback_once("explicit attention mask (kernel supports none/causal only)")
+        return False
+    if head_dim > 256:
+        _log_fallback_once(f"head_dim {head_dim} > 256 exceeds the kernel's VMEM tile")
         return False
     if force == "1":  # tests force the kernel on small CPU shapes
         return True
-    return _on_tpu() and sq >= _min_flash_seq()
+    if not _on_tpu():
+        _log_fallback_once("backend is not TPU (Pallas kernel is TPU-only)")
+        return False
+    if sq < _min_flash_seq():
+        _log_fallback_once(
+            f"seq {sq} < LUMEN_FLASH_MIN_SEQ ({_min_flash_seq()}): one fused "
+            "XLA einsum beats a degenerate one-block kernel grid"
+        )
+        return False
+    return True
+
+
+def record_flash_ab(ref_ms: float, flash_ms: float, block: str, platform: str) -> dict:
+    """Publish a flash-vs-reference A/B verdict as the ``flash-ab`` gauge
+    provider (and return the gauge dict). ``bench.py phase_flash_ab``
+    calls this so the measured verdict lands on /metrics instead of
+    being visible only in the bench JSON tail; a negative verdict
+    (``speedup_pct < 100``) alongside ``flash_attention: false`` in the
+    capability report says the fallback is MEASURED, not an accident."""
+    from ..utils.metrics import metrics
+
+    speedup = ref_ms / flash_ms if flash_ms else 0.0
+    verdict = {
+        "ref_ms": round(ref_ms, 3),
+        "flash_ms": round(flash_ms, 3),
+        "speedup_pct": round(speedup * 100, 1),
+        "flash_wins": 1 if speedup >= 1.0 else 0,
+    }
+    import logging
+
+    logging.getLogger(__name__).info(
+        "flash A/B verdict (%s, block %s): %.3fx reference", platform, block, speedup
+    )
+    metrics.register_gauges("flash-ab", lambda: dict(verdict))
+    return verdict
 
 
 def _interpret_mode() -> bool:

@@ -19,7 +19,11 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
-from lumen_tpu.ops.image import decode_image_bytes, letterbox_numpy
+from lumen_tpu.ops.image import (
+    decode_image_bytes,
+    decode_image_bytes_scaled,
+    letterbox_numpy,
+)
 from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
 
 logger = logging.getLogger(__name__)
@@ -62,6 +66,7 @@ class PhotoIngestPipeline:
         inflight: int = 2,
         workers: int | None = None,
         on_decode_error: str = "raise",
+        decode_max_edge: int | None = None,
     ):
         if on_decode_error not in ("raise", "record"):
             raise ValueError("on_decode_error must be 'raise' or 'record'")
@@ -107,6 +112,27 @@ class PhotoIngestPipeline:
         self.caption_prompt = caption_prompt
         self.caption_max_tokens = caption_max_tokens
 
+        # Scaled decode target: the producer decodes oversized JPEGs at
+        # reduced scale, never below the LARGEST consumer's input edge, so
+        # every stage's resize/letterbox still only downscales. ``None`` =
+        # auto (max over the configured stages); ``0`` disables (full
+        # decode). Stage coordinates are mapped back to the ORIGINAL frame
+        # via the per-item decode scale, so records are unchanged apart
+        # from resampling tolerance.
+        if decode_max_edge is None:
+            targets = []
+            if clip is not None:
+                targets.append(clip.cfg.image_size)
+            if face is not None:
+                targets.append(face.det_cfg.input_size)
+            if ocr is not None:
+                from lumen_tpu.runtime.batcher import bucket_for
+
+                buckets = sorted(ocr.spec.det_buckets)
+                targets.append(bucket_for(ocr_det_size or buckets[-1], buckets))
+            decode_max_edge = max(targets)
+        self.decode_max_edge = decode_max_edge
+
         stages = []
         if clip is not None:
             stages.append(self._clip_stage(mesh))
@@ -150,6 +176,9 @@ class PhotoIngestPipeline:
                 "classify_top_k": classify_top_k,
                 "ocr_det_size": ocr_det_size,
                 "ocr_use_angle_cls": ocr_use_angle_cls,
+                # Decode resolution changes record numerics (resampling):
+                # entries from one decode policy must not answer another.
+                "decode_max_edge": self.decode_max_edge,
             },
         )
 
@@ -157,11 +186,16 @@ class PhotoIngestPipeline:
 
     def _decode(self, item) -> dict:
         try:
-            img = (
-                decode_image_bytes(item, color="rgb")
-                if isinstance(item, (bytes, bytearray))
-                else np.asarray(item)
-            )
+            dscale, orig_hw = 1.0, None
+            if isinstance(item, (bytes, bytearray)):
+                if self.decode_max_edge:
+                    img, dscale, orig_hw = decode_image_bytes_scaled(
+                        item, color="rgb", max_edge=self.decode_max_edge
+                    )
+                else:
+                    img = decode_image_bytes(item, color="rgb")
+            else:
+                img = np.asarray(item)
             if img.ndim != 3 or img.shape[2] != 3:
                 raise ValueError(f"expected HWC RGB image, got shape {img.shape}")
         except ValueError as e:
@@ -169,7 +203,12 @@ class PhotoIngestPipeline:
                 raise
             # Placeholder keeps batch shapes static; stages skip real work.
             return {"img": np.zeros((8, 8, 3), np.uint8), "meta": {}, "error": str(e)}
-        return {"img": img, "meta": {}}
+        return {
+            "img": img,
+            "meta": {},
+            "decode_scale": dscale,
+            "orig_hw": orig_hw if orig_hw is not None else img.shape[:2],
+        }
 
     # -- stages -----------------------------------------------------------
 
@@ -205,8 +244,11 @@ class PhotoIngestPipeline:
 
         def preprocess(decoded: dict) -> np.ndarray:
             boxed, scale, pad_top, pad_left = letterbox_numpy(decoded["img"], det_size)
-            h, w = decoded["img"].shape[:2]
-            decoded["meta"]["face"] = (scale, pad_top, pad_left, h, w)
+            # Fold the scaled-decode factor into the unmap scale so boxes
+            # and landmarks come out in ORIGINAL image coordinates.
+            dscale = decoded.get("decode_scale", 1.0)
+            h, w = decoded.get("orig_hw", decoded["img"].shape[:2])
+            decoded["meta"]["face"] = (scale * dscale, pad_top, pad_left, h, w)
             return boxed
 
         def device_fn(images):
@@ -222,7 +264,10 @@ class PhotoIngestPipeline:
                 scale=scale, pad_top=pad_top, pad_left=pad_left, image_hw=(h, w),
             )
             if faces:
-                mgr.embed_detections(decoded["img"], faces)
+                mgr.embed_detections(
+                    decoded["img"], faces,
+                    coord_scale=decoded.get("decode_scale", 1.0),
+                )
             return faces
 
         return Stage("face", preprocess, device_fn, postprocess)
@@ -261,9 +306,16 @@ class PhotoIngestPipeline:
             )
             if not found:
                 return []
-            return mgr.recognize_boxes(
+            results = mgr.recognize_boxes(
                 img, found, use_angle_cls=self.ocr_use_angle_cls
             )
+            # Crops come from the (possibly scaled-)decoded frame; the
+            # reported quads go back to ORIGINAL coordinates.
+            dscale = decoded.get("decode_scale", 1.0)
+            if dscale != 1.0:
+                for r in results:
+                    r.box = np.asarray(r.box, np.float32) / dscale
+            return results
 
         return Stage("ocr", preprocess, device_fn, postprocess)
 

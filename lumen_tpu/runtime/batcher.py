@@ -5,13 +5,21 @@ The reference serves exactly one payload per ONNX session call
 sits between gRPC worker threads and a jit-compiled model function:
 
 - callers ``submit()`` single items and block on a future,
-- a collector thread drains the queue until ``max_batch`` items or
-  ``max_latency_ms`` elapsed since the first item,
-- items are stacked, padded to a static *bucket* size (so XLA compiles one
-  program per bucket, not per batch size), and DISPATCHED as one device
-  call — JAX dispatch is async, so the collector hands the un-fetched
-  result to a bounded in-flight deque and immediately goes back to
-  collecting,
+- a collector thread drains the queue until ``max_batch`` items or the
+  collection window closes. The window is ADAPTIVE by default
+  (``LUMEN_BATCH_ADAPTIVE``): an EWMA of the submit arrival rate predicts
+  how long the rest of the batch takes to arrive — the wait stretches
+  (bounded by ``LUMEN_BATCH_WINDOW_MS``, default the fixed
+  ``max_latency_ms``) when traffic can fill ``max_batch`` and collapses to
+  ~0 for a lone request. Batch fill is exported as the
+  ``batch-occupancy:<name>`` gauge provider (mean fill % against
+  ``max_batch`` + per-bucket batch counts),
+- items are stacked into reusable per-bucket staging arenas (no per-batch
+  allocation on the hot path), padded to a static *bucket* size (so XLA
+  compiles one program per bucket, not per batch size), and DISPATCHED as
+  one device call — JAX dispatch is async, so the collector hands the
+  un-fetched result to a bounded in-flight deque and immediately goes back
+  to collecting,
 - a fetch/settle worker drains the deque in dispatch order: ONE blocking
   device->host transfer per batch (``jax.device_get`` on the whole result
   tree), then the rows are scattered back to the callers.
@@ -127,6 +135,152 @@ def warmup_batcher(batcher: "MicroBatcher", make_dummy: Callable[[int], Any]) ->
         jax.block_until_ready(batcher.fn(make_dummy(b), b))
 
 
+def batch_adaptive() -> bool:
+    """``LUMEN_BATCH_ADAPTIVE`` (default on): the collector's wait window
+    tracks the measured arrival rate instead of sitting at the fixed
+    ``max_latency_ms`` — stretched (bounded by ``LUMEN_BATCH_WINDOW_MS``)
+    when traffic can fill ``max_batch``, collapsed to ~0 when idle.
+    ``0`` restores the fixed window everywhere."""
+    return os.environ.get("LUMEN_BATCH_ADAPTIVE", "1") != "0"
+
+
+def batch_window_ms() -> float | None:
+    """``LUMEN_BATCH_WINDOW_MS``: upper bound on the adaptive collection
+    window. Unset/malformed = each batcher's own ``max_latency_ms`` (the
+    adaptive controller then never waits LONGER than the fixed window did,
+    only shorter); explicit values let an operator stretch the window past
+    the fixed default when occupancy matters more than tail latency."""
+    raw = os.environ.get("LUMEN_BATCH_WINDOW_MS")
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return None
+
+
+class AdaptiveWindow:
+    """EWMA arrival-rate controller for the collector's batch window.
+
+    ``observe()`` is called at every ``submit()`` (cheap: one EWMA update
+    under the submit lock the caller already holds is avoided — this has
+    its own tiny lock so hot submitters don't serialize on the collector).
+    ``window_s(have)`` answers: with ``have`` items already collected, how
+    long is it worth waiting for the rest of the batch?
+
+    - **No history yet** → the fixed window (cold start must not dispatch
+      singletons before the rate is known).
+    - **Idle** (inter-arrival EWMA beyond ``IDLE_FACTOR`` caps) → ~0: a
+      lone request pays dispatch latency, not a window it cannot fill.
+      The factor matters: closed-loop callers (a worker pool that submits
+      the next item when the previous settles) measure an arrival
+      interval ≈ the service interval, slightly ABOVE a tight cap — that
+      is a convoy to coalesce, not idleness.
+    - **Traffic** → the predicted time for the REST of the batch to
+      arrive, clamped to the cap: a saturating producer fills ``max_batch``
+      and the window never stretches past ``cap_s``.
+
+    ``clock`` is injectable for deterministic tests."""
+
+    #: "idle" = the next arrival is expected beyond this many cap-widths
+    #: away; between 1 and this, waiting one cap still buys co-batching.
+    IDLE_FACTOR = 8.0
+    #: multiplier on the predicted fill time: the EWMA is a point estimate
+    #: and closed-loop arrival jitter is on the order of the interval
+    #: itself — without headroom the window closes exactly when the last
+    #: item was DUE, losing it to the next batch half the time. Bounded by
+    #: the cap either way, so tail latency is unchanged.
+    HEADROOM = 2.0
+
+    __slots__ = ("max_batch", "cap_s", "fixed_s", "alpha", "clock", "_interval", "_last", "_lock")
+
+    def __init__(
+        self,
+        max_batch: int,
+        cap_s: float,
+        fixed_s: float,
+        alpha: float = 0.2,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_batch = max_batch
+        self.cap_s = cap_s
+        self.fixed_s = fixed_s
+        self.alpha = alpha
+        self.clock = clock
+        self._interval: float | None = None  # EWMA inter-arrival seconds
+        self._last: float | None = None
+        self._lock = threading.Lock()
+
+    def observe(self) -> None:
+        now = self.clock()
+        with self._lock:
+            if self._last is not None:
+                # Clamp a long idle gap to 2x the idle threshold before
+                # folding it in: the gap still reads as idle (above the
+                # window_s threshold), but resumed traffic needs ~3
+                # observations to decay back under it instead of ~20 —
+                # one 10s pause must not make the next burst dispatch as
+                # singletons while a poisoned EWMA recovers.
+                dt = min(now - self._last, self.cap_s * self.IDLE_FACTOR * 2)
+                self._interval = (
+                    dt
+                    if self._interval is None
+                    else (1.0 - self.alpha) * self._interval + self.alpha * dt
+                )
+            self._last = now
+
+    def window_s(self, have: int) -> float:
+        with self._lock:
+            interval = self._interval
+        if interval is None:
+            return min(self.fixed_s, self.cap_s) if self.cap_s > 0 else self.fixed_s
+        if self.cap_s <= 0:
+            return 0.0
+        if interval > self.cap_s * self.IDLE_FACTOR:
+            return 0.0  # idle: the next arrival is nowhere near
+        need = max(0, self.max_batch - have)
+        return min(self.cap_s, need * interval * self.HEADROOM)
+
+
+class _Occupancy:
+    """Batch-fill telemetry: mean fill % against ``max_batch`` plus a
+    per-bucket batch count, exported as the ``batch-occupancy:<name>``
+    gauge provider. A fixed-window batcher under bursty traffic shows its
+    padding waste here; the adaptive window's whole point is making this
+    gauge read high under load."""
+
+    __slots__ = ("max_batch", "batches", "items", "by_bucket", "_lock")
+
+    def __init__(self, max_batch: int):
+        self.max_batch = max_batch
+        self.batches = 0
+        self.items = 0
+        self.by_bucket: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def record(self, n: int, size: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.items += n
+            self.by_bucket[size] = self.by_bucket.get(size, 0) + 1
+
+    def gauges(self) -> dict:
+        with self._lock:
+            if not self.batches:
+                return {"batches": 0, "items": 0, "mean_fill_pct": 0.0}
+            out = {
+                "batches": self.batches,
+                "items": self.items,
+                "mean_fill_pct": round(
+                    100.0 * self.items / (self.batches * self.max_batch), 1
+                ),
+                "mean_items": round(self.items / self.batches, 2),
+            }
+            for size, count in sorted(self.by_bucket.items()):
+                out[f"bucket_{size}"] = count
+            return out
+
+
 def batch_wait_timeout() -> float:
     """Default seconds a caller waits on a batched-call future — must
     tolerate a cold bucket compile through the tunnel (see
@@ -212,9 +366,12 @@ class _Inflight:
     """One dispatched-but-unfetched batch riding the in-flight deque.
     ``entries`` keeps the (item, future, fingerprint) triples so a
     fetch-time failure can still bisect (re-dispatching needs the host
-    items, which are tiny next to the device result they produced)."""
+    items, which are tiny next to the device result they produced).
+    ``arena`` lists the staging buffers the batch was stacked into (when
+    the collector's reusable arenas were used) so the fetch path can
+    detect — and copy out of — a result that aliases them."""
 
-    __slots__ = ("futures", "result", "n", "size", "entries")
+    __slots__ = ("futures", "result", "n", "size", "entries", "arena")
 
     def __init__(
         self,
@@ -223,12 +380,14 @@ class _Inflight:
         n: int,
         size: int,
         entries: list[tuple] | None = None,
+        arena: list | None = None,
     ):
         self.futures = futures
         self.result = result  # un-fetched device result tree
         self.n = n
         self.size = size
         self.entries = entries or []
+        self.arena = arena
 
 
 class MicroBatcher:
@@ -258,6 +417,9 @@ class MicroBatcher:
         bisect_depth: int | None = None,
         watchdog_s: float | None = None,
         quarantine: QuarantineRegistry | None = None,
+        adaptive: bool | None = None,
+        window_ms: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -281,6 +443,24 @@ class MicroBatcher:
         )
         self.watchdog_s = batch_watchdog_s() if watchdog_s is None else max(0.0, watchdog_s)
         self._quarantine = quarantine
+        # Adaptive collection window: the EWMA controller replaces the
+        # fixed wait when enabled (LUMEN_BATCH_ADAPTIVE, default on); the
+        # cap is LUMEN_BATCH_WINDOW_MS or this batcher's own fixed window.
+        self.adaptive = batch_adaptive() if adaptive is None else adaptive
+        cap_ms = batch_window_ms() if window_ms is None else max(0.0, window_ms)
+        self.window_cap_s = (cap_ms / 1e3) if cap_ms is not None else self.max_latency_s
+        self._clock = clock
+        self._window = AdaptiveWindow(
+            max_batch, self.window_cap_s, self.max_latency_s, clock=clock
+        )
+        self._occupancy = _Occupancy(max_batch)
+        # Reusable per-bucket staging arenas: (size, treedef, leaf sig) ->
+        # ring of buffer lists. Ring length inflight+2 guarantees a slot is
+        # only rewritten after its batch's device work has been fetched
+        # (the collector blocks once `inflight` batches are un-fetched), so
+        # a backend that zero-copy-aliases host numpy stays correct.
+        self._arenas: dict[tuple, list[list[np.ndarray]]] = {}
+        self._arena_seq: dict[tuple, int] = {}
         self._queue: queue.Queue[tuple[Any, Future, float | None, str | None] | None] = queue.Queue()
         self._thread: threading.Thread | None = None
         self._fetch_thread: threading.Thread | None = None
@@ -321,8 +501,12 @@ class MicroBatcher:
         self._fetch_thread = threading.Thread(
             target=self._fetch_loop, name=f"{self.name}-fetch", daemon=True
         )
-        self._thread.start()
+        # Fetch worker FIRST: the collector's dead-fetch-worker guard reads
+        # a not-yet-started thread as dead, and with pre-queued items and a
+        # collapsed adaptive window the collector can reach its first
+        # dispatch within microseconds of starting.
         self._fetch_thread.start()
+        self._thread.start()
         if self.watchdog_s > 0:
             self._watchdog_thread = threading.Thread(
                 target=self._watchdog_loop, name=f"{self.name}-watchdog", daemon=True
@@ -347,6 +531,13 @@ class MicroBatcher:
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"batcher:{self.name}", _gauges)
+
+        def _occupancy_gauges() -> dict:
+            b = ref()
+            return {} if b is None else b._occupancy.gauges()
+
+        self._occupancy_gauge_fn = _occupancy_gauges
+        metrics.register_gauges(f"batch-occupancy:{self.name}", _occupancy_gauges)
         return self
 
     def close(self) -> None:
@@ -394,6 +585,8 @@ class MicroBatcher:
         # None (= unconditional) and evict a live same-name batcher's.
         if fn := getattr(self, "_gauge_fn", None):
             metrics.unregister_gauges(f"batcher:{self.name}", fn)
+        if fn := getattr(self, "_occupancy_gauge_fn", None):
+            metrics.unregister_gauges(f"batch-occupancy:{self.name}", fn)
 
     # -- client side ------------------------------------------------------
 
@@ -438,6 +631,8 @@ class MicroBatcher:
             except PoisonInput:
                 self.stats["quarantine_rejected"] += 1
                 raise
+        if self.adaptive:
+            self._window.observe()
         fut: Future = Future()
         with self._submit_lock:
             # Wedge check INSIDE the lock: _fire_watchdog sets _wedged and
@@ -500,19 +695,40 @@ class MicroBatcher:
             if first is None:
                 break
             batch = [first]
-            deadline = time.monotonic() + self.max_latency_s
+            # Window from the FIRST item's pickup. Fixed mode keeps the
+            # historical ``max_latency_ms`` wait; adaptive mode asks the
+            # EWMA controller and re-asks after each arrival (more items in
+            # hand = less of the batch left to wait for), always bounded by
+            # ``window_cap_s`` from the first item.
+            t_first = time.monotonic()
+            if self.adaptive:
+                deadline = t_first + min(self._window.window_s(1), self.window_cap_s)
+            else:
+                deadline = t_first + self.max_latency_s
             while len(batch) < self.max_batch:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
+                # Drain-first: items ALREADY queued join the batch
+                # regardless of the window — a collapsed (~0) adaptive
+                # window must mean "don't wait for traffic that isn't
+                # coming", never "strand waiting items for a later batch".
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    nxt = self._queue.get_nowait()
                 except queue.Empty:
-                    break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
                 if nxt is None:
                     self._closed.set()
                     break
                 batch.append(nxt)
+                if self.adaptive:
+                    deadline = min(
+                        t_first + self.window_cap_s,
+                        time.monotonic() + self._window.window_s(len(batch)),
+                    )
             self._dispatch(batch)
         # Drain anything left after close.
         while True:
@@ -584,8 +800,11 @@ class MicroBatcher:
         futures = [b[1] for b in live]
         n = len(items)
         size = bucket_for(n, self.buckets)
+        self._occupancy.record(n, size)
+        arena = None
         try:
-            result = self._execute(live, n, size)
+            stacked, arena = self._stack(items, size)
+            result = self._execute(live, n, size, stacked=stacked)
         except Exception as e:  # noqa: BLE001 - contain, or fan out to callers
             self._contain_failure(live, e)
             return
@@ -593,17 +812,27 @@ class MicroBatcher:
             if self._fetch_thread is not None and not self._fetch_thread.is_alive():
                 dead = True  # nobody left to settle this result
             else:
-                self._inflight.append(_Inflight(futures, result, n, size, entries=live))
+                self._inflight.append(
+                    _Inflight(futures, result, n, size, entries=live, arena=arena)
+                )
                 self._inflight_cv.notify_all()
         if dead:
             self._abort_dead_fetch(futures)
 
-    def _execute(self, entries: list[tuple[Any, Future, str | None]], n: int, size: int):
+    def _execute(
+        self,
+        entries: list[tuple[Any, Future, str | None]],
+        n: int,
+        size: int,
+        stacked: Any | None = None,
+    ):
         """Fault checks + stack + dispatch for one (sub-)batch, watched by
-        the watchdog. Shared by the normal dispatch path and bisection
-        probes, so an armed fault point (or a real per-item failure, e.g. a
-        shape mismatch surfacing in ``stack_and_pad``) fires identically
-        for every sub-batch that still contains the offending item."""
+        the watchdog. Shared by the normal dispatch path (which pre-stacks
+        into a reusable arena and passes ``stacked``) and bisection probes
+        (which re-stack their sub-batch here), so an armed fault point (or
+        a real per-item failure, e.g. a shape mismatch surfacing in
+        ``stack_and_pad``) fires identically for every sub-batch that
+        still contains the offending item."""
         from ..testing.faults import faults
 
         with self._watched([e[1] for e in entries]):
@@ -617,8 +846,70 @@ class MicroBatcher:
                     faults.check("batch_poison", f"{self.name}:{fingerprint}")
             if faults.fires("batch_hang", self.name):
                 self._hang()
-            stacked = stack_and_pad([e[0] for e in entries], size)
+            if stacked is None:
+                stacked = stack_and_pad([e[0] for e in entries], size)
             return self.fn(stacked, n)  # async dispatch; fetch worker settles
+
+    #: bound on distinct (bucket, leaf-signature) arena keys; past it new
+    #: shapes fall back to allocating stacks (a shape-churning caller must
+    #: not grow pinned staging memory without limit).
+    _MAX_ARENA_KEYS = 8
+
+    def _stack(self, items: list[Any], size: int):
+        """Stack ``items`` into a reusable per-bucket staging arena
+        (collector thread only — bisection probes and salvage paths use the
+        allocating :func:`stack_and_pad`). Returns ``(stacked_tree,
+        arena_buffers | None)``; the buffers ride the in-flight entry so
+        the fetch path can copy out of a result that aliases them.
+
+        A ring of ``inflight + 2`` buffer sets per signature makes reuse
+        safe even when the backend zero-copy-aliases host numpy: a slot is
+        rewritten only after its batch left the in-flight deque (the
+        collector blocks at ``inflight`` un-fetched batches), i.e. after
+        its device work was fetched. Any shape/structure surprise falls
+        back to ``stack_and_pad`` so error semantics (and bisection) are
+        exactly the pre-arena ones."""
+        try:
+            flat = [jax.tree_util.tree_flatten(it) for it in items]
+            leaves0 = [np.asarray(l) for l in flat[0][0]]
+            treedef0 = flat[0][1]
+            key = (size, treedef0, tuple((a.shape, a.dtype.str) for a in leaves0))
+            ring = self._arenas.get(key)
+            if ring is None:
+                if len(self._arenas) >= self._MAX_ARENA_KEYS:
+                    return stack_and_pad(items, size), None
+                ring = [
+                    [np.empty((size, *a.shape), a.dtype) for a in leaves0]
+                    for _ in range(self.inflight + 2)
+                ]
+                self._arenas[key] = ring
+                self._arena_seq[key] = 0
+            seq = self._arena_seq[key]
+            self._arena_seq[key] = seq + 1
+            bufs = ring[seq % len(ring)]
+            n = len(items)
+            for i, (leaves, treedef) in enumerate(flat):
+                if treedef != treedef0:
+                    raise ValueError("mixed pytree structures in batch")
+                for j, leaf in enumerate(leaves):
+                    arr = np.asarray(leaf)
+                    # Exact-match gate, like np.stack's: a broadcastable
+                    # (or castable) mismatch must fall through to the
+                    # allocating path and RAISE there — never silently
+                    # broadcast/truncate into a wrong device result.
+                    if arr.shape != leaves0[j].shape or arr.dtype != leaves0[j].dtype:
+                        raise ValueError(
+                            f"item {i} leaf {j} shape/dtype "
+                            f"{arr.shape}/{arr.dtype} != arena "
+                            f"{leaves0[j].shape}/{leaves0[j].dtype}"
+                        )
+                    bufs[j][i] = arr
+            if n < size:
+                for buf in bufs:
+                    buf[n:size] = buf[n - 1]  # repeat-last padding
+            return jax.tree_util.tree_unflatten(treedef0, bufs), bufs
+        except Exception:  # noqa: BLE001 - degrade to the allocating path
+            return stack_and_pad(items, size), None
 
     def _hang(self) -> None:
         """Simulate a wedged device call (``batch_hang`` fault point):
@@ -893,7 +1184,7 @@ class MicroBatcher:
                 entry = self._inflight[0]
             try:
                 with self._watched(entry.futures):
-                    rows = unstack(entry.result, entry.n)
+                    rows = _unstack_guarded(entry.result, entry.n, entry.arena)
             except Exception as e:  # noqa: BLE001 - contain, or fan out to THIS batch only
                 # A device error often surfaces at the FETCH, not the
                 # dispatch (XLA dispatch is async): bisection runs here
@@ -939,6 +1230,32 @@ def stack_and_pad(items: list[Any], size: int) -> Any:
         return np.stack(arrs)
 
     return jax.tree_util.tree_map(stack, *items)
+
+
+def _unstack_guarded(tree: Any, n: int, arena: list | None) -> list[Any]:
+    """``unstack`` with an arena-alias guard: a passthrough/zero-copy
+    backend can hand back host arrays that ALIAS the reusable staging
+    buffers the batch was stacked into — rows sliced from those would be
+    silently rewritten when the arena slot cycles. Any fetched leaf that
+    may share memory with an arena buffer is copied out first (real device
+    results are fresh host arrays, so the check is a no-op bounds test on
+    the hot path)."""
+    tree = jax.device_get(tree)
+    if arena:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        leaves = [
+            np.array(leaf, copy=True)
+            if isinstance(leaf, np.ndarray)
+            and any(np.may_share_memory(leaf, buf) for buf in arena)
+            else leaf
+            for leaf in leaves
+        ]
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return [
+        jax.tree_util.tree_unflatten(treedef, [leaf[i] for leaf in leaves])
+        for i in range(n)
+    ]
 
 
 def unstack(tree: Any, n: int) -> list[Any]:

@@ -163,6 +163,7 @@ class ClipService(BaseService):
             extra={
                 "embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values()),
                 "quant_routes": ",".join(routes),
+                "bulk_stream": "1",  # many-items-per-stream Infer lane
             },
         )
 

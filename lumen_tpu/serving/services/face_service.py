@@ -94,6 +94,7 @@ class FaceService(BaseService):
             extra={
                 "det_size": str(self.manager.det_cfg.input_size),
                 "embedding_dim": str(self.manager.rec_cfg.embed_dim),
+                "bulk_stream": "1",  # many-items-per-stream Infer lane
             },
         )
 

@@ -68,6 +68,7 @@ class OcrService(BaseService):
                 "det_buckets": ",".join(str(b) for b in self.manager.spec.det_buckets),
                 "rec_height": str(self.manager.rec_cfg.height),
                 "vocab_size": str(len(self.manager.vocab)),
+                "bulk_stream": "1",  # many-items-per-stream Infer lane
             },
         )
 

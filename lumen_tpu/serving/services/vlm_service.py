@@ -107,12 +107,18 @@ class VlmService(BaseService):
             model_ids=[self.manager.model_id],
             runtime="jax-tpu",
             max_concurrency=max(1, width),
-            precisions=["bf16", "fp32"],
+            # Routes reflect what initialize() actually chose — a manager
+            # that opted into int8 but fell back to bf16 (warmup A/B
+            # showed a decode regression) must not advertise int8.
+            precisions=["bf16", "fp32"]
+            + (["int8"] if self.manager.quant_route == "int8" else []),
             extra={
                 "max_new_cap": str(self.manager.max_new_cap),
                 "max_seq": str(self.manager.max_seq),
                 "vision_tokens": str(self.manager.vision_tokens),
                 "vocab_size": str(self.manager.cfg.decoder.vocab_size),
+                "bulk_stream": "1",  # many-items-per-stream Infer lane
+                "quant_route": self.manager.quant_route,
             },
         )
 

@@ -1,0 +1,292 @@
+"""Adaptive batch window (ISSUE 5 tentpole): EWMA controller semantics
+under a deterministic fake clock, occupancy-gauge acceptance under a
+saturating producer, and staging-arena correctness.
+
+The controller tests use a fake clock and contain NO sleeps in their
+assertions: the window math is pure given the observed arrival times.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from lumen_tpu.runtime.batcher import (
+    AdaptiveWindow,
+    MicroBatcher,
+    batch_adaptive,
+    batch_window_ms,
+)
+from lumen_tpu.utils.metrics import metrics
+
+
+def identity(tree, n):
+    return tree
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class TestAdaptiveWindowController:
+    """Pure controller semantics — fake clock, no threads, no sleeps."""
+
+    def test_cold_start_uses_fixed_window(self):
+        w = AdaptiveWindow(max_batch=8, cap_s=0.05, fixed_s=0.005, clock=FakeClock())
+        # No arrival history: the fixed window (never MORE than the cap).
+        assert w.window_s(1) == 0.005
+
+    def test_saturating_traffic_stretches_to_predicted_fill(self):
+        clock = FakeClock()
+        w = AdaptiveWindow(max_batch=8, cap_s=0.050, fixed_s=0.005, clock=clock)
+        for _ in range(16):  # steady 1ms inter-arrival
+            w.observe()
+            clock.advance(0.001)
+        # 7 more items expected at ~1ms each (x HEADROOM jitter margin):
+        # above the fixed 5ms (stretched) but bounded by the 50ms cap.
+        win = w.window_s(1)
+        assert 0.005 < win <= 0.050
+        assert win == pytest.approx(7 * 0.001 * AdaptiveWindow.HEADROOM, rel=0.05)
+        # More items in hand -> proportionally less wait.
+        assert w.window_s(7) == pytest.approx(1 * 0.001 * AdaptiveWindow.HEADROOM, rel=0.05)
+
+    def test_window_clamped_to_cap(self):
+        clock = FakeClock()
+        w = AdaptiveWindow(max_batch=64, cap_s=0.010, fixed_s=0.005, clock=clock)
+        for _ in range(8):  # 1ms arrivals, but 63 more needed = 63ms >> cap
+            w.observe()
+            clock.advance(0.001)
+        assert w.window_s(1) == 0.010
+
+    def test_idle_collapses_to_zero(self):
+        clock = FakeClock()
+        w = AdaptiveWindow(max_batch=8, cap_s=0.005, fixed_s=0.005, clock=clock)
+        for _ in range(4):  # sporadic: 1 request per second
+            w.observe()
+            clock.advance(1.0)
+        # Not even one further arrival expected inside the cap: don't tax
+        # the lone request with a window it cannot fill.
+        assert w.window_s(1) == 0.0
+
+    def test_lone_request_latency_within_2x_fixed_baseline(self):
+        """ISSUE 5 satellite acceptance: under a lone request the dispatch
+        wait must stay within ~2x the fixed-window baseline. Deterministic:
+        at every history state the adaptive window never exceeds
+        max(fixed, cap) — and in the idle regime it is strictly SMALLER
+        than the fixed wait (zero)."""
+        clock = FakeClock()
+        fixed_s = 0.005
+        w = AdaptiveWindow(max_batch=8, cap_s=fixed_s, fixed_s=fixed_s, clock=clock)
+        # Cold start: exactly the fixed baseline (1x).
+        assert w.window_s(1) <= 2 * fixed_s
+        # Idle history: better than baseline.
+        for _ in range(4):
+            w.observe()
+            clock.advance(10.0)
+        assert w.window_s(1) == 0.0 <= 2 * fixed_s
+        # Busy history: capped at cap_s == fixed -> still <= 2x baseline.
+        for _ in range(16):
+            w.observe()
+            clock.advance(0.0005)
+        assert w.window_s(1) <= 2 * fixed_s
+
+    def test_idle_gap_does_not_poison_recovery(self):
+        """One long pause is clamped before entering the EWMA: the first
+        request after the gap still dispatches immediately (idle), but
+        resumed steady traffic re-earns a stretched window within a few
+        arrivals instead of ~20 singleton dispatches."""
+        clock = FakeClock()
+        cap = 0.005
+        w = AdaptiveWindow(max_batch=8, cap_s=cap, fixed_s=cap, clock=clock)
+        for _ in range(16):  # steady 1ms traffic
+            w.observe()
+            clock.advance(0.001)
+        clock.advance(10.0)  # service idle 10s
+        w.observe()  # first request after the gap
+        # The clamped gap cannot blow the estimate up: the post-gap wait
+        # stays bounded by one cap (<= 2x the fixed baseline), and the
+        # estimate must still be in the co-batching band, not pinned at
+        # ~2s of unclamped gap poisoning the next ~20 dispatches.
+        assert w.window_s(1) <= cap
+        assert w._interval < cap * AdaptiveWindow.IDLE_FACTOR
+        for _ in range(4):  # traffic resumes, spaced 3ms (co-batching band)
+            clock.advance(0.003)
+            w.observe()
+        assert 0.0 < w.window_s(1) <= cap  # convoy coalesces again
+
+    def test_ewma_smooths_bursts(self):
+        clock = FakeClock()
+        w = AdaptiveWindow(max_batch=8, cap_s=0.050, fixed_s=0.005, clock=clock)
+        for _ in range(5):  # bursts of 4 back-to-back, 20ms apart
+            for _ in range(4):
+                w.observe()
+                clock.advance(0.0001)
+            clock.advance(0.020)
+        # The smoothed interval sits between the intra- and inter-burst
+        # gaps: the next burst is worth waiting for, within the cap.
+        assert 0.0 < w.window_s(1) <= 0.050
+
+
+class TestKnobParsing:
+    def test_adaptive_default_on(self, monkeypatch):
+        monkeypatch.delenv("LUMEN_BATCH_ADAPTIVE", raising=False)
+        assert batch_adaptive() is True
+        monkeypatch.setenv("LUMEN_BATCH_ADAPTIVE", "0")
+        assert batch_adaptive() is False
+
+    def test_window_ms_parsing(self, monkeypatch):
+        monkeypatch.delenv("LUMEN_BATCH_WINDOW_MS", raising=False)
+        assert batch_window_ms() is None
+        monkeypatch.setenv("LUMEN_BATCH_WINDOW_MS", "25")
+        assert batch_window_ms() == 25.0
+        monkeypatch.setenv("LUMEN_BATCH_WINDOW_MS", "junk")
+        assert batch_window_ms() is None
+
+    def test_batcher_defaults(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_BATCH_WINDOW_MS", "40")
+        b = MicroBatcher(identity, max_batch=4, max_latency_ms=5)
+        assert b.adaptive is True
+        assert b.window_cap_s == pytest.approx(0.040)
+        monkeypatch.delenv("LUMEN_BATCH_WINDOW_MS")
+        b2 = MicroBatcher(identity, max_batch=4, max_latency_ms=5)
+        assert b2.window_cap_s == pytest.approx(0.005)  # cap = fixed window
+
+
+class TestOccupancyGauge:
+    def test_saturating_producer_fills_batches(self):
+        """ISSUE 5 acceptance: under a saturating producer the occupancy
+        gauge reports >= 80% mean fill at max_batch. Items are pre-queued
+        (the most saturating producer possible), so no sleeps are needed
+        and the drain-first collector must assemble full batches."""
+        b = MicroBatcher(identity, max_batch=8, max_latency_ms=5, name="occ-t")
+        futs = [b.submit(np.full((2,), i, np.float32)) for i in range(64)]
+        b.start()
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(timeout=10), np.full((2,), i))
+        gauges = metrics.snapshot()["gauges"]["batch-occupancy:occ-t"]
+        assert gauges["batches"] >= 8
+        assert gauges["mean_fill_pct"] >= 80.0
+        assert gauges.get("bucket_8", 0) >= 7  # full batches dominated
+        b.close()
+        # close() unregisters the provider.
+        assert "batch-occupancy:occ-t" not in metrics.snapshot().get("gauges", {})
+
+    def test_occupancy_counts_partial_batches(self):
+        b = MicroBatcher(identity, max_batch=8, max_latency_ms=1, name="occ-p")
+        b.start()
+        assert np.asarray(b(np.zeros(2), timeout=10)).shape == (2,)
+        g = metrics.snapshot()["gauges"]["batch-occupancy:occ-p"]
+        assert g["batches"] == 1
+        assert g["mean_fill_pct"] == pytest.approx(100.0 / 8, abs=0.1)
+        assert g["bucket_1"] == 1
+        b.close()
+
+
+class TestStagingArenas:
+    def test_rows_survive_arena_reuse(self):
+        """Many batches through the same bucket cycle the arena ring; every
+        caller must still hold ITS OWN row afterwards (the alias guard
+        copies results that share memory with a staging buffer)."""
+        b = MicroBatcher(identity, max_batch=4, max_latency_ms=1, name="arena-t")
+        b.start()
+        futs = [b.submit(np.full((3,), i, np.float32)) for i in range(40)]
+        rows = [f.result(timeout=10) for f in futs]
+        for i, row in enumerate(rows):
+            np.testing.assert_array_equal(row, np.full((3,), i, np.float32))
+        b.close()
+
+    def test_dict_tree_items(self):
+        b = MicroBatcher(identity, max_batch=4, max_latency_ms=1, name="arena-d")
+        b.start()
+        futs = [
+            b.submit({"a": np.full((2,), i, np.int32), "b": np.float32(i)})
+            for i in range(16)
+        ]
+        for i, f in enumerate(futs):
+            row = f.result(timeout=10)
+            np.testing.assert_array_equal(row["a"], np.full((2,), i, np.int32))
+            assert float(row["b"]) == float(i)
+        b.close()
+
+    def test_shape_change_falls_back_and_still_works(self):
+        """A caller changing leaf shapes between submissions lands in a new
+        arena key (or the allocating fallback past the key cap) — results
+        stay correct either way."""
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=1, name="arena-s")
+        b.start()
+        for size in (2, 3, 4, 5, 6, 7, 8, 9, 10, 11):  # > _MAX_ARENA_KEYS
+            out = b(np.full((size,), size, np.float32), timeout=10)
+            np.testing.assert_array_equal(out, np.full((size,), size, np.float32))
+        b.close()
+
+    def test_ragged_shapes_still_raise_per_batch(self):
+        """Mixed shapes in ONE batch must keep the historical stacking
+        error (bisection relies on it), not silently mis-stack."""
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=50, name="arena-r", bisect_depth=0)
+        f1 = b.submit(np.zeros(2, np.float32))
+        f2 = b.submit(np.zeros(3, np.float32))
+        b.start()
+        errs = 0
+        for f in (f1, f2):
+            try:
+                f.result(timeout=10)
+            except Exception:
+                errs += 1
+        assert errs == 2  # whole batch failed (bisection off)
+        b.close()
+
+
+class TestAdaptiveEndToEnd:
+    def test_fixed_mode_still_coalesces(self):
+        """adaptive=False restores the historical fixed-window behavior."""
+        calls = []
+
+        def fn(tree, n):
+            calls.append(n)
+            return tree
+
+        b = MicroBatcher(fn, max_batch=4, max_latency_ms=50, adaptive=False)
+        f1 = b.submit(np.zeros(1))
+        f2 = b.submit(np.zeros(1))
+        b.start()
+        f1.result(timeout=10), f2.result(timeout=10)
+        assert calls and calls[0] == 2  # one batch of two
+        b.close()
+
+    def test_adaptive_concurrent_callers_batch_together(self):
+        """Concurrent submitters under adaptive mode coalesce: the drain
+        loop plus the EWMA window must not devolve into singletons."""
+        calls = []
+
+        def fn(tree, n):
+            calls.append(n)
+            return tree
+
+        b = MicroBatcher(fn, max_batch=8, max_latency_ms=10, name="adapt-cc").start()
+        results = [None] * 32
+        barrier = threading.Barrier(8)
+
+        def worker(wid):
+            barrier.wait()
+            for i in range(4):
+                idx = wid * 4 + i
+                results[idx] = b(np.full((2,), idx, np.float32), timeout=10)
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for idx, row in enumerate(results):
+            np.testing.assert_array_equal(row, np.full((2,), idx, np.float32))
+        # Mean batch size must show real coalescing (not 32 singletons).
+        assert sum(calls) == 32
+        assert len(calls) <= 24
+        b.close()

@@ -486,6 +486,7 @@ class TestVlmSamplingBypass:
         mgr.info = SimpleNamespace(version="1.0.0")
         mgr.policy = get_policy("float32")
         mgr.quantize = None
+        mgr.quant_route = "bf16"
 
         def fake_uncached(messages, image_bytes=None, *args, **kw):
             counter.append(1)

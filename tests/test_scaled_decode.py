@@ -1,0 +1,140 @@
+"""Scaled JPEG decode (ISSUE 5 host-lane fast path): reduction factor
+rules, pixel correctness vs full-decode+resize, coordinate provenance,
+and the one-fingerprint-hash-per-item guarantee on the ingest producer.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+from PIL import Image  # noqa: E402
+
+from lumen_tpu.ops.image import (  # noqa: E402
+    _reduced_decode_factor,
+    decode_image_bytes,
+    decode_image_bytes_scaled,
+    probe_image_size,
+)
+
+
+def make_jpeg(h: int, w: int, seed: int = 0, quality: int = 90) -> bytes:
+    rng = np.random.default_rng(seed)
+    # Upsampled low-frequency content: a realistic photo spectrum, so the
+    # scaled-decode tolerance check measures resampling, not JPEG noise.
+    base = rng.integers(0, 255, (max(8, h // 16), max(8, w // 16), 3), np.uint8)
+    arr = np.asarray(Image.fromarray(base).resize((w, h)))
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+class TestProbeAndFactor:
+    def test_probe_reads_header_only(self):
+        assert probe_image_size(make_jpeg(480, 640)) == (480, 640)
+        assert probe_image_size(b"not an image") is None
+
+    def test_factor_rules(self):
+        jpeg = make_jpeg(1200, 1600)
+        # min side 1200: target 224 -> 1200//4=300 >= 224, //8=150 < 224.
+        assert _reduced_decode_factor(jpeg, 224) == 4
+        assert _reduced_decode_factor(jpeg, 600) == 2
+        assert _reduced_decode_factor(jpeg, 601) == 1  # < 2x oversize: full
+        assert _reduced_decode_factor(jpeg, 100) == 8
+        assert _reduced_decode_factor(jpeg, 0) == 1
+        assert _reduced_decode_factor(b"junk", 224) == 1  # unprobeable: full
+
+    def test_decoded_dims_never_below_target(self):
+        jpeg = make_jpeg(900, 1600)  # min side 900
+        img = decode_image_bytes(jpeg, max_edge=224)
+        assert min(img.shape[:2]) >= 224  # factor limited by the SHORT side
+
+
+class TestPixelCorrectness:
+    def test_scaled_matches_full_decode_resize_within_tolerance(self):
+        """ISSUE 5 acceptance: scaled decode -> resize must match
+        full decode -> resize within tolerance (resampling differences
+        only, no content shift)."""
+        for h, w in ((960, 1280), (1200, 1600), (2000, 1500)):
+            jpeg = make_jpeg(h, w, seed=h)
+            full = decode_image_bytes(jpeg)
+            scaled = decode_image_bytes(jpeg, max_edge=224)
+            assert min(scaled.shape[:2]) >= 224
+            assert scaled.shape[0] < full.shape[0]  # reduction engaged
+            a = cv2.resize(full, (224, 224), interpolation=cv2.INTER_LINEAR).astype(np.float32)
+            b = cv2.resize(scaled, (224, 224), interpolation=cv2.INTER_LINEAR).astype(np.float32)
+            diff = np.abs(a - b)
+            assert diff.mean() < 6.0, f"{h}x{w}: mean {diff.mean():.2f}"
+            # Structural agreement, not just low average error.
+            corr = np.corrcoef(a.ravel(), b.ravel())[0, 1]
+            assert corr > 0.98, f"{h}x{w}: corr {corr:.4f}"
+
+    def test_small_image_passthrough_identical(self):
+        jpeg = make_jpeg(120, 160)
+        np.testing.assert_array_equal(
+            decode_image_bytes(jpeg), decode_image_bytes(jpeg, max_edge=224)
+        )
+
+
+class TestScaledProvenance:
+    def test_scale_and_orig_hw(self):
+        jpeg = make_jpeg(1200, 1600)
+        img, scale, orig_hw = decode_image_bytes_scaled(jpeg, max_edge=224)
+        assert orig_hw == (1200, 1600)
+        assert scale == pytest.approx(img.shape[0] / 1200, rel=0.01)
+        assert 0 < scale < 1
+        # Round-trip: decoded coords / scale land in the original frame.
+        assert img.shape[0] / scale == pytest.approx(1200, rel=0.02)
+
+    def test_full_decode_reports_unit_scale(self):
+        jpeg = make_jpeg(100, 100)
+        img, scale, orig_hw = decode_image_bytes_scaled(jpeg, max_edge=224)
+        assert scale == 1.0 and orig_hw == (100, 100)
+        # PNG rides cv2's reduced path too; provenance must stay exact.
+        png = io.BytesIO()
+        Image.fromarray(np.zeros((700, 900, 3), np.uint8)).save(png, format="PNG")
+        img2, scale2, hw2 = decode_image_bytes_scaled(png.getvalue(), max_edge=224)
+        assert img2.shape[:2] == (350, 450) and scale2 == 0.5 and hw2 == (700, 900)
+
+
+class TestIngestSingleHash:
+    def test_one_fingerprint_hash_per_item(self, monkeypatch):
+        """The producer's ONE make_key serves both the quarantine gate and
+        the cache lookup — no double sha256 per ingest item."""
+        import jax
+
+        import lumen_tpu.pipeline.ingest as ingest_mod
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+        from lumen_tpu.runtime.mesh import build_mesh
+        from lumen_tpu.runtime.result_cache import reset_result_cache
+
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(16 << 20))
+        reset_result_cache()
+        try:
+            calls: list[str] = []
+            real_make_key = ingest_mod.make_key
+
+            def counting_make_key(ns, options, payload):
+                key = real_make_key(ns, options, payload)
+                calls.append(key)
+                return key
+
+            monkeypatch.setattr(ingest_mod, "make_key", counting_make_key)
+            stage = Stage(
+                name="probe",
+                preprocess=lambda item: np.array([len(item)], np.float32),
+                device_fn=jax.jit(lambda x: x * 2),
+                postprocess=lambda decoded, row: float(row[0]),
+            )
+            pipe = IngestPipeline(
+                build_mesh(), [stage], batch_size=8,
+                cache_namespace="bulktest/ingest/hash@1",
+            )
+            items = [f"payload-{i}".encode() for i in range(12)]
+            records = pipe.run_all(items)
+            assert len(records) == 12
+            assert len(calls) == 12  # exactly one hash per item
+        finally:
+            monkeypatch.setenv("LUMEN_CACHE_BYTES", "0")
+            reset_result_cache()

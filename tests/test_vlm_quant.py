@@ -163,6 +163,73 @@ class TestQuantServing:
             QDense(16, kernel_mode="dyanmic").apply(params, x)
 
 
+class TestQ8RouteGate:
+    """ISSUE 5 satellite: the VLM decode route gets the same warmup A/B
+    auto-fallback the CLIP q8 route has — q8 only engages when it wins."""
+
+    def test_bf16_pin_skips_quantization(self, model_dir, monkeypatch):
+        monkeypatch.setenv("LUMEN_VLM_Q8_ROUTE", "bf16")
+        mgr = _mgr(model_dir, "int8")
+        try:
+            assert mgr.quant_route == "bf16"
+            assert mgr.cfg.decoder.weight_quant is None
+            # No (q, scale) leaves anywhere: quantization never ran.
+            attn = mgr.params["decoder"]["layers_0"]["attn"]["q_proj"]
+            assert "q" not in attn and "kernel" in attn
+            out = mgr.generate([ChatMessage(role="user", content="describe")], max_new_tokens=4)
+            assert out.tokens
+        finally:
+            mgr.close()
+
+    def test_auto_without_warmup_honors_opt_in(self, model_dir, monkeypatch):
+        monkeypatch.delenv("LUMEN_VLM_Q8_ROUTE", raising=False)
+        mgr = _mgr(model_dir, "int8")  # warmup=False: nothing to time against
+        try:
+            assert mgr.quant_route == "int8"
+            attn = mgr.params["decoder"]["layers_0"]["attn"]["q_proj"]
+            assert attn["q"].dtype == jnp.int8
+        finally:
+            mgr.close()
+
+    @pytest.mark.parametrize("q8_tps,expect_route", [(50.0, "bf16"), (400.0, "int8")])
+    def test_warmup_ab_picks_winner(self, model_dir, monkeypatch, q8_tps, expect_route):
+        """The A/B verdict follows the measurement (timing monkeypatched
+        for determinism: bf16 pinned at 100 tokens/s)."""
+        monkeypatch.setenv("LUMEN_VLM_Q8_ROUTE", "auto")
+
+        def fake_time(self, model, cfg, params, quantized):
+            return q8_tps if quantized else 100.0
+
+        monkeypatch.setattr(VLMManager, "_time_decode_route", fake_time)
+        mgr = VLMManager(
+            model_dir, dtype="float32", max_seq=128, max_new_cap=8,
+            prefill_buckets=(16, 32), quantize="int8", warmup=True,
+        )
+        mgr.initialize()
+        try:
+            assert mgr.quant_route == expect_route
+            assert mgr.quant_speedup == pytest.approx(q8_tps / 100.0)
+            from lumen_tpu.utils.metrics import metrics
+
+            gauge = metrics.snapshot()["gauges"][f"vlm-quant:{mgr.model_id}"]
+            assert gauge["int8_active"] == (1 if expect_route == "int8" else 0)
+            assert gauge["q8_speedup_pct"] == pytest.approx(q8_tps, abs=0.2)
+            # The capability surface reflects the real route.
+            from lumen_tpu.serving.services.vlm_service import VlmService
+
+            cap = VlmService(mgr).capability()
+            assert ("int8" in list(cap.precisions)) == (expect_route == "int8")
+            assert cap.extra["quant_route"] == expect_route
+            out = mgr.generate([ChatMessage(role="user", content="describe")], max_new_tokens=4)
+            assert out.tokens
+        finally:
+            mgr.close()
+        # close() unregisters the route gauge.
+        from lumen_tpu.utils.metrics import metrics
+
+        assert f"vlm-quant:{mgr.model_id}" not in metrics.snapshot().get("gauges", {})
+
+
 class TestUntiedLmHead:
     def test_untied_lm_head_quantizes_and_gates(self):
         """tie_word_embeddings=False ships an lm_head kernel; the quantized
