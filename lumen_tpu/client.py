@@ -35,8 +35,21 @@ from google.protobuf import empty_pb2
 
 from lumen_tpu.serving.proto import ml_service_pb2 as pb
 from lumen_tpu.serving.proto import ml_service_pb2_grpc as pbg
+from lumen_tpu.utils import trace as utrace
 
 CHUNK = 1 << 20  # 1 MiB
+
+
+def _begin_client_trace(task: str):
+    """Client half of end-to-end tracing (``LUMEN_TRACE_SAMPLE`` > 0 in
+    the CLIENT environment): returns ``(trace, grpc_metadata)``. The
+    trace id rides the ``lumen-trace`` request-metadata key, so the
+    server's ``/traces`` records carry the SAME id as this process's
+    recorder — one grep joins both sides of the RPC."""
+    tr = utrace.begin_request(f"client:{task}")
+    if tr is None:
+        return None, None
+    return tr, ((utrace.TRACE_META_KEY, tr.trace_id),)
 
 
 def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
@@ -91,8 +104,32 @@ def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream"
     down its streammates."""
     from lumen_tpu.serving import ServiceError, reassemble_result
 
+    tr, md = _begin_client_trace(task)
+    # payloads may be any iterable (downstream only enumerates it) — a
+    # len() here would make enabling tracing reject generator inputs.
+    n_items = str(len(payloads)) if hasattr(payloads, "__len__") else "?"
+    rpc_span = tr.begin("rpc.client", {"items": n_items}) if tr else None
     pending: dict[str, list] = {}
-    for resp in stub.Infer(_bulk_requests(task, payloads, mime, meta or {}), timeout=timeout):
+    try:
+        yield from _infer_bulk_stream(
+            stub, task, payloads, mime, meta, timeout, md, pending,
+            ServiceError, reassemble_result,
+        )
+    except BaseException as e:
+        if rpc_span is not None:
+            rpc_span.end(error=type(e).__name__)
+        utrace.finish_request(tr, error=f"{type(e).__name__}: {e}" if tr else None)
+        raise
+    else:
+        if rpc_span is not None:
+            rpc_span.end()
+        utrace.finish_request(tr)
+
+
+def _infer_bulk_stream(stub, task, payloads, mime, meta, timeout, md, pending,
+                       ServiceError, reassemble_result):
+    kwargs = {"timeout": timeout} if md is None else {"timeout": timeout, "metadata": md}
+    for resp in stub.Infer(_bulk_requests(task, payloads, mime, meta or {}), **kwargs):
         cid = resp.correlation_id
         if resp.HasField("error") and (resp.error.code or resp.error.message):
             pending.pop(cid, None)
@@ -168,10 +205,30 @@ def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
 
 def _infer_once(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
                 timeout: float, stream: bool, state: dict):
+    tr, md = _begin_client_trace(task)
+    rpc_span = tr.begin("rpc.client") if tr is not None else None
+    try:
+        out = _infer_attempt(stub, task, payload, mime, meta, timeout, stream, state, md)
+    except BaseException as e:
+        if tr is not None:
+            rpc_span.end(error=type(e).__name__)
+            utrace.finish_request(tr, error=f"{type(e).__name__}: {e}")
+        raise
+    if tr is not None:
+        rpc_span.end()
+        utrace.finish_request(tr)
+    return out
+
+
+def _infer_attempt(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
+                   timeout: float, stream: bool, state: dict, md=None):
     from lumen_tpu.serving import ServiceError, reassemble_result
 
     state["responded"] = False
-    responses = stub.Infer(_requests(task, payload, mime, meta), timeout=timeout)
+    # metadata only when tracing is on: fakes/stubs without the kwarg
+    # (and the untraced hot path) keep the exact pre-trace call shape.
+    kwargs = {"timeout": timeout} if md is None else {"timeout": timeout, "metadata": md}
+    responses = stub.Infer(_requests(task, payload, mime, meta), **kwargs)
     chunked: list = []
     saw_deltas = False
     for resp in responses:

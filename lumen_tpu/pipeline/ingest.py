@@ -39,6 +39,7 @@ from lumen_tpu.runtime.decode_pool import DecodePool, get_decode_pool
 from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
 from lumen_tpu.runtime.quarantine import QuarantineRegistry, get_quarantine
 from lumen_tpu.runtime.result_cache import ResultCache, get_result_cache, make_key
+from lumen_tpu.runtime.trace import begin_request, finish_request
 
 logger = logging.getLogger(__name__)
 
@@ -103,7 +104,10 @@ class IngestStats:
 
 
 class _Batch:
-    __slots__ = ("decoded", "inputs", "outputs", "n", "indices", "keys")
+    __slots__ = (
+        "decoded", "inputs", "outputs", "n", "indices", "keys",
+        "trace", "qspan", "wspan",
+    )
 
     def __init__(
         self,
@@ -122,6 +126,12 @@ class _Batch:
         # the item is uncacheable or caching is off).
         self.indices = indices if indices is not None else list(range(n))
         self.keys = keys if keys is not None else [None] * n
+        # Per-batch request trace (LUMEN_TRACE_SAMPLE > 0): the trace and
+        # its open queue-wait / inflight-wait spans hop from the producer
+        # thread to the consumer with the batch — contextvars don't cross.
+        self.trace = None
+        self.qspan = None
+        self.wspan = None
 
 
 class IngestPipeline:
@@ -207,6 +217,12 @@ class IngestPipeline:
     # -- producer lane ----------------------------------------------------
 
     def _prepare(self, pool: DecodePool, chunk: list[tuple[int, Any, str | None]]) -> _Batch:
+        # One trace per BATCH (not per item — 64x cheaper and the stages
+        # are batch-granular anyway): decode covers the producer lane
+        # (pool fan-out + stack + transfer), queue is the hand-off wait to
+        # the consumer, then dispatch/fetch/post land on the consumer.
+        tr = begin_request("ingest")
+        dspan = tr.begin("decode", {"items": len(chunk)}) if tr is not None else None
         raw_items = [item for _, item, _ in chunk]
         decoded = pool.map(self.decode, raw_items)
         inputs: dict[str, Any] = {}
@@ -220,13 +236,18 @@ class IngestPipeline:
         # own `tasks` gauge is process-wide, so THIS run's decode work has
         # to be tallied where it is submitted.
         self._run_pool_tasks += len(raw_items) * (1 + len(self.stages))
-        return _Batch(
+        batch = _Batch(
             decoded,
             inputs,
             len(raw_items),
             [idx for idx, _, _ in chunk],
             [key for _, _, key in chunk],
         )
+        if tr is not None:
+            dspan.end()
+            batch.trace = tr
+            batch.qspan = tr.begin("queue")
+        return batch
 
     @staticmethod
     def _offer(out: queue.Queue, entry, stop: threading.Event) -> bool:
@@ -399,12 +420,26 @@ class IngestPipeline:
                             rec["_index"] = i
                             finished[i] = rec
                         continue
+                    if got.qspan is not None:
+                        got.qspan.end()  # thread hop: producer -> consumer
                     try:
-                        for stage in self.stages:
-                            got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
+                        if got.trace is not None:
+                            with got.trace.span("device.dispatch"):
+                                for stage in self.stages:
+                                    got.outputs[stage.name] = stage.device_fn(
+                                        got.inputs[stage.name]
+                                    )
+                        else:
+                            for stage in self.stages:
+                                got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     except Exception as e:  # noqa: BLE001 - contain, don't abort the run
                         self._salvage_batch(got, e, cache, fence, quarantine, finished)
                         continue
+                    if got.trace is not None:
+                        # Device compute overlaps this wait (async dispatch):
+                        # the batch sits dispatched-but-unfetched while the
+                        # consumer settles its predecessors.
+                        got.wspan = got.trace.begin("inflight")
                     pending.append(got)
                     self.stats.max_inflight = max(self.stats.max_inflight, len(pending))
                 yielded = False
@@ -422,16 +457,24 @@ class IngestPipeline:
                     continue  # block in the fill loop for more input
                 batch = pending.popleft()
                 t0 = time.perf_counter()
+                if batch.wspan is not None:
+                    batch.wspan.end()
+                fspan = batch.trace.begin("fetch") if batch.trace is not None else None
                 try:
                     rows_by_stage = {
                         s.name: unstack(batch.outputs[s.name], batch.n) for s in self.stages
                     }
                 except Exception as e:  # noqa: BLE001 - async dispatch: errors often land at fetch
+                    if fspan is not None:
+                        fspan.end(error=type(e).__name__)
                     self.stats.device_s += time.perf_counter() - t0
                     self._salvage_batch(batch, e, cache, fence, quarantine, finished)
                     continue
+                if fspan is not None:
+                    fspan.end()
                 self.stats.device_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
+                pspan = batch.trace.begin("post") if batch.trace is not None else None
                 for i in range(batch.n):
                     record: dict[str, Any] = {"_index": batch.indices[i]}
                     for s in self.stages:
@@ -451,6 +494,9 @@ class IngestPipeline:
                             fence=fence,
                         )
                     finished[batch.indices[i]] = record
+                if pspan is not None:
+                    pspan.end()
+                finish_request(batch.trace)
                 self.stats.post_s += time.perf_counter() - t0
                 self.stats.batches += 1
         finally:
@@ -549,6 +595,7 @@ class IngestPipeline:
                 finished[batch.indices[i]]["_error"] = (
                     f"batch: {type(error).__name__}: {error}"
                 )
+        finish_request(batch.trace, error=f"{type(error).__name__}: {error}")
         self.stats.post_s += time.perf_counter() - t0
         self.stats.batches += 1
 

@@ -84,8 +84,28 @@ from ..utils.deadline import (
 )
 from ..utils.metrics import metrics
 from .quarantine import QuarantineRegistry, get_quarantine
+from .trace import current_trace
 
 logger = logging.getLogger(__name__)
+
+
+def _end_trace_spans(fut: Future) -> None:
+    """Done-callback backstop for the request-trace span handles riding a
+    caller future: whatever settles the future (fetch worker, bisection,
+    watchdog, close-time drain, a caller's cancel) also closes its open
+    spans — ``SpanHandle.end`` is idempotent, so the explicit ends on the
+    happy path stay authoritative and this only catches the error lanes."""
+    if getattr(fut, "_lumen_settled", None) is None:
+        fut._lumen_settled = time.perf_counter()  # cancel path: no _settle ran
+    if fut.cancelled():
+        err: str | None = "cancelled"
+    else:
+        e = fut.exception()
+        err = type(e).__name__ if e is not None else None
+    for attr in ("_lumen_collect", "_lumen_device"):
+        h = getattr(fut, attr, None)
+        if h is not None:
+            h.end(error=err)
 
 
 def default_buckets(max_batch: int) -> list[int]:
@@ -343,6 +363,10 @@ def _settle(fut: Future, result: Any = None, exception: BaseException | None = N
     check and its set — set_result/set_exception on a cancelled Future
     raises InvalidStateError, which must not kill the collector thread.
     Returns True when the future was actually settled."""
+    # Settle instant for the traced caller's ``batch.wake`` span — stamped
+    # BEFORE set_result because the waiter wakes before done-callbacks run.
+    if getattr(fut, "_lumen_trace", None) is not None:
+        fut._lumen_settled = time.perf_counter()
     if fut.cancelled():
         return False
     try:
@@ -634,6 +658,16 @@ class MicroBatcher:
         if self.adaptive:
             self._window.observe()
         fut: Future = Future()
+        # Request tracing: the collect span begins HERE (caller thread,
+        # where the contextvar is visible) and ends when the collector
+        # picks the batch for dispatch — queue wait + collect window, one
+        # number. The handle rides the future because contextvars do not
+        # cross into the collector/fetch threads.
+        tr = current_trace()
+        if tr is not None:
+            fut._lumen_trace = tr
+            fut._lumen_collect = tr.begin("batch.collect", {"batcher": self.name})
+            fut.add_done_callback(_end_trace_spans)
         with self._submit_lock:
             # Wedge check INSIDE the lock: _fire_watchdog sets _wedged and
             # drains the queue under the same lock, so an entry can never
@@ -671,7 +705,25 @@ class MicroBatcher:
             timeout = max(rem, 0.0)
         fut = self.submit(item, fingerprint=fingerprint)
         try:
-            return fut.result(timeout=timeout)
+            result = fut.result(timeout=timeout)
+            # Close the span handles HERE, not only in the done-callback:
+            # set_result wakes this waiter BEFORE callbacks run, so the
+            # request could otherwise finish its trace while the fetch
+            # worker is still descheduled — dropping the device span from
+            # exactly the slow trace being captured. end() is idempotent;
+            # whichever side runs first wins.
+            if getattr(fut, "_lumen_trace", None) is not None:
+                _end_trace_spans(fut)
+                # Attribution completeness: on a loaded host the gap
+                # between the fetch worker settling the future and THIS
+                # thread being rescheduled is real milliseconds — charge
+                # it to ``batch.wake`` instead of leaving it dark.
+                settled = getattr(fut, "_lumen_settled", None)
+                if settled is not None:
+                    fut._lumen_trace.add_span(
+                        "batch.wake", settled, time.perf_counter()
+                    )
+            return result
         except FuturesTimeout:
             if not deadline_bounded:
                 raise
@@ -686,6 +738,14 @@ class MicroBatcher:
             raise DeadlineExpired(
                 f"{self.name}: request deadline expired while waiting for a batch slot"
             ) from None
+        except BaseException:
+            # Settled-with-exception path (poison, watchdog, shed at
+            # dispatch...): same span-close determinism as the success
+            # path — the error verdict must reach the trace before the
+            # request finishes it.
+            if fut.done() and getattr(fut, "_lumen_trace", None) is not None:
+                _end_trace_spans(fut)
+            raise
 
     # -- collector thread -------------------------------------------------
 
@@ -801,6 +861,18 @@ class MicroBatcher:
         n = len(items)
         size = bucket_for(n, self.buckets)
         self._occupancy.record(n, size)
+        # Trace hand-off at the thread hop: collect ends on THIS (collector)
+        # thread; the device span opens here and is closed by whatever
+        # settles the future (fetch worker on the happy path — see
+        # ``_end_trace_spans``), so it covers dispatch + device compute +
+        # the one device->host transfer, bisection passes included.
+        for _, fut, _ in live:
+            h = getattr(fut, "_lumen_collect", None)
+            if h is not None:
+                h.end()
+                fut._lumen_device = fut._lumen_trace.begin(
+                    "batch.device", {"batcher": self.name, "n": n, "size": size}
+                )
         arena = None
         try:
             stacked, arena = self._stack(items, size)

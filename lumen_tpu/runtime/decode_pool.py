@@ -35,6 +35,7 @@ from typing import Any, Callable, Iterable
 
 from ..utils.deadline import DeadlineExpired, get_deadline
 from ..utils.metrics import metrics
+from .trace import current_trace
 
 DECODE_WORKERS_ENV = "LUMEN_DECODE_WORKERS"
 
@@ -90,6 +91,8 @@ class DecodePool:
         kwargs: dict,
         t_submit: float,
         deadline: float | None,
+        qspan=None,
+        box: dict | None = None,
     ) -> Any:
         self._local.in_pool = True
         wait_ms = (time.perf_counter() - t_submit) * 1e3
@@ -97,6 +100,11 @@ class DecodePool:
             self._pending -= 1
             self._tasks += 1
             self._wait_ms.append(wait_ms)
+        # Trace hand-off at the thread hop: the queue span (begun on the
+        # submitting thread) ends here on the pool worker, and the run
+        # span covers the decode itself.
+        if qspan is not None:
+            qspan.end()
         # Same contract as the batcher's pre-dispatch gate, one stage
         # earlier: a request whose deadline expired while it sat in the
         # decode queue must not burn a pool worker decoding an image
@@ -107,24 +115,58 @@ class DecodePool:
             raise DeadlineExpired(
                 f"{self.name}: request deadline expired while queued for decode"
             )
-        return fn(*args, **kwargs)
+        if qspan is None:
+            return fn(*args, **kwargs)
+        rspan = qspan.trace.begin("decode", {"pool": self.name})
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:
+            rspan.end(error=type(e).__name__)
+            raise
+        rspan.end()
+        if box is not None:
+            # Completion instant for the caller's ``decode.wake`` span —
+            # written before _task returns, so run() can never read a
+            # half-stamped box.
+            box["settled"] = time.perf_counter()
+        return result
 
     def submit(self, fn: Callable, *args, **kwargs) -> Future:
         # The ambient deadline is a contextvar of the CALLING thread;
-        # capture it here, not in the worker.
+        # capture it here, not in the worker. Same for the request trace:
+        # the queue span must begin where the contextvar is visible.
         deadline = get_deadline()
+        tr = current_trace()
+        qspan = box = None
+        if tr is not None:
+            qspan = tr.begin("decode.queue", {"pool": self.name})
+            box = {}
         with self._lock:
             self._pending += 1
-        return self._pool.submit(
-            self._task, fn, args, kwargs, time.perf_counter(), deadline
+        fut = self._pool.submit(
+            self._task, fn, args, kwargs, time.perf_counter(), deadline, qspan, box
         )
+        if tr is not None:
+            fut._lumen_trace = tr
+            fut._lumen_box = box
+        return fut
 
     def run(self, fn: Callable, *args, **kwargs) -> Any:
         """Run ``fn`` in the pool and wait for its result (exceptions
         propagate unchanged). Inline when already on a pool thread."""
         if getattr(self._local, "in_pool", False):
             return fn(*args, **kwargs)
-        return self.submit(fn, *args, **kwargs).result()
+        fut = self.submit(fn, *args, **kwargs)
+        result = fut.result()
+        # Attribution completeness: on a loaded host the worker finishing
+        # and THIS thread resuming are milliseconds apart — charge that
+        # scheduler gap to ``decode.wake`` instead of leaving it dark.
+        box = getattr(fut, "_lumen_box", None)
+        if box is not None and "settled" in box:
+            fut._lumen_trace.add_span(
+                "decode.wake", box["settled"], time.perf_counter()
+            )
+        return result
 
     def map(self, fn: Callable, items: Iterable) -> list:
         """Parallel map preserving input order (inline on a pool thread)."""

@@ -168,8 +168,16 @@ class QuarantineRegistry:
     def check(self, key: str | None) -> None:
         """Raise :class:`PoisonInput` when ``key`` is quarantined — the
         up-front rejection every layer calls before spending work. Marks
-        the request-note scope so the response carries ``quarantined``."""
+        the request-note scope so the response carries ``quarantined``,
+        and records a ``quarantine`` span on the active request trace
+        (only when one is live — the bare lookup stays a dict probe)."""
+        from .trace import current_trace
+
+        tr = current_trace()
+        span = tr.begin("quarantine") if tr is not None else None
         reason = self.reason(key)
+        if span is not None:
+            span.end(rejected="1" if reason is not None else "0")
         if reason is not None:
             _mark("quarantined")
             raise PoisonInput(

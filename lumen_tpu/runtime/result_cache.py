@@ -64,6 +64,7 @@ import numpy as np
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, remaining
 from ..utils.metrics import metrics
 from ..utils.request_notes import mark as _mark
+from .trace import current_trace
 
 logger = logging.getLogger(__name__)
 
@@ -235,7 +236,20 @@ class ResultCache:
 
     def get(self, key: str, clone: Callable[[Any], Any] | None = None) -> tuple[bool, Any]:
         """RAM-then-disk probe. Returns ``(found, value)``; a disk hit is
-        promoted into the RAM tier. Marks the request-note scope on hit."""
+        promoted into the RAM tier. Marks the request-note scope on hit,
+        and records a ``cache.lookup`` span on the active request trace."""
+        tr = current_trace()
+        if tr is None:
+            return self._get(key, clone)
+        h = tr.begin("cache.lookup")
+        found = False
+        try:
+            found, value = self._get(key, clone)
+            return found, value
+        finally:
+            h.end(hit="1" if found else "0")
+
+    def _get(self, key: str, clone: Callable[[Any], Any] | None = None) -> tuple[bool, Any]:
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
@@ -406,6 +420,8 @@ class ResultCache:
                 break
             with self._lock:
                 self._waiting += 1
+            tr = current_trace()
+            wspan = tr.begin("cache.wait") if tr is not None else None
             try:
                 # Bounded by the WAITER's own ambient request deadline
                 # (None = wait for the owner, whose resolution is
@@ -462,6 +478,8 @@ class ResultCache:
                 _mark("coalesced")
                 return clone(value) if clone else value
             finally:
+                if wspan is not None:
+                    wspan.end()
                 with self._lock:
                     self._waiting -= 1
         # -- owner path

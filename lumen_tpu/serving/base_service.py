@@ -44,6 +44,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from ..utils import deadline as request_deadline, request_notes
+from ..utils import trace as request_trace
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
 from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
@@ -182,6 +183,9 @@ class _Assembly:
     meta: dict[str, str] = field(default_factory=dict)
     chunks: dict[int, bytes] = field(default_factory=dict)
     total: int = 0
+    #: first-chunk arrival instant — the request trace back-dates to here
+    #: so the ``rpc.recv`` span covers chunked-payload reassembly.
+    t0: float = field(default_factory=time.perf_counter)
 
     def add(self, req: pb.InferRequest) -> None:
         if not self.task:
@@ -405,7 +409,59 @@ class BaseService(InferenceServicer):
             return None
         return None if rem is None else time.monotonic() + rem
 
+    @staticmethod
+    def _trace_id_from(context) -> str | None:
+        """Client-propagated trace id from the ``lumen-trace`` gRPC
+        request metadata key (None on stub contexts or untraced callers)
+        — lets a client stitch its side of the request into ``/traces``."""
+        md = getattr(context, "invocation_metadata", None)
+        if not callable(md):
+            return None
+        try:
+            for item in md() or ():
+                key = getattr(item, "key", None)
+                value = getattr(item, "value", None)
+                if key is None and isinstance(item, (tuple, list)) and len(item) == 2:
+                    key, value = item
+                if key == request_trace.TRACE_META_KEY and value:
+                    return str(value)
+        except Exception:  # noqa: BLE001 - tracing must never break dispatch
+            return None
+        return None
+
     def _dispatch(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
+        """Trace-lifecycle wrapper around :meth:`_dispatch_inner`. With
+        tracing off (``LUMEN_TRACE_SAMPLE=0``, the default) the cost is
+        one cached env check; with it on, the request gets a contextvar-
+        propagated :class:`~lumen_tpu.utils.trace.Trace` back-dated to
+        the first chunk's arrival (the ``rpc.recv`` span), every error
+        response marks the trace errored (tail sampling always retains
+        those), and the finished trace lands in the process recorder."""
+        tr = None
+        if request_trace.enabled():
+            tr = request_trace.begin_request(
+                asm.task, trace_id=self._trace_id_from(context), t0=asm.t0
+            )
+        if tr is None:
+            yield from self._dispatch_inner(cid, asm, context)
+            return
+        tr.add_span("rpc.recv", asm.t0, time.perf_counter())
+        token = request_trace.activate(tr)
+        try:
+            for resp in self._dispatch_inner(cid, asm, context):
+                if resp.HasField("error"):
+                    tr.set_error(resp.error.message or "error")
+                yield resp
+        except BaseException as e:
+            # Includes GeneratorExit: a client that hung up mid-stream
+            # leaves an errored (always-retained) trace behind.
+            tr.set_error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            request_trace.deactivate(token)
+            request_trace.finish_request(tr)
+
+    def _dispatch_inner(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
         task = self.registry.get(asm.task)
         if task is None:
             yield self._error(
@@ -423,7 +479,11 @@ class BaseService(InferenceServicer):
         # shed-by-breaker (backend broken, back off hard) from
         # shed-by-queue (overload, back off briefly).
         if self.breaker is not None:
+            tr = request_trace.current_trace()
+            bspan = tr.begin("breaker") if tr is not None else None
             admitted, retry_after = self.breaker.allow()
+            if bspan is not None:
+                bspan.end(admitted="1" if admitted else "0")
             if not admitted:
                 metrics.count("breaker_sheds")
                 metrics.count_error(asm.task)
@@ -509,7 +569,17 @@ class BaseService(InferenceServicer):
                     meta["cache_hit"] = "1"
                 if marks.get("coalesced"):
                     meta["cache_coalesced"] = "1"
+                tr = request_trace.current_trace()
+                ser = None
+                if tr is not None:
+                    # Echo the id so the client can join its span with
+                    # ours; the span covers protobuf construction AND the
+                    # consumer-side sends (the generator resumes per chunk).
+                    meta[request_trace.TRACE_RESPONSE_META] = tr.trace_id
+                    ser = tr.begin("serialize", {"bytes": len(result)})
                 yield from self._chunked_response(cid, result, mime, meta)
+                if ser is not None:
+                    ser.end()
             else:
                 # Streaming handler: iterator of (bytes, mime, meta) chunks.
                 yield from self._stream_out(cid, asm.task, out, t0)
@@ -604,6 +674,9 @@ class BaseService(InferenceServicer):
         lat_ms = (time.perf_counter() - t0) * 1e3
         metrics.observe(task_name, lat_ms)
         meta["lat_ms"] = f"{lat_ms:.2f}"
+        tr = request_trace.current_trace()
+        if tr is not None:
+            meta[request_trace.TRACE_RESPONSE_META] = tr.trace_id
         yield pb.InferResponse(
             correlation_id=cid,
             is_final=True,

@@ -2,16 +2,22 @@
 
 A stdlib ``http.server`` thread exposing:
 
-- ``GET  /metrics``        — Prometheus text exposition,
+- ``GET  /metrics``        — Prometheus text exposition (cumulative
+  ``le``-labeled ``_bucket`` histograms + ``_sum``/``_count``),
 - ``GET  /metrics.json``   — JSON snapshot (per-task p50/p90/p99, errors),
+- ``GET  /traces``         — retained request traces (tail-sampled ring:
+  errors + slowest-N + a sampled fraction; see ``utils/trace.py``),
+- ``GET  /traces/perfetto``— the same traces as Chrome trace-event JSON,
+  loadable in Perfetto/chrome://tracing next to a ``jax.profiler`` dump,
 - ``POST /profiler/start`` — begin a ``jax.profiler`` trace (query
   parameter ``dir=...``, default ``/tmp/lumen-tpu-trace``),
 - ``POST /profiler/stop``  — end the trace; response carries the trace dir.
 
 Fills SURVEY.md §5's gap ("Tracing/profiling: none" in the reference): the
 profiler endpoints give on-demand XLA/TPU traces viewable in TensorBoard or
-Perfetto, and the histograms come from the per-dispatch hook in
-``base_service.py``. Enabled with ``lumen-tpu --metrics-port N``.
+Perfetto, the request traces attribute per-stage host latency (the gap the
+device profiler cannot see), and the histograms come from the per-dispatch
+hook in ``base_service.py``. Enabled with ``lumen-tpu --metrics-port N``.
 """
 
 from __future__ import annotations
@@ -23,6 +29,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils.metrics import metrics
+from ..utils.trace import get_recorder
 
 logger = logging.getLogger(__name__)
 
@@ -92,6 +99,10 @@ class MetricsServer:
                     snap = metrics.snapshot()
                     snap["device_memory"] = metrics.device_memory()
                     self._send(200, json.dumps(snap))
+                elif path == "/traces":
+                    self._send(200, json.dumps(get_recorder().export()))
+                elif path == "/traces/perfetto":
+                    self._send(200, json.dumps(get_recorder().perfetto()))
                 elif path == "/health":
                     self._send(200, json.dumps({"status": "ok"}))
                 else:

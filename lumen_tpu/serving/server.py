@@ -269,6 +269,21 @@ def serve(
         metrics_server = MetricsServer(port=metrics_port, host="127.0.0.1")
         metrics_server.start()
 
+    # Request-tracing status belongs in the boot log: whether per-stage
+    # attribution (GET /traces on the sidecar) is live is a deploy-time
+    # fact an operator should not have to probe for.
+    from ..utils import trace as request_trace
+
+    if request_trace.enabled():
+        logger.info(
+            "request tracing ON (sample=%.3g, ring=%d, slowest-%d retained)",
+            request_trace.sample_rate(),
+            request_trace.trace_ring(),
+            request_trace.trace_slow_n(),
+        )
+    else:
+        logger.info("request tracing off (set LUMEN_TRACE_SAMPLE to enable)")
+
     logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
     for name, svc in services.items():
         logger.info("  %s [%s] tasks: %s", name, svc.status(), svc.registry.task_names())

@@ -56,6 +56,29 @@ class LatencyHistogram:
                     return self.bounds[i] if i < len(self.bounds) else self.max_ms
             return self.max_ms
 
+    def cumulative(self) -> list[tuple[float, int]]:
+        """``(upper_bound_ms, cumulative_count)`` pairs ending with
+        ``(inf, total)`` — the Prometheus histogram ``_bucket`` contract
+        (cumulative ``le`` buckets), not the internal per-bucket counts."""
+        return self.exposition()[0]
+
+    def exposition(self) -> tuple[list[tuple[float, int]], int, float]:
+        """``(cumulative_buckets, total, sum_ms)`` from ONE locked read:
+        the exposition format requires ``_bucket{le="+Inf"}`` == ``_count``
+        within a scrape, so buckets and totals must not come from two
+        reads with observes landing in between."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.total
+            sum_ms = self.sum_ms
+        out: list[tuple[float, int]] = []
+        seen = 0
+        for bound, n in zip(self.bounds, counts):
+            seen += n
+            out.append((bound, seen))
+        out.append((math.inf, total))
+        return out, total, sum_ms
+
     def snapshot(self) -> dict:
         with self._lock:
             total, s = self.total, self.sum_ms
@@ -219,21 +242,49 @@ class MetricsRegistry:
         except Exception:  # noqa: BLE001 - metrics must never take down serving
             return {}
 
+    @staticmethod
+    def _le(bound: float) -> str:
+        return "+Inf" if math.isinf(bound) else f"{bound:.6g}"
+
     def prometheus_lines(self) -> Iterator[str]:
-        """Prometheus text exposition of the same data."""
+        """Prometheus text exposition of the same data. Latency is a real
+        cumulative histogram (``le``-labeled ``_bucket`` series plus
+        ``_sum``/``_count``) — scrapeable by an actual Prometheus/Grafana
+        stack (``histogram_quantile()`` works server-side), unlike the
+        snapshot-only quantile gauges this replaced, which could not be
+        aggregated across instances or re-quantiled over time ranges.
+        ``/metrics.json`` keeps the p50/p90/p99 snapshot shape."""
         snap = self.snapshot()
+        with self._lock:
+            hists = dict(self._hist)
         yield "# TYPE lumen_task_requests_total counter"
         for name, s in snap["tasks"].items():
             yield f'lumen_task_requests_total{{task="{name}"}} {s["count"]}'
         yield "# TYPE lumen_task_errors_total counter"
         for name, s in snap["tasks"].items():
             yield f'lumen_task_errors_total{{task="{name}"}} {s["errors"]}'
-        yield "# TYPE lumen_task_latency_ms summary"
+        yield "# TYPE lumen_task_latency_ms histogram"
         for name, s in snap["tasks"].items():
-            for q, key in (("0.5", "p50_ms"), ("0.9", "p90_ms"), ("0.99", "p99_ms")):
-                yield f'lumen_task_latency_ms{{task="{name}",quantile="{q}"}} {s[key]}'
-            yield f'lumen_task_latency_ms_sum{{task="{name}"}} {s["sum_ms"]}'
-            yield f'lumen_task_latency_ms_count{{task="{name}"}} {s["count"]}'
+            hist = hists.get(name)
+            if hist is not None:
+                # Buckets + sum + count from ONE locked read: an observe
+                # landing mid-scrape must not make le="+Inf" disagree
+                # with _count (an inconsistent histogram breaks
+                # OpenMetrics validation and bucket-based rate math).
+                buckets, total, sum_ms = hist.exposition()
+                for bound, cum in buckets:
+                    yield (
+                        f'lumen_task_latency_ms_bucket{{task="{name}",'
+                        f'le="{self._le(bound)}"}} {cum}'
+                    )
+                yield f'lumen_task_latency_ms_sum{{task="{name}"}} {round(sum_ms, 3)}'
+                yield f'lumen_task_latency_ms_count{{task="{name}"}} {total}'
+            else:
+                # Error-only task: no histogram yet, but the series must
+                # still be well-formed (a +Inf bucket is mandatory).
+                yield f'lumen_task_latency_ms_bucket{{task="{name}",le="+Inf"}} 0'
+                yield f'lumen_task_latency_ms_sum{{task="{name}"}} 0.0'
+                yield f'lumen_task_latency_ms_count{{task="{name}"}} 0'
         if snap.get("counters"):
             yield "# TYPE lumen_events_total counter"
             for name, val in snap["counters"].items():

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Assert every metric name published by ``lumen_tpu/`` is documented in
+``docs/OBSERVABILITY.md``.
+
+The metric surface (counters via ``metrics.count``, latency histograms
+via ``metrics.observe`` with a literal/prefixed name, gauge providers via
+``metrics.register_gauges``) is an operator API: dashboards and alerts
+are built on these names, so a counter added in code but missing from the
+cookbook is silent drift. This check is collected by pytest
+(``tests/test_check_metrics.py``) so tier-1 fails on the gap, and runs
+standalone::
+
+    python scripts/check_metrics.py
+
+Mechanics: regex scan over the package source for name literals. F-string
+names (``f"deadline_drops:{self.name}"``, ``f"stage:{task}/..."``) are
+reduced to their literal prefix before the first ``{`` — the cookbook
+documents the prefix family (``deadline_drops:*``, ``stage:*``). Purely
+dynamic names (``metrics.observe(asm.task, ...)`` — the per-task request
+histograms) have no literal to scan and are documented as the task table
+itself.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+
+#: patterns applied to EVERY package file — each capture is a published
+#: metric name or (for f-strings) a name prefix.
+_PATTERNS = [
+    # counters: metrics.count("name") / metrics.count(f"name:{...}")
+    re.compile(r'metrics\.count\(\s*f?"([^"]+)"'),
+    # result_cache's indirection: self._count("stat", "metric_name")
+    re.compile(r'self\._count\(\s*"[a-z_]+",\s*"([^"]+)"'),
+    # literal-named histograms: metrics.observe("x"/f"stage:{...}")
+    re.compile(r'metrics\.observe\(\s*f?"([^"]+)"'),
+    # gauge providers: metrics.register_gauges("x"/f"batcher:{...}")
+    re.compile(r'register_gauges\(\s*f?"([^"]+)"'),
+]
+
+#: components that call ``register_gauges(name, ...)`` through a variable:
+#: their provider names are the ``name=...`` literals in these files only
+#: (applying that loose pattern package-wide would drag in every flax
+#: submodule name).
+_NAME_VAR_FILES = {"decode_pool.py", "result_cache.py", "quarantine.py"}
+_NAME_VAR_PATTERN = re.compile(r'name(?:: str)? ?= ?f?"([^"]+)"')
+
+
+def _prefix(name: str) -> str:
+    """Reduce an f-string name to its documented literal prefix."""
+    return name.split("{", 1)[0]
+
+
+def published_names() -> set[str]:
+    found: set[str] = set()
+    for dirpath, _, filenames in os.walk(os.path.join(REPO_ROOT, "lumen_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8", errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            patterns = list(_PATTERNS)
+            if fn in _NAME_VAR_FILES:
+                patterns.append(_NAME_VAR_PATTERN)
+            for pat in patterns:
+                for m in pat.findall(text):
+                    name = _prefix(m).strip()
+                    if name:
+                        found.add(name)
+    return found
+
+
+def documented_text() -> str:
+    if not os.path.exists(DOC_PATH):
+        return ""
+    with open(DOC_PATH, encoding="utf-8", errors="ignore") as f:
+        return f.read()
+
+
+def undocumented() -> list[str]:
+    doc = documented_text()
+    return sorted(name for name in published_names() if name not in doc)
+
+
+def main() -> int:
+    missing = undocumented()
+    if missing:
+        print("metric names published in code but missing from docs/OBSERVABILITY.md:")
+        for name in missing:
+            print(f"  {name}")
+        return 1
+    print(f"ok: {len(published_names())} published metric names all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,13 @@ if _ROOT not in sys.path:
 os.environ["LUMEN_CACHE_BYTES"] = "0"
 os.environ.pop("LUMEN_CACHE_DIR", None)
 
+# Request tracing: OFF for the suite (a developer's exported
+# LUMEN_TRACE_* must not leak in — traced requests allocate per-request
+# and the overhead-guard test asserts the disabled path). Tracing tests
+# opt back in with monkeypatched env + reset_recorder().
+for _k in ("LUMEN_TRACE_SAMPLE", "LUMEN_TRACE_RING", "LUMEN_TRACE_SLOW_N"):
+    os.environ.pop(_k, None)
+
 # Circuit breakers: OFF for the suite (LUMEN_BREAKER_FAILURES=0). Several
 # tests drive deliberate failure bursts through serve()-built services; a
 # default-on breaker would flip their expected error codes to UNAVAILABLE

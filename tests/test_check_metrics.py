@@ -1,0 +1,37 @@
+"""Tier-1 gate: every counter/gauge/histogram name published in the
+package appears in the docs/OBSERVABILITY.md cookbook, so the metric
+surface can't silently drift. See scripts/check_metrics.py."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_metrics",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_metrics.py"),
+)
+check_metrics = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_metrics)
+
+
+def test_every_published_metric_is_documented():
+    missing = check_metrics.undocumented()
+    assert not missing, (
+        f"metric names published in code but missing from "
+        f"docs/OBSERVABILITY.md: {missing} — add each to the cookbook "
+        "(counter table / gauge-provider table / histogram section)"
+    )
+
+
+def test_scan_finds_known_names():
+    # Sanity that the scan sees through each pattern family — a regex typo
+    # must not turn the gate into a silent pass.
+    names = check_metrics.published_names()
+    assert "sheds" in names                 # metrics.count literal
+    assert "deadline_drops:" in names       # metrics.count f-string prefix
+    assert "cache_hits" in names            # result_cache _count indirection
+    assert "stage:" in names                # trace-fed histogram prefix
+    assert "batcher:" in names              # register_gauges f-string prefix
+    assert "result_cache" in names          # name-variable provider
+    doc = check_metrics.documented_text()
+    assert "lumen_events_total" in doc

@@ -71,7 +71,31 @@ class TestRegistry:
         reg.observe("face_detect", 3.0)
         text = "\n".join(reg.prometheus_lines())
         assert 'lumen_task_requests_total{task="face_detect"} 1' in text
-        assert 'quantile="0.99"' in text
+        # Conformant cumulative histogram: le-labeled buckets + sum/count
+        # (scrapeable by real Prometheus; histogram_quantile works).
+        assert "# TYPE lumen_task_latency_ms histogram" in text
+        assert 'lumen_task_latency_ms_bucket{task="face_detect",le="+Inf"} 1' in text
+        assert 'lumen_task_latency_ms_count{task="face_detect"} 1' in text
+        assert 'lumen_task_latency_ms_sum{task="face_detect"} 3.0' in text
+        assert "quantile=" not in text  # the old summary gauges are gone
+
+    def test_prometheus_buckets_cumulative(self):
+        reg = MetricsRegistry()
+        for ms in (0.5, 5.0, 5.0, 5000.0):
+            reg.observe("t", ms)
+        lines = [l for l in reg.prometheus_lines() if 'bucket{task="t"' in l]
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+        # Monotone non-decreasing, ending at the total in +Inf.
+        assert counts == sorted(counts)
+        assert lines[-1].startswith('lumen_task_latency_ms_bucket{task="t",le="+Inf"}')
+        assert counts[-1] == 4
+
+    def test_prometheus_error_only_task_still_wellformed(self):
+        reg = MetricsRegistry()
+        reg.count_error("broken")
+        text = "\n".join(reg.prometheus_lines())
+        assert 'lumen_task_latency_ms_bucket{task="broken",le="+Inf"} 0' in text
+        assert 'lumen_task_latency_ms_count{task="broken"} 0' in text
 
     def test_gauge_providers(self):
         reg = MetricsRegistry()
