@@ -8,6 +8,7 @@ request, task keyword on the first message, JSON result bytes back.
 Usage (server from `python -m lumen_tpu.serving.server --config ...`):
 
     python examples/client.py caps
+    python examples/client.py topology
     python examples/client.py health
     python examples/client.py embed-text "a photo of a cat"
     python examples/client.py embed-image photo.jpg
@@ -279,6 +280,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeout", type=float, default=300.0)
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("caps")
+    sub.add_parser(
+        "topology",
+        help="per-service device topology + replica fleet layout "
+        "(StreamCapabilities extra: device_count, mesh_axes, replicas, "
+        "dispatch policy, live replica states)",
+    )
     sub.add_parser("health")
     p = sub.add_parser("embed-text"); p.add_argument("text")
     p = sub.add_parser("embed-image"); p.add_argument("image")
@@ -315,6 +322,24 @@ def main(argv: list[str] | None = None) -> int:
             "runtime": caps.runtime,
             "tasks": [t.name for t in caps.tasks],
         }, indent=2))
+        return 0
+    if args.cmd == "topology":
+        # The per-service capability records carry the fleet layout in
+        # ``extra`` — a fleet-internal client picks its endpoint (and how
+        # hard to fan out) from this, with zero Infer probes.
+        topo_keys = (
+            "device_count", "mesh_axes", "devices_per_replica", "replicas",
+            "replica_policy", "replica_states", "breaker",
+        )
+        out = {}
+        for cap in stub.StreamCapabilities(empty_pb2.Empty(), timeout=args.timeout):
+            extra = dict(cap.extra)
+            out[cap.service_name] = {
+                "models": list(cap.model_ids),
+                "max_concurrency": cap.max_concurrency,
+                **{k: extra[k] for k in topo_keys if k in extra},
+            }
+        print(json.dumps(out, indent=2))
         return 0
     if args.cmd == "health":
         stub.Health(empty_pb2.Empty(), timeout=args.timeout)

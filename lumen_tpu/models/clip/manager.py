@@ -39,9 +39,16 @@ from ...ops.image import (
 )
 from ...runtime.batcher import MicroBatcher, mesh_buckets, mesh_sharded, warmup_batcher
 from ...runtime.decode_pool import get_decode_pool
+from ...runtime.fleet import (
+    batcher_name,
+    build_fleet,
+    each_batcher,
+    plan_replicas,
+    replicate_all,
+    topology_extra,
+)
 from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
-from ...runtime.mesh import build_mesh
 from ...runtime.policy import get_policy
 from ...runtime.weights import load_state_dict
 from ...utils.metrics import metrics
@@ -92,17 +99,30 @@ class CLIPManager:
         classify_mode: Literal["softmax", "cosine"] = "softmax",
         warmup: bool = False,
         quantize: str | None = None,  # None | "int8" (W8A8 tower blocks)
+        name_prefix: str = "clip",
     ):
         if quantize not in (None, "int8"):
             raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
         self.quantize = quantize
+        # Batcher/gauge name scope: "clip" for the default manager (the
+        # historical names — dashboards don't move), the config alias for
+        # siblings (a bioclip manager's batchers are "bioclip-image"/
+        # "bioclip-text", so two managers in one service never collide on
+        # gauges or replica-fleet state keys).
+        self.name_prefix = name_prefix
         self.model_dir = model_dir
         self.dataset_name = dataset
         self.classify_mode = classify_mode
         self.policy = get_policy(dtype)
         self.batch_size = batch_size
         self.max_batch_latency_ms = max_batch_latency_ms
-        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        # Replica fleet (LUMEN_REPLICAS / LUMEN_REPLICAS_CLIP): the host's
+        # devices partition into N slices, one mesh per replica; the plan
+        # is the single all-device mesh of every pre-fleet PR when N=1.
+        # ``self.mesh`` stays the primary (replica-0) mesh — shape logic,
+        # quant-route timing and label embedding all run there.
+        self.fleet_plan = plan_replicas("clip", mesh_axes)
+        self.mesh = self.fleet_plan.meshes[0]
         from ...ops.quant_matmul import note_mesh_model_axis
 
         # TP x int8: pl.pallas_call has no GSPMD sharding rule, so a
@@ -144,8 +164,9 @@ class CLIPManager:
         self.quant_route = "bf16"
         self.quant_speedup: float | None = None  # measured q8/bf16, when timed
         self._initialized = False
-        self._image_batcher: MicroBatcher | None = None
+        self._image_batcher: MicroBatcher | None = None  # or ReplicaSet (fleet)
         self._text_batcher: MicroBatcher | None = None
+        self._fleet_params: list | None = None  # per-replica param placements
         self.label_names: list[str] = []
         self._label_matrix: jax.Array | None = None  # [L, D] unit-norm fp32
 
@@ -292,14 +313,17 @@ class CLIPManager:
                         params, include_text=self.cfg.text_arch != "bert"
                     )
 
-            def place(p, quantized: bool):
+            def place(p, quantized: bool, mesh=None):
                 # DP serving: params replicated over the mesh; micro-batches
                 # are data-sharded so one batched call spreads across every
                 # device (trivial placement on a 1-device mesh). A mesh with
                 # a ``model`` axis additionally tensor-parallelizes the
                 # towers (both towers are standard transformers, so the
-                # shared TP rules apply — SURVEY §2.8).
-                if dict(self.mesh.shape).get("model", 1) > 1:
+                # shared TP rules apply — SURVEY §2.8). Replica fleets call
+                # this once per replica mesh: every slice gets its own full
+                # (or TP-sharded) copy of the winning params.
+                mesh = self.mesh if mesh is None else mesh
+                if dict(mesh.shape).get("model", 1) > 1:
                     from ...parallel.sharding import (
                         INT8_TP_RULES,
                         TRANSFORMER_TP_RULES,
@@ -307,8 +331,8 @@ class CLIPManager:
                     )
 
                     rules = (INT8_TP_RULES if quantized else []) + TRANSFORMER_TP_RULES
-                    return shard_params(p, self.mesh, rules)
-                return replicate(p, self.mesh)
+                    return shard_params(p, mesh, rules)
+                return replicate(p, mesh)
 
             def make_encoders(model):
                 @jax.jit
@@ -335,6 +359,10 @@ class CLIPManager:
             if qparams is None:
                 self.model = base_model
                 self.params = place(params, quantized=False)
+                self._fleet_params = [self.params] + [
+                    place(params, quantized=False, mesh=m)
+                    for m in self.fleet_plan.meshes[1:]
+                ]
                 encode_images, encode_texts = make_encoders(base_model)
             else:
                 encode_images, encode_texts = self._pick_quant_route(
@@ -373,12 +401,15 @@ class CLIPManager:
                 updates["embed_dim"] = dim
             if updates:
                 self.cfg = dataclasses.replace(self.cfg, **updates)
-            self.params = replicate(
-                {
-                    "vision": dict(vision_graph.module.params),
-                    "text": dict(text_graph.module.params),
-                },
-                self.mesh,
+            host_tree = {
+                "vision": dict(vision_graph.module.params),
+                "text": dict(text_graph.module.params),
+            }
+            self.params = replicate(host_tree, self.mesh)
+            # Every replica mesh gets its copy BEFORE the host weights are
+            # released (there is nothing to re-place from afterwards).
+            self._fleet_params = replicate_all(
+                host_tree, self.fleet_plan, primary=self.params
             )
             # The jitted closures only need the graph TOPOLOGY; drop the
             # host-RAM weight copies (params AND the aliasing initializers)
@@ -405,30 +436,50 @@ class CLIPManager:
 
         dp = self.mesh.shape.get("data", 1)
         buckets = mesh_buckets(self.batch_size, dp)
+
         # Batcher fns DISPATCH and return the un-fetched device array: the
         # MicroBatcher's fetch worker does the one blocking device->host
         # transfer per batch, so the next batch stacks/transfers/dispatches
-        # while this one computes (the pipelined serving data path).
-        self._image_batcher = MicroBatcher(
-            mesh_sharded(
-                lambda pixels, n: self._encode_images(self.params, pixels),
-                self.mesh,
-            ),
-            max_batch=buckets[-1],
-            max_latency_ms=self.max_batch_latency_ms,
-            buckets=buckets,
-            name="clip-image",
-        ).start()
-        self._text_batcher = MicroBatcher(
-            mesh_sharded(
-                lambda ids, n: self._encode_texts(self.params, ids),
-                self.mesh,
-            ),
-            max_batch=buckets[-1],
-            max_latency_ms=self.max_batch_latency_ms,
-            buckets=buckets,
-            name="clip-text",
-        ).start()
+        # while this one computes (the pipelined serving data path). Each
+        # replica closes over ITS mesh slice's param placement; build_fleet
+        # hands back the plain single batcher (today's exact path) when the
+        # fleet plan is one replica, a routed ReplicaSet otherwise. The
+        # closures double as the fleet's revive hook: a wedged replica gets
+        # a fresh batcher over the same placed params.
+        def build_image(rid, mesh):
+            params = self._fleet_params[rid or 0]
+            return MicroBatcher(
+                mesh_sharded(
+                    lambda pixels, n, _p=params: self._encode_images(_p, pixels),
+                    mesh,
+                ),
+                max_batch=buckets[-1],
+                max_latency_ms=self.max_batch_latency_ms,
+                buckets=buckets,
+                name=batcher_name(f"{self.name_prefix}-image", rid),
+                replica=None if rid is None else f"r{rid}",
+            ).start()
+
+        def build_text(rid, mesh):
+            params = self._fleet_params[rid or 0]
+            return MicroBatcher(
+                mesh_sharded(
+                    lambda ids, n, _p=params: self._encode_texts(_p, ids),
+                    mesh,
+                ),
+                max_batch=buckets[-1],
+                max_latency_ms=self.max_batch_latency_ms,
+                buckets=buckets,
+                name=batcher_name(f"{self.name_prefix}-text", rid),
+                replica=None if rid is None else f"r{rid}",
+            ).start()
+
+        self._image_batcher = build_fleet(
+            self.fleet_plan, f"{self.name_prefix}-image", build_image
+        )
+        self._text_batcher = build_fleet(
+            self.fleet_plan, f"{self.name_prefix}-text", build_text
+        )
 
         self._load_label_embeddings()
         if self.warmup:
@@ -466,10 +517,12 @@ class CLIPManager:
         the batchers' own callables so the cache is guaranteed to hit."""
         t0 = time.perf_counter()
         size = self.cfg.image_size
-        warmup_batcher(self._image_batcher, lambda b: np.zeros((b, size, size, 3), np.uint8))
-        warmup_batcher(
-            self._text_batcher, lambda b: np.zeros((b, self.cfg.serving_text_length), np.int32)
-        )
+        for b in each_batcher(self._image_batcher):
+            warmup_batcher(b, lambda n: np.zeros((n, size, size, 3), np.uint8))
+        for b in each_batcher(self._text_batcher):
+            warmup_batcher(
+                b, lambda n: np.zeros((n, self.cfg.serving_text_length), np.int32)
+            )
         logger.info("warmup: %d bucket(s) compiled in %.1fs", len(buckets), time.perf_counter() - t0)
 
     def close(self) -> None:
@@ -480,6 +533,11 @@ class CLIPManager:
         if fn := getattr(self, "_route_gauge_fn", None):
             metrics.unregister_gauges(f"clip-quant:{self.model_id}", fn)
         self._initialized = False
+
+    def topology(self) -> dict[str, str]:
+        """Device topology + replica layout for the capability ``extra``
+        (fleet-internal clients pick endpoints from this, not by probing)."""
+        return topology_extra(self.mesh, self._image_batcher, self._text_batcher)
 
     # -- quantization route ------------------------------------------------
 
@@ -510,6 +568,10 @@ class CLIPManager:
         if route == "int8":
             self.quant_route = "int8"
             self.params = place(qparams, quantized=True)
+            self._fleet_params = [self.params] + [
+                place(qparams, quantized=True, mesh=m)
+                for m in self.fleet_plan.meshes[1:]
+            ]
             return make_encoders(q_model)
 
         # One-shot warmup A/B, timed SEQUENTIALLY so peak HBM stays at one
@@ -533,6 +595,10 @@ class CLIPManager:
             )
             self.quant_route = "int8"
             self.params = params_q8
+            self._fleet_params = [self.params] + [
+                place(qparams, quantized=True, mesh=m)
+                for m in self.fleet_plan.meshes[1:]
+            ]
             return enc_q8
         logger.warning(
             "CLIP int8 route DISABLED: warmup A/B measured q8 at %.3fx bf16 "
@@ -545,6 +611,10 @@ class CLIPManager:
         self.model = base_model
         del params_q8
         self.params = place(params, quantized=False)
+        self._fleet_params = [self.params] + [
+            place(params, quantized=False, mesh=m)
+            for m in self.fleet_plan.meshes[1:]
+        ]
         return enc_bf16
 
     def _time_image_encode(self, encode, placed_params) -> float:

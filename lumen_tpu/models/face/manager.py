@@ -37,7 +37,14 @@ from ...runtime.batcher import (
     warmup_batcher,
 )
 from ...runtime.decode_pool import get_decode_pool
-from ...runtime.mesh import build_mesh
+from ...runtime.fleet import (
+    batcher_name,
+    build_fleet,
+    each_batcher,
+    plan_replicas,
+    replicate_all,
+    topology_extra,
+)
 from ...runtime.quarantine import guarded_key
 from ...runtime.result_cache import get_result_cache, make_namespace
 from ...runtime.policy import get_policy
@@ -120,7 +127,10 @@ class FaceManager:
         self.policy = get_policy(dtype)
         self.batch_size = batch_size
         self.max_batch_latency_ms = max_batch_latency_ms
-        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        # Replica fleet (LUMEN_REPLICAS / LUMEN_REPLICAS_FACE): one mesh
+        # slice per replica; the single all-device mesh when N=1.
+        self.fleet_plan = plan_replicas("face", mesh_axes)
+        self.mesh = self.fleet_plan.meshes[0]
         self.warmup = warmup
         # Architecture comes from the model dir's manifest
         # (extra_metadata.detector / .embedder), explicit args win (tests).
@@ -172,9 +182,9 @@ class FaceManager:
         variables["params"] = self.policy.cast_params(variables["params"])
         if "batch_stats" in variables:
             variables["batch_stats"] = self.policy.cast_params(variables["batch_stats"])
-        from ...parallel.sharding import replicate
-
-        return replicate(variables, self.mesh)
+        # One placement per replica mesh ([0] is the primary); a 1-replica
+        # plan is exactly the old single replicate().
+        return replicate_all(variables, self.fleet_plan)
 
     def initialize(self) -> None:
         if self._initialized:
@@ -182,7 +192,6 @@ class FaceManager:
         s = self.spec
         compute = self.policy.compute_dtype
         det_cfg = self.det_cfg
-        from ...parallel.sharding import replicate
         from .graph import ArcFaceGraph, ScrfdGraph, find_onnx_models
 
         onnx_models = find_onnx_models(self.model_dir)
@@ -192,9 +201,10 @@ class FaceManager:
             # ONNX->JAX bridge (reference runs the same file through
             # onnxruntime, ``onnxrt_backend.py:485-745``).
             graph_det = ScrfdGraph.from_path(onnx_models["detection"], num_anchors=det_cfg.num_anchors)
-            self.det_vars = replicate(dict(graph_det.module.params), self.mesh)
+            self._det_vars_fleet = replicate_all(dict(graph_det.module.params), self.fleet_plan)
+            self.det_vars = self._det_vars_fleet[0]
             logger.info("face detector: SCRFD graph %s (%d MB params)", onnx_models["detection"], graph_det.module.param_bytes() >> 20)
-            graph_det.module.release_weights()  # mesh holds the weights now
+            graph_det.module.release_weights()  # the meshes hold the weights now
 
             @jax.jit
             def run_detector(variables, images_u8):
@@ -212,7 +222,8 @@ class FaceManager:
 
         else:
             det_shape = (1, det_cfg.input_size, det_cfg.input_size, 3)
-            self.det_vars = self._load_variables("detection.safetensors", self.detector, det_shape, "detection")
+            self._det_vars_fleet = self._load_variables("detection.safetensors", self.detector, det_shape, "detection")
+            self.det_vars = self._det_vars_fleet[0]
 
             @jax.jit
             def run_detector(variables, images_u8):
@@ -230,9 +241,10 @@ class FaceManager:
 
         if "recognition" in onnx_models:
             graph_rec = ArcFaceGraph.from_path(onnx_models["recognition"])
-            self.rec_vars = replicate(dict(graph_rec.module.params), self.mesh)
+            self._rec_vars_fleet = replicate_all(dict(graph_rec.module.params), self.fleet_plan)
+            self.rec_vars = self._rec_vars_fleet[0]
             logger.info("face embedder: ArcFace graph %s", onnx_models["recognition"])
-            graph_rec.module.release_weights()  # mesh holds the weights now
+            graph_rec.module.release_weights()  # the meshes hold the weights now
 
             @jax.jit
             def run_embedder(variables, crops_u8):
@@ -242,7 +254,8 @@ class FaceManager:
 
         else:
             rec_shape = (1, self.rec_cfg.input_size, self.rec_cfg.input_size, 3)
-            self.rec_vars = self._load_variables("recognition.safetensors", self.embedder, rec_shape, "recognition")
+            self._rec_vars_fleet = self._load_variables("recognition.safetensors", self.embedder, rec_shape, "recognition")
+            self.rec_vars = self._rec_vars_fleet[0]
 
             @jax.jit
             def run_embedder(variables, crops_u8):
@@ -258,31 +271,43 @@ class FaceManager:
         # Batcher fns dispatch async and return un-fetched device trees;
         # the MicroBatcher fetch worker makes the one blocking transfer
         # per batch (pipelined executor — batch k+1 stacks while k runs).
-        self._det_batcher = MicroBatcher(
-            mesh_sharded(
-                lambda imgs, n: self._run_detector(self.det_vars, imgs),
-                self.mesh,
-            ),
-            max_batch=det_buckets[-1],
-            max_latency_ms=self.max_batch_latency_ms,
-            buckets=det_buckets,
-            name="face-det",
-        ).start()
-        self._rec_batcher = MicroBatcher(
-            mesh_sharded(
-                lambda crops, n: self._run_embedder(self.rec_vars, crops),
-                self.mesh,
-            ),
-            max_batch=rec_buckets[-1],
-            max_latency_ms=self.max_batch_latency_ms,
-            buckets=rec_buckets,
-            name="face-rec",
-        ).start()
+        def build_det(rid, mesh):
+            variables = self._det_vars_fleet[rid or 0]
+            return MicroBatcher(
+                mesh_sharded(
+                    lambda imgs, n, _v=variables: self._run_detector(_v, imgs),
+                    mesh,
+                ),
+                max_batch=det_buckets[-1],
+                max_latency_ms=self.max_batch_latency_ms,
+                buckets=det_buckets,
+                name=batcher_name("face-det", rid),
+                replica=None if rid is None else f"r{rid}",
+            ).start()
+
+        def build_rec(rid, mesh):
+            variables = self._rec_vars_fleet[rid or 0]
+            return MicroBatcher(
+                mesh_sharded(
+                    lambda crops, n, _v=variables: self._run_embedder(_v, crops),
+                    mesh,
+                ),
+                max_batch=rec_buckets[-1],
+                max_latency_ms=self.max_batch_latency_ms,
+                buckets=rec_buckets,
+                name=batcher_name("face-rec", rid),
+                replica=None if rid is None else f"r{rid}",
+            ).start()
+
+        self._det_batcher = build_fleet(self.fleet_plan, "face-det", build_det)
+        self._rec_batcher = build_fleet(self.fleet_plan, "face-rec", build_rec)
         if self.warmup:
             t0 = time.perf_counter()
             ds, rs = self.det_cfg.input_size, self.rec_cfg.input_size
-            warmup_batcher(self._det_batcher, lambda b: np.zeros((b, ds, ds, 3), np.uint8))
-            warmup_batcher(self._rec_batcher, lambda b: np.zeros((b, rs, rs, 3), np.uint8))
+            for b in each_batcher(self._det_batcher):
+                warmup_batcher(b, lambda n: np.zeros((n, ds, ds, 3), np.uint8))
+            for b in each_batcher(self._rec_batcher):
+                warmup_batcher(b, lambda n: np.zeros((n, rs, rs, 3), np.uint8))
             logger.info(
                 "face warmup: %d+%d buckets in %.1fs",
                 len(det_buckets), len(rec_buckets), time.perf_counter() - t0,
@@ -295,6 +320,14 @@ class FaceManager:
             self._det_batcher.close()
             self._rec_batcher.close()
             self._initialized = False
+
+    def topology(self) -> dict[str, str]:
+        """Device topology + replica layout for the capability ``extra``."""
+        return topology_extra(
+            self.mesh,
+            getattr(self, "_det_batcher", None),
+            getattr(self, "_rec_batcher", None),
+        )
 
     # -- caching ----------------------------------------------------------
 

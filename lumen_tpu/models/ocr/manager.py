@@ -335,6 +335,16 @@ class OcrManager:
     def close(self) -> None:
         self._initialized = False
 
+    def topology(self) -> dict[str, str]:
+        """Device topology for the capability ``extra``. OCR dispatches
+        ragged det/rec shapes directly (no MicroBatcher, no mesh), so it
+        reports a single replica on the default device — a fleet for this
+        family needs the ragged-batching rework first (ROADMAP item 2's
+        paged/ragged lane is the natural vehicle)."""
+        from ...runtime.fleet import topology_extra
+
+        return topology_extra(None)
+
     # -- detection --------------------------------------------------------
 
     def detect(

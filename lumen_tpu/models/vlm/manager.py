@@ -237,6 +237,18 @@ class VLMManager:
         # ``expert`` axis shards MoE expert banks (SURVEY §2.8); without
         # either the mesh is the trivial data mesh and weights replicate.
         self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+        from ...runtime.fleet import replicas_for
+
+        # The generator is a stateful continuous/coalescing scheduler, not
+        # a stateless MicroBatcher — the replica fleet (runtime/fleet.py)
+        # does not slice it yet. Honor the knob honestly: say so once
+        # instead of silently serving one replica an operator thinks is N.
+        if replicas_for("vlm") != 1:  # includes the "max" sentinel (-1)
+            logger.warning(
+                "LUMEN_REPLICAS(_VLM) > 1 requested but the VLM generate "
+                "scheduler is not replica-fleeted yet; serving 1 replica "
+                "over the full mesh (continuous batching owns the devices)"
+            )
         from ...ops.quant_matmul import note_mesh_model_axis
 
         # TP x int8: pl.pallas_call has no GSPMD sharding rule, so a
@@ -691,6 +703,14 @@ class VLMManager:
         if fn := getattr(self, "_route_gauge_fn", None):
             metrics.unregister_gauges(f"vlm-quant:{self.model_id}", fn)
         self._initialized = False
+
+    def topology(self) -> dict[str, str]:
+        """Device topology for the capability ``extra`` — one replica over
+        the full serving mesh (the continuous generator owns all devices;
+        see the replica-fleet note in ``__init__``)."""
+        from ...runtime.fleet import topology_extra
+
+        return topology_extra(self.mesh)
 
     # -- prompt prep -------------------------------------------------------
 

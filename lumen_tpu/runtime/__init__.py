@@ -7,6 +7,15 @@ backends (`SURVEY.md` §2 "native compute" note).
 from .compile_cache import enable_persistent_cache
 from .batcher import MicroBatcher, bucket_for, default_buckets
 from .decode_pool import DecodePool, get_decode_pool, shutdown_decode_pool
+from .fleet import (
+    FleetPlan,
+    ReplicaSet,
+    build_fleet,
+    each_batcher,
+    plan_replicas,
+    register_policy,
+    replicas_for,
+)
 from .quarantine import QuarantineRegistry, get_quarantine, reset_quarantine
 from .result_cache import ResultCache, get_result_cache, reset_result_cache
 from .mesh import (
@@ -39,6 +48,13 @@ __all__ = [
     "DecodePool",
     "get_decode_pool",
     "shutdown_decode_pool",
+    "FleetPlan",
+    "ReplicaSet",
+    "build_fleet",
+    "each_batcher",
+    "plan_replicas",
+    "register_policy",
+    "replicas_for",
     "QuarantineRegistry",
     "get_quarantine",
     "reset_quarantine",

@@ -386,6 +386,67 @@ def bucket_for(n: int, buckets: list[int]) -> int:
     return buckets[-1]
 
 
+def wait_for_batch(fut: Future, name: str, stats: dict, timeout: float | None = None) -> Any:
+    """Wait on a batcher future — the blocking half of
+    ``MicroBatcher.__call__``, shared with the replica fleet's routed
+    dispatch (``ReplicaSet.__call__`` submits through whichever replica
+    the policy picked and waits here with that replica's name/stats).
+
+    The default wait must tolerate a cold XLA compile of a new bucket
+    THROUGH the axon tunnel (observed >60s on a v5e: the first on-chip
+    gRPC bench died on exactly this) — the client's own RPC deadline, not
+    this timeout, bounds user-visible latency. ``LUMEN_BATCH_TIMEOUT_S``
+    overrides; unset → 300s. An ambient request deadline, when sooner,
+    bounds the wait instead (no point blocking a gRPC thread past its
+    caller's hangup)."""
+    if timeout is None:
+        timeout = batch_wait_timeout()
+    rem = remaining()
+    deadline_bounded = rem is not None and rem < timeout
+    if deadline_bounded:
+        timeout = max(rem, 0.0)
+    try:
+        result = fut.result(timeout=timeout)
+        # Close the span handles HERE, not only in the done-callback:
+        # set_result wakes this waiter BEFORE callbacks run, so the
+        # request could otherwise finish its trace while the fetch
+        # worker is still descheduled — dropping the device span from
+        # exactly the slow trace being captured. end() is idempotent;
+        # whichever side runs first wins.
+        if getattr(fut, "_lumen_trace", None) is not None:
+            _end_trace_spans(fut)
+            # Attribution completeness: on a loaded host the gap
+            # between the fetch worker settling the future and THIS
+            # thread being rescheduled is real milliseconds — charge
+            # it to ``batch.wake`` instead of leaving it dark.
+            settled = getattr(fut, "_lumen_settled", None)
+            if settled is not None:
+                fut._lumen_trace.add_span("batch.wake", settled, time.perf_counter())
+        return result
+    except FuturesTimeout:
+        if not deadline_bounded:
+            raise
+        # The caller's deadline — not the batch-wait budget — expired.
+        # Cancel so the collector skips the dead entry (when it hasn't
+        # started) and surface the wire-mappable deadline error, not a
+        # generic timeout that reads as a handler crash.
+        if fut.cancel():
+            stats["expired"] += 1
+            metrics.count("deadline_drops")
+            metrics.count(f"deadline_drops:{name}")
+        raise DeadlineExpired(
+            f"{name}: request deadline expired while waiting for a batch slot"
+        ) from None
+    except BaseException:
+        # Settled-with-exception path (poison, watchdog, shed at
+        # dispatch...): same span-close determinism as the success
+        # path — the error verdict must reach the trace before the
+        # request finishes it.
+        if fut.done() and getattr(fut, "_lumen_trace", None) is not None:
+            _end_trace_spans(fut)
+        raise
+
+
 class _Inflight:
     """One dispatched-but-unfetched batch riding the in-flight deque.
     ``entries`` keeps the (item, future, fingerprint) triples so a
@@ -444,10 +505,15 @@ class MicroBatcher:
         adaptive: bool | None = None,
         window_ms: float | None = None,
         clock: Callable[[], float] = time.monotonic,
+        replica: str | None = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.fn = fn
+        # Replica tag when this batcher is one slice of a ReplicaSet
+        # (runtime/fleet.py): rides the ``batch.device`` trace span so a
+        # slow trace names the chip slice that served it.
+        self.replica = replica
         self.max_batch = max_batch
         self.max_latency_s = max_latency_ms / 1e3
         self.buckets = sorted(buckets) if buckets else default_buckets(max_batch)
@@ -690,62 +756,17 @@ class MicroBatcher:
     def __call__(
         self, item: Any, timeout: float | None = None, fingerprint: str | None = None
     ) -> Any:
-        """Submit and wait. The default wait must tolerate a cold XLA
-        compile of a new bucket THROUGH the axon tunnel (observed >60s on
-        a v5e: the first on-chip gRPC bench died on exactly this) — the
-        client's own RPC deadline, not this timeout, bounds user-visible
-        latency. ``LUMEN_BATCH_TIMEOUT_S`` overrides; unset → 300s. An
-        ambient request deadline, when sooner, bounds the wait instead
-        (no point blocking a gRPC thread past its caller's hangup)."""
-        if timeout is None:
-            timeout = batch_wait_timeout()
-        rem = remaining()
-        deadline_bounded = rem is not None and rem < timeout
-        if deadline_bounded:
-            timeout = max(rem, 0.0)
+        """Submit and wait (see :func:`wait_for_batch` for the wait
+        semantics — shared with the replica fleet's routed dispatch)."""
         fut = self.submit(item, fingerprint=fingerprint)
-        try:
-            result = fut.result(timeout=timeout)
-            # Close the span handles HERE, not only in the done-callback:
-            # set_result wakes this waiter BEFORE callbacks run, so the
-            # request could otherwise finish its trace while the fetch
-            # worker is still descheduled — dropping the device span from
-            # exactly the slow trace being captured. end() is idempotent;
-            # whichever side runs first wins.
-            if getattr(fut, "_lumen_trace", None) is not None:
-                _end_trace_spans(fut)
-                # Attribution completeness: on a loaded host the gap
-                # between the fetch worker settling the future and THIS
-                # thread being rescheduled is real milliseconds — charge
-                # it to ``batch.wake`` instead of leaving it dark.
-                settled = getattr(fut, "_lumen_settled", None)
-                if settled is not None:
-                    fut._lumen_trace.add_span(
-                        "batch.wake", settled, time.perf_counter()
-                    )
-            return result
-        except FuturesTimeout:
-            if not deadline_bounded:
-                raise
-            # The caller's deadline — not the batch-wait budget — expired.
-            # Cancel so the collector skips the dead entry (when it hasn't
-            # started) and surface the wire-mappable deadline error, not a
-            # generic timeout that reads as a handler crash.
-            if fut.cancel():
-                self.stats["expired"] += 1
-                metrics.count("deadline_drops")
-                metrics.count(f"deadline_drops:{self.name}")
-            raise DeadlineExpired(
-                f"{self.name}: request deadline expired while waiting for a batch slot"
-            ) from None
-        except BaseException:
-            # Settled-with-exception path (poison, watchdog, shed at
-            # dispatch...): same span-close determinism as the success
-            # path — the error verdict must reach the trace before the
-            # request finishes it.
-            if fut.done() and getattr(fut, "_lumen_trace", None) is not None:
-                _end_trace_spans(fut)
-            raise
+        return wait_for_batch(fut, self.name, self.stats, timeout)
+
+    def load(self) -> int:
+        """Queued + dispatched-but-unsettled items — the signal the
+        fleet's least-loaded dispatch policy ranks replicas by."""
+        with self._inflight_cv:
+            inflight = sum(e.n for e in self._inflight)
+        return self._queue.qsize() + inflight
 
     # -- collector thread -------------------------------------------------
 
@@ -870,9 +891,10 @@ class MicroBatcher:
             h = getattr(fut, "_lumen_collect", None)
             if h is not None:
                 h.end()
-                fut._lumen_device = fut._lumen_trace.begin(
-                    "batch.device", {"batcher": self.name, "n": n, "size": size}
-                )
+                attrs = {"batcher": self.name, "n": n, "size": size}
+                if self.replica is not None:
+                    attrs["replica"] = self.replica
+                fut._lumen_device = fut._lumen_trace.begin("batch.device", attrs)
         arena = None
         try:
             stacked, arena = self._stack(items, size)

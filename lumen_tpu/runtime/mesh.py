@@ -35,25 +35,75 @@ STAGE_AXIS = "stage"
 EXPERT_AXIS = "expert"
 
 
+_degrade_warned: set[str] = set()
+
+
 def resolve_axes(axes: dict[str, int], n_devices: int) -> dict[str, int]:
     """Resolve a mesh request ({axis: size, one size may be -1}) against the
-    actual device count. The -1 axis absorbs all remaining devices."""
+    actual device count. The -1 axis absorbs all remaining devices.
+
+    A ``data`` axis that does not fit degrades to the largest size that
+    does (one-shot warning per request shape): ``data`` is the replica/
+    throughput axis, so ``{"data": 8}`` on a 4-chip host should serve 4
+    ways, not fail boot. When no exact cover exists under the requested
+    size (e.g. ``{"data": 3}`` on 8 devices) the resolved mesh may use
+    FEWER devices than the host has — :func:`build_mesh` slices the device
+    list to fit. Non-``data`` axes (tensor/sequence/expert parallelism)
+    still raise: silently shrinking a TP axis would change which
+    checkpoints even fit, and that IS an operator error."""
     fixed = math.prod(s for s in axes.values() if s != -1)
+    degraded = False
     if n_devices % fixed != 0:
-        raise ValueError(
-            f"mesh axes {axes} do not divide device count {n_devices} "
-            f"(fixed product {fixed})"
-        )
+        others = math.prod(s for a, s in axes.items() if a != DATA_AXIS and s != -1)
+        dp = axes.get(DATA_AXIS, 0)
+        if dp > 0 and n_devices % others == 0:
+            # Prefer the exact cover (every device used); otherwise the
+            # largest dividing size <= the request (idle devices, warned).
+            slots = n_devices // others
+            new_dp = min(dp, slots)
+            while slots % new_dp:
+                new_dp -= 1
+            key = f"{sorted(axes.items())}@{n_devices}"
+            if key not in _degrade_warned:
+                _degrade_warned.add(key)
+                logger.warning(
+                    "mesh axes %s do not divide device count %d; degrading "
+                    "data axis %d -> %d",
+                    axes, n_devices, dp, new_dp,
+                )
+            axes = {**axes, DATA_AXIS: new_dp}
+            fixed = math.prod(s for s in axes.values() if s != -1)
+            degraded = True
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"mesh axes {axes} do not divide device count {n_devices} "
+                f"(fixed product {fixed})"
+            )
     resolved = dict(axes)
     for name, size in axes.items():
         if size == -1:
             resolved[name] = n_devices // fixed
             break
-    if math.prod(resolved.values()) != n_devices:
-        raise ValueError(
-            f"mesh {resolved} uses {math.prod(resolved.values())} devices, "
-            f"have {n_devices}"
-        )
+    used = math.prod(resolved.values())
+    if used != n_devices:
+        # Consistent degrade policy: an all-fixed request that covers
+        # FEWER devices than the host has (whether asked for directly,
+        # e.g. {"data": 4} on 8 chips, or produced by the data-axis
+        # degrade above) serves on the device prefix — build_mesh slices
+        # the list — instead of failing boot. Over-subscription or a
+        # non-sliceable remainder still raises.
+        if used < n_devices and n_devices % used == 0:
+            key = f"{sorted(resolved.items())}@{n_devices}"
+            if not degraded and key not in _degrade_warned:
+                _degrade_warned.add(key)
+                logger.warning(
+                    "mesh %s uses %d of %d device(s); serving on the prefix",
+                    resolved, used, n_devices,
+                )
+        else:
+            raise ValueError(
+                f"mesh {resolved} uses {used} devices, have {n_devices}"
+            )
     return resolved
 
 
@@ -74,6 +124,12 @@ def build_mesh(
     resolved = resolve_axes(axes, len(devices))
     names = tuple(resolved)
     shape = tuple(resolved[n] for n in names)
+    used = math.prod(shape)
+    if used < len(devices):
+        # A degraded data axis (resolve_axes warning) may cover fewer
+        # devices than the host has: serve on the prefix instead of
+        # failing boot. The idle tail stays available to other services.
+        devices = devices[:used]
     if len(devices) == 1:
         arr = np.array(devices).reshape(shape)
     else:

@@ -228,6 +228,15 @@ class BaseService(InferenceServicer):
     def healthy(self) -> bool:
         return True
 
+    def replica_states(self) -> dict:
+        """Per-replica health states keyed by dispatcher name, e.g.
+        ``{"clip-image": {"r0": "serving", "r1": "down"}}``. Populated by
+        services whose managers run a replica fleet
+        (:mod:`lumen_tpu.runtime.fleet`); ``{}`` means single-replica.
+        Surfaces in ``Health`` trailing metadata (``lumen-replica-status``)
+        next to the breaker/quarantine keys."""
+        return {}
+
     def _record_outcome(self, e: BaseException | None) -> None:
         """One source of truth for breaker accounting (shared by the unary
         and streaming dispatch paths). ``None`` = success. Backend-health

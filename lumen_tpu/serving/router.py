@@ -220,6 +220,23 @@ class HubRouter(InferenceServicer):
             if (breaker := getattr(svc, "breaker", None)) is not None
         }
 
+    def _replica_states(self) -> dict[str, dict]:
+        """Per-service replica-fleet states ({service: {dispatcher:
+        {replica: state}}}); services without a fleet report nothing.
+        jax-free: the states come from the service objects, the router
+        never touches the runtime package."""
+        with self._lock:
+            services = list(self.services.items())
+        out: dict[str, dict] = {}
+        for name, svc in services:
+            try:
+                states = svc.replica_states()
+            except Exception:  # noqa: BLE001 - health must never fail on telemetry
+                continue
+            if states:
+                out[name] = states
+        return out
+
     @staticmethod
     def _quarantine_size() -> int | None:
         """Entries currently quarantined, WITHOUT importing the runtime
@@ -245,6 +262,13 @@ class HubRouter(InferenceServicer):
                 quarantined = self._quarantine_size()
                 if quarantined is not None:
                     trailing.append(("lumen-quarantine-size", str(quarantined)))
+                replicas = self._replica_states()
+                if replicas:
+                    # Per-replica fleet health next to the breaker/
+                    # quarantine keys: a DOWN replica is a reported
+                    # condition (siblings keep the hub SERVING), exactly
+                    # like a degraded sibling service.
+                    trailing.append(("lumen-replica-status", json.dumps(replicas)))
                 context.set_trailing_metadata(tuple(trailing))
             except Exception:  # noqa: BLE001 - test stubs may lack metadata support
                 pass

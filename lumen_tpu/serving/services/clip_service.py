@@ -142,6 +142,9 @@ class ClipService(BaseService):
                 classify_mode="cosine" if key == "bioclip" else "softmax",
                 warmup=bs.warmup,
                 quantize=bs.quantize,
+                # Scope batcher/gauge names per manager so a clip+bioclip
+                # hub never collides on "clip-image" gauges or fleet keys.
+                name_prefix=key,
             )
         svc = cls(managers)
         for mgr in managers.values():
@@ -155,6 +158,11 @@ class ClipService(BaseService):
         # regression) must not advertise int8.
         routes = sorted({getattr(m, "quant_route", "bf16") for m in self.managers.values()})
         precisions = ["bf16", "fp32"] + (["int8"] if "int8" in routes else [])
+        # Device topology + replica layout (the primary manager's view):
+        # fleet-internal clients pick endpoints from these keys instead of
+        # probing — device_count, mesh_axes, replicas, replica_policy and
+        # live replica_states.
+        primary = next(iter(self.managers.values()))
         return self.registry.build_capability(
             model_ids=ids,
             runtime=f"jax-{_backend_name()}",
@@ -164,11 +172,20 @@ class ClipService(BaseService):
                 "embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values()),
                 "quant_routes": ",".join(routes),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                **primary.topology(),
             },
         )
 
     def healthy(self) -> bool:
         return all(m._initialized for m in self.managers.values())
+
+    def replica_states(self) -> dict:
+        from ...runtime.fleet import replica_states_of
+
+        return replica_states_of(
+            *(b for m in self.managers.values()
+              for b in (m._image_batcher, m._text_batcher))
+        )
 
     def close(self) -> None:
         for m in self.managers.values():

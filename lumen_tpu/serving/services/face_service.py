@@ -95,11 +95,24 @@ class FaceService(BaseService):
                 "det_size": str(self.manager.det_cfg.input_size),
                 "embedding_dim": str(self.manager.rec_cfg.embed_dim),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                # device topology + replica layout (fleet-internal clients
+                # pick endpoints from these instead of probing)
+                **self.manager.topology(),
             },
         )
 
     def healthy(self) -> bool:
         return self.manager._initialized
+
+    def replica_states(self) -> dict:
+        from ...runtime.fleet import replica_states_of
+
+        # getattr: the batchers only exist after manager.initialize(), and
+        # Health may probe the construct-before-initialize window.
+        return replica_states_of(
+            getattr(self.manager, "_det_batcher", None),
+            getattr(self.manager, "_rec_batcher", None),
+        )
 
     def close(self) -> None:
         self.manager.close()

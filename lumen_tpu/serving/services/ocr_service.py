@@ -69,6 +69,7 @@ class OcrService(BaseService):
                 "rec_height": str(self.manager.rec_cfg.height),
                 "vocab_size": str(len(self.manager.vocab)),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                **self.manager.topology(),
             },
         )
 

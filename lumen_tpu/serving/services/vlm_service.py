@@ -119,6 +119,7 @@ class VlmService(BaseService):
                 "vocab_size": str(self.manager.cfg.decoder.vocab_size),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
                 "quant_route": self.manager.quant_route,
+                **self.manager.topology(),
             },
         )
 

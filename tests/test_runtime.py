@@ -36,8 +36,18 @@ class TestMesh:
         assert resolve_axes({"data": 4, "model": 2}, 8) == {"data": 4, "model": 2}
 
     def test_resolve_mismatch(self):
-        with pytest.raises(ValueError):
-            resolve_axes({"data": 3}, 8)
+        # A data axis that does not fit degrades to the largest size that
+        # does (ISSUE 7 satellite: LUMEN_REPLICAS=8 on a 4-chip host must
+        # serve 4 ways, not fail boot) ...
+        assert resolve_axes({"data": 8}, 4) == {"data": 4}
+        assert resolve_axes({"data": 3}, 8) == {"data": 2}
+        assert resolve_axes({"data": 6, "model": 2}, 8) == {"data": 4, "model": 2}
+        # Exact-divisor under-cover serves on the device prefix (same
+        # graceful policy as the non-dividing case above, which also
+        # lands on a 4-of-8 mesh).
+        assert resolve_axes({"data": 4}, 8) == {"data": 4}
+        # ... but a non-data axis (TP) still raises: silently shrinking it
+        # would change which checkpoints even fit.
         with pytest.raises(ValueError):
             resolve_axes({"data": -1, "model": 3}, 8)
 
