@@ -37,8 +37,28 @@ from google.protobuf import empty_pb2
 from lumen_tpu.serving.proto import ml_service_pb2 as pb
 from lumen_tpu.serving.proto import ml_service_pb2_grpc as pbg
 from lumen_tpu.utils import trace as utrace
+from lumen_tpu.utils.qos import RETRY_AFTER_META, TENANT_META_KEY
 
 CHUNK = 1 << 20  # 1 MiB
+
+
+def _with_tenant(md, tenant: str | None):
+    """Append the ``lumen-tenant`` request-metadata pair to the (possibly
+    None) trace metadata — None stays None when there is nothing to send,
+    preserving the exact no-metadata call shape for fakes/stubs."""
+    if not tenant:
+        return md
+    return (*(md or ()), (TENANT_META_KEY, tenant))
+
+
+def _shed_retry_after_s(meta) -> float | None:
+    """Parse the server's ``lumen-retry-after-ms`` response-meta hint
+    (sent on quota/queue/breaker sheds) into seconds."""
+    try:
+        ms = int(meta[RETRY_AFTER_META])
+    except (KeyError, TypeError, ValueError):
+        return None
+    return ms / 1000.0 if ms > 0 else None
 
 
 def _begin_client_trace(task: str):
@@ -93,7 +113,8 @@ def _bulk_requests(task: str, payloads, mime: str, meta: dict[str, str]):
 
 
 def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream",
-               meta: dict[str, str] | None = None, timeout: float = 300.0):
+               meta: dict[str, str] | None = None, timeout: float = 300.0,
+               tenant: str | None = None):
     """Run MANY payloads through ONE ``Infer`` stream (the server's bulk
     fan-out lane): stream setup, admission and context bookkeeping are
     paid once, and the server coalesces the items into full device
@@ -106,6 +127,7 @@ def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream"
     from lumen_tpu.serving import ServiceError, reassemble_result
 
     tr, md = _begin_client_trace(task)
+    md = _with_tenant(md, tenant)
     # payloads may be any iterable (downstream only enumerates it) — a
     # len() here would make enabling tracing reject generator inputs.
     n_items = str(len(payloads)) if hasattr(payloads, "__len__") else "?"
@@ -155,11 +177,15 @@ _RETRYABLE_RPC = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.RESOURCE_EXHAUSTE
 class _InbandUnavailable(Exception):
     """An in-band ERROR_CODE_UNAVAILABLE response: a load shed or degraded
     service that answered BEFORE dispatching the task, so re-sending is
-    explicitly safe (the server's own detail says to retry with backoff)."""
+    explicitly safe (the server's own detail says to retry with backoff).
+    ``retry_after_s`` carries the server's ``lumen-retry-after-ms``
+    response-meta hint when the shed sent one (quota/queue/breaker sheds
+    all do) — the shared retry helper floors its backoff on it."""
 
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, retry_after_s: float | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after_s = retry_after_s
 
 
 def _transient_rpc(exc: BaseException) -> bool:
@@ -179,7 +205,7 @@ def _client_retry_policy():
 
 
 def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
-           timeout: float, stream: bool = False):
+           timeout: float, stream: bool = False, tenant: str | None = None):
     """One Infer attempt with stream-setup retries: an attempt that dies on
     a transient RpcError *before any response arrived* is retried with
     backoff (re-sending the request stream is safe then — the server never
@@ -190,7 +216,8 @@ def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
     state = {"responded": False}
 
     def attempt():
-        return _infer_once(stub, task, payload, mime, meta, timeout, stream, state)
+        return _infer_once(stub, task, payload, mime, meta, timeout, stream, state,
+                           tenant=tenant)
 
     try:
         return retry_call(
@@ -205,8 +232,9 @@ def _infer(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
 
 
 def _infer_once(stub, task: str, payload: bytes, mime: str, meta: dict[str, str],
-                timeout: float, stream: bool, state: dict):
+                timeout: float, stream: bool, state: dict, tenant: str | None = None):
     tr, md = _begin_client_trace(task)
+    md = _with_tenant(md, tenant)
     rpc_span = tr.begin("rpc.client") if tr is not None else None
     try:
         out = _infer_attempt(stub, task, payload, mime, meta, timeout, stream, state, md)
@@ -238,7 +266,12 @@ def _infer_attempt(stub, task: str, payload: bytes, mime: str, meta: dict[str, s
             if resp.error.code == pb.ERROR_CODE_UNAVAILABLE:
                 # Shed / degraded-service answer: retryable by contract
                 # (the server refused before dispatch; see _InbandUnavailable).
-                raise _InbandUnavailable(resp.error.code, resp.error.message)
+                # The response meta may say exactly when to come back.
+                raise _InbandUnavailable(
+                    resp.error.code,
+                    resp.error.message,
+                    retry_after_s=_shed_retry_after_s(resp.meta),
+                )
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
         # Disambiguate the two total>1 shapes on the wire: a STREAMING
         # final message also carries total=n_deltas+1, but its deltas
@@ -278,6 +311,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--addr", default="127.0.0.1:50051")
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument(
+        "--tenant",
+        default=None,
+        help="tenant id sent as lumen-tenant request metadata (server-side "
+        "weighted-fair queuing + per-tenant quota; default: the 'default' tenant)",
+    )
+    ap.add_argument(
+        "--priority",
+        choices=("interactive", "bulk"),
+        default=None,
+        help="priority lane (interactive > bulk; the bulk command auto-tags bulk)",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("caps")
     sub.add_parser(
@@ -346,13 +391,22 @@ def main(argv: list[str] | None = None) -> int:
         print("ok")
         return 0
 
+    # QoS identity for every Infer this invocation makes: the tenant rides
+    # gRPC request metadata, the priority lane rides request meta.
+    qos_meta = {"priority": args.priority} if args.priority else {}
+
+    def run_infer(task, payload, mime, meta, stream=False):
+        return _infer(stub, task, payload, mime, {**qos_meta, **meta},
+                      args.timeout, stream=stream, tenant=args.tenant)
+
     if args.cmd == "bulk":
         from lumen_tpu.serving import ServiceError
 
         payloads, mimes = zip(*(_read(p) for p in args.images))
         failed = 0
         for idx, res in infer_bulk(
-            stub, args.task, list(payloads), mime=mimes[0], timeout=args.timeout
+            stub, args.task, list(payloads), mime=mimes[0], timeout=args.timeout,
+            meta=qos_meta, tenant=args.tenant,
         ):
             name = args.images[idx]
             if isinstance(res, ServiceError):
@@ -368,21 +422,21 @@ def main(argv: list[str] | None = None) -> int:
         return 1 if failed else 0
 
     if args.cmd == "embed-text":
-        out = _infer(stub, "clip_text_embed", args.text.encode(), "text/plain", {}, args.timeout)
+        out = run_infer("clip_text_embed", args.text.encode(), "text/plain", {})
     elif args.cmd == "embed-image":
         data, mime = _read(args.image)
-        out = _infer(stub, "clip_image_embed", data, mime, {}, args.timeout)
+        out = run_infer("clip_image_embed", data, mime, {})
     elif args.cmd == "classify":
         data, mime = _read(args.image)
         task = "clip_scene_classify" if args.scene else "clip_classify"
-        out = _infer(stub, task, data, mime, {"topk": str(args.top_k)}, args.timeout)
+        out = run_infer(task, data, mime, {"topk": str(args.top_k)})
     elif args.cmd == "faces":
         data, mime = _read(args.image)
         task = "face_detect_and_embed" if args.embed else "face_detect"
-        out = _infer(stub, task, data, mime, {}, args.timeout)
+        out = run_infer(task, data, mime, {})
     elif args.cmd == "ocr":
         data, mime = _read(args.image)
-        out = _infer(stub, "ocr", data, mime, {}, args.timeout)
+        out = run_infer("ocr", data, mime, {})
     elif args.cmd == "caption":
         data, mime = _read(args.image)
         meta = {
@@ -391,7 +445,7 @@ def main(argv: list[str] | None = None) -> int:
             "do_sample": "false",
         }
         task = "vlm_generate_stream" if args.stream else "vlm_generate"
-        out = _infer(stub, task, data, mime, meta, args.timeout, stream=args.stream)
+        out = run_infer(task, data, mime, meta, stream=args.stream)
         if args.stream:
             print()  # newline after streamed chunks
     else:  # pragma: no cover

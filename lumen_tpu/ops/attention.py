@@ -23,6 +23,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 _LANES = 128  # VMEM lane width; scratch stats are padded to this
 
+from ..utils.env import env_int
 from .pallas_compat import CompilerParams
 
 #: batch*heads and q-block axes carry no state between steps, so megacore
@@ -384,10 +385,7 @@ _MIN_FLASH_SEQ_DEFAULT = 256
 
 
 def _min_flash_seq() -> int:
-    try:
-        return int(os.environ.get("LUMEN_FLASH_MIN_SEQ", _MIN_FLASH_SEQ_DEFAULT))
-    except ValueError:
-        return _MIN_FLASH_SEQ_DEFAULT
+    return env_int("LUMEN_FLASH_MIN_SEQ", _MIN_FLASH_SEQ_DEFAULT)
 
 
 #: fallback reasons already logged this process (log ONCE per distinct
@@ -474,11 +472,7 @@ def _flash_blocks() -> tuple[int, int]:
     # must degrade, not crash the server — clamp to [16, 1024]; above
     # 1024 the q x k tile alone exceeds VMEM on every current TPU.
     def _one(name: str) -> int:
-        try:
-            v = int(os.environ.get(name, 128))
-        except ValueError:
-            return 128
-        return min(1024, max(16, v))
+        return env_int(name, 128, minimum=16, maximum=1024)
 
     return (_one("LUMEN_FLASH_BLOCK_Q"), _one("LUMEN_FLASH_BLOCK_K"))
 

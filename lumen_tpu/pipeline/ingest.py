@@ -38,8 +38,16 @@ from lumen_tpu.runtime.batcher import stack_and_pad, unstack
 from lumen_tpu.runtime.decode_pool import DecodePool, get_decode_pool
 from lumen_tpu.runtime.mesh import DATA_AXIS, data_sharding
 from lumen_tpu.runtime.quarantine import QuarantineRegistry, get_quarantine
+from lumen_tpu.runtime.qos import (
+    LANE_BULK,
+    activate as qos_activate,
+    current_qos as qos_current,
+    deactivate as qos_deactivate,
+    qos_context,
+)
 from lumen_tpu.runtime.result_cache import ResultCache, get_result_cache, make_key
 from lumen_tpu.runtime.trace import begin_request, finish_request
+from lumen_tpu.utils.deadline import QueueFull
 
 logger = logging.getLogger(__name__)
 
@@ -269,12 +277,25 @@ class IngestPipeline:
         pool: DecodePool | None,
         cache: ResultCache | None,
         quarantine: QuarantineRegistry,
+        tenant: str | None = None,
     ) -> None:
         # ``pool`` is run()'s single resolve of the shared pool (None when
         # ``workers`` is pinned) — resolving again here could land on a
         # different pool if the shared one is rebuilt mid-run, and the
         # finally-block gauge snapshot would describe the wrong pool.
+        # The producer lane runs on the BULK QoS lane (contextvars don't
+        # cross thread starts, so run()'s tag must be re-applied here):
+        # ingest is the canonical bulk-convoy workload, and any lane-aware
+        # component it reaches (today the consumer-side shared-batcher
+        # submits — see the postprocess loop in run() — tomorrow anything
+        # under the decode/cache path) must see it as bulk, never
+        # displacing interactive traffic. The TENANT is run()'s caller
+        # identity, captured on the caller thread and re-applied here for
+        # the same reason: the producer computes cache keys and quarantine
+        # fingerprints, and a tenant-scoped ingest must never read/flag
+        # the default tenant's namespace.
         private: DecodePool | None = None
+        qos_token = qos_activate(tenant, LANE_BULK)
         try:
             if pool is None:  # workers pinned: run-scoped private pool
                 pool = private = DecodePool(
@@ -354,6 +375,7 @@ class IngestPipeline:
         except BaseException as e:  # noqa: BLE001 - surface in the consumer
             self._offer(out, e, stop)
         finally:
+            qos_deactivate(qos_token)
             if private is not None:
                 self.stats.pool = private.gauges()
                 private.close()
@@ -387,7 +409,11 @@ class IngestPipeline:
         stop = threading.Event()
         producer = threading.Thread(
             target=self._producer,
-            args=(items, ready, stop, run_pool, cache, quarantine),
+            # The caller's tenant rides along explicitly: contextvars do
+            # not cross the thread start, and the producer's cache keys /
+            # quarantine fingerprints must stay in the caller's namespace.
+            args=(items, ready, stop, run_pool, cache, quarantine,
+                  qos_current()[0]),
             name="ingest-producer", daemon=True
         )
         producer.start()
@@ -423,15 +449,20 @@ class IngestPipeline:
                     if got.qspan is not None:
                         got.qspan.end()  # thread hop: producer -> consumer
                     try:
-                        if got.trace is not None:
-                            with got.trace.span("device.dispatch"):
+                        # Bulk-lane scope like the producer/postprocess/
+                        # salvage paths: a device_fn that submits into a
+                        # shared lane-aware admission queue must compete
+                        # as bulk, never displacing interactive traffic.
+                        with qos_context(None, LANE_BULK):
+                            if got.trace is not None:
+                                with got.trace.span("device.dispatch"):
+                                    for stage in self.stages:
+                                        got.outputs[stage.name] = stage.device_fn(
+                                            got.inputs[stage.name]
+                                        )
+                            else:
                                 for stage in self.stages:
-                                    got.outputs[stage.name] = stage.device_fn(
-                                        got.inputs[stage.name]
-                                    )
-                        else:
-                            for stage in self.stages:
-                                got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
+                                    got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     except Exception as e:  # noqa: BLE001 - contain, don't abort the run
                         self._salvage_batch(got, e, cache, fence, quarantine, finished)
                         continue
@@ -475,25 +506,47 @@ class IngestPipeline:
                 self.stats.device_s += time.perf_counter() - t0
                 t0 = time.perf_counter()
                 pspan = batch.trace.begin("post") if batch.trace is not None else None
-                for i in range(batch.n):
-                    record: dict[str, Any] = {"_index": batch.indices[i]}
-                    for s in self.stages:
-                        record[s.name] = s.postprocess(batch.decoded[i], rows_by_stage[s.name][i])
-                    if self.annotate is not None:
-                        record.update(self.annotate(batch.decoded[i]))
-                    # Store back (deep-copied: the caller owns and may
-                    # mutate the yielded record) — except records flagged
-                    # by annotate() as errored (e.g. decode failures under
-                    # on_decode_error="record"): an error placeholder must
-                    # not become the cached truth for those bytes.
-                    if cache is not None and batch.keys[i] is not None and not record.get("_error"):
-                        cache.put(
-                            batch.keys[i],
-                            {k: v for k, v in record.items() if k != "_index"},
-                            clone=copy.deepcopy,
-                            fence=fence,
-                        )
-                    finished[batch.indices[i]] = record
+                # Postprocess runs under the BULK lane: per-item hooks can
+                # submit into SHARED admission queues (the face stage's
+                # embed_detections rides the rec-model MicroBatcher), and
+                # those submits must queue as bulk — browning out before
+                # interactive face requests, never displacing them. Scoped
+                # to the loop (not the generator) so the tag cannot leak
+                # into the caller's context across a yield.
+                with qos_context(None, LANE_BULK):
+                    for i in range(batch.n):
+                        record: dict[str, Any] = {"_index": batch.indices[i]}
+                        try:
+                            for s in self.stages:
+                                record[s.name] = s.postprocess(
+                                    batch.decoded[i], rows_by_stage[s.name][i]
+                                )
+                            if self.annotate is not None:
+                                record.update(self.annotate(batch.decoded[i]))
+                        except QueueFull as e:
+                            # A bulk-lane shed from a shared admission queue
+                            # (postprocess hooks submit into MicroBatchers,
+                            # which brown bulk out under pressure). Transient
+                            # load, not bad input: the item gets a retryable
+                            # _error record and the run continues.
+                            record = {
+                                "_index": batch.indices[i],
+                                "_error": f"shed: {type(e).__name__}: {e}",
+                            }
+                            self.stats.errors += 1
+                        # Store back (deep-copied: the caller owns and may
+                        # mutate the yielded record) — except records flagged
+                        # by annotate() as errored (e.g. decode failures under
+                        # on_decode_error="record"): an error placeholder must
+                        # not become the cached truth for those bytes.
+                        if cache is not None and batch.keys[i] is not None and not record.get("_error"):
+                            cache.put(
+                                batch.keys[i],
+                                {k: v for k, v in record.items() if k != "_index"},
+                                clone=copy.deepcopy,
+                                fence=fence,
+                            )
+                        finished[batch.indices[i]] = record
                 if pspan is not None:
                     pspan.end()
                 finish_request(batch.trace)
@@ -535,45 +588,78 @@ class IngestPipeline:
         their fingerprints quarantined (the next ingest pass rejects them
         pre-decode); innocents keep their real records. Cost: up to
         ``batch_size`` full-shape device calls for the one failing batch —
-        the rare-poison price, paid only on failure."""
+        the rare-poison price, paid only on failure.
+
+        Exception: a :class:`QueueFull` is a bulk-lane load shed from a
+        shared admission queue, not a poison suspicion — every item becomes
+        a retryable ``shed:`` record immediately (no per-item re-runs, which
+        would hammer the very queue that just shed, and no quarantine)."""
+        t0 = time.perf_counter()
+        if isinstance(error, QueueFull):
+            logger.warning(
+                "ingest batch of %d shed by a shared admission queue (%s); "
+                "items marked retryable", batch.n, error,
+            )
+            for i in range(batch.n):
+                finished[batch.indices[i]] = {
+                    "_index": batch.indices[i],
+                    "_error": f"shed: {type(error).__name__}: {error}",
+                }
+                self.stats.errors += 1
+            finish_request(batch.trace, error=f"{type(error).__name__}: {error}")
+            self.stats.post_s += time.perf_counter() - t0
+            self.stats.batches += 1
+            return
         logger.warning(
             "ingest batch of %d failed (%s: %s); salvaging per-item",
             batch.n, type(error).__name__, error,
         )
-        t0 = time.perf_counter()
         succeeded = 0
         failed: list[tuple[int, Exception]] = []  # (batch row, its error)
-        for i in range(batch.n):
-            idx = batch.indices[i]
-            record: dict[str, Any] = {"_index": idx}
-            try:
-                for s in self.stages:
-                    tree = s.preprocess(batch.decoded[i])
-                    stacked = stack_and_pad([tree], self.batch_size)
-                    placed = jax.tree_util.tree_map(
-                        lambda leaf: jax.device_put(leaf, self._sharding), stacked
-                    )
-                    row = unstack(s.device_fn(placed), 1)[0]
-                    record[s.name] = s.postprocess(batch.decoded[i], row)
-            except Exception as e:  # noqa: BLE001 - candidate poison (pending sibling evidence)
-                record = {
-                    "_index": idx,
-                    "_error": f"poison: {type(e).__name__}: {e}",
-                }
-                self.stats.errors += 1
-                failed.append((i, e))
-            else:
-                succeeded += 1
-                if self.annotate is not None:
-                    record.update(self.annotate(batch.decoded[i]))
-                if cache is not None and batch.keys[i] is not None and not record.get("_error"):
-                    cache.put(
-                        batch.keys[i],
-                        {k: v for k, v in record.items() if k != "_index"},
-                        clone=copy.deepcopy,
-                        fence=fence,
-                    )
-            finished[idx] = record
+        # Bulk-lane scope for the same reason as run()'s postprocess loop:
+        # the per-item re-runs call postprocess hooks that can submit into
+        # shared admission queues.
+        with qos_context(None, LANE_BULK):
+            for i in range(batch.n):
+                idx = batch.indices[i]
+                record: dict[str, Any] = {"_index": idx}
+                try:
+                    for s in self.stages:
+                        tree = s.preprocess(batch.decoded[i])
+                        stacked = stack_and_pad([tree], self.batch_size)
+                        placed = jax.tree_util.tree_map(
+                            lambda leaf: jax.device_put(leaf, self._sharding), stacked
+                        )
+                        row = unstack(s.device_fn(placed), 1)[0]
+                        record[s.name] = s.postprocess(batch.decoded[i], row)
+                except QueueFull as e:
+                    # Shed mid-salvage (postprocess hooks submit into shared
+                    # queues): transient, never a poison verdict — counts in
+                    # neither `succeeded` nor `failed`.
+                    record = {
+                        "_index": idx,
+                        "_error": f"shed: {type(e).__name__}: {e}",
+                    }
+                    self.stats.errors += 1
+                except Exception as e:  # noqa: BLE001 - candidate poison (pending sibling evidence)
+                    record = {
+                        "_index": idx,
+                        "_error": f"poison: {type(e).__name__}: {e}",
+                    }
+                    self.stats.errors += 1
+                    failed.append((i, e))
+                else:
+                    succeeded += 1
+                    if self.annotate is not None:
+                        record.update(self.annotate(batch.decoded[i]))
+                    if cache is not None and batch.keys[i] is not None and not record.get("_error"):
+                        cache.put(
+                            batch.keys[i],
+                            {k: v for k, v in record.items() if k != "_index"},
+                            clone=copy.deepcopy,
+                            fence=fence,
+                        )
+                finished[idx] = record
         # Same evidence rule as the batcher's bisection: a poison verdict
         # (and quarantine registration) requires at least one sibling that
         # ran clean. If EVERY item failed alone, the device — not the

@@ -55,6 +55,14 @@ Fault containment (three mechanisms, all per-batcher):
   :class:`~lumen_tpu.utils.deadline.WatchdogTimeout`, queued and in-flight
   work is drained loudly, and the batcher refuses new submits instead of
   wedging — mirroring the dead-fetch-worker containment.
+
+Multi-tenant QoS: the admission queue is tenant-aware by default
+(``LUMEN_QOS``, :mod:`~lumen_tpu.runtime.qos`) — per-(tenant, lane)
+sub-queues popped by virtual-time weighted-fair queuing, interactive
+outranking bulk, with the bulk lane browning out first under sustained
+pressure. ``QueueFull`` sheds carry the queue depth and a drain-time
+estimate from the measured service rate, so clients (and the serving
+layer's ``lumen-retry-after-ms`` hint) back off proportionally.
 """
 
 from __future__ import annotations
@@ -82,7 +90,9 @@ from ..utils.deadline import (
     get_deadline,
     remaining,
 )
+from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
+from .qos import WFQAdmissionQueue, wfq_enabled
 from .quarantine import QuarantineRegistry, get_quarantine
 from .trace import current_trace
 
@@ -170,13 +180,7 @@ def batch_window_ms() -> float | None:
     adaptive controller then never waits LONGER than the fixed window did,
     only shorter); explicit values let an operator stretch the window past
     the fixed default when occupancy matters more than tail latency."""
-    raw = os.environ.get("LUMEN_BATCH_WINDOW_MS")
-    if raw is None:
-        return None
-    try:
-        return max(0.0, float(raw))
-    except ValueError:
-        return None
+    return env_float("LUMEN_BATCH_WINDOW_MS", None, minimum=0.0)
 
 
 class AdaptiveWindow:
@@ -301,34 +305,78 @@ class _Occupancy:
             return out
 
 
+class _DrainRate:
+    """EWMA of settled items/second — the service-rate signal behind the
+    ``QueueFull`` drain-time estimate. A shed used to say only "queue
+    full"; with this, the error (and the ``lumen-retry-after-ms`` hint the
+    serving layer derives from it) says *when the backlog will clear*, so
+    clients back off proportionally instead of guessing."""
+
+    __slots__ = ("alpha", "_rate", "_last", "_lock")
+
+    #: inter-settle gaps above this are idle time, not service time — an
+    #: unclamped 5-minute lull before a burst would read as a ~0 rate and
+    #: tell the burst's shed clients to come back in minutes for a queue
+    #: that drains in under a second (same idiom as AdaptiveWindow's
+    #: idle-gap clamp). Clamping only ever UNDER-estimates drain time,
+    #: and an early retry is a cheap O(1) shed.
+    MAX_GAP_S = 5.0
+    #: hint ceiling: past this the estimate is stale-rate noise, and a
+    #: retry-after floor of minutes hurts more than an extra shed.
+    MAX_ESTIMATE_S = 30.0
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._rate: float | None = None  # items/second
+        self._last: float | None = None
+        self._lock = threading.Lock()
+
+    def record(self, n: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._last is not None:
+                dt = min(now - self._last, self.MAX_GAP_S)
+                if dt > 1e-6:
+                    inst = n / dt
+                    self._rate = (
+                        inst
+                        if self._rate is None
+                        else (1.0 - self.alpha) * self._rate + self.alpha * inst
+                    )
+            self._last = now
+
+    def estimate_s(self, queued: int) -> float | None:
+        """Seconds to drain ``queued`` items at the measured service rate
+        (capped at :data:`MAX_ESTIMATE_S`); ``None`` before any rate is
+        known (cold batcher)."""
+        with self._lock:
+            rate = self._rate
+        if rate is None or rate <= 0:
+            return None
+        return min(queued / rate, self.MAX_ESTIMATE_S)
+
+
 def batch_wait_timeout() -> float:
     """Default seconds a caller waits on a batched-call future — must
     tolerate a cold bucket compile through the tunnel (see
     :meth:`MicroBatcher.__call__`). ``LUMEN_BATCH_TIMEOUT_S`` overrides."""
-    try:
-        return float(os.environ.get("LUMEN_BATCH_TIMEOUT_S", "300"))
-    except ValueError:
-        return 300.0
+    return env_float("LUMEN_BATCH_TIMEOUT_S", 300.0)
 
 
 def batch_queue_depth() -> int:
     """Default queue-depth limit for admission control:
-    ``LUMEN_BATCH_QUEUE_DEPTH`` (0 / unset / malformed = unbounded, the
-    pre-resilience behavior)."""
-    try:
-        return max(0, int(os.environ.get("LUMEN_BATCH_QUEUE_DEPTH", "0")))
-    except ValueError:
-        return 0
+    ``LUMEN_BATCH_QUEUE_DEPTH`` (0 / unset = unbounded, the
+    pre-resilience behavior; a malformed value degrades to unbounded WITH
+    a one-shot warning — a typo'd depth limit must not silently remove
+    admission control)."""
+    return env_int("LUMEN_BATCH_QUEUE_DEPTH", 0, minimum=0)
 
 
 def batch_inflight() -> int:
     """Default bound on dispatched-but-unfetched batches:
     ``LUMEN_BATCH_INFLIGHT`` (default 2 — one computing, one settling;
     1 = no dispatch pipelining, malformed = default)."""
-    try:
-        return max(1, int(os.environ.get("LUMEN_BATCH_INFLIGHT", "2")))
-    except ValueError:
-        return 2
+    return env_int("LUMEN_BATCH_INFLIGHT", 2, minimum=1)
 
 
 def bisect_depth_default(max_batch: int) -> int:
@@ -336,12 +384,9 @@ def bisect_depth_default(max_batch: int) -> int:
     (0 disables bisection — a failing batch fans out to every caller, the
     pre-containment behavior); otherwise ``ceil(log2(max_batch))``, enough
     to isolate a single poison item out of a full batch."""
-    raw = os.environ.get("LUMEN_BISECT_DEPTH")
+    raw = env_int("LUMEN_BISECT_DEPTH", None, minimum=0)
     if raw is not None:
-        try:
-            return max(0, int(raw))
-        except ValueError:
-            pass
+        return raw
     return max(1, math.ceil(math.log2(max(2, max_batch))))
 
 
@@ -351,10 +396,7 @@ def batch_watchdog_s() -> float:
     (0 / unset / malformed = off — the CPU/test default; on TPU, size it
     above the worst warmed-bucket batch latency, and remember a cold
     compile through a tunnel can take >60s: warm up first)."""
-    try:
-        return max(0.0, float(os.environ.get("LUMEN_BATCH_WATCHDOG_S", "0")))
-    except ValueError:
-        return 0.0
+    return env_float("LUMEN_BATCH_WATCHDOG_S", 0.0, minimum=0.0)
 
 
 def _settle(fut: Future, result: Any = None, exception: BaseException | None = None) -> bool:
@@ -551,7 +593,19 @@ class MicroBatcher:
         # a backend that zero-copy-aliases host numpy stays correct.
         self._arenas: dict[tuple, list[list[np.ndarray]]] = {}
         self._arena_seq: dict[tuple, int] = {}
-        self._queue: queue.Queue[tuple[Any, Future, float | None, str | None] | None] = queue.Queue()
+        # Admission queue: tenant-aware weighted-fair by default
+        # (LUMEN_QOS, runtime/qos.py) — per-(tenant, lane) sub-queues
+        # popped by virtual-time WFQ, with the bulk lane browning out
+        # first under pressure. With only default-tenant interactive
+        # traffic the schedule IS the old FIFO; LUMEN_QOS=0 restores the
+        # plain stdlib queue outright.
+        self._queue: Any
+        if wfq_enabled():
+            self._queue = WFQAdmissionQueue(name=name, max_queue=self.max_queue)
+        else:
+            self._queue = queue.Queue()
+        # Service-rate EWMA feeding the QueueFull drain-time estimate.
+        self._drain = _DrainRate()
         self._thread: threading.Thread | None = None
         self._fetch_thread: threading.Thread | None = None
         self._watchdog_thread: threading.Thread | None = None
@@ -628,6 +682,20 @@ class MicroBatcher:
 
         self._occupancy_gauge_fn = _occupancy_gauges
         metrics.register_gauges(f"batch-occupancy:{self.name}", _occupancy_gauges)
+        if isinstance(self._queue, WFQAdmissionQueue):
+            # Per-tenant admission telemetry (queued/admitted/shed by
+            # tenant, lane totals, brownout level) next to the batcher's
+            # own gauges. The queue is reached through the batcher weakref
+            # like the sibling providers — capturing it directly would let
+            # the registry pin a dropped batcher's queue (and its queued
+            # entry tuples) forever.
+
+            def _qos_gauges() -> dict:
+                b = ref()
+                return {} if b is None else b._queue.gauges()
+
+            self._qos_gauge_fn = _qos_gauges
+            metrics.register_gauges(f"qos:{self.name}", _qos_gauges)
         return self
 
     def close(self) -> None:
@@ -677,6 +745,8 @@ class MicroBatcher:
             metrics.unregister_gauges(f"batcher:{self.name}", fn)
         if fn := getattr(self, "_occupancy_gauge_fn", None):
             metrics.unregister_gauges(f"batch-occupancy:{self.name}", fn)
+        if fn := getattr(self, "_qos_gauge_fn", None):
+            metrics.unregister_gauges(f"qos:{self.name}", fn)
 
     # -- client side ------------------------------------------------------
 
@@ -747,11 +817,45 @@ class MicroBatcher:
                 self.stats["shed"] += 1
                 metrics.count("sheds")
                 metrics.count(f"sheds:{self.name}")
-                raise QueueFull(
-                    f"{self.name}: admission queue full ({self.max_queue} waiting); request shed"
-                )
-            self._queue.put((item, fut, deadline, fingerprint))
+                raise self._queue_full_error(self.max_queue)
+            try:
+                self._queue.put((item, fut, deadline, fingerprint))
+            except QueueFull as e:
+                # WFQ brownout: the bulk lane sheds below the full depth
+                # so interactive traffic keeps the remaining headroom.
+                # Same accounting and drain-context contract as the
+                # full-queue shed above.
+                self.stats["shed"] += 1
+                metrics.count("sheds")
+                metrics.count(f"sheds:{self.name}")
+                self._attach_drain_hint(e, self._queue.qsize())
+                raise
         return fut
+
+    def _queue_full_error(self, depth: int) -> QueueFull:
+        """Build the full-queue shed error WITH backoff context: queue
+        depth plus the drain-time estimate from the measured service rate
+        (when one exists), so the client — and the serving layer's
+        ``lumen-retry-after-ms`` hint — can back off proportionally
+        instead of re-knocking on a queue that needs seconds to clear."""
+        est = self._drain.estimate_s(depth)
+        detail = f"{depth} waiting"
+        if est is not None:
+            detail += f", est drain {est:.2f}s"
+        e = QueueFull(
+            f"{self.name}: admission queue full ({detail}); request shed"
+        )
+        e.queue_depth = depth
+        if est is not None:
+            e.retry_after_s = est
+        return e
+
+    def _attach_drain_hint(self, e: QueueFull, depth: int) -> None:
+        e.queue_depth = getattr(e, "queue_depth", depth)
+        if getattr(e, "retry_after_s", None) is None:
+            est = self._drain.estimate_s(depth)
+            if est is not None:
+                e.retry_after_s = est
 
     def __call__(
         self, item: Any, timeout: float | None = None, fingerprint: str | None = None
@@ -1295,6 +1399,7 @@ class MicroBatcher:
                 self.stats["batches"] += 1
                 self.stats["items"] += entry.n
                 self.stats["padded"] += entry.size - entry.n
+                self._drain.record(entry.n)
                 for f, row in zip(entry.futures, rows):
                     _settle(f, result=row)
             with self._inflight_cv:

@@ -34,6 +34,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Iterable
 
 from ..utils.deadline import DeadlineExpired, get_deadline
+from ..utils.env import env_int
 from ..utils.metrics import metrics
 from .trace import current_trace
 
@@ -44,10 +45,7 @@ def decode_workers() -> int:
     """Pool size: ``LUMEN_DECODE_WORKERS`` when set to a positive int,
     else ``min(cpu_count, 16)`` (decode is CPU-bound; past the core count
     extra workers only add context switches)."""
-    try:
-        n = int(os.environ.get(DECODE_WORKERS_ENV, "0"))
-    except ValueError:
-        n = 0
+    n = env_int(DECODE_WORKERS_ENV, 0)
     if n > 0:
         return n
     return min(os.cpu_count() or 4, 16)

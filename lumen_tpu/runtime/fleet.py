@@ -55,6 +55,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
+from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
 from .batcher import MicroBatcher, wait_for_batch
 
@@ -100,20 +101,14 @@ def replica_failures() -> int:
     """``LUMEN_REPLICA_FAILURES``: consecutive backend failures that mark
     one replica down (default 3; 0 = replicas are never marked down by
     outcome — a wedged batcher still fails over at submit time)."""
-    try:
-        return max(0, int(os.environ.get(FAILURES_ENV, "3")))
-    except ValueError:
-        return 3
+    return env_int(FAILURES_ENV, 3, minimum=0)
 
 
 def replica_revive_s() -> float:
     """``LUMEN_REPLICA_REVIVE_S``: cooldown before a downed replica's
     batcher is rebuilt in the background (default 5s; 0 disables automatic
     revival — :meth:`ReplicaSet.revive` stays available to operators)."""
-    try:
-        return max(0.0, float(os.environ.get(REVIVE_ENV, "5")))
-    except ValueError:
-        return 5.0
+    return env_float(REVIVE_ENV, 5.0, minimum=0.0)
 
 
 def largest_dividing(requested: int, n: int) -> int:

@@ -28,13 +28,13 @@ host bookkeeping, usable from the serving layer without a backend.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 import weakref
 from collections import OrderedDict
 
 from ..utils.deadline import PoisonInput
+from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
 from ..utils.request_notes import mark as _mark
 
@@ -50,22 +50,13 @@ DEFAULT_MAX_ENTRIES = 4096
 def quarantine_ttl_s() -> float:
     """``LUMEN_QUARANTINE_TTL_S``: seconds an isolated fingerprint stays
     rejected (0 disables quarantine entirely; unset/malformed -> 300)."""
-    raw = os.environ.get(QUARANTINE_TTL_ENV)
-    if raw is None:
-        return DEFAULT_TTL_S
-    try:
-        return max(0.0, float(raw))
-    except ValueError:
-        return DEFAULT_TTL_S
+    return env_float(QUARANTINE_TTL_ENV, DEFAULT_TTL_S, minimum=0.0)
 
 
 def quarantine_max_entries() -> int:
     """``LUMEN_QUARANTINE_MAX``: LRU cap on tracked fingerprints
     (unset/malformed -> 4096; floor 1)."""
-    try:
-        return max(1, int(os.environ.get(QUARANTINE_MAX_ENV, DEFAULT_MAX_ENTRIES)))
-    except ValueError:
-        return DEFAULT_MAX_ENTRIES
+    return env_int(QUARANTINE_MAX_ENV, DEFAULT_MAX_ENTRIES, minimum=1)
 
 
 class _Entry:

@@ -43,6 +43,18 @@ host plumbing, importable from the serving layer without a backend.
 Caching is only ever keyed on deterministic work: the VLM manager bypasses
 the cache when ``do_sample`` / ``temperature > 0`` — sampled generations
 must stay sampled.
+
+Multi-tenant isolation (:mod:`~lumen_tpu.utils.qos`): keys for a
+non-default tenant carry a ``/tenant=<id>`` namespace qualifier, so one
+tenant's entries (and its poison-quarantine fingerprints — a tenant must
+not be able to poison-flag content another tenant serves) never answer
+for another's; per-tenant byte accounting rides each entry, and when the
+RAM tier is over budget it evicts **fair-share-first**: the victim is
+always the least-recently-used entry of the tenant holding the MOST
+bytes, so a flooding tenant's churn evicts its own backlog while smaller
+tenants' hot sets stay resident. ``cross_tenant_evictions`` counts the
+violations (an under-fair-share tenant losing an entry to another
+tenant's store) — zero by construction, watched by ``bench.py qos``.
 """
 
 from __future__ import annotations
@@ -62,7 +74,9 @@ from urllib.parse import quote, unquote
 import numpy as np
 
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, remaining
+from ..utils.env import env_int
 from ..utils.metrics import metrics
+from ..utils.qos import DEFAULT_TENANT, _MAX_TENANT_STATS, current_tenant
 from ..utils.request_notes import mark as _mark
 from .trace import current_trace
 
@@ -76,14 +90,9 @@ DEFAULT_CACHE_BYTES = 256 * 1024 * 1024
 
 def cache_bytes() -> int:
     """RAM-tier byte budget: ``LUMEN_CACHE_BYTES`` (0 disables the RAM
-    tier; unset/malformed -> 256 MiB default)."""
-    raw = os.environ.get(CACHE_BYTES_ENV)
-    if raw is None:
-        return DEFAULT_CACHE_BYTES
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        return DEFAULT_CACHE_BYTES
+    tier; unset -> 256 MiB default, malformed -> default with the shared
+    parser's one-shot warning)."""
+    return env_int(CACHE_BYTES_ENV, DEFAULT_CACHE_BYTES, minimum=0)
 
 
 def cache_dir() -> str | None:
@@ -117,10 +126,25 @@ def make_namespace(
     return ns
 
 
+#: namespace qualifier marking a non-default tenant's entries
+_TENANT_MARK = "/tenant="
+
+
 def make_key(namespace: str, options: Mapping[str, Any] | None, payload: bytes) -> str:
     """``{namespace}:{sha256 digest}`` — the namespace stays in the clear so
     prefix invalidation (model hot-swap) can drop a whole model's entries
-    without remembering its keys."""
+    without remembering its keys.
+
+    Tenant-scoped: a request running under a non-default tenant (the
+    ``lumen-tenant`` contextvar, see :mod:`~lumen_tpu.utils.qos`) gets a
+    trailing ``/tenant=<id>`` qualifier, so tenants never share entries —
+    or poison-quarantine fingerprints, which are this same key. The
+    family prefix stays leading, so hot-swap invalidation
+    (``invalidate("clip/")``) still sweeps every tenant's entries.
+    Default-tenant keys are byte-identical to the pre-QoS format."""
+    tenant = current_tenant()
+    if tenant != DEFAULT_TENANT:
+        namespace = f"{namespace}{_TENANT_MARK}{quote(tenant, safe='')}"
     h = hashlib.sha256()
     h.update(namespace.encode("utf-8"))
     h.update(b"\x00")
@@ -130,12 +154,25 @@ def make_key(namespace: str, options: Mapping[str, Any] | None, payload: bytes) 
     return f"{namespace}:{h.hexdigest()}"
 
 
-class _Entry:
-    __slots__ = ("value", "nbytes")
+def key_tenant(key: str) -> str:
+    """The tenant a cache key belongs to (``default`` for unscoped keys)
+    — the entry's accounting identity is intrinsic to its key, so
+    promotions and replacements always charge the same tenant no matter
+    which request context performs them."""
+    ns, _, _ = key.rpartition(":")
+    i = ns.rfind(_TENANT_MARK)
+    if i < 0:
+        return DEFAULT_TENANT
+    return unquote(ns[i + len(_TENANT_MARK):])
 
-    def __init__(self, value: Any, nbytes: int):
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "tenant")
+
+    def __init__(self, value: Any, nbytes: int, tenant: str = DEFAULT_TENANT):
         self.value = value
         self.nbytes = nbytes
+        self.tenant = tenant
 
 
 class ResultCache:
@@ -174,6 +211,18 @@ class ResultCache:
         self._inval_seq = 0
         self._inval_marks: dict[str, int] = {}
         self._waiting = 0  # callers currently blocked on another's flight
+        # Per-tenant RAM-tier byte accounting (entry tenant is intrinsic
+        # to its key): drives fair-share-first eviction and the
+        # ``bytes:{tenant}`` gauges. Only tenants with live entries keep
+        # a row — a drained tenant's row is deleted, so churn through
+        # many tenant ids cannot grow this without bound.
+        self._tenant_bytes: dict[str, int] = {}
+        # Per-tenant LRU key order mirroring ``_entries`` (same recency
+        # updates, same lock): victim selection in fair-share eviction is
+        # a first-key lookup instead of a scan over every other tenant's
+        # entries — churn under one tenant must not hold the cache lock
+        # for O(total entries) per eviction.
+        self._tenant_lru: dict[str, OrderedDict[str, None]] = {}
         # Local mirrors of the global event counters, for gauges/bench.
         self.stats = {
             "hits": 0,
@@ -181,6 +230,7 @@ class ResultCache:
             "misses": 0,
             "coalesced": 0,
             "evictions": 0,
+            "cross_tenant_evictions": 0,
             "stores": 0,
         }
         self._pickle_warned = False
@@ -220,6 +270,14 @@ class ResultCache:
                 "inflight": len(self._inflight),
                 "waiting": self._waiting,
             }
+            # Per-tenant residency only when a non-default tenant holds
+            # entries — single-tenant deployments keep the exact pre-QoS
+            # gauge payload.
+            if len(self._tenant_bytes) > 1 or (
+                self._tenant_bytes and DEFAULT_TENANT not in self._tenant_bytes
+            ):
+                for tenant, n in sorted(self._tenant_bytes.items()):
+                    out[f"bytes:{tenant}"] = n
         return out
 
     def hit_rate(self) -> float:
@@ -254,6 +312,7 @@ class ResultCache:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
+                self._lru_touch_locked(entry.tenant, key)
                 value = entry.value
             else:
                 value = None
@@ -529,7 +588,10 @@ class ResultCache:
             self._inval_marks[prefix] = self._inval_seq
             doomed = [k for k in self._entries if k.startswith(prefix)]
             for k in doomed:
-                self._bytes -= self._entries.pop(k).nbytes
+                e = self._entries.pop(k)
+                self._bytes -= e.nbytes
+                self._account_locked(e.tenant, -e.nbytes)
+                self._lru_forget_locked(e.tenant, k)
             # Retire matching in-flight computations too: a caller
             # arriving AFTER the invalidation must not coalesce onto a
             # pre-swap flight and be served the predecessor model's
@@ -548,6 +610,8 @@ class ResultCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._tenant_bytes.clear()
+            self._tenant_lru.clear()
             self._bytes = 0
 
     def close(self) -> None:
@@ -555,12 +619,74 @@ class ResultCache:
 
     # -- RAM tier ----------------------------------------------------------
 
+    def _accounting_tenant_locked(self, tenant: str) -> str:
+        """Accounting identity for a stored entry, bounded at the same
+        64-id cap as the quota/WFQ stat tables: overflow tenant ids
+        collapse onto the shared ``_other`` identity. Without the cap a
+        client spraying fabricated ``lumen-tenant`` ids would (a) shrink
+        ``fair = max_bytes / #tenants`` until the legitimate largest
+        tenant becomes the perpetual eviction victim while the
+        ``cross_tenant_evictions`` watchdog stays silent, and (b) grow the
+        ``bytes:{tenant}`` gauge payload without bound. Entries keep the
+        identity they were stored under (it rides the ``_Entry``), so
+        accounting stays consistent even as the mapping saturates."""
+        if tenant in self._tenant_bytes or len(self._tenant_bytes) < _MAX_TENANT_STATS:
+            return tenant
+        return "_other"
+
+    def _account_locked(self, tenant: str, delta: int) -> None:
+        n = self._tenant_bytes.get(tenant, 0) + delta
+        if n > 0:
+            self._tenant_bytes[tenant] = n
+        else:
+            self._tenant_bytes.pop(tenant, None)
+
+    def _lru_track_locked(self, tenant: str, key: str) -> None:
+        self._tenant_lru.setdefault(tenant, OrderedDict())[key] = None
+
+    def _lru_touch_locked(self, tenant: str, key: str) -> None:
+        order = self._tenant_lru.get(tenant)
+        if order is not None and key in order:
+            order.move_to_end(key)
+
+    def _lru_forget_locked(self, tenant: str, key: str) -> None:
+        order = self._tenant_lru.get(tenant)
+        if order is not None:
+            order.pop(key, None)
+            if not order:
+                del self._tenant_lru[tenant]
+
+    def _pop_victim_locked(self) -> _Entry:
+        """Fair-share-first eviction: the victim is the least-recently-
+        used entry of the tenant holding the MOST bytes. With one tenant
+        (the common single-tenant deployment) this IS plain LRU. The
+        largest tenant necessarily holds at least the mean share, so an
+        under-fair-share tenant is never the victim — one tenant's churn
+        cannot evict another's hot set. O(#tenants) via the per-tenant
+        LRU mirror, never O(#entries)."""
+        victim = None
+        if len(self._tenant_bytes) > 1:
+            fattest = max(self._tenant_bytes, key=self._tenant_bytes.get)
+            order = self._tenant_lru.get(fattest)
+            if order:  # accounting drift guard; always populated
+                k = next(iter(order))
+                victim = self._entries.pop(k)
+                self._lru_forget_locked(fattest, k)
+        if victim is None:
+            k, victim = self._entries.popitem(last=False)
+            self._lru_forget_locked(victim.tenant, k)
+        self._bytes -= victim.nbytes
+        self._account_locked(victim.tenant, -victim.nbytes)
+        return victim
+
     def _store_ram(
         self, key: str, value: Any, nbytes: int, fence: int | None = None
     ) -> None:
         if self.max_bytes <= 0 or nbytes > self.max_bytes:
             return  # RAM tier off, or a single value that outweighs it
+        tenant = key_tenant(key)
         evicted = 0
+        cross = 0
         with self._lock:
             # Authoritative fence check, under the same lock invalidate()
             # sweeps with: either this insert lands before the sweep (and
@@ -570,15 +696,31 @@ class ResultCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
-            self._entries[key] = _Entry(value, nbytes)
+                self._account_locked(old.tenant, -old.nbytes)
+                self._lru_forget_locked(old.tenant, key)
+            tenant = self._accounting_tenant_locked(tenant)
+            self._entries[key] = _Entry(value, nbytes, tenant)
             self._bytes += nbytes
+            self._account_locked(tenant, nbytes)
+            self._lru_track_locked(tenant, key)
             while self._bytes > self.max_bytes and self._entries:
-                _, victim = self._entries.popitem(last=False)
-                self._bytes -= victim.nbytes
+                fair = self.max_bytes / max(1, len(self._tenant_bytes))
+                victim = self._pop_victim_locked()
                 evicted += 1
+                if victim.tenant != tenant and (
+                    self._tenant_bytes.get(victim.tenant, 0) + victim.nbytes < fair
+                ):
+                    # An under-fair-share tenant lost an entry to another
+                    # tenant's store — the isolation violation the
+                    # fair-share policy exists to prevent. Zero by
+                    # construction; counted so the bench can prove it.
+                    cross += 1
         if evicted:
             self.stats["evictions"] += evicted
             metrics.count("cache_evictions", evicted)
+        if cross:
+            self.stats["cross_tenant_evictions"] += cross
+            metrics.count("cache_cross_tenant_evictions", cross)
 
     # -- disk tier ---------------------------------------------------------
 

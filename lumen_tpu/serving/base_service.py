@@ -27,6 +27,15 @@ quarantine, error mapping), and a client disconnect mid-stream cancels the
 not-yet-started remainder of the fan-out. This amortizes stream setup,
 admission and context bookkeeping that BENCH_r05 showed costing more than
 the device call itself (77 rps through gRPC vs 9k images/s on-device).
+
+**Multi-tenant QoS** (:mod:`lumen_tpu.utils.qos`): every dispatch resolves
+a ``(tenant, lane)`` identity — tenant from the ``lumen-tenant`` gRPC
+request-metadata key (or a ``tenant`` request-meta field), lane from an
+explicit ``priority`` meta or the bulk lane's auto-tag — gates it through
+the per-tenant token buckets (``LUMEN_QOS_TENANT_RPS``; sheds answer
+RESOURCE_EXHAUSTED-style with a ``lumen-retry-after-ms`` hint in O(1),
+before payload/cache/decode work), and carries the identity on a
+contextvar into the batcher's weighted-fair admission queue.
 """
 
 from __future__ import annotations
@@ -44,8 +53,10 @@ import grpc
 from google.protobuf import empty_pb2
 
 from ..utils import deadline as request_deadline, request_notes
+from ..utils import qos as request_qos
 from ..utils import trace as request_trace
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
+from ..utils.env import env_int
 from ..utils.metrics import metrics
 from .proto import ml_service_pb2 as pb
 from .proto.ml_service_pb2_grpc import InferenceServicer
@@ -65,10 +76,7 @@ def bulk_workers() -> int:
     (decode runs on the decode pool, the device call on the batcher), so
     they are waiters, not CPU burners: the floor keeps enough of them to
     fill a device batch even on small hosts)."""
-    try:
-        n = int(os.environ.get("LUMEN_BULK_WORKERS", "0"))
-    except ValueError:
-        n = 0
+    n = env_int("LUMEN_BULK_WORKERS", 0, minimum=0)
     if n > 0:
         return n
     return max(8, min((os.cpu_count() or 4) * 2, 16))
@@ -94,12 +102,14 @@ def _get_bulk_pool() -> ThreadPoolExecutor:
 
 def _response_chunk_bytes() -> int:
     """LUMEN_RESPONSE_CHUNK_BYTES, clamped to [1 MB, 60 MB]; malformed
-    values fall back to the 48 MB default (degrade, not crash)."""
-    try:
-        v = int(os.environ.get("LUMEN_RESPONSE_CHUNK_BYTES", 48 * 1024 * 1024))
-    except ValueError:
-        return 48 * 1024 * 1024
-    return min(60 * 1024 * 1024, max(1 << 20, v))
+    values fall back to the 48 MB default (degrade, not crash — with the
+    shared parser's one-shot warning)."""
+    return env_int(
+        "LUMEN_RESPONSE_CHUNK_BYTES",
+        48 * 1024 * 1024,
+        minimum=1 << 20,
+        maximum=60 * 1024 * 1024,
+    )
 
 
 def reassemble_result(responses) -> tuple[bytes, str, dict[str, str]]:
@@ -419,10 +429,9 @@ class BaseService(InferenceServicer):
         return None if rem is None else time.monotonic() + rem
 
     @staticmethod
-    def _trace_id_from(context) -> str | None:
-        """Client-propagated trace id from the ``lumen-trace`` gRPC
-        request metadata key (None on stub contexts or untraced callers)
-        — lets a client stitch its side of the request into ``/traces``."""
+    def _invocation_meta(context, wanted: str) -> str | None:
+        """One gRPC request-metadata value by key (None on stub contexts
+        or absent keys) — shared by the trace-id and tenant-id reads."""
         md = getattr(context, "invocation_metadata", None)
         if not callable(md):
             return None
@@ -432,11 +441,40 @@ class BaseService(InferenceServicer):
                 value = getattr(item, "value", None)
                 if key is None and isinstance(item, (tuple, list)) and len(item) == 2:
                     key, value = item
-                if key == request_trace.TRACE_META_KEY and value:
+                if key == wanted and value:
                     return str(value)
-        except Exception:  # noqa: BLE001 - tracing must never break dispatch
+        except Exception:  # noqa: BLE001 - metadata must never break dispatch
             return None
         return None
+
+    @classmethod
+    def _trace_id_from(cls, context) -> str | None:
+        """Client-propagated trace id from the ``lumen-trace`` gRPC
+        request metadata key (None on stub contexts or untraced callers)
+        — lets a client stitch its side of the request into ``/traces``."""
+        return cls._invocation_meta(context, request_trace.TRACE_META_KEY)
+
+    @classmethod
+    def _qos_identity(cls, asm: _Assembly, context) -> tuple[str, str]:
+        """Resolve the request's ``(tenant, lane)``. Tenant: the
+        ``lumen-tenant`` gRPC request-metadata key, else a ``tenant``
+        request-meta field (in-process/stub callers), else ``default``.
+        Lane: an explicit ``priority`` meta (``interactive``/``bulk``)
+        wins; otherwise the bulk streaming lane auto-tags ``bulk`` and
+        everything else is interactive."""
+        tenant = (
+            cls._invocation_meta(context, request_qos.TENANT_META_KEY)
+            or asm.meta.get("tenant")
+            or request_qos.DEFAULT_TENANT
+        )
+        explicit = asm.meta.get("priority")
+        if explicit in request_qos.LANES:
+            lane = explicit
+        elif asm.meta.get(BULK_META) == "1":
+            lane = request_qos.LANE_BULK
+        else:
+            lane = request_qos.LANE_INTERACTIVE
+        return tenant, lane
 
     def _dispatch(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
         """Trace-lifecycle wrapper around :meth:`_dispatch_inner`. With
@@ -502,9 +540,45 @@ class BaseService(InferenceServicer):
                     f"circuit breaker open for service "
                     f"{self.registry.service_name!r}; request shed",
                     f"backend failing repeatedly; retry after ~{retry_after:.1f}s",
-                    meta={"breaker_open": "1"},
+                    meta={
+                        "breaker_open": "1",
+                        request_qos.RETRY_AFTER_META: request_qos.retry_after_ms(
+                            retry_after
+                        ),
+                    },
                 )
                 return
+        # Per-tenant quota gate: a tenant over its token-bucket rate
+        # (LUMEN_QOS_TENANT_RPS / LUMEN_QOS_RPS_<TENANT>) is shed HERE —
+        # before payload assembly, cache lookups, the decode pool and the
+        # admission queue, in O(1) (~10µs, same order as a breaker shed) —
+        # with the RESOURCE_EXHAUSTED shape plus a ``lumen-retry-after-ms``
+        # hint saying exactly when the next token lands.
+        tenant, lane = self._qos_identity(asm, context)
+        admitted, retry_after = request_qos.get_quota().gate(tenant)
+        if not admitted:
+            err = ResourceExhausted(
+                f"tenant {tenant!r} over its request-rate quota; "
+                f"{asm.task!r} shed",
+                f"per-tenant quota exceeded; retry after ~{retry_after:.2f}s",
+            )
+            # A quota shed says nothing about backend health, but it may
+            # hold the half-open probe slot — release it (neutral).
+            self._record_outcome(err)
+            metrics.count_error(asm.task)
+            yield self._error(
+                cid,
+                err.code,
+                str(err),
+                err.detail,
+                meta={
+                    "qos_shed": "1",
+                    request_qos.RETRY_AFTER_META: request_qos.retry_after_ms(
+                        retry_after
+                    ),
+                },
+            )
+            return
         payload = asm.payload()
         if len(payload) > task.max_payload_bytes:
             # Past the breaker gate but before the handler: this request
@@ -540,6 +614,11 @@ class BaseService(InferenceServicer):
         # body runs inside _stream_out's iteration, and its batcher
         # submits must still see the request deadline.
         token = request_deadline.set_deadline(deadline)
+        # QoS identity scope: the batcher's weighted-fair admission queue
+        # (and the result cache's per-tenant accounting) read the tenant
+        # and priority lane from this contextvar — no signature in
+        # between grows a parameter, same pattern as the deadline.
+        qos_token = request_qos.activate(tenant, lane)
         # Cache-note scope: the result cache (layers below, in the manager)
         # marks hit/coalesce here; unary responses surface the marks as
         # trailing ``cache_hit`` / ``cache_coalesced`` meta. A hit is
@@ -594,6 +673,7 @@ class BaseService(InferenceServicer):
                 yield from self._stream_out(cid, asm.task, out, t0)
         finally:
             request_notes.end_notes(notes_token)
+            request_qos.deactivate(qos_token)
             request_deadline.reset(token)
 
     #: Split unary results larger than this into seq/total/offset chunks
@@ -707,10 +787,19 @@ class BaseService(InferenceServicer):
         isolation or quarantine verdict, and the response meta carries
         ``quarantined`` when the quarantine registry flagged it), and a
         :class:`WatchdogTimeout` is an :class:`Unavailable` (backend
-        stalled; the breaker/recovery path is already on it)."""
+        stalled; the breaker/recovery path is already on it). A
+        :class:`QueueFull` that carries the batcher's drain-time estimate
+        surfaces it as the ``lumen-retry-after-ms`` response-meta hint —
+        the same key quota and breaker sheds use — so every shed tells
+        the client when to come back."""
         meta = None
         if isinstance(e, QueueFull):
             err: ServiceError = ResourceExhausted(f"{task_name}: {e}")
+            hint = getattr(e, "retry_after_s", None)
+            if hint is not None:
+                meta = {
+                    request_qos.RETRY_AFTER_META: request_qos.retry_after_ms(hint)
+                }
         elif isinstance(e, PoisonInput):
             err = InvalidArgument(
                 f"{task_name}: {e}",

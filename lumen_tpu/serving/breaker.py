@@ -39,12 +39,12 @@ successes. State changes land on :mod:`~lumen_tpu.utils.metrics`
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 import weakref
 from typing import Callable
 
+from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -67,28 +67,19 @@ _STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 def breaker_failures() -> int:
     """``LUMEN_BREAKER_FAILURES``: consecutive non-poison failures that
     trip the breaker (0 disables; unset/malformed -> 6)."""
-    try:
-        return max(0, int(os.environ.get(BREAKER_FAILURES_ENV, DEFAULT_FAILURES)))
-    except ValueError:
-        return DEFAULT_FAILURES
+    return env_int(BREAKER_FAILURES_ENV, DEFAULT_FAILURES, minimum=0)
 
 
 def breaker_window_s() -> float:
     """``LUMEN_BREAKER_WINDOW_S``: the failure streak must fit in this
     window to trip (a streak older than the window restarts the count)."""
-    try:
-        return max(0.1, float(os.environ.get(BREAKER_WINDOW_ENV, DEFAULT_WINDOW_S)))
-    except ValueError:
-        return DEFAULT_WINDOW_S
+    return env_float(BREAKER_WINDOW_ENV, DEFAULT_WINDOW_S, minimum=0.1)
 
 
 def breaker_reset_s() -> float:
     """``LUMEN_BREAKER_RESET_S``: how long an open breaker sheds before
     admitting one half-open probe."""
-    try:
-        return max(0.05, float(os.environ.get(BREAKER_RESET_ENV, DEFAULT_RESET_S)))
-    except ValueError:
-        return DEFAULT_RESET_S
+    return env_float(BREAKER_RESET_ENV, DEFAULT_RESET_S, minimum=0.05)
 
 
 class CircuitBreaker:

@@ -21,11 +21,11 @@ and ``LUMEN_RECOVERY_BACKOFF_MAX_S`` for the backoff shape.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import TYPE_CHECKING, Callable
 
+from ..utils.env import env_int
 from ..utils.metrics import metrics
 from ..utils.retry import RetryPolicy, policy_from_env
 from .base_service import BaseService, Unavailable
@@ -127,10 +127,7 @@ def recovery_policy() -> RetryPolicy:
 def recovery_max_attempts() -> int:
     """``LUMEN_RECOVERY_RETRIES``: cap on recovery attempts per service
     (0 / unset / malformed = unlimited)."""
-    try:
-        return max(0, int(os.environ.get("LUMEN_RECOVERY_RETRIES", "0")))
-    except ValueError:
-        return 0
+    return env_int("LUMEN_RECOVERY_RETRIES", 0, minimum=0)
 
 
 class RecoveryManager:

@@ -23,7 +23,11 @@ Resilience semantics on top of the reference:
   ride ``Health`` trailing metadata (``lumen-breaker-status``) and each
   ``StreamCapabilities`` record (``extra["breaker"]``), and the current
   poison-quarantine size rides ``lumen-quarantine-size`` — a client can
-  tell "backend fast-failing" from "overloaded" without a failed Infer.
+  tell "backend fast-failing" from "overloaded" without a failed Infer;
+- multi-tenant QoS state rides ``lumen-qos-status`` (per-admission-queue
+  occupancy + brownout level, per-tenant quota admit/shed totals) so an
+  operator sees "tenant X is being browned out" from a Health probe, and
+  each ``StreamCapabilities`` record carries ``extra["qos"]``.
 """
 
 from __future__ import annotations
@@ -238,6 +242,18 @@ class HubRouter(InferenceServicer):
         return out
 
     @staticmethod
+    def _qos_status() -> dict:
+        """Live multi-tenant QoS state (jax-free — the implementation
+        lives in ``utils.qos`` precisely so this router can read it on
+        jax-free deployments). ``{}`` omits the key entirely."""
+        from ..utils import qos
+
+        try:
+            return qos.status()
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            return {}
+
+    @staticmethod
     def _quarantine_size() -> int | None:
         """Entries currently quarantined, WITHOUT importing the runtime
         package (which drags in jax — this router must stay importable and
@@ -269,6 +285,14 @@ class HubRouter(InferenceServicer):
                     # condition (siblings keep the hub SERVING), exactly
                     # like a degraded sibling service.
                     trailing.append(("lumen-replica-status", json.dumps(replicas)))
+                qos_state = self._qos_status()
+                if qos_state:
+                    # Multi-tenant QoS next to the containment keys:
+                    # per-admission-queue occupancy/brownout and the
+                    # quota gate's per-tenant admit/shed totals — a
+                    # browned-out bulk lane is a reported condition, not
+                    # an outage.
+                    trailing.append(("lumen-qos-status", json.dumps(qos_state)))
                 context.set_trailing_metadata(tuple(trailing))
             except Exception:  # noqa: BLE001 - test stubs may lack metadata support
                 pass

@@ -24,6 +24,7 @@ from ...core.config import ServiceConfig
 from ...core.result_schemas import EmbeddingV1, LabelsV1, LabelItem
 from ...models.clip import CLIPManager
 from ...runtime.rknn import require_executable_runtime
+from ...utils.qos import service_extra as qos_service_extra
 from ..base_service import BaseService, InvalidArgument, Unavailable, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -172,6 +173,10 @@ class ClipService(BaseService):
                 "embed_dims": ",".join(str(m.cfg.embed_dim) for m in self.managers.values()),
                 "quant_routes": ",".join(routes),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                # Multi-tenant QoS: WFQ admission state + brownout level
+                # of this family's batchers (clip-image/clip-text, plus
+                # bioclip-* when both aliases are loaded).
+                "qos": qos_service_extra(*self.managers.keys()),
                 **primary.topology(),
             },
         )

@@ -20,6 +20,7 @@ from ...core.config import ServiceConfig
 from ...core.result_schemas import FaceItem, FaceV1
 from ...models.face import FaceManager
 from ...runtime.rknn import require_executable_runtime
+from ...utils.qos import service_extra as qos_service_extra
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -95,6 +96,9 @@ class FaceService(BaseService):
                 "det_size": str(self.manager.det_cfg.input_size),
                 "embedding_dim": str(self.manager.rec_cfg.embed_dim),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                # Multi-tenant QoS: WFQ admission state + brownout level
+                # of the face-det/face-rec admission queues.
+                "qos": qos_service_extra("face"),
                 # device topology + replica layout (fleet-internal clients
                 # pick endpoints from these instead of probing)
                 **self.manager.topology(),

@@ -14,6 +14,7 @@ from ...core.config import ServiceConfig
 from ...core.result_schemas import OcrItem, OCRV1
 from ...models.ocr import OcrManager
 from ...runtime.rknn import require_executable_runtime
+from ...utils.qos import service_extra as qos_service_extra
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -69,6 +70,10 @@ class OcrService(BaseService):
                 "rec_height": str(self.manager.rec_cfg.height),
                 "vocab_size": str(len(self.manager.vocab)),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                # Multi-tenant QoS: OCR has no MicroBatcher (ragged
+                # det/rec shapes), so this reports the quota/lane config
+                # only — no per-queue brownout entry.
+                "qos": qos_service_extra("ocr"),
                 **self.manager.topology(),
             },
         )

@@ -20,6 +20,7 @@ from ...core.config import ServiceConfig
 from ...core.result_schemas import TextGenerationV1
 from ...models.vlm import ChatMessage, VLMManager
 from ...runtime.rknn import require_executable_runtime
+from ...utils.qos import service_extra as qos_service_extra
 from ..base_service import BaseService, InvalidArgument
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -118,6 +119,10 @@ class VlmService(BaseService):
                 "vision_tokens": str(self.manager.vision_tokens),
                 "vocab_size": str(self.manager.cfg.decoder.vocab_size),
                 "bulk_stream": "1",  # many-items-per-stream Infer lane
+                # Multi-tenant QoS: the VLM generation batcher schedules
+                # its own slot pool, so this reports the quota/lane
+                # config (the gRPC-layer gate still applies to it).
+                "qos": qos_service_extra("vlm"),
                 "quant_route": self.manager.quant_route,
                 **self.manager.topology(),
             },

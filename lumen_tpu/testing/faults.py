@@ -41,6 +41,12 @@ The containment points (per-item match support):
   the batcher parks the dispatch where a wedged device call would block,
   until its watchdog (``LUMEN_BATCH_WATCHDOG_S``) fires or the batcher
   closes. ``LUMEN_FAULTS="batch_hang:1:1@vlm"`` hangs one VLM batch.
+- ``tenant_flood`` — consulted via :meth:`FaultInjector.fires` by the
+  per-tenant quota gate (:class:`~lumen_tpu.utils.qos.TenantQuota`) with
+  the tenant id as detail: armed, the matched tenant's token bucket reads
+  as exhausted, so every one of its requests sheds with the retry-after
+  hint — a deterministic tenant flood with zero generated traffic.
+  ``LUMEN_FAULTS="tenant_flood@team-a"`` floods tenant ``team-a`` only.
 
 Production hooks call :meth:`FaultInjector.check`; its disarmed fast path
 is one attribute read, so shipping the hooks costs nothing.
@@ -68,6 +74,7 @@ MODEL_LOAD = "model_load"
 BATCH_EXECUTE = "batch_execute"
 BATCH_POISON = "batch_poison"
 BATCH_HANG = "batch_hang"
+TENANT_FLOOD = "tenant_flood"
 
 
 class FaultInjected(ResourceError):

@@ -12,17 +12,24 @@ Every retry is visible: attempts land on the process-global metrics
 registry as ``retries`` (aggregate) and ``retries:{scope}`` counters, so an
 operator can tell "the hub is quietly re-fetching flaky artifacts" from a
 dashboard instead of log archaeology.
-"""
+
+Server retry hints: when the failure itself says when to come back — a
+QoS quota or queue shed carrying ``lumen-retry-after-ms`` trailing meta,
+surfaced by callers as a ``retry_after_s`` attribute on the raised
+exception — that hint becomes the backoff *floor*: the jittered delay is
+taken as usual but never undershoots what the server asked for, so a
+shed fleet converges on the server's drain estimate instead of
+re-knocking at full-jitter random."""
 
 from __future__ import annotations
 
 import logging
-import os
 import random
 import time
 from dataclasses import dataclass
 from typing import Callable, Iterator, Type
 
+from .env import env_float
 from .metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -64,10 +71,7 @@ def policy_from_env(prefix: str, default: RetryPolicy) -> RetryPolicy:
     env knob in the stack: a typo'd override must not crash serving)."""
 
     def _num(name: str, fallback: float) -> float:
-        try:
-            return float(os.environ.get(name, fallback))
-        except ValueError:
-            return fallback
+        return env_float(name, fallback)
 
     retries = _num(f"LUMEN_{prefix}_RETRIES", default.attempts - 1)
     return RetryPolicy(
@@ -76,6 +80,22 @@ def policy_from_env(prefix: str, default: RetryPolicy) -> RetryPolicy:
         max_delay_s=max(0.0, _num(f"LUMEN_{prefix}_BACKOFF_MAX_S", default.max_delay_s)),
         jitter=default.jitter,
     )
+
+
+def retry_after_hint(exc: BaseException) -> float | None:
+    """The server-provided retry-after hint riding ``exc`` (seconds), or
+    None. The convention: any layer that learns when the server wants the
+    caller back (the client parsing ``lumen-retry-after-ms`` response
+    meta, the batcher stamping its drain estimate on a ``QueueFull``)
+    sets ``retry_after_s`` on the exception it raises."""
+    hint = getattr(exc, "retry_after_s", None)
+    if hint is None:
+        return None
+    try:
+        hint = float(hint)
+    except (TypeError, ValueError):
+        return None
+    return hint if hint > 0 else None
 
 
 def _is_retryable(exc: BaseException, spec) -> bool:
@@ -115,6 +135,14 @@ def retry_call(
             if last_try or not _is_retryable(e, retryable):
                 raise
             delay = policy.delay(attempt, rng)
+            hint = retry_after_hint(e)
+            if hint is not None and delay < hint:
+                # The server said when to come back: its hint floors the
+                # backoff. A jittered overshoot (up to 25% past the hint)
+                # de-correlates a fleet shed at the same instant with the
+                # same hint — clamping everyone to exactly the hint would
+                # resynchronize the stampede on the token-arrival time.
+                delay = hint * (1.0 + 0.25 * (rng or random).random())
             metrics.count("retries")
             if scope:
                 metrics.count(f"retries:{scope}")

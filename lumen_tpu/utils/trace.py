@@ -55,6 +55,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Iterator
 
+from .env import env_int
 from .metrics import metrics
 
 TRACE_SAMPLE_ENV = "LUMEN_TRACE_SAMPLE"
@@ -98,20 +99,14 @@ def enabled() -> bool:
 def trace_ring() -> int:
     """``LUMEN_TRACE_RING``: capacity of the sampled-trace ring buffer
     (unset/malformed -> 256; floor 1)."""
-    try:
-        return max(1, int(os.environ.get(TRACE_RING_ENV, "256")))
-    except ValueError:
-        return 256
+    return env_int(TRACE_RING_ENV, 256, minimum=1)
 
 
 def trace_slow_n() -> int:
     """``LUMEN_TRACE_SLOW_N``: how many slowest traces are always
     retained regardless of sampling (unset/malformed -> 16; 0 disables
     the slowest-N lane)."""
-    try:
-        return max(0, int(os.environ.get(TRACE_SLOW_ENV, "16")))
-    except ValueError:
-        return 16
+    return env_int(TRACE_SLOW_ENV, 16, minimum=0)
 
 
 def new_trace_id() -> str:

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Assert every ``lumen-*`` trailing/request-metadata key the serving
+layer emits is documented in ``docs/OBSERVABILITY.md``.
+
+The key vocabulary (breaker / quarantine / replica / qos / trace status
+riding gRPC metadata) has outgrown ad-hoc docs: clients and dashboards
+parse these keys, so one added in code but missing from the cookbook is
+silent API drift — exactly the gap ``check_metrics.py`` closes for
+metric names. Collected by pytest (``tests/test_check_meta_keys.py``) so
+tier-1 fails on the gap, and runs standalone::
+
+    python scripts/check_meta_keys.py
+
+Mechanics: two literal scans, unioned —
+
+- tuple-paired emission sites in ``lumen_tpu/serving/``:
+  ``("lumen-foo", value)`` appended to trailing metadata;
+- package-wide key *constants* (``FOO_META = "lumen-foo"`` /
+  ``FOO_META_KEY = "lumen-foo"``) — serving emits through these names
+  (``utils/qos.py``, ``utils/trace.py``), so the definition site is the
+  single literal to find.
+
+Plain ``lumen-`` prose (package names like ``lumen-clip``, the
+``lumen-tpu`` binary) matches neither shape, so no allowlist is needed.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+
+#: ("lumen-foo", ...) — a metadata tuple literal at an emission site.
+_TUPLE_KEY = re.compile(r'\(\s*"(lumen-[a-z0-9-]+)"\s*,')
+#: FOO_META / FOO_META_KEY = "lumen-foo" — a key constant definition.
+_CONST_KEY = re.compile(r'^[A-Z0-9_]*_META(?:_KEY)?\s*=\s*"(lumen-[a-z0-9-]+)"', re.M)
+
+
+def _walk_py(root: str):
+    for dirpath, _, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8", errors="ignore") as f:
+                    yield f.read()
+            except OSError:
+                continue
+
+
+def emitted_keys() -> set[str]:
+    """Every lumen-* metadata key the serving layer can emit."""
+    found: set[str] = set()
+    for text in _walk_py(os.path.join(REPO_ROOT, "lumen_tpu", "serving")):
+        found.update(_TUPLE_KEY.findall(text))
+    for text in _walk_py(os.path.join(REPO_ROOT, "lumen_tpu")):
+        found.update(_CONST_KEY.findall(text))
+    return found
+
+
+def documented_text() -> str:
+    if not os.path.exists(DOC_PATH):
+        return ""
+    with open(DOC_PATH, encoding="utf-8", errors="ignore") as f:
+        return f.read()
+
+
+def undocumented() -> list[str]:
+    doc = documented_text()
+    return sorted(key for key in emitted_keys() if key not in doc)
+
+
+def main() -> int:
+    missing = undocumented()
+    if missing:
+        print("lumen-* metadata keys emitted in code but missing from docs/OBSERVABILITY.md:")
+        for key in missing:
+            print(f"  {key}")
+        return 1
+    print(f"ok: {len(emitted_keys())} emitted metadata keys all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""Tier-1 gate: every ``lumen-*`` gRPC metadata key the serving layer
+emits appears in the docs/OBSERVABILITY.md key table, so the metadata
+vocabulary (breaker/quarantine/replica/qos/trace) can't silently drift.
+See scripts/check_meta_keys.py."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_meta_keys",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_meta_keys.py"),
+)
+check_meta_keys = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_meta_keys)
+
+
+def test_every_emitted_meta_key_is_documented():
+    missing = check_meta_keys.undocumented()
+    assert not missing, (
+        f"lumen-* metadata keys emitted in code but missing from "
+        f"docs/OBSERVABILITY.md: {missing} — add each to the metadata-key "
+        "table"
+    )
+
+
+def test_scan_finds_known_keys():
+    # Sanity that both scan shapes work — a regex typo must not turn the
+    # gate into a silent pass.
+    keys = check_meta_keys.emitted_keys()
+    assert "lumen-service-status" in keys   # router trailing tuple
+    assert "lumen-qos-status" in keys       # router trailing tuple (QoS)
+    assert "lumen-tenant" in keys           # constant in utils/qos.py
+    assert "lumen-retry-after-ms" in keys   # constant in utils/qos.py
+    assert "lumen-trace" in keys            # constant in utils/trace.py
+    # package names / the binary name are prose, not keys
+    assert "lumen-tpu" not in keys
+    assert "lumen-clip" not in keys

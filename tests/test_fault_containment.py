@@ -802,6 +802,72 @@ class TestIngestContainment:
         assert len(registry) == 0
         registry.close()
 
+    def test_queue_full_is_transient_shed_nothing_quarantined(self, mesh, monkeypatch):
+        """A QueueFull out of a stage (shared admission queue browning the
+        bulk lane out) is a load shed, not a poison suspicion: every item
+        gets a retryable ``shed:`` record in ONE pass — no per-item re-runs
+        hammering the queue that just shed — and nothing is quarantined."""
+        import lumen_tpu.runtime.quarantine as qmod
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+        from lumen_tpu.utils.deadline import QueueFull
+
+        registry = QuarantineRegistry(ttl_s=60)
+        monkeypatch.setattr(qmod, "_shared", registry)
+        calls = []
+
+        def shedding_device(batch):
+            calls.append(1)
+            raise QueueFull("admission queue full (8 waiting); request shed")
+
+        pipe = IngestPipeline(
+            mesh,
+            [Stage("s", preprocess=lambda d: np.zeros((2,), np.float32),
+                   device_fn=shedding_device)],
+            batch_size=4,
+            workers=1,
+            cache_namespace="ingest/shed",
+        )
+        records = pipe.run_all([b"a", b"b", b"c", b"d"])
+        assert [r["_index"] for r in records] == [0, 1, 2, 3]
+        assert all(r["_error"].startswith("shed:") for r in records)
+        assert len(registry) == 0  # never a poison verdict
+        assert len(calls) == 1  # no per-item salvage re-runs
+        assert pipe.stats.errors == 4
+        registry.close()
+
+    def test_queue_full_in_postprocess_sheds_item_run_continues(self, mesh, monkeypatch):
+        """Postprocess hooks submit into shared MicroBatchers; a bulk-lane
+        shed there must become THAT item's retryable error record, not
+        abort the run (and never quarantine the item's bytes)."""
+        import lumen_tpu.runtime.quarantine as qmod
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+        from lumen_tpu.utils.deadline import QueueFull
+
+        registry = QuarantineRegistry(ttl_s=60)
+        monkeypatch.setattr(qmod, "_shared", registry)
+
+        def shedding_post(decoded, row):
+            if decoded == b"shed-me":
+                raise QueueFull("rec-model admission queue full; request shed")
+            return float(np.asarray(row).sum())
+
+        pipe = IngestPipeline(
+            mesh,
+            [Stage("s", preprocess=lambda d: np.ones((2,), np.float32),
+                   device_fn=lambda b: b.sum(-1), postprocess=shedding_post)],
+            batch_size=4,
+            workers=1,
+            cache_namespace="ingest/shedpost",
+        )
+        records = pipe.run_all([b"a", b"shed-me", b"c", b"d"])
+        assert [r["_index"] for r in records] == [0, 1, 2, 3]
+        assert records[1]["_error"].startswith("shed:")
+        ok = [r for r in records if not r.get("_error")]
+        assert len(ok) == 3 and all(r["s"] == pytest.approx(2.0) for r in ok)
+        assert len(registry) == 0
+        assert pipe.stats.errors == 1
+        registry.close()
+
     def test_quarantined_bytes_rejected_pre_decode(self, mesh, monkeypatch):
         import lumen_tpu.runtime.quarantine as qmod
         from lumen_tpu.pipeline.ingest import IngestPipeline, Stage

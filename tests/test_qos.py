@@ -1,0 +1,752 @@
+"""Multi-tenant QoS: weighted-fair admission, priority lanes, per-tenant
+quotas, brownout, tenant-scoped caching, and the retry-after contract.
+
+Covers the full layer cake: the shared env-knob parser, the WFQ admission
+queue in isolation and wired into a real MicroBatcher, the token-bucket
+quota gate (including the ``tenant_flood`` fault point), the serving
+layer's tenant/lane resolution and its RESOURCE_EXHAUSTED + retry-after
+answers over real gRPC, the result cache's tenant scoping and
+fair-share-first eviction, and the client/retry side of the retry-after
+hint. Property-based fairness invariants live in ``test_qos_props.py``.
+"""
+
+import json
+import logging
+import queue as stdlib_queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+import numpy as np
+import pytest
+from google.protobuf import empty_pb2
+
+from lumen_tpu.runtime.batcher import MicroBatcher
+from lumen_tpu.runtime.result_cache import ResultCache, key_tenant, make_key
+from lumen_tpu.serving import BaseService, HubRouter, TaskDefinition, TaskRegistry
+from lumen_tpu.serving.proto import ml_service_pb2 as pb
+from lumen_tpu.serving.proto.ml_service_pb2_grpc import (
+    InferenceStub,
+    add_InferenceServicer_to_server,
+)
+from lumen_tpu.testing import faults
+from lumen_tpu.utils import env as env_knobs
+from lumen_tpu.utils import qos
+from lumen_tpu.utils.deadline import QueueFull
+from lumen_tpu.utils.metrics import metrics
+from lumen_tpu.utils.qos import (
+    LANE_BULK,
+    LANE_INTERACTIVE,
+    RETRY_AFTER_META,
+    TENANT_META_KEY,
+    TenantQuota,
+    WFQAdmissionQueue,
+    qos_context,
+)
+from lumen_tpu.utils.retry import RetryPolicy, retry_after_hint, retry_call
+
+
+@pytest.fixture(autouse=True)
+def _clean_qos():
+    faults.reset()
+    qos.reset_quota()
+    yield
+    faults.reset()
+    qos.reset_quota()
+
+
+# -- shared env-knob parser ---------------------------------------------------
+
+
+class TestEnvParser:
+    def test_unset_returns_default_silently(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="lumen_tpu.utils.env"):
+            assert env_knobs.env_int("LUMEN_TEST_KNOB_UNSET", 7) == 7
+            assert env_knobs.env_float("LUMEN_TEST_KNOB_UNSET", None) is None
+        assert not caplog.records
+
+    def test_malformed_warns_once_and_degrades(self, monkeypatch, caplog):
+        env_knobs._reset_warnings()
+        monkeypatch.setenv("LUMEN_TEST_KNOB_BAD", "64O")  # letter O typo
+        with caplog.at_level(logging.WARNING, logger="lumen_tpu.utils.env"):
+            assert env_knobs.env_int("LUMEN_TEST_KNOB_BAD", 64) == 64
+            assert env_knobs.env_int("LUMEN_TEST_KNOB_BAD", 64) == 64
+        warned = [r for r in caplog.records if "LUMEN_TEST_KNOB_BAD" in r.message]
+        assert len(warned) == 1  # one-shot, not per-read
+
+    def test_clamping_applies_to_parsed_values_only(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_TEST_KNOB_CLAMP", "-3")
+        assert env_knobs.env_int("LUMEN_TEST_KNOB_CLAMP", 5, minimum=0) == 0
+        monkeypatch.setenv("LUMEN_TEST_KNOB_CLAMP", "900")
+        assert env_knobs.env_float("LUMEN_TEST_KNOB_CLAMP", 5.0, maximum=10.0) == 10.0
+        # The default is returned as given, even outside the clamp range.
+        monkeypatch.delenv("LUMEN_TEST_KNOB_CLAMP")
+        assert env_knobs.env_int("LUMEN_TEST_KNOB_CLAMP", -1, minimum=0) == -1
+
+    def test_batcher_queue_depth_typo_warns(self, monkeypatch, caplog):
+        from lumen_tpu.runtime.batcher import batch_queue_depth
+
+        env_knobs._reset_warnings()
+        monkeypatch.setenv("LUMEN_BATCH_QUEUE_DEPTH", "1O24")
+        with caplog.at_level(logging.WARNING, logger="lumen_tpu.utils.env"):
+            assert batch_queue_depth() == 0  # degrades to unbounded...
+        assert any("LUMEN_BATCH_QUEUE_DEPTH" in r.message for r in caplog.records)
+
+
+# -- WFQ admission queue ------------------------------------------------------
+
+
+class TestWFQQueue:
+    def test_single_flow_is_fifo(self):
+        q = WFQAdmissionQueue(name="t-fifo")
+        for i in range(10):
+            q.put(i)
+        assert [q.get_nowait() for _ in range(10)] == list(range(10))
+
+    def test_fifo_preserved_within_each_tenant(self):
+        q = WFQAdmissionQueue(name="t-flow-fifo")
+        with qos_context("a"):
+            for i in range(5):
+                q.put(("a", i))
+        with qos_context("b"):
+            for i in range(5):
+                q.put(("b", i))
+        seen = {"a": [], "b": []}
+        for _ in range(10):
+            tenant, i = q.get_nowait()
+            seen[tenant].append(i)
+        assert seen["a"] == list(range(5))
+        assert seen["b"] == list(range(5))
+
+    def test_equal_weights_interleave(self):
+        q = WFQAdmissionQueue(name="t-interleave")
+        with qos_context("flood"):
+            for i in range(50):
+                q.put(("flood", i))
+        with qos_context("victim"):
+            q.put(("victim", 0))
+        # The victim's head tag is one quantum past virtual time — it must
+        # be served within the first two pops, not behind the 50 floods.
+        first_two = [q.get_nowait()[0] for _ in range(2)]
+        assert "victim" in first_two
+
+    def test_weight_override_shifts_share(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_WEIGHT_HEAVY", "3")
+        q = WFQAdmissionQueue(name="t-weights")
+        with qos_context("heavy"):
+            for i in range(40):
+                q.put(i)
+        with qos_context("light"):
+            for i in range(40):
+                q.put(i)
+        served = {"heavy": 0, "light": 0}
+        for _ in range(40):
+            # Track which flow each pop came from by draining tag order.
+            with q._lock:
+                before = {k: len(f.entries) for k, f in q._flows.items()}
+            q.get_nowait()
+            with q._lock:
+                after = {k: len(f.entries) for k, f in q._flows.items()}
+            for k in before:
+                if after.get(k, 0) < before[k]:
+                    served[k[0]] += 1
+        # 3:1 weights over a continuously-backlogged window: the heavy
+        # tenant gets ~30 of the first 40 services.
+        assert served["heavy"] >= 25
+
+    def test_bulk_lane_yields_to_interactive(self):
+        q = WFQAdmissionQueue(name="t-lanes")
+        with qos_context("a", LANE_BULK):
+            for i in range(20):
+                q.put(("bulk", i))
+        with qos_context("a", LANE_INTERACTIVE):
+            for i in range(20):
+                q.put(("inter", i))
+        first_ten = [q.get_nowait()[0] for _ in range(10)]
+        # Default bulk share 0.25: interactive dominates a backlogged window.
+        assert first_ten.count("inter") >= 7
+
+    def test_brownout_ladder_sheds_bulk_only(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_BROWNOUT_PCT", "40")
+        monkeypatch.setenv("LUMEN_QOS_BULK_SHED_PCT", "60")
+        q = WFQAdmissionQueue(name="t-brownout", max_queue=10)
+        for i in range(4):
+            q.put(i)
+        assert q.brownout_level() == 1  # 40% occupancy: bulk share shrunk
+        with qos_context("a", LANE_BULK):
+            q.put("bulk-ok")  # shrunk share still admits below shed rung
+        q.put("x")  # 6/10 = 60%
+        assert q.brownout_level() == 2
+        with qos_context("a", LANE_BULK):
+            with pytest.raises(QueueFull) as ei:
+                q.put("bulk-shed")
+            assert getattr(ei.value, "lane", None) == LANE_BULK
+            assert "browned out" in str(ei.value)
+        # Interactive admission is untouched at the same occupancy.
+        with qos_context("a", LANE_INTERACTIVE):
+            q.put("interactive-still-admitted")
+        g = q.gauges()
+        assert g["shed_bulk"] == 1
+        assert g["brownout"] == 2
+
+    def test_close_sentinel_latches_after_backlog(self):
+        q = WFQAdmissionQueue(name="t-sentinel")
+        q.put("work")
+        q.put(None)  # close signal arrives while work is queued
+        assert q.get_nowait() == "work"
+        assert q.get(timeout=1) is None  # sentinel only after drain
+
+    def test_get_timeout_raises_empty(self):
+        q = WFQAdmissionQueue(name="t-empty")
+        with pytest.raises(stdlib_queue.Empty):
+            q.get(timeout=0.01)
+        with pytest.raises(stdlib_queue.Empty):
+            q.get_nowait()
+
+    def test_blocking_get_wakes_on_put(self):
+        q = WFQAdmissionQueue(name="t-wake")
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.get(timeout=5)))
+        t.start()
+        time.sleep(0.05)
+        q.put("ping")
+        t.join(timeout=5)
+        assert out == ["ping"]
+
+    def test_gauges_per_tenant(self):
+        q = WFQAdmissionQueue(name="t-gauges")
+        with qos_context("a"):
+            q.put(1)
+        with qos_context("b", LANE_BULK):
+            q.put(2)
+        g = q.gauges()
+        assert g["queued"] == 2
+        assert g["queued:a"] == 1 and g["queued:b"] == 1
+        assert g["queued_interactive"] == 1 and g["queued_bulk"] == 1
+        assert g["admitted:a"] == 1
+
+    def test_drained_flows_are_dropped(self):
+        q = WFQAdmissionQueue(name="t-flowgc")
+        for tenant in ("a", "b", "c"):
+            with qos_context(tenant):
+                q.put(tenant)
+        for _ in range(3):
+            q.get_nowait()
+        with q._lock:
+            assert not q._flows  # tenant churn must not grow the table
+
+
+# -- per-tenant token buckets -------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestTenantQuota:
+    def test_unlimited_by_default(self):
+        quota = TenantQuota()
+        for _ in range(100):
+            admitted, retry = quota.gate("anyone")
+            assert admitted and retry == 0.0
+        quota.close()
+
+    def test_rate_limit_sheds_with_retry_hint(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "2")
+        monkeypatch.setenv("LUMEN_QOS_TENANT_BURST", "2")
+        clock = FakeClock()
+        quota = TenantQuota(clock=clock)
+        assert quota.gate("t")[0]
+        assert quota.gate("t")[0]
+        admitted, retry = quota.gate("t")
+        assert not admitted
+        assert retry == pytest.approx(0.5)  # next token at rate 2/s
+        clock.now += 0.5
+        assert quota.gate("t")[0]  # refilled
+        quota.close()
+
+    def test_per_tenant_rps_override(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "1")
+        monkeypatch.setenv("LUMEN_QOS_RPS_VIP_TEAM", "0")  # vip-team unlimited
+        clock = FakeClock()
+        quota = TenantQuota(clock=clock)
+        for _ in range(50):
+            assert quota.gate("vip-team")[0]
+        # the default-rate tenant still sheds after its burst
+        sheds = sum(0 if quota.gate("pleb")[0] else 1 for _ in range(10))
+        assert sheds > 0
+        quota.close()
+
+    def test_id_spray_cannot_grow_quota_state(self, monkeypatch):
+        """An attacker-controlled lumen-tenant id must not grow the bucket
+        table, the stats dict, or the gauge payload past the cardinality
+        cap — overflow ids collapse onto the shared ``_other`` bucket
+        (which then collectively rate-limits the spray)."""
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "1")
+        clock = FakeClock()
+        quota = TenantQuota(clock=clock)
+        for i in range(500):
+            quota.gate(f"sprayed-{i}")
+        cap = qos._MAX_TENANT_STATS + 1  # distinct ids + the shared _other row
+        assert len(quota._buckets) <= cap
+        assert len(quota.stats) <= cap
+        assert "_other" in quota.stats
+        # gauge payload bounded too (admits/sheds/tokens rows)
+        assert len(quota.gauges()) <= 3 * cap
+        # the shared overflow bucket sheds once its burst is gone
+        assert not quota.gate("sprayed-9999")[0]
+        quota.close()
+
+    def test_unlimited_fast_path_keeps_no_state(self):
+        """The unconfigured gate (no rate, no flood) must not touch the
+        shared lock or grow per-tenant state — it sits on every dispatch,
+        including all bulk fan-out workers."""
+        quota = TenantQuota()
+        for i in range(100):
+            assert quota.gate(f"t{i}") == (True, 0.0)
+        assert quota.stats == {} and quota._buckets == {}
+        assert not quota.active()
+        quota.close()
+
+    def test_stats_snapshot_is_locked_copy(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "5")
+        quota = TenantQuota()
+        quota.gate("t1")
+        snap = quota.stats_snapshot()
+        assert snap["t1"]["admits"] == 1
+        snap["t1"]["admits"] = 999  # mutating the copy leaves state alone
+        assert quota.stats["t1"]["admits"] == 1
+        quota.close()
+
+    def test_tenant_flood_fault_point(self):
+        faults.configure("tenant_flood", match="team-a")
+        quota = TenantQuota()
+        admitted, retry = quota.gate("team-a")
+        assert not admitted and retry > 0
+        assert quota.gate("team-b")[0]  # unmatched tenant unaffected
+        quota.close()
+
+    def test_shed_cost_is_o1(self, monkeypatch):
+        """The quota shed must stay dict-lookup cheap (~10µs/req): it runs
+        before payload/cache/decode work, and its whole point is that a
+        flood costs the host nothing."""
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "1")
+        quota = TenantQuota()
+        quota.gate("flood")  # burn the burst
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            quota.gate("flood")
+        per_req = (time.perf_counter() - t0) / n
+        assert per_req < 200e-6  # generous CI bound; ~10µs typical
+        quota.close()
+
+    def test_status_surface(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS_TENANT_RPS", "1")
+        quota = qos.get_quota()
+        quota.gate("t1")
+        quota.gate("t1")
+        st = qos.status()
+        assert "quota" in st
+        assert st["quota"]["t1"]["admits"] + st["quota"]["t1"]["sheds"] == 2
+
+
+# -- batcher integration ------------------------------------------------------
+
+
+def identity(tree, n):
+    return tree
+
+
+class TestBatcherWFQ:
+    def test_wfq_queue_is_default(self):
+        b = MicroBatcher(identity, max_batch=4, name="qos-default")
+        assert isinstance(b._queue, WFQAdmissionQueue)
+        b.close()
+
+    def test_kill_switch_restores_fifo(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_QOS", "0")
+        b = MicroBatcher(identity, max_batch=4, name="qos-off")
+        assert isinstance(b._queue, stdlib_queue.Queue)
+        b.close()
+
+    def test_roundtrip_through_wfq(self):
+        b = MicroBatcher(identity, max_batch=4, max_latency_ms=1, name="qos-rt")
+        b.start()
+        try:
+            with qos_context("team-a"):
+                fa = b.submit(np.ones(2))
+            with qos_context("team-b", LANE_BULK):
+                fb = b.submit(np.full(2, 2.0))
+            np.testing.assert_allclose(np.asarray(fa.result(timeout=5)), np.ones(2))
+            np.testing.assert_allclose(np.asarray(fb.result(timeout=5)), np.full(2, 2.0))
+        finally:
+            b.close()
+
+    def test_queue_full_carries_drain_context(self):
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=1, max_queue=2,
+                         name="qos-drain")
+        b.start()
+        try:
+            # Prime the drain-rate EWMA with real settles.
+            for _ in range(4):
+                b(np.zeros(1), timeout=5)
+        finally:
+            b.close()
+        # Closed batcher keeps its measured rate; build the error directly.
+        err = b._queue_full_error(10)
+        assert err.queue_depth == 10
+        assert err.retry_after_s is not None and err.retry_after_s > 0
+        assert "est drain" in str(err)
+
+    def test_drain_rate_clamps_idle_gaps_and_caps_estimate(self, monkeypatch):
+        from lumen_tpu.runtime.batcher import _DrainRate
+
+        clock = [0.0]
+        monkeypatch.setattr("lumen_tpu.runtime.batcher.time.monotonic",
+                            lambda: clock[0])
+        d = _DrainRate()
+        d.record(8)  # first settle only stamps _last
+        # A 5-minute lull before the next settle must read as the clamped
+        # MAX_GAP_S, not as a ~0.03 items/s service rate that would tell
+        # shed clients to come back in minutes.
+        clock[0] += 300.0
+        d.record(8)
+        est = d.estimate_s(128)
+        assert est is not None
+        assert est <= 128 / (8 / _DrainRate.MAX_GAP_S) + 1e-9
+        # And the surfaced estimate never exceeds the hint ceiling.
+        assert d.estimate_s(10**9) == _DrainRate.MAX_ESTIMATE_S
+
+    def test_cold_batcher_error_still_carries_depth(self):
+        b = MicroBatcher(identity, max_batch=2, max_queue=2, name="qos-cold")
+        err = b._queue_full_error(2)
+        assert err.queue_depth == 2
+        assert getattr(err, "retry_after_s", None) is None  # no rate yet
+        b.close()
+
+    @pytest.mark.multichip
+    def test_ingest_postprocess_runs_on_bulk_lane(self):
+        # The ingest consumer's per-item postprocess hooks can submit into
+        # SHARED admission queues (the face stage's embed_detections rides
+        # the rec-model MicroBatcher): those submits must queue as bulk.
+        # The producer thread's decode/cache work is tagged too.
+        from lumen_tpu.pipeline import IngestPipeline, Stage
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        lanes: list[str] = []
+        stage = Stage(
+            name="probe",
+            preprocess=lambda item: np.array([item], np.float32),
+            device_fn=lambda x: x,
+            postprocess=lambda decoded, row: lanes.append(qos.current_lane()),
+        )
+        IngestPipeline(build_mesh({"data": -1}), [stage], batch_size=8).run_all(
+            range(3)
+        )
+        assert lanes == [LANE_BULK] * 3
+        # The consumer tag is scoped to the loop — the caller's ambient
+        # lane is untouched after the run.
+        assert qos.current_lane() == LANE_INTERACTIVE
+
+    def test_qos_gauges_registered(self):
+        b = MicroBatcher(identity, max_batch=2, max_latency_ms=1, name="qos-gauge")
+        b.start()
+        try:
+            b(np.zeros(1), timeout=5)
+            snap = metrics.snapshot()["gauges"]
+            assert "qos:qos-gauge" in snap
+            assert snap["qos:qos-gauge"]["dispatched"] >= 1
+        finally:
+            b.close()
+        assert "qos:qos-gauge" not in metrics.snapshot().get("gauges", {})
+
+
+# -- result cache tenant scoping ---------------------------------------------
+
+
+class TestTenantCache:
+    def test_keys_scoped_per_tenant(self):
+        k_default = make_key("clip/t/m@1", None, b"payload")
+        with qos_context("team-a"):
+            k_a = make_key("clip/t/m@1", None, b"payload")
+        assert k_default != k_a
+        assert key_tenant(k_default) == "default"
+        assert key_tenant(k_a) == "team-a"
+        assert k_a.startswith("clip/")  # hot-swap prefix invalidation intact
+
+    def test_hot_swap_invalidation_sweeps_all_tenants(self):
+        c = ResultCache(max_bytes=100000, disk_dir=None, name="t-inval")
+        c.put(make_key("clip/t/m@1", None, b"x"), b"v")
+        with qos_context("team-a"):
+            c.put(make_key("clip/t/m@1", None, b"x"), b"v")
+        assert c.invalidate("clip/") == 2
+        c.close()
+
+    def test_fair_share_eviction_protects_small_tenant(self):
+        c = ResultCache(max_bytes=10000, disk_dir=None, name="t-fair")
+        with qos_context("victim"):
+            hot = [make_key("clip/m@1", None, b"hot%d" % i) for i in range(3)]
+            for k in hot:
+                c.put(k, b"x" * 400)
+        with qos_context("flood"):
+            for i in range(200):
+                c.put(make_key("clip/m@1", None, b"f%d" % i), b"y" * 900)
+        g = c.gauges()
+        assert g["evictions"] > 0
+        assert g["cross_tenant_evictions"] == 0
+        with qos_context("victim"):
+            for k in hot:
+                found, _ = c.get(k)
+                assert found  # the flood evicted only its own entries
+        c.close()
+
+    def test_id_spray_cannot_defeat_fair_share(self):
+        """Fabricated tenant ids must not shrink the fair share out from
+        under a legitimate tenant: accounting identities share the 64-id
+        ``_other`` cap, so a spray's entries pile onto one shared identity
+        (which then becomes the eviction victim) instead of multiplying
+        ``#tenants`` until the real tenant is always over fair share."""
+        c = ResultCache(max_bytes=20000, disk_dir=None, name="t-spray")
+        with qos_context("victim"):
+            hot = [make_key("clip/m@1", None, b"hot%d" % i) for i in range(3)]
+            for k in hot:
+                c.put(k, b"x" * 100)
+        for i in range(600):  # tiny entries: the uncapped attack shape
+            with qos_context(f"spray-{i}"):
+                c.put(make_key("clip/m@1", None, b"s%d" % i), b"y")
+        cap = qos._MAX_TENANT_STATS + 1  # distinct ids + the shared _other
+        assert len(c._tenant_bytes) <= cap
+        g = c.gauges()
+        assert len([k for k in g if k.startswith("bytes:")]) <= cap
+        assert g["evictions"] > 0
+        assert g["cross_tenant_evictions"] == 0
+        with qos_context("victim"):
+            for k in hot:
+                found, _ = c.get(k)
+                assert found  # the spray only ever evicted itself
+        c.close()
+
+    def test_ingest_producer_keeps_caller_tenant(self, monkeypatch):
+        """The ingest producer runs on its own thread (contextvars don't
+        cross the start): the caller's tenant must be re-applied there so
+        cache keys / quarantine fingerprints stay in the caller's
+        namespace — never the default tenant's."""
+        from lumen_tpu.pipeline.ingest import IngestPipeline, Stage
+        from lumen_tpu.runtime import result_cache as rc
+        from lumen_tpu.runtime.mesh import build_mesh
+
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(1 << 20))
+        monkeypatch.delenv("LUMEN_CACHE_DIR", raising=False)
+        rc.reset_result_cache()
+        try:
+            stage = Stage(
+                name="double",
+                preprocess=lambda v: np.array([v], np.float32),
+                device_fn=lambda x: x * 2,
+                postprocess=lambda decoded, row: float(row[0]),
+            )
+            pipe = IngestPipeline(
+                build_mesh({"data": -1}), [stage],
+                decode=lambda b: int.from_bytes(b, "big"),
+                batch_size=8, cache_namespace="ingest/test/m@1",
+            )
+            items = [int(i).to_bytes(2, "big") for i in range(4)]
+            with qos_context("team-a"):
+                pipe.run_all(items)
+            stored = list(rc.get_result_cache()._entries)
+            assert stored and all("/tenant=team-a" in k for k in stored)
+            # A default-tenant rerun computes different keys: no hits.
+            pipe.run_all(items)
+            assert pipe.stats.cache_hits == 0
+            # The same tenant's rerun is pure cache traffic.
+            with qos_context("team-a"):
+                pipe.run_all(items)
+            assert pipe.stats.cache_hits == len(items)
+        finally:
+            rc.reset_result_cache()
+
+    def test_single_tenant_eviction_is_plain_lru(self):
+        c = ResultCache(max_bytes=2000, disk_dir=None, name="t-lru")
+        keys = [make_key("ns", None, b"%d" % i) for i in range(4)]
+        for k in keys:
+            c.put(k, b"x" * 600)  # 600+64 bytes each: budget holds ~3
+        found_first, _ = c.get(keys[0])
+        found_last, _ = c.get(keys[-1])
+        assert not found_first and found_last
+        c.close()
+
+
+# -- serving layer ------------------------------------------------------------
+
+
+class QosEchoService(BaseService):
+    def __init__(self, name="qecho"):
+        registry = TaskRegistry(name)
+        registry.register(TaskDefinition(name=f"{name}_echo", handler=self._echo))
+        super().__init__(registry)
+
+    def capability(self):
+        return self.registry.build_capability(model_ids=["qecho"], runtime="none")
+
+    def healthy(self):
+        return True
+
+    def _echo(self, payload, mime, meta):
+        # Surface the ambient QoS identity so tests can assert the
+        # contextvar really crossed the dispatch layer.
+        tenant, lane = qos.current_qos()
+        return payload, mime or "text/plain", {"seen-tenant": tenant, "seen-lane": lane}
+
+
+@pytest.fixture()
+def qos_hub():
+    server = grpc.server(ThreadPoolExecutor(max_workers=4))
+    router = HubRouter({"qecho": QosEchoService()})
+    add_InferenceServicer_to_server(router, server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield InferenceStub(channel), router
+    channel.close()
+    server.stop(0)
+
+
+def _req(task, meta=None):
+    return pb.InferRequest(
+        correlation_id="c1", task=task, payload=b"hi",
+        payload_mime="text/plain", meta=meta or {},
+    )
+
+
+@pytest.mark.integration
+class TestServingQoS:
+    def test_tenant_metadata_reaches_handler(self, qos_hub):
+        stub, _ = qos_hub
+        (r,) = stub.Infer(
+            iter([_req("qecho_echo")]), metadata=((TENANT_META_KEY, "team-a"),)
+        )
+        assert r.meta["seen-tenant"] == "team-a"
+        assert r.meta["seen-lane"] == LANE_INTERACTIVE
+
+    def test_unlabeled_traffic_is_default_tenant(self, qos_hub):
+        stub, _ = qos_hub
+        (r,) = stub.Infer(iter([_req("qecho_echo")]))
+        assert r.meta["seen-tenant"] == "default"
+
+    def test_priority_meta_selects_bulk_lane(self, qos_hub):
+        stub, _ = qos_hub
+        (r,) = stub.Infer(iter([_req("qecho_echo", meta={"priority": "bulk"})]))
+        assert r.meta["seen-lane"] == LANE_BULK
+
+    def test_bulk_stream_auto_tags_bulk_lane(self, qos_hub):
+        stub, _ = qos_hub
+        (r,) = stub.Infer(iter([_req("qecho_echo", meta={"bulk": "1"})]))
+        assert r.meta["seen-lane"] == LANE_BULK
+
+    def test_quota_shed_is_resource_exhausted_with_retry_after(self, qos_hub):
+        stub, _ = qos_hub
+        faults.configure("tenant_flood", match="team-a")
+        (r,) = stub.Infer(
+            iter([_req("qecho_echo")]), metadata=((TENANT_META_KEY, "team-a"),)
+        )
+        assert r.error.code == pb.ERROR_CODE_UNAVAILABLE
+        assert "quota" in r.error.message
+        assert int(r.meta[RETRY_AFTER_META]) >= 1
+        assert r.meta["qos_shed"] == "1"
+        # Other tenants keep serving through the same hub.
+        (ok,) = stub.Infer(
+            iter([_req("qecho_echo")]), metadata=((TENANT_META_KEY, "team-b"),)
+        )
+        assert not ok.error.message
+
+    def test_health_carries_qos_status(self, qos_hub):
+        stub, _ = qos_hub
+        faults.configure("tenant_flood", match="team-a")
+        list(stub.Infer(
+            iter([_req("qecho_echo")]), metadata=((TENANT_META_KEY, "team-a"),)
+        ))
+        call = stub.Health.with_call(empty_pb2.Empty())
+        trailing = dict(call[1].trailing_metadata() or ())
+        status = json.loads(trailing["lumen-qos-status"])
+        assert status["quota"]["team-a"]["sheds"] >= 1
+
+    def test_capability_extra_carries_qos(self, qos_hub):
+        stub, router = qos_hub
+        from lumen_tpu.utils.qos import service_extra
+
+        blob = json.loads(service_extra("nonexistent-prefix"))
+        assert blob["wfq"] == "on"
+        assert blob["lanes"] == "interactive>bulk"
+
+
+# -- retry-after contract (client side) --------------------------------------
+
+
+class TestRetryAfter:
+    def test_hint_floors_backoff(self):
+        class Shed(Exception):
+            retry_after_s = 1.5
+
+        delays = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise Shed("shed")
+            return "ok"
+
+        out = retry_call(
+            flaky,
+            policy=RetryPolicy(attempts=5, base_delay_s=0.001, max_delay_s=0.01),
+            retryable=Shed,
+            sleep=delays.append,
+        )
+        assert out == "ok"
+        assert all(d >= 1.5 for d in delays)
+
+    def test_no_hint_keeps_full_jitter(self):
+        delays = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise ValueError("plain")
+            return "ok"
+
+        retry_call(
+            flaky,
+            policy=RetryPolicy(attempts=3, base_delay_s=0.001, max_delay_s=0.002),
+            retryable=ValueError,
+            sleep=delays.append,
+        )
+        assert all(d <= 0.002 for d in delays)
+
+    def test_hint_extraction(self):
+        e = Exception()
+        assert retry_after_hint(e) is None
+        e.retry_after_s = 0.25
+        assert retry_after_hint(e) == 0.25
+        e.retry_after_s = "bogus"
+        assert retry_after_hint(e) is None
+        e.retry_after_s = -1
+        assert retry_after_hint(e) is None
+
+    def test_client_parses_shed_meta(self):
+        from lumen_tpu.client import _shed_retry_after_s, _with_tenant
+
+        assert _shed_retry_after_s({RETRY_AFTER_META: "250"}) == 0.25
+        assert _shed_retry_after_s({}) is None
+        assert _shed_retry_after_s({RETRY_AFTER_META: "junk"}) is None
+        assert _with_tenant(None, None) is None
+        md = _with_tenant(None, "team-a")
+        assert (TENANT_META_KEY, "team-a") in md
+        md2 = _with_tenant((("lumen-trace", "abc"),), "team-a")
+        assert len(md2) == 2
