@@ -2301,13 +2301,15 @@ def phase_probe() -> dict:
         "device_kind": dev.device_kind,
         "jax_version": jax.__version__,
     }
-    try:  # chip identification for the artifact; absent on some backends
-        stats = dev.memory_stats() or {}
-        limit = stats.get("bytes_limit")
-        if limit:
-            out["hbm_gib"] = round(limit / 2**30, 1)
-    except Exception:  # noqa: BLE001 - diagnostics only
-        pass
+    # Chip identification for the artifact; absent on some backends. Via
+    # the shared device-memory probe (not dev.memory_stats() directly) so
+    # the disable/fallback logic and bytes-key normalization live in ONE
+    # place — the sidecar, /stats and this phase must agree on shape.
+    from lumen_tpu.utils.metrics import MetricsRegistry
+
+    limit = MetricsRegistry.device_memory().get(str(dev.id), {}).get("bytes_limit")
+    if limit:
+        out["hbm_gib"] = round(limit / 2**30, 1)
     return out
 
 
@@ -3102,6 +3104,254 @@ def phase_qos() -> dict:
     return out
 
 
+def phase_capacity() -> dict:
+    """Capacity-telemetry acceptance (ISSUE 10): under a c10 gRPC CLIP
+    load, ``GET /stats?window=30`` on a real sidecar must report device
+    duty cycle, decode-pool busy fraction, padding waste and (on TPU)
+    HBM occupancy that are all nonzero and internally consistent — the
+    device duty within ±10% of the busy wall-time derived from the
+    retained ``batch.device`` trace spans. An induced breaker-open must
+    capture an incident bundle carrying the triggering event, >=1
+    correlated trace id and a device-memory snapshot. (The <2µs
+    disabled-path guard is tier-1: tests/test_telemetry.py.)"""
+    _apply_platform_env()
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LUMEN_TRACE_SAMPLE", "LUMEN_TELEMETRY_BUCKET_S", "LUMEN_TRACE_RING")
+    }
+    # 1s buckets: the consistency check compares a ~seconds-long run
+    # against a bucketed window; 5s quantization would dominate the ±10%.
+    os.environ["LUMEN_TELEMETRY_BUCKET_S"] = "1"
+    try:
+        with _cache_env("0"):
+            return _capacity_impl()
+    finally:
+        for key, prev in saved.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        from lumen_tpu.utils.telemetry import reset_hub
+        from lumen_tpu.utils.trace import reset_recorder
+
+        reset_hub()
+        reset_recorder()
+
+
+def _capacity_impl() -> dict:
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from lumen_tpu.models.clip.manager import CLIPManager
+    from lumen_tpu.runtime.decode_pool import get_decode_pool
+    from lumen_tpu.serving.observability import MetricsServer
+    from lumen_tpu.serving.services.clip_service import ClipService
+    from lumen_tpu.utils import telemetry as tele
+    from lumen_tpu.utils.trace import get_recorder, reset_recorder
+
+    cpu = jax.default_backend() == "cpu"
+    n = 120 if cpu else 600
+    root = tempfile.mkdtemp(prefix="bench_capacity_")
+    out: dict = {"platform": jax.devices()[0].platform}
+
+    def unique_jpegs(count: int, size: int) -> list[bytes]:
+        import io
+
+        import numpy as np
+        from PIL import Image
+
+        rng = np.random.default_rng(11)
+        blobs = []
+        for _ in range(count):
+            arr = rng.integers(0, 255, (size, size, 3), np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=85)
+            blobs.append(buf.getvalue())
+        return blobs
+
+    def sidecar_stats(port: int, window: int) -> dict:
+        # The real client helper — one copy of the /stats wire contract.
+        from lumen_tpu.client import get_stats
+
+        return get_stats(f"127.0.0.1:{port}", window=window, timeout=30)
+
+    try:
+        _state("capacity:build")
+        os.environ.pop("LUMEN_TRACE_SAMPLE", None)  # warmup stays untraced
+        os.environ["LUMEN_TRACE_RING"] = str(2 * n)  # every request retained
+        clip_dir = _write_bench_clip_dir(root, tiny=cpu)
+        mgr = CLIPManager(
+            clip_dir,
+            dtype="float32" if cpu else "bfloat16",
+            # 8 (not 4): buckets 1/2/4/8 leave odd c10 coalescings (3, 5,
+            # 6, 7) to pad — the phase asserts padding waste is visible.
+            batch_size=8 if cpu else 16,
+            max_batch_latency_ms=2.0,
+            warmup=True,
+        )
+        svc = ClipService({"clip": mgr})
+        mgr.initialize()
+        server, channel, stub, pb = _start_grpc({"clip": svc})
+        sidecar = MetricsServer(port=0)
+        sidecar_port = sidecar.start()
+        try:
+            payloads = unique_jpegs(40, 32 if cpu else 224)
+            # Warm the wire + buckets untraced, then reset the hub so the
+            # 30s window holds ONLY the measured run (warmup batches
+            # would be invisible to the span-derived duty, which only
+            # sees traced requests). Duty capacities re-declare against
+            # the fresh hub — registration happened at component start.
+            _grpc_round_robin(stub, pb, "clip_image_embed", payloads[:8], 16, 4)
+            tele.reset_hub()
+            tele.set_capacity("device:clip-image", 1.0, union=True)
+            tele.set_capacity("decode:decode_pool", float(get_decode_pool().workers))
+            os.environ["LUMEN_TRACE_SAMPLE"] = "1"
+            reset_recorder()
+            _state("capacity:c10")
+            t_run0 = time.perf_counter()
+            out["workload"] = _grpc_round_robin(
+                stub, pb, "clip_image_embed", payloads, n, 10
+            )
+            out["run_wall_s"] = round(time.perf_counter() - t_run0, 2)
+            os.environ.pop("LUMEN_TRACE_SAMPLE", None)
+
+            stats = sidecar_stats(sidecar_port, 30)
+            # Padding insurance: if every measured batch landed exactly on
+            # a bucket size (possible, rare), top up with c3 bursts that
+            # coalesce into a 3-wide batch padded to 4.
+            for _ in range(3):
+                if stats.get("batch", {}).get("clip-image", {}).get("padded", 0):
+                    break
+                _grpc_round_robin(stub, pb, "clip_image_embed", payloads[:3], 9, 3)
+                stats = sidecar_stats(sidecar_port, 30)
+
+            duty = stats["duty"]["device:clip-image"]
+            decode_duty = stats["duty"].get("decode:decode_pool", {"busy_s": 0.0})
+            batch = stats["batch"]["clip-image"]
+            out["stats_window"] = {
+                "device_busy_s": duty["busy_s"],
+                "device_fraction": duty["fraction"],
+                "decode_busy_s": decode_duty["busy_s"],
+                "decode_fraction": decode_duty.get("fraction", 0.0),
+                "batch": batch,
+                "transfer": stats.get("transfer", {}).get("clip-image", {}),
+                "compile_window": stats.get("compile", {}).get("compiles", 0),
+                "windowed_p95_ms": stats["tasks"]
+                .get("clip_image_embed", {})
+                .get("p95_ms", 0.0),
+            }
+
+            # Span-derived device busy: union of the retained
+            # ``batch.device`` span intervals (requests co-batched share
+            # one interval; the union dedupes it) — the independent
+            # measurement the duty meter must agree with.
+            intervals = []
+            for rec in get_recorder().traces():
+                base = rec["start_unix_ms"]
+                for s in rec["spans"]:
+                    if s["name"] == "batch.device":
+                        s0 = base + s["start_ms"]
+                        intervals.append((s0, s0 + s["dur_ms"]))
+            intervals.sort()
+            union_ms, cur_end = 0.0, float("-inf")
+            for a, b in intervals:
+                if b <= cur_end:
+                    continue
+                union_ms += b - max(a, cur_end)
+                cur_end = b
+            span_busy_s = union_ms / 1e3
+            out["span_device_busy_s"] = round(span_busy_s, 3)
+            rel_err = (
+                abs(duty["busy_s"] - span_busy_s) / span_busy_s
+                if span_busy_s > 0
+                else float("inf")
+            )
+            out["duty_vs_spans_rel_err"] = round(rel_err, 4)
+
+            hbm = {
+                dev: m
+                for dev, m in stats.get("device_memory", {}).items()
+                if m.get("bytes_in_use")
+            }
+            out["hbm"] = hbm
+
+            # -- induced breaker-open -> incident bundle -----------------
+            _state("capacity:incident")
+            from lumen_tpu.serving.breaker import CircuitBreaker
+            from lumen_tpu.testing.faults import faults
+
+            os.environ["LUMEN_TRACE_SAMPLE"] = "1"
+            svc.breaker = CircuitBreaker("clip", failures=2, reset_s=600)
+            faults.configure("batch_execute", match="clip-image")
+            failed = 0
+            try:
+                for i in range(4):
+                    resps = list(
+                        stub.Infer(
+                            iter([
+                                pb.InferRequest(
+                                    correlation_id=f"inc-{i}",
+                                    task="clip_image_embed",
+                                    payload=payloads[0],
+                                    payload_mime="image/jpeg",
+                                )
+                            ])
+                        )
+                    )
+                    failed += bool(resps and resps[-1].HasField("error"))
+            finally:
+                faults.reset()
+                os.environ.pop("LUMEN_TRACE_SAMPLE", None)
+            assert svc.breaker.state() == "open", svc.breaker.state()
+            bundles = tele.export_incidents()["incidents"]
+            assert bundles, "breaker-open captured no incident bundle"
+            bundle = bundles[-1]
+            out["incident"] = {
+                "kind": bundle["kind"],
+                "trigger_component": bundle["trigger"].get("component"),
+                "n_events": len(bundle["events"]),
+                "n_trace_ids": len(bundle["trace_ids"]),
+                "has_device_memory": "device_memory" in bundle,
+                "failed_requests": failed,
+            }
+            svc.breaker.close()
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{sidecar_port}/events?n=10", timeout=30
+            ) as r:
+                events = json.loads(r.read().decode())["events"]
+            out["event_kinds_tail"] = [e["kind"] for e in events]
+        finally:
+            sidecar.stop()
+            channel.close()
+            server.stop(0)
+            svc.close()
+
+        # Flush before the gate (group protocol: later lines overwrite) —
+        # a failing gate must leave the measured surface visible.
+        print(json.dumps({**out, "phase": "capacity", "partial": True}), flush=True)
+
+        out["acceptance"] = {
+            "device_duty_nonzero": out["stats_window"]["device_busy_s"] > 0,
+            "decode_busy_nonzero": out["stats_window"]["decode_busy_s"] > 0,
+            "padding_waste_nonzero": out["stats_window"]["batch"].get("padded", 0) > 0,
+            "duty_within_10pct_of_spans": out["duty_vs_spans_rel_err"] <= 0.10,
+            "hbm_nonzero_or_cpu": bool(out["hbm"]) or out["platform"] == "cpu",
+            "incident_bundle_complete": (
+                out["incident"]["kind"] == "breaker_open"
+                and out["incident"]["n_trace_ids"] >= 1
+                and out["incident"]["has_device_memory"]
+            ),
+        }
+        assert all(out["acceptance"].values()), f"capacity acceptance: {out['acceptance']}"
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
@@ -3119,6 +3369,7 @@ PHASES = {
     "replica_scaling": phase_replica_scaling,
     "replica_scaling_worker": phase_replica_scaling_worker,
     "attribution": phase_attribution,
+    "capacity": phase_capacity,
     "bench_grpc_ref": phase_bench_grpc_ref,
     "baseline": phase_baseline_torch,
     "baseline_vlm": phase_baseline_vlm,

@@ -10,6 +10,7 @@ Usage (server from `python -m lumen_tpu.serving.server --config ...`):
     python examples/client.py caps
     python examples/client.py topology
     python examples/client.py health
+    python examples/client.py stats --metrics-addr 127.0.0.1:9100 --window 60
     python examples/client.py embed-text "a photo of a cat"
     python examples/client.py embed-image photo.jpg
     python examples/client.py classify photo.jpg --top-k 5
@@ -40,6 +41,73 @@ from lumen_tpu.utils import trace as utrace
 from lumen_tpu.utils.qos import RETRY_AFTER_META, TENANT_META_KEY
 
 CHUNK = 1 << 20  # 1 MiB
+
+
+def get_stats(metrics_addr: str, window: float = 60.0, timeout: float = 10.0) -> dict:
+    """Fetch the observability sidecar's rolling-window capacity view
+    (``GET /stats?window=N``): last-N-seconds task latencies, device and
+    decode-pool duty cycles, batch padding waste, HBM occupancy/headroom
+    and the SLO burn summary. ``metrics_addr`` is the sidecar's
+    ``host:port`` (the server's ``--metrics-port``) or a full URL."""
+    import urllib.request
+
+    base = metrics_addr if "://" in metrics_addr else f"http://{metrics_addr}"
+    url = f"{base.rstrip('/')}/stats?window={int(window)}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _print_stats(stats: dict) -> None:
+    """Operator view of the capacity-telemetry layer: one block per
+    surface, omitting whatever the window saw nothing of."""
+    w = stats.get("window_s", 0)
+    print(f"window: last {w:.0f}s (telemetry {'on' if stats.get('enabled') else 'OFF'})")
+    tasks = {
+        name: s for name, s in stats.get("tasks", {}).items()
+        if not name.startswith("stage:")
+    }
+    if tasks:
+        print("tasks:")
+        for name, s in tasks.items():
+            print(
+                f"  {name}: n={s['count']} rps={s.get('rps', 0)} "
+                f"p50={s['p50_ms']}ms p95={s['p95_ms']}ms p99={s['p99_ms']}ms"
+            )
+    duty = stats.get("duty", {})
+    if duty:
+        print("duty cycles:")
+        for name, d in duty.items():
+            print(
+                f"  {name}: {100 * d['fraction']:.1f}% busy "
+                f"({d['busy_s']:.2f}s of {w:.0f}s x {d['capacity']:.0f})"
+            )
+    for batcher, b in stats.get("batch", {}).items():
+        print(
+            f"batch {batcher}: items={b.get('items', 0)} "
+            f"padded={b.get('padded', 0)} "
+            f"waste={b.get('padding_waste_pct', 0.0)}% "
+            f"buckets={b.get('distinct_buckets', 0)}"
+        )
+    comp = stats.get("compile", {})
+    if comp.get("compiles"):
+        print(f"xla compiles: {comp['compiles']} in window (recompile storm?)")
+    for dev, m in stats.get("device_memory", {}).items():
+        if "occupancy_pct" in m:
+            print(
+                f"device {dev}: HBM {m['occupancy_pct']}% used, "
+                f"headroom {m['headroom_bytes'] / 2**30:.2f} GiB "
+                f"of {m.get('bytes_limit', 0) / 2**30:.2f} GiB"
+            )
+    slo = stats.get("slo", {})
+    if slo:
+        print("slo:")
+        for task, rec in slo.items():
+            print(
+                f"  {task}: {rec.get('state')} burn_5m={rec.get('burn_5m')} "
+                f"burn_1h={rec.get('burn_1h')}"
+            )
+    else:
+        print("slo: no objectives configured (set LUMEN_SLO_<TASK>_P95_MS)")
 
 
 def _with_tenant(md, tenant: str | None):
@@ -332,6 +400,19 @@ def main(argv: list[str] | None = None) -> int:
         "dispatch policy, live replica states)",
     )
     sub.add_parser("health")
+    p = sub.add_parser(
+        "stats",
+        help="rolling-window capacity view from the observability sidecar "
+        "(windowed p50/p95 per task, device/decode duty cycles, HBM "
+        "headroom, SLO burn)",
+    )
+    p.add_argument(
+        "--metrics-addr",
+        default="127.0.0.1:9100",
+        help="host:port (or URL) of the server's --metrics-port sidecar",
+    )
+    p.add_argument("--window", type=float, default=60.0, help="window seconds")
+    p.add_argument("--json", action="store_true", help="raw JSON instead of the summary")
     p = sub.add_parser("embed-text"); p.add_argument("text")
     p = sub.add_parser("embed-image"); p.add_argument("image")
     p = sub.add_parser("classify"); p.add_argument("image"); p.add_argument("--top-k", type=int, default=5); p.add_argument("--scene", action="store_true")
@@ -344,6 +425,15 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("bulk", help="many images down ONE stream (server bulk lane)")
     p.add_argument("task"); p.add_argument("images", nargs="+")
     args = ap.parse_args(argv)
+
+    if args.cmd == "stats":
+        # Sidecar HTTP, not gRPC: no channel needed (and none opened).
+        stats = get_stats(args.metrics_addr, window=args.window)
+        if args.json:
+            print(json.dumps(stats, indent=2))
+        else:
+            _print_stats(stats)
+        return 0
 
     from lumen_tpu.utils.retry import retry_call
 

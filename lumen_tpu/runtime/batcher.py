@@ -92,6 +92,7 @@ from ..utils.deadline import (
 )
 from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
+from . import telemetry
 from .qos import WFQAdmissionQueue, wfq_enabled
 from .quarantine import QuarantineRegistry, get_quarantine
 from .trace import current_trace
@@ -498,7 +499,7 @@ class _Inflight:
     the collector's reusable arenas were used) so the fetch path can
     detect — and copy out of — a result that aliases them."""
 
-    __slots__ = ("futures", "result", "n", "size", "entries", "arena")
+    __slots__ = ("futures", "result", "n", "size", "entries", "arena", "t_dispatch")
 
     def __init__(
         self,
@@ -508,6 +509,7 @@ class _Inflight:
         size: int,
         entries: list[tuple] | None = None,
         arena: list | None = None,
+        t_dispatch: float = 0.0,
     ):
         self.futures = futures
         self.result = result  # un-fetched device result tree
@@ -515,6 +517,10 @@ class _Inflight:
         self.size = size
         self.entries = entries or []
         self.arena = arena
+        # Dispatch instant (monotonic): the fetch worker credits the
+        # dispatch->settle envelope to the ``device:{name}`` duty meter —
+        # the same envelope the ``batch.device`` trace span covers.
+        self.t_dispatch = t_dispatch
 
 
 class MicroBatcher:
@@ -675,6 +681,11 @@ class MicroBatcher:
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"batcher:{self.name}", _gauges)
+        # Duty meter for this batcher's device stream: capacity 1 in
+        # union mode (dispatch->settle envelopes overlap under
+        # pipelining; settle order == dispatch order, so union-clamping
+        # yields true busy wall-time and the fraction can never top 1).
+        telemetry.set_capacity(f"device:{self.name}", 1.0, union=True)
 
         def _occupancy_gauges() -> dict:
             b = ref()
@@ -817,6 +828,14 @@ class MicroBatcher:
                 self.stats["shed"] += 1
                 metrics.count("sheds")
                 metrics.count(f"sheds:{self.name}")
+                # Flight-recorder breadcrumb, rate-limited per batcher: a
+                # shed storm is one line a second in the ring, not a
+                # flood that churns breaker transitions out of it.
+                telemetry.record_event(
+                    "shed", self.name,
+                    f"admission queue full ({self.max_queue} waiting)",
+                    min_interval_s=1.0,
+                )
                 raise self._queue_full_error(self.max_queue)
             try:
                 self._queue.put((item, fut, deadline, fingerprint))
@@ -828,6 +847,9 @@ class MicroBatcher:
                 self.stats["shed"] += 1
                 metrics.count("sheds")
                 metrics.count(f"sheds:{self.name}")
+                telemetry.record_event(
+                    "shed", self.name, str(e), min_interval_s=1.0,
+                )
                 self._attach_drain_hint(e, self._queue.qsize())
                 raise
         return fut
@@ -1000,8 +1022,16 @@ class MicroBatcher:
                     attrs["replica"] = self.replica
                 fut._lumen_device = fut._lumen_trace.begin("batch.device", attrs)
         arena = None
+        t_dispatch = time.monotonic()
         try:
             stacked, arena = self._stack(items, size)
+            if telemetry.enabled():
+                # Host->device payload for this batch (the staged numpy
+                # tree the backend will transfer). Per-batch, not
+                # per-request; a windowed byte rate on /stats.
+                telemetry.count(
+                    f"transfer_h2d:{self.name}", _tree_nbytes(stacked)
+                )
             result = self._execute(live, n, size, stacked=stacked)
         except Exception as e:  # noqa: BLE001 - contain, or fan out to callers
             self._contain_failure(live, e)
@@ -1011,7 +1041,10 @@ class MicroBatcher:
                 dead = True  # nobody left to settle this result
             else:
                 self._inflight.append(
-                    _Inflight(futures, result, n, size, entries=live, arena=arena)
+                    _Inflight(
+                        futures, result, n, size, entries=live, arena=arena,
+                        t_dispatch=t_dispatch,
+                    )
                 )
                 self._inflight_cv.notify_all()
         if dead:
@@ -1249,11 +1282,17 @@ class MicroBatcher:
 
     def _probe(self, entries: list[tuple[Any, Future, str | None]]) -> list[Any]:
         """One synchronous bisection probe: dispatch the group and block on
-        its fetch. Returns per-item rows; raises what the group raises."""
+        its fetch. Returns per-item rows; raises what the group raises.
+        Probe device time feeds the same duty meter as normal batches —
+        a bisection storm IS device load an operator should see."""
         n = len(entries)
-        result = self._execute(entries, n, bucket_for(n, self.buckets))
-        with self._watched([e[1] for e in entries]):
-            return unstack(result, n)
+        t0 = time.monotonic()
+        try:
+            result = self._execute(entries, n, bucket_for(n, self.buckets))
+            with self._watched([e[1] for e in entries]):
+                return unstack(result, n)
+        finally:
+            telemetry.busy(f"device:{self.name}", t0, time.monotonic())
 
     # -- watchdog ----------------------------------------------------------
 
@@ -1316,6 +1355,11 @@ class MicroBatcher:
         self.stats["watchdog"] += 1
         metrics.count("watchdog_timeouts")
         metrics.count(f"watchdog_timeouts:{self.name}")
+        telemetry.record_event(
+            "watchdog", self.name,
+            f"batch exceeded the {self.watchdog_s:.1f}s watchdog budget; "
+            "batcher disabled pending reload",
+        )
         logger.error("%s", err)
         for f in futures:
             _settle(f, exception=err)
@@ -1400,6 +1444,29 @@ class MicroBatcher:
                 self.stats["items"] += entry.n
                 self.stats["padded"] += entry.size - entry.n
                 self._drain.record(entry.n)
+                if telemetry.enabled():
+                    # Capacity telemetry, all per-batch: the device duty
+                    # envelope (dispatch->settle, union-merged so the
+                    # pipelined overlap isn't double-counted), windowed
+                    # batch fill vs padding, the bucket the batch
+                    # compiled into, and the device->host result bytes.
+                    now = time.monotonic()
+                    if entry.t_dispatch:
+                        telemetry.busy(
+                            f"device:{self.name}", entry.t_dispatch, now
+                        )
+                    telemetry.count(f"batch_items:{self.name}", entry.n)
+                    telemetry.count(
+                        f"batch_padded:{self.name}", entry.size - entry.n
+                    )
+                    telemetry.count(
+                        f"batch_bucket:{self.name}:{entry.size}"
+                    )
+                    if rows:
+                        telemetry.count(
+                            f"transfer_d2h:{self.name}",
+                            _tree_nbytes(rows[0]) * entry.n,
+                        )
                 for f, row in zip(entry.futures, rows):
                     _settle(f, result=row)
             with self._inflight_cv:
@@ -1413,6 +1480,14 @@ class MicroBatcher:
 
 
 # -- pytree stacking helpers ------------------------------------------------
+
+
+def _tree_nbytes(tree: Any) -> int:
+    """Total bytes across a pytree's array leaves (host-side accounting
+    for the transfer-byte telemetry; leaves without ``nbytes`` count 0).
+    One flatten per BATCH — never on the per-request path."""
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    return sum(int(getattr(leaf, "nbytes", 0) or 0) for leaf in leaves)
 
 
 def stack_and_pad(items: list[Any], size: int) -> Any:

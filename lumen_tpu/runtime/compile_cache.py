@@ -16,12 +16,56 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 logger = logging.getLogger(__name__)
 
 _DEFAULT_DIR = os.path.join(
     os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "lumen_tpu", "xla"
 )
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _on_jax_event(name: str, secs: float, **kwargs) -> None:  # noqa: ARG001
+    """jax.monitoring duration listener: every backend compile lands on
+    the capacity-telemetry rings as a count + a duration observation —
+    the recompile-storm signal continuous batching needs (a healthy warm
+    server shows ~0 compiles/window; a shape-churning caller shows a
+    rising windowed rate at seconds per compile)."""
+    if not name.endswith("backend_compile_duration"):
+        return
+    from . import telemetry
+    from ..utils.metrics import metrics
+
+    # metrics.count tees into the rolling window itself, so the windowed
+    # `xla_compiles` rate comes for free with the cumulative counter;
+    # only the duration histogram is telemetry-direct (a metrics.observe
+    # would fabricate an "xla_compile_ms" row in the per-task table).
+    metrics.count("xla_compiles")
+    telemetry.observe("xla_compile_ms", secs * 1e3)
+
+
+def install_compile_listener() -> bool:
+    """Register the XLA compile-event hook (idempotent; returns whether
+    the hook is live). Called from :func:`enable_persistent_cache` — the
+    one place this repo configures JAX's compilation machinery — and
+    safe on jax versions without ``jax.monitoring`` (degrades to off)."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_jax_event)
+        except Exception as e:  # noqa: BLE001 - telemetry hook is never fatal
+            logger.warning("XLA compile-event listener unavailable: %s", e)
+            return False
+        _listener_installed = True
+    logger.info("XLA compile events feeding capacity telemetry")
+    return True
 
 
 def enable_persistent_cache(path: str | None = None) -> str | None:
@@ -30,6 +74,9 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
     Idempotent; safe to call before or after backend init (the cache is
     consulted per compile). Returns the cache dir, or None when disabled.
     """
+    # Compile events feed telemetry whether or not the disk cache is on:
+    # the recompile-storm detector must not vanish with LUMEN_COMPILE_CACHE=0.
+    install_compile_listener()
     if os.environ.get("LUMEN_COMPILE_CACHE") == "0":
         return None
     import jax

@@ -36,6 +36,7 @@ from typing import Any, Callable, Iterable
 from ..utils.deadline import DeadlineExpired, get_deadline
 from ..utils.env import env_int
 from ..utils.metrics import metrics
+from . import telemetry
 from .trace import current_trace
 
 DECODE_WORKERS_ENV = "LUMEN_DECODE_WORKERS"
@@ -79,6 +80,11 @@ class DecodePool:
 
         self._gauge_fn = _gauges
         metrics.register_gauges(name, _gauges)
+        # Worker duty meter: per-task run time sums against a capacity of
+        # ``workers``, so /stats reports the pool's busy fraction — the
+        # "is the host decode lane the wall right now" signal.
+        self._duty_name = f"decode:{name}"
+        telemetry.set_capacity(self._duty_name, float(self.workers))
 
     # -- task plumbing -----------------------------------------------------
 
@@ -113,15 +119,24 @@ class DecodePool:
             raise DeadlineExpired(
                 f"{self.name}: request deadline expired while queued for decode"
             )
+        # Worker busy accounting (per task, not per request-stage): the
+        # run time sums into the ``decode:{pool}`` duty meter whatever
+        # the tracing state is — duty cycles are always-on telemetry.
+        t_run = time.monotonic()
         if qspan is None:
-            return fn(*args, **kwargs)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                telemetry.busy(self._duty_name, t_run, time.monotonic())
         rspan = qspan.trace.begin("decode", {"pool": self.name})
         try:
             result = fn(*args, **kwargs)
         except BaseException as e:
             rspan.end(error=type(e).__name__)
+            telemetry.busy(self._duty_name, t_run, time.monotonic())
             raise
         rspan.end()
+        telemetry.busy(self._duty_name, t_run, time.monotonic())
         if box is not None:
             # Completion instant for the caller's ``decode.wake`` span —
             # written before _task returns, so run() can never read a

@@ -57,6 +57,7 @@ from typing import Any, Callable, Iterator
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
 from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
+from . import telemetry
 from .batcher import MicroBatcher, wait_for_batch
 
 logger = logging.getLogger(__name__)
@@ -502,6 +503,10 @@ class ReplicaSet:
             r.error = f"{type(err).__name__}: {err}"
         metrics.count("replica_down")
         metrics.count(f"replica_down:{self.name}")
+        telemetry.record_event(
+            "replica_down", f"{self.name}/{r.tag}",
+            f"replica marked down ({r.error}); siblings keep serving",
+        )
         logger.error(
             "%s: replica %s DOWN (%s) — siblings keep serving%s",
             self.name, r.tag, r.error,
@@ -604,6 +609,10 @@ class ReplicaSet:
             return False
         metrics.count("replica_revivals")
         metrics.count(f"replica_revivals:{self.name}")
+        telemetry.record_event(
+            "replica_revive", f"{self.name}/{r.tag}",
+            "dead replica's batcher rebuilt and swapped back in",
+        )
         logger.info("%s: replica %s revived", self.name, r.tag)
         if old is not None:
             try:

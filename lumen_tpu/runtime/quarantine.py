@@ -130,6 +130,12 @@ class QuarantineRegistry:
                 self._entries.popitem(last=False)
                 self.stats["evicted"] += 1
         metrics.count("quarantine_adds")
+        from ..utils import telemetry
+
+        telemetry.record_event(
+            "quarantine_add", self.name, reason,
+            fingerprint=key.split(":")[-1][:16],
+        )
         logger.warning("quarantined input %s: %s", key.split(":")[-1][:16], reason)
         return True
 

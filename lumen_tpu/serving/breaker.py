@@ -44,6 +44,7 @@ import time
 import weakref
 from typing import Callable
 
+from ..utils import telemetry
 from ..utils.env import env_float, env_int
 from ..utils.metrics import metrics
 
@@ -178,6 +179,9 @@ class CircuitBreaker:
             self._probe_out = False
         if closed:
             metrics.count("breaker_closes")
+            telemetry.record_event(
+                "breaker_close", self.name, "half-open probe succeeded"
+            )
             logger.info("breaker %r closed: probe succeeded", self.name)
 
     def record_failure(self) -> None:
@@ -200,6 +204,15 @@ class CircuitBreaker:
                 if self._streak >= self.failures:
                     tripped = self._trip_locked(now)
             # open: in-flight stragglers admitted pre-trip; nothing to do.
+        if tripped:
+            # Flight recorder + incident bundle OUTSIDE the state lock:
+            # the capture walks the metrics/trace surfaces, which must
+            # not serialize behind (or deadlock with) breaker admission.
+            telemetry.record_event(
+                "breaker_open", self.name,
+                f"circuit opened after repeated backend failures; "
+                f"shedding for {self.reset_s:.1f}s",
+            )
         if tripped and self.on_open is not None:
             try:
                 self.on_open()

@@ -5,6 +5,14 @@ A stdlib ``http.server`` thread exposing:
 - ``GET  /metrics``        — Prometheus text exposition (cumulative
   ``le``-labeled ``_bucket`` histograms + ``_sum``/``_count``),
 - ``GET  /metrics.json``   — JSON snapshot (per-task p50/p90/p99, errors),
+- ``GET  /stats?window=N`` — rolling-window capacity view: last-N-seconds
+  task latencies/rates, device/decode duty cycles, batch padding waste,
+  transfer bytes, XLA compile activity, HBM occupancy/headroom and the
+  SLO summary (see ``utils/telemetry.py``),
+- ``GET  /slo``            — SLO objectives + multi-window burn state,
+- ``GET  /events?n=K``     — the incident flight recorder's event ring,
+- ``GET  /incidents``      — captured incident bundles (breaker-open /
+  replica-down / SLO-breach context dumps),
 - ``GET  /traces``         — retained request traces (tail-sampled ring:
   errors + slowest-N + a sampled fraction; see ``utils/trace.py``),
 - ``GET  /traces/perfetto``— the same traces as Chrome trace-event JSON,
@@ -18,6 +26,10 @@ profiler endpoints give on-demand XLA/TPU traces viewable in TensorBoard or
 Perfetto, the request traces attribute per-stage host latency (the gap the
 device profiler cannot see), and the histograms come from the per-dispatch
 hook in ``base_service.py``. Enabled with ``lumen-tpu --metrics-port N``.
+
+Every HTTP route handled here must have a row in docs/OBSERVABILITY.md's
+endpoint table — ``scripts/check_endpoints.py`` (collected by tier-1)
+fails on the gap.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import telemetry
 from ..utils.metrics import metrics
 from ..utils.trace import get_recorder
 
@@ -92,13 +105,38 @@ class MetricsServer:
                 self.wfile.write(data)
 
             def do_GET(self):  # noqa: N802 - stdlib API
-                path = urlparse(self.path).path
+                parsed = urlparse(self.path)
+                path = parsed.path
                 if path == "/metrics":
                     self._send(200, "\n".join(metrics.prometheus_lines()) + "\n", "text/plain; version=0.0.4")
                 elif path == "/metrics.json":
                     snap = metrics.snapshot()
                     snap["device_memory"] = metrics.device_memory()
                     self._send(200, json.dumps(snap))
+                elif path == "/stats":
+                    q = parse_qs(parsed.query)
+                    try:
+                        window = float(q.get("window", ["60"])[0])
+                    except ValueError:
+                        window = 60.0
+                    self._send(200, json.dumps(telemetry.capacity_stats(window)))
+                elif path == "/slo":
+                    self._send(200, json.dumps(telemetry.slo_report()))
+                elif path == "/events":
+                    q = parse_qs(parsed.query)
+                    try:
+                        n = int(q.get("n", ["0"])[0])
+                    except ValueError:
+                        n = 0
+                    # n caps the tail; zero/negative means "everything"
+                    # (a negative slice bound would silently invert the
+                    # semantics to drop-oldest-K).
+                    self._send(
+                        200,
+                        json.dumps(telemetry.export_events(n if n > 0 else None)),
+                    )
+                elif path == "/incidents":
+                    self._send(200, json.dumps(telemetry.export_incidents()))
                 elif path == "/traces":
                     self._send(200, json.dumps(get_recorder().export()))
                 elif path == "/traces/perfetto":

@@ -298,4 +298,11 @@ class RecoveryManager:
                     logger.exception("closing unswappable service %r failed", name)
             return
         metrics.count("recoveries")
+        from ..utils import telemetry
+
+        telemetry.record_event(
+            "recovery_swap", name,
+            f"recovered service hot-swapped into the router after "
+            f"{attempt} failed attempt(s)",
+        )
         logger.info("service %r recovered after %d failed attempt(s)", name, attempt)

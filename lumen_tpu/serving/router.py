@@ -27,7 +27,11 @@ Resilience semantics on top of the reference:
 - multi-tenant QoS state rides ``lumen-qos-status`` (per-admission-queue
   occupancy + brownout level, per-tenant quota admit/shed totals) so an
   operator sees "tenant X is being browned out" from a Health probe, and
-  each ``StreamCapabilities`` record carries ``extra["qos"]``.
+  each ``StreamCapabilities`` record carries ``extra["qos"]``;
+- SLO burn state rides ``lumen-slo-status`` (per-task breach/ok + 5m/1h
+  error-budget burn rates from ``utils/telemetry.py``) — a Health probe
+  is also the lazy SLO evaluation tick, so breach counters and incident
+  bundles fire within one probe of the window turning bad.
 """
 
 from __future__ import annotations
@@ -254,6 +258,20 @@ class HubRouter(InferenceServicer):
             return {}
 
     @staticmethod
+    def _slo_state() -> dict:
+        """Evaluated SLO burn state per task (jax-free — the engine lives
+        in ``utils.telemetry``). ``{}`` (no objectives configured, or no
+        traffic) omits the key entirely. Evaluating here is what makes a
+        Health probe flip ``lumen-slo-status`` within one window: the
+        engine is lazy, and Health is the operator's poll."""
+        from ..utils import telemetry
+
+        try:
+            return telemetry.slo_status()
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            return {}
+
+    @staticmethod
     def _quarantine_size() -> int | None:
         """Entries currently quarantined, WITHOUT importing the runtime
         package (which drags in jax — this router must stay importable and
@@ -285,6 +303,12 @@ class HubRouter(InferenceServicer):
                     # condition (siblings keep the hub SERVING), exactly
                     # like a degraded sibling service.
                     trailing.append(("lumen-replica-status", json.dumps(replicas)))
+                slo_state = self._slo_state()
+                if slo_state:
+                    # SLO burn next to the containment keys: a breaching
+                    # task is a reported condition (clients may back off
+                    # bulk traffic), not an outage — the hub still serves.
+                    trailing.append(("lumen-slo-status", json.dumps(slo_state)))
                 qos_state = self._qos_status()
                 if qos_state:
                     # Multi-tenant QoS next to the containment keys:

@@ -284,6 +284,28 @@ def serve(
     else:
         logger.info("request tracing off (set LUMEN_TRACE_SAMPLE to enable)")
 
+    # Same deploy-time-facts rule for the capacity/SLO layer: whether
+    # /stats has windows and whether any SLO objective is armed should be
+    # one boot-log line, not a probe.
+    from ..utils import telemetry as capacity_telemetry
+
+    objectives = capacity_telemetry.slo_objectives()
+    availability = capacity_telemetry.slo_availability()
+    if capacity_telemetry.telemetry_enabled():
+        logger.info(
+            "capacity telemetry ON (bucket=%.0fs, retain=%.0fs); SLO: %s",
+            capacity_telemetry.telemetry_bucket_s(),
+            capacity_telemetry.telemetry_retain_s(),
+            (
+                f"{sorted(objectives)} p95 objectives"
+                + (f", availability>={availability}" if availability else "")
+                if objectives or availability
+                else "no objectives (set LUMEN_SLO_<TASK>_P95_MS)"
+            ),
+        )
+    else:
+        logger.info("capacity telemetry off (LUMEN_TELEMETRY=0)")
+
     logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
     for name, svc in services.items():
         logger.info("  %s [%s] tasks: %s", name, svc.status(), svc.registry.task_names())

@@ -17,6 +17,21 @@ from bisect import bisect_left
 from typing import Callable, Iterator
 
 
+def _telemetry():
+    """Lazy handle on :mod:`lumen_tpu.utils.telemetry` — resolved at
+    first use (telemetry imports THIS module at its top level, so the
+    reverse edge must not be an import-time one) and cached."""
+    global _telemetry_mod
+    if _telemetry_mod is None:
+        from . import telemetry
+
+        _telemetry_mod = telemetry
+    return _telemetry_mod
+
+
+_telemetry_mod = None
+
+
 def _default_bounds() -> list[float]:
     """Log-spaced latency bucket upper bounds in ms: 0.1ms .. ~100s."""
     return [0.1 * (10 ** (i / 6)) for i in range(37)]  # x10 every 6 buckets
@@ -105,6 +120,7 @@ class MetricsRegistry:
         self._errors: dict[str, int] = {}
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, Callable[[], dict]] = {}
+        self._provider_errors_warned: set[str] = set()
         self.started_at = time.time()
 
     def register_gauges(self, provider: str, fn: Callable[[], dict]) -> None:
@@ -134,10 +150,16 @@ class MetricsRegistry:
             with self._lock:
                 hist = self._hist.setdefault(task, LatencyHistogram())
         hist.observe(ms)
+        # Tee into the rolling-window capacity layer: the cumulative
+        # histogram above answers "since boot", the ring answers "the
+        # last N seconds" (and feeds the SLO burn engine). No-op (one
+        # cached env check) under LUMEN_TELEMETRY=0.
+        _telemetry().observe(task, ms)
 
     def count_error(self, task: str) -> None:
         with self._lock:
             self._errors[task] = self._errors.get(task, 0) + 1
+        _telemetry().count_error(task)
 
     def count(self, name: str, n: int = 1) -> None:
         """Bump a named event counter (monotonic). The resilience layer
@@ -146,6 +168,7 @@ class MetricsRegistry:
         inferred from latency percentiles after the fact."""
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
+        _telemetry().count(name, n)
 
     def counter_value(self, name: str) -> int:
         with self._lock:
@@ -172,6 +195,21 @@ class MetricsRegistry:
             try:
                 vals = fn() or {}
             except Exception:  # noqa: BLE001 - metrics must never take down serving
+                # One bad provider is skipped, never a 500 for the whole
+                # scrape — but silently is how a dashboard goes dark:
+                # log it once per provider name and keep a counter so
+                # the failure itself is observable.
+                self.count("gauge_provider_errors")
+                with self._lock:
+                    first = name not in self._provider_errors_warned
+                    self._provider_errors_warned.add(name)
+                if first:
+                    import logging
+
+                    logging.getLogger("lumen_tpu.metrics").exception(
+                        "gauge provider %r raised; skipping it in this and "
+                        "future snapshots until it behaves", name,
+                    )
                 continue
             vals = {
                 k: v for k, v in vals.items()

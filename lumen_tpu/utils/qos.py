@@ -363,6 +363,7 @@ class WFQAdmissionQueue:
         self.stats = {"admitted": 0, "dispatched": 0, "shed_bulk": 0, "brownouts": 0}
         self._tenant_admits: dict[str, int] = {}
         self._tenant_sheds: dict[str, int] = {}
+        self._last_rung = 0  # last observed brownout level (event edges)
         if self.max_queue <= 0:
             _warn_brownout_unbounded()
         _register_queue(self)
@@ -419,9 +420,13 @@ class WFQAdmissionQueue:
         shed_pct, brown_pct = bulk_shed_pct(), brownout_pct()
         brown_factor = brownout_factor()
         shed_at: tuple[float, int] | None = None
+        rung_change: tuple[int, int] | None = None
         with self._cv:
             occ = self._occupancy_locked()
             level = 2 if occ >= shed_pct else (1 if occ >= brown_pct else 0)
+            if level != self._last_rung:
+                rung_change = (self._last_rung, level)
+                self._last_rung = level
             if lane == LANE_BULK and level >= 2:
                 # Decision only under the lock; the counter bumps (which
                 # take the process-global metrics lock) and the message
@@ -447,6 +452,17 @@ class WFQAdmissionQueue:
                 self.stats["admitted"] += 1
                 self._bump(self._tenant_admits, tenant)
                 self._cv.notify()
+        if rung_change is not None:
+            # Rung EDGES only (0->1->2 and back), outside the lock: the
+            # flight recorder tells the brownout story in a handful of
+            # events, while the per-put level itself stays a gauge.
+            from . import telemetry
+
+            old, new = rung_change
+            telemetry.record_event(
+                "brownout", self.name,
+                f"brownout rung {old} -> {new} at {occ:.0f}% queue occupancy",
+            )
         if shed_at is not None:
             occ, waiting = shed_at
             metrics.count("qos_bulk_sheds")
@@ -646,6 +662,14 @@ class TenantQuota:
         if not admitted:
             metrics.count("qos_quota_sheds")
             metrics.count(f"qos_quota_sheds:{tenant}")
+            from . import telemetry
+
+            telemetry.record_event(
+                "qos_shed", tenant,
+                f"tenant over its request-rate quota; next token in "
+                f"{retry_after:.2f}s",
+                min_interval_s=1.0,
+            )
         return admitted, retry_after
 
     def active(self) -> bool:

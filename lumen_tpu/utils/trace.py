@@ -69,9 +69,32 @@ TRACE_META_KEY = "lumen-trace"
 #: response-meta key echoing the request's trace id back to the caller.
 TRACE_RESPONSE_META = "trace_id"
 
-# (raw env string, parsed rate) — re-parsed only when the raw value
+# The per-request env probe reads os.environ's BACKING DICT directly:
+# ``os._Environ.get`` resolves a missing key by raising-and-catching
+# KeyError internally, which costs over a microsecond on a loaded 1-core
+# host — most of the <2µs disabled-path budget for the same answer every
+# time. The backing dict is the store ``os.environ[...]`` (and pytest's
+# monkeypatch.setenv) mutate, so visibility semantics are unchanged:
+# a mid-process flip is seen on the very next request. Falls back to the
+# public API if the CPython internals ever move.
+try:
+    _env_data = os.environ._data
+    _env_key = os.environ.encodekey(TRACE_SAMPLE_ENV)
+except AttributeError:  # pragma: no cover - non-CPython / API drift
+    _env_data = None
+    _env_key = TRACE_SAMPLE_ENV
+
+
+def _raw_sample():
+    data = _env_data
+    if data is not None:
+        return data.get(_env_key)
+    return os.environ.get(TRACE_SAMPLE_ENV)
+
+
+# (raw env value, parsed rate) — re-parsed only when the raw value
 # changes, so the disabled-path check stays a dict lookup + compare.
-_rate_cache: tuple[str | None, float] = ("\x00unset", 0.0)
+_rate_cache: tuple = (b"\x00unset", 0.0)
 
 
 def sample_rate() -> float:
@@ -80,12 +103,13 @@ def sample_rate() -> float:
     non-error, non-slowest traces in the ring (tail sampling). Malformed
     values read as 0 (off) — tracing must degrade, not crash serving."""
     global _rate_cache
-    raw = os.environ.get(TRACE_SAMPLE_ENV)
+    raw = _raw_sample()
     cached_raw, cached = _rate_cache
     if raw == cached_raw:
         return cached
     try:
-        rate = min(1.0, max(0.0, float(raw))) if raw else 0.0
+        text = os.fsdecode(raw) if raw is not None else None
+        rate = min(1.0, max(0.0, float(text))) if text else 0.0
     except ValueError:
         rate = 0.0
     _rate_cache = (raw, rate)

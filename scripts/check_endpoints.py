@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Assert every HTTP route the observability sidecar handles is
+documented in ``docs/OBSERVABILITY.md``'s endpoint table.
+
+The sidecar's routes are an operator API exactly like the metric names
+(``check_metrics.py``) and the gRPC metadata keys
+(``check_meta_keys.py``): dashboards, probes and the ``stats`` client
+subcommand are built on them, so a route added in
+``serving/observability.py`` but missing from the endpoint table is
+silent API drift. This check is collected by pytest
+(``tests/test_check_endpoints.py``) so tier-1 fails on the gap, and runs
+standalone::
+
+    python scripts/check_endpoints.py
+
+Mechanics: scan the handler source for route comparisons
+(``path == "/stats"`` / ``parsed.path == "/profiler/start"``) and
+require each captured path to appear verbatim in OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HANDLER_PATH = os.path.join(
+    REPO_ROOT, "lumen_tpu", "serving", "observability.py"
+)
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+
+#: ``... == "/route"`` — a route comparison in the request handler.
+_ROUTE = re.compile(r'==\s*"(/[A-Za-z0-9_./-]*)"')
+
+
+def handled_routes() -> set[str]:
+    with open(HANDLER_PATH, encoding="utf-8", errors="ignore") as f:
+        return set(_ROUTE.findall(f.read()))
+
+
+def documented_text() -> str:
+    if not os.path.exists(DOC_PATH):
+        return ""
+    with open(DOC_PATH, encoding="utf-8", errors="ignore") as f:
+        return f.read()
+
+
+def undocumented() -> list[str]:
+    doc = documented_text()
+    return sorted(route for route in handled_routes() if route not in doc)
+
+
+def main() -> int:
+    missing = undocumented()
+    if missing:
+        print(
+            "sidecar routes handled in serving/observability.py but missing "
+            "from docs/OBSERVABILITY.md's endpoint table:"
+        )
+        for route in missing:
+            print(f"  {route}")
+        return 1
+    print(f"ok: {len(handled_routes())} sidecar routes all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,10 @@ _PATTERNS = [
     re.compile(r'metrics\.observe\(\s*f?"([^"]+)"'),
     # gauge providers: metrics.register_gauges("x"/f"batcher:{...}")
     re.compile(r'register_gauges\(\s*f?"([^"]+)"'),
+    # rolling-window telemetry feeds: telemetry.count/observe/busy and
+    # duty-meter declarations (telemetry.set_capacity) — windowed names
+    # surface on GET /stats, so they are operator API like the rest.
+    re.compile(r'telemetry\.(?:count|observe|busy|set_capacity)\(\s*f?"([^"]+)"'),
 ]
 
 #: components that call ``register_gauges(name, ...)`` through a variable:
@@ -49,6 +53,12 @@ _PATTERNS = [
 #: submodule name).
 _NAME_VAR_FILES = {"decode_pool.py", "result_cache.py", "quarantine.py"}
 _NAME_VAR_PATTERN = re.compile(r'name(?:: str)? ?= ?f?"([^"]+)"')
+
+#: the registry's own internal counters (``self.count("...")`` inside
+#: metrics.py — e.g. ``gauge_provider_errors``); the loose ``self.count``
+#: shape is scanned in this file only.
+_SELF_COUNT_FILES = {"metrics.py"}
+_SELF_COUNT_PATTERN = re.compile(r'self\.count\(\s*f?"([^"]+)"')
 
 
 def _prefix(name: str) -> str:
@@ -72,6 +82,8 @@ def published_names() -> set[str]:
             patterns = list(_PATTERNS)
             if fn in _NAME_VAR_FILES:
                 patterns.append(_NAME_VAR_PATTERN)
+            if fn in _SELF_COUNT_FILES:
+                patterns.append(_SELF_COUNT_PATTERN)
             for pat in patterns:
                 for m in pat.findall(text):
                     name = _prefix(m).strip()

@@ -65,6 +65,18 @@ os.environ.pop("LUMEN_CACHE_DIR", None)
 for _k in ("LUMEN_TRACE_SAMPLE", "LUMEN_TRACE_RING", "LUMEN_TRACE_SLOW_N"):
     os.environ.pop(_k, None)
 
+# Capacity telemetry / SLO / flight-recorder knobs must not leak in from a
+# developer's environment: a configured SLO objective would make unrelated
+# serving tests trip breach transitions, and a nonstandard bucket width
+# breaks the fake-clock telemetry tests' window math. The layer itself
+# stays default-ON (it is always-on in production and bounded); telemetry
+# tests install their own hub (install_hub) for isolation.
+for _k in [k for k in os.environ if k.startswith("LUMEN_SLO_")] + [
+    "LUMEN_TELEMETRY", "LUMEN_TELEMETRY_BUCKET_S", "LUMEN_TELEMETRY_RETAIN_S",
+    "LUMEN_EVENTS_RING", "LUMEN_INCIDENTS_MAX", "LUMEN_INCIDENT_COOLDOWN_S",
+]:
+    os.environ.pop(_k, None)
+
 # Circuit breakers: OFF for the suite (LUMEN_BREAKER_FAILURES=0). Several
 # tests drive deliberate failure bursts through serve()-built services; a
 # default-on breaker would flip their expected error codes to UNAVAILABLE

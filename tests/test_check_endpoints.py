@@ -1,0 +1,34 @@
+"""Tier-1 gate: every HTTP route the observability sidecar handles has a
+row in docs/OBSERVABILITY.md's endpoint table, so the sidecar surface
+can't silently drift. See scripts/check_endpoints.py."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_endpoints",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_endpoints.py"),
+)
+check_endpoints = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_endpoints)
+
+
+def test_every_handled_route_is_documented():
+    missing = check_endpoints.undocumented()
+    assert not missing, (
+        f"sidecar routes handled in serving/observability.py but missing "
+        f"from docs/OBSERVABILITY.md: {missing} — add each to the endpoint "
+        "table"
+    )
+
+
+def test_scan_finds_known_routes():
+    # A regex typo must not turn the gate into a silent pass: the scan has
+    # to see both the GET comparisons and the POST (parsed.path) ones.
+    routes = check_endpoints.handled_routes()
+    assert "/metrics" in routes
+    assert "/health" in routes          # the route PR 7-era docs missed
+    assert "/stats" in routes
+    assert "/incidents" in routes
+    assert "/profiler/start" in routes  # parsed.path comparison shape
