@@ -165,11 +165,14 @@ class BackendSettings(BaseModel):
     batch_buckets: list[int] | None = None
     # Compile every batch bucket at startup instead of on first request.
     warmup: bool = False
-    # VLM decode scheduling: "coalesce" groups same-shape concurrent
-    # requests into one fused-loop program (lowest dispatch overhead);
-    # "continuous" runs a slot pool that admits arrivals mid-decode
-    # (no queueing behind long generations). Other services ignore this.
-    scheduler: Literal["coalesce", "continuous"] = "coalesce"
+    # VLM decode scheduling: "continuous" (the default) runs the paged-KV
+    # continuous-batching engine — requests admit/retire at step
+    # granularity into a shared page pool, no queueing behind long
+    # generations; "coalesce" groups same-shape concurrent requests into
+    # one fused-loop program (lowest dispatch overhead, best for
+    # same-shaped bursts). LUMEN_VLM_SCHEDULER overrides either at boot.
+    # Other services ignore this.
+    scheduler: Literal["coalesce", "continuous"] = "continuous"
     # Continuous scheduler only: decode steps per compiled block (one host
     # dispatch per block; larger amortizes dispatch, smaller admits and
     # retires rows sooner). Ignored by "coalesce".

@@ -1,27 +1,33 @@
-"""Continuous batching scheduler for VLM generation.
+"""Continuous batching over a paged KV pool for VLM generation.
 
 The coalescing batcher (``manager._GenBatcher``) groups only requests that
 arrive within one small latency window AND share a prompt bucket; once a
 fused generation program launches, everything behind it queues until the
-longest row finishes. This scheduler removes that cliff:
+longest row finishes. The slot-era version of this scheduler removed that
+cliff but still gave every decode row a contiguous ``max_seq`` KV region —
+the pool paid worst-case memory per slot and admission needed a same-shape
+bucket. This engine is the paged rebuild:
 
-- a fixed pool of ``slots`` decode rows advances together in ``block``-step
-  compiled programs (``Generator._step_block_impl``);
-- new requests are ADMITTED into free slots between blocks — a burst of
-  same-shaped arrivals prefills as ONE batched forward (``ADMIT_BUCKETS``
-  groups, so admission cost under load is ~1 prefill per bucket, not one
-  per request) — and start decoding immediately next block, regardless of
-  what the other slots are doing;
-- rows retire on EOS / per-request cap without stopping the others.
+- KV lives in a shared POOL OF PAGES (``paged_kv.PagedKVPool`` host
+  accounting + ``Generator.init_pool`` device arrays); each row owns a
+  block table that grows page by page as it decodes and returns its pages
+  at retire, so long and short generations share memory and a request
+  admits the moment a slot and its prompt's pages are free;
+- decode attention is RAGGED PAGED ATTENTION (``ops.attention``): the
+  Pallas kernel on TPU, the exact XLA gather reference on CPU — tier-1
+  runs the same code path end to end;
+- a burst of same-shaped arrivals still prefills as ONE batched forward
+  (``ADMIT_BUCKETS``), and long prompts go through a CHUNKED PREFILL LANE
+  — one prompt chunk per scheduler turn — so a 1k-token prompt never
+  stalls in-flight decode steps;
+- rows retire on EOS / per-request cap without stopping the others; if
+  the pool runs dry mid-decode the newest row is PREEMPTED back to the
+  queue (pages freed, generation restarts — greedy requests reproduce
+  their tokens exactly) instead of wedging the engine.
 
-This is the slot half of TPU continuous batching (the "ragged batch" of
-paged attention with contiguous per-slot KV regions instead of pages).
-Trade-off vs the fused ``lax.while_loop`` path: one host dispatch per
-``block`` tokens instead of one per generation — pick ``block`` to
-amortize dispatch overhead, and prefer the coalescing batcher when traffic
-arrives in same-shaped bursts.
-
-The reference serves one request at a time per process
+Per-step occupancy (active rows / pool pages) is published as gauges and
+each decode block lands a ``batch.device`` span on every active request's
+trace. The reference serves one request at a time per process
 (``packages/lumen-vlm/src/lumen_vlm/backends/onnxrt_backend.py:298-356``);
 neither strategy has an upstream equivalent.
 """
@@ -32,7 +38,9 @@ import logging
 import os
 import queue as queue_mod
 import threading
+import time
 import weakref
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
@@ -40,7 +48,9 @@ import jax
 import numpy as np
 
 from ...utils.metrics import metrics
+from ...utils.trace import current_trace
 from .manager import _PendingGen
+from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool
 
 logger = logging.getLogger(__name__)
 
@@ -69,24 +79,42 @@ def _retire(req: "_Request", tokens: list, eos: bool) -> None:
 @dataclass
 class _Request(_PendingGen):
     """One continuous-batching request: the batcher's fields plus a
-    per-request rng, an optional stream queue, and a cancel flag (set when
-    a stream consumer goes away so the slot stops decoding)."""
+    per-request rng, an optional stream queue, a cancel flag (set when
+    a stream consumer goes away so the slot stops decoding), and the
+    submitter's trace (decode blocks land ``batch.device`` spans on it)."""
 
     rng: object = None
     future: Future = field(default_factory=Future)
     stream_q: "queue_mod.SimpleQueue | None" = None
     cancelled: bool = False
+    trace: object = None
+    #: carried across preemption so a resumed stream never re-delivers.
+    delivered: int = 0
 
 
 @dataclass
 class _Slot:
     request: _Request
+    prompt_len: int = 0  # live prompt tokens (host mirror of pool cur_len base)
+    seq: int = 0  # admission order; preemption evicts the newest first
     tokens: list = field(default_factory=list)
-    delivered: int = 0
+
+
+@dataclass
+class _PrefillJob:
+    """One long prompt moving through the chunked prefill lane."""
+
+    request: _Request
+    caches: object = None  # contiguous [1, kvh, Lb, dh] scratch per layer
+    scratch_len: int = 0  # Lb (page- and chunk-aligned)
+    offset: int = 0  # prompt tokens already processed
+    length: int = 0  # live prompt tokens (host int)
+    last_logits: object = None  # logits of the most recent chunk
+    last_off: int = 0  # offset of that chunk
 
 
 class ContinuousScheduler:
-    """Slot-pool decode loop on a dedicated thread.
+    """Paged continuous-batching decode loop on a dedicated thread.
 
     ``submit`` returns a Future resolving to ``(tokens_np, n_gen, eos)`` —
     the same contract as the coalescing batcher — and optionally streams
@@ -96,10 +124,18 @@ class ContinuousScheduler:
 
     def __init__(
         self, generator, params, slots: int = 8, block: int = 8,
-        name: str = "vlm",
+        name: str = "vlm", page_size: int | None = None,
+        pages: int | None = None, prefill_chunk: int | None = None,
+        mesh=None,
     ):
+        from ...utils.env import env_int
+
         self.gen = generator
         self.params = params
+        #: replica mesh slice (fleet mode): the page pool is pinned to it
+        #: and submitted request tensors are transferred over (prepare
+        #: programs run on replica 0's devices). None = legacy placement.
+        self.mesh = mesh
         # Gauge provider id: per-model-name, matching the batcher's
         # ``batcher:{name}`` semantics — distinct models coexist; a
         # same-name replacement takes over the slot (last-writer-wins
@@ -107,7 +143,25 @@ class ContinuousScheduler:
         self.name = name
         self.n_slots = slots
         self.block = block
-        self.pool = generator.init_pool(slots)
+        self.page_size = page_size or env_int(
+            "LUMEN_VLM_PAGE_SIZE", DEFAULT_PAGE_SIZE, minimum=8, maximum=256
+        )
+        max_pages = -(-generator.max_seq // self.page_size)
+        if pages is None:
+            pages = slots * max_pages + 1  # slot-era footprint fallback
+        self.kv = PagedKVPool(pages, self.page_size, slots, max_pages)
+        self.pool = generator.init_pool(slots, pages=pages, page_size=self.page_size)
+        if mesh is not None:
+            from ...parallel.sharding import replicate
+
+            self.pool = replicate(self.pool, mesh)
+        # Prompts longer than this (padded length) prefill through the
+        # chunk lane, one chunk per scheduler turn; the chunk is rounded
+        # to a page multiple so scratch caches scatter cleanly into pages.
+        chunk = prefill_chunk or env_int(
+            "LUMEN_VLM_PREFILL_CHUNK", 256, minimum=32, maximum=4096
+        )
+        self.prefill_chunk = -(-chunk // self.page_size) * self.page_size
         # Decode sampling draws from one scheduler-level stream (sample()
         # takes a single key per batched step); entropy-seeded so sampled
         # continuations differ across processes. An admission group's
@@ -119,10 +173,18 @@ class ContinuousScheduler:
         self._rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "big"))
         self._slots: dict[int, _Slot] = {}  # slot idx -> live request
         self._pending: list[_Request] = []
+        self._prefill_jobs: deque[_PrefillJob] = deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._admit_seq = 0
         self.blocks_run = 0  # observability
         self.admitted = 0
+        self.preemptions = 0
+        self.chunks_run = 0
+        # Decode-step occupancy accumulators: active-row fill per block
+        # (every step in a block shares the block-start row count).
+        self._occ_rows = 0
+        self._occ_blocks = 0
         self._thread = threading.Thread(target=self._loop, name="vlm-continuous", daemon=True)
         self._thread.start()
         ref = weakref.ref(self)  # registry must not pin the pool/params
@@ -131,13 +193,31 @@ class ContinuousScheduler:
             s = ref()
             if s is None:
                 return {}
-            return {
+            stats = s.kv.stats()
+            out = {
                 "blocks_run": s.blocks_run,
                 "admitted": s.admitted,
+                "preempted": s.preemptions,
+                "prefill_chunks_run": s.chunks_run,
+                "prefill_lane_depth": len(s._prefill_jobs),
                 "slots_total": s.n_slots,
                 "slots_live": len(s._slots),
                 "queue_depth": len(s._pending),
+                "page_size": stats.page_size,
+                "pages_total": stats.pages_total,
+                "pages_free": stats.pages_free,
+                "pages_live": stats.pages_live,
+                "pages_allocated_total": stats.allocated_total,
+                "pages_freed_total": stats.freed_total,
+                "pages_fill_pct": round(
+                    100.0 * stats.pages_live / max(stats.pages_total - 1, 1), 1
+                ),
             }
+            if s._occ_blocks:
+                out["occupancy_pct_mean"] = round(
+                    100.0 * s._occ_rows / (s._occ_blocks * s.n_slots), 1
+                )
+            return out
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"vlm-continuous:{self.name}", _gauges)
@@ -148,9 +228,37 @@ class ContinuousScheduler:
         with self._cond:
             if self._closed:
                 raise RuntimeError("continuous scheduler is closed")
+        # Feasibility is checked at the door: a request whose prompt +
+        # budget can NEVER fit the pool (even alone) must fail loudly now,
+        # not deadlock the admission queue later.
+        need = int(np.asarray(req.length)[0]) + int(req.max_new) + 1
+        if not self.kv.fits(need):
+            raise ValueError(
+                f"request needs {need} KV tokens but the paged pool holds at "
+                f"most {min(self.kv.row_capacity(), (self.kv.pages_total - 1) * self.kv.page_size)} "
+                "per row; raise LUMEN_VLM_KV_PAGES or lower max_new_tokens"
+            )
+        if req.trace is None:
+            req.trace = current_trace()
+        if self.mesh is not None:
+            # Fleet mode: prepare ran on replica 0's devices; move the
+            # request tensors onto THIS engine's slice before its jitted
+            # programs see them (same-placement transfers are no-ops).
+            from ...parallel.sharding import replicate
+
+            req.embeds, req.positions, req.length, req.prompt_ids = replicate(
+                (req.embeds, req.positions, req.length, req.prompt_ids), self.mesh
+            )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("continuous scheduler is closed")
             self._pending.append(req)
             self._cond.notify()
         return req.future
+
+    def load(self) -> int:
+        """Dispatch weight for the manager's least-loaded engine pick."""
+        return len(self._pending) + len(self._slots) + len(self._prefill_jobs)
 
     def submit_stream(self, req: _Request):
         """Submit and iterate generated token ids as they decode."""
@@ -183,8 +291,9 @@ class ContinuousScheduler:
         with self._cond:
             pending, self._pending = self._pending, []
             live, self._slots = list(self._slots.values()), {}
+            jobs, self._prefill_jobs = list(self._prefill_jobs), deque()
         err = RuntimeError("continuous scheduler closed")
-        for req in pending + [s.request for s in live]:
+        for req in pending + [s.request for s in live] + [j.request for j in jobs]:
             _fail(req, err)
         if fn := getattr(self, "_gauge_fn", None):
             metrics.unregister_gauges(f"vlm-continuous:{self.name}", fn)
@@ -192,15 +301,30 @@ class ContinuousScheduler:
     # -- scheduler loop ----------------------------------------------------
 
     def _take_work(self) -> list[_Request]:
-        """Block until there is something to do; drain admissible requests."""
+        """Block until there is something to do; drain admissible requests.
+        Chunk-lane jobs hold a slot reservation, so the drain never takes
+        more requests than slots that will actually be free."""
         with self._cond:
-            while not self._closed and not self._pending and not self._slots:
+            while (
+                not self._closed
+                and not self._pending
+                and not self._slots
+                and not self._prefill_jobs
+            ):
                 self._cond.wait()
             if self._closed:
                 return []
-            free = self.n_slots - len(self._slots)
+            free = self.n_slots - len(self._slots) - len(self._prefill_jobs)
+            if free <= 0:
+                return []
             take, self._pending = self._pending[:free], self._pending[free:]
             return take
+
+    def _requeue_front(self, reqs: list[_Request]) -> None:
+        """Return unplaceable requests to the head of the queue in order."""
+        if reqs:
+            with self._cond:
+                self._pending[:0] = reqs
 
     def _loop(self) -> None:
         try:
@@ -224,7 +348,32 @@ class ContinuousScheduler:
                         _retire(req, [], eos=False)
                     else:
                         live.append(req)
-                groups = self._admit_groups(live)
+                # Page gating: take requests in arrival order while the
+                # free list covers their prompts; the rest go back to the
+                # queue head and wait for retires to free pages. A
+                # finished chunk-lane job waiting on pages gets its need
+                # RESERVED out of the budget first — without that, a
+                # sustained stream of short arrivals re-grants every
+                # freed page each turn and starves the long prompt
+                # forever.
+                placeable, deferred = [], []
+                budget = self.kv.pages_free - self._lane_reserved_pages()
+                for req in live:
+                    n = int(np.asarray(req.length)[0])
+                    need = self.kv.pages_for(n + 1)
+                    if deferred or need > budget:
+                        deferred.append(req)
+                    else:
+                        budget -= need
+                        placeable.append(req)
+                self._requeue_front(deferred)
+                direct = []
+                for req in placeable:
+                    if req.embeds.shape[1] > self.prefill_chunk:
+                        self._prefill_jobs.append(self._start_chunk_job(req))
+                    else:
+                        direct.append(req)
+                groups = self._admit_groups(direct)
                 for gpos, group in enumerate(groups):
                     try:
                         self._admit_group(group)
@@ -246,6 +395,7 @@ class ContinuousScheduler:
                             raise RuntimeError(
                                 "slot pool invalidated by failed admission"
                             ) from e
+                self._advance_prefill_lane()
                 if self._slots:
                     self._run_block()
         except BaseException as e:  # noqa: BLE001 - never strand callers
@@ -254,11 +404,12 @@ class ContinuousScheduler:
                 self._closed = True
                 pending, self._pending = self._pending, []
                 live, self._slots = list(self._slots.values()), {}
-            for req in pending + [s.request for s in live]:
+                jobs, self._prefill_jobs = list(self._prefill_jobs), deque()
+            for req in pending + [s.request for s in live] + [j.request for j in jobs]:
                 _fail(req, RuntimeError(f"continuous scheduler died: {e!r}"))
 
     def _pool_invalid(self) -> bool:
-        """True when the slot pool's buffers were deleted by a donation
+        """True when the page pool's buffers were deleted by a donation
         whose computation then failed (see ``Generator._admit``'s
         ``donate_argnames``)."""
         return any(
@@ -296,6 +447,37 @@ class ContinuousScheduler:
                 group = group[k:]
         return groups
 
+    def _admit_kv_len(self, span: int) -> int:
+        """Prefill-scratch length for a prompt span: the generator's KV
+        bucket rounded up to a page multiple (the scratch scatters into
+        pages whole)."""
+        kv_len = next((b for b in self.gen.seq_buckets if b >= span), self.gen.max_seq)
+        kv_len = max(kv_len, span)
+        return -(-kv_len // self.page_size) * self.page_size
+
+    def _install_row(self, req: _Request, caches1, tok0, seen1, length) -> int:
+        """Grant pages + write one prefilled row into a free slot. The
+        device write donates the pool, so a failure here may invalidate
+        it (callers escalate via ``_pool_invalid``)."""
+        slot = self._free_slot()
+        n = int(np.asarray(length)[0])
+        bt_row = self.kv.admit(slot, n)
+        try:
+            self.pool = self.gen._admit(
+                self.pool, slot, caches1, tok0, seen1, length,
+                jax.numpy.asarray(bt_row), req.max_new, req.temperature,
+                req.top_p, req.do_sample, req.repetition_penalty,
+            )
+        except Exception:
+            self.kv.release(slot)
+            raise
+        self._admit_seq += 1
+        slot_state = _Slot(request=req, prompt_len=n, seq=self._admit_seq)
+        with self._cond:
+            self._slots[slot] = slot_state
+        self.admitted += 1
+        return slot
+
     def _admit_group(self, reqs: list[_Request]) -> None:
         """One batched prefill for the group, then per-row slot admission.
         The group shares one sampling key (same semantics as the
@@ -315,15 +497,11 @@ class ContinuousScheduler:
             lengths = jnp.concatenate([r.length for r in reqs], axis=0)
             prompt_ids = jnp.concatenate([r.prompt_ids for r in reqs], axis=0)
         # Right-size the admission prefill cache to the PROMPT span only:
-        # decode happens in the pool's full-size per-slot cache, so the
-        # prefill buffer never needs max_seq. Without this, a burst of 8
-        # would transiently allocate a second pool-sized KV buffer
-        # (8 x max_seq) — an OOM spike on exactly the load batched
-        # admission exists for.
-        kv_len = next(
-            (b for b in self.gen.seq_buckets if b >= embeds.shape[1]),
-            self.gen.max_seq,
-        )
+        # decode happens in the shared page pool, so the prefill buffer
+        # never needs max_seq. Without this, a burst of 8 would
+        # transiently allocate a second pool-sized KV buffer — an OOM
+        # spike on exactly the load batched admission exists for.
+        kv_len = self._admit_kv_len(embeds.shape[1])
         caches, tok0, seen = self.gen._prefill(
             self.params, embeds, positions, lengths, prompt_ids, sub,
             jnp.asarray([r.temperature for r in reqs], jnp.float32),
@@ -335,24 +513,17 @@ class ContinuousScheduler:
         group_slots: list[int] = []
         try:
             for i, req in enumerate(reqs):
-                slot = self._free_slot()
                 row = slice(i, i + 1)
                 caches1 = jax.tree.map(lambda c, r=row: c[r], caches)
-                self.pool = self.gen._admit(
-                    self.pool, slot, caches1, tok0[row], seen[row], lengths[row],
-                    req.max_new, req.temperature, req.top_p, req.do_sample,
-                    req.repetition_penalty,
-                )
-                self._slots[slot] = _Slot(request=req)
+                slot = self._install_row(req, caches1, tok0[row], seen[row], lengths[row])
                 group_slots.append(slot)
-                self.admitted += 1
         except Exception:
             # Mid-group failure with earlier rows already admitted: the
             # caller fails EVERY request in the group, so rows already in
             # _slots must be evicted too — otherwise they keep decoding to
-            # max_new for futures that already errored, burning slots. If
-            # the pool was invalidated (donation consumed), skip the
-            # device write; the caller escalates to fail-everything.
+            # max_new for futures that already errored, burning slots and
+            # pages. If the pool was invalidated (donation consumed), skip
+            # the device write; the caller escalates to fail-everything.
             if group_slots and not self._pool_invalid():
                 import jax.numpy as jnp
 
@@ -361,7 +532,164 @@ class ContinuousScheduler:
             with self._cond:
                 for slot in group_slots:
                     self._slots.pop(slot, None)
+                    self.kv.release(slot)
             raise
+
+    # -- chunked prefill lane ----------------------------------------------
+
+    def _lane_reserved_pages(self) -> int:
+        """Pages spoken for by the head chunk-lane job once its chunks
+        have all run (it admits the moment the free list covers them)."""
+        if not self._prefill_jobs:
+            return 0
+        job = self._prefill_jobs[0]
+        if job.offset < job.length or job.request.cancelled:
+            return 0
+        return self.kv.pages_for(job.length + 1)
+
+    def _start_chunk_job(self, req: _Request) -> _PrefillJob:
+        n = int(np.asarray(req.length)[0])
+        span = int(req.embeds.shape[1])
+        # Sized to the padded span only (tail chunks shrink to fit): the
+        # scratch must stay within what a block-table row can address.
+        scratch_len = self._admit_kv_len(span)
+        return _PrefillJob(
+            request=req,
+            caches=self.gen.new_prefill_cache(scratch_len),
+            scratch_len=scratch_len,
+            length=n,
+        )
+
+    def _advance_prefill_lane(self) -> None:
+        """Run ONE chunk of the head-of-lane prefill job (decode blocks
+        interleave between chunks), admitting the job when its last live
+        chunk has run and pages are free."""
+        import jax.numpy as jnp
+
+        while self._prefill_jobs:
+            job = self._prefill_jobs[0]
+            req = job.request
+            if req.cancelled:
+                self._prefill_jobs.popleft()
+                _retire(req, [], eos=False)
+                continue
+            if job.offset < job.length:
+                off = job.offset
+                # Tail chunks shrink to the padded span — off and the
+                # chunk size are host ints, so each (span, off) pair is
+                # one tiny compiled slice; counts are bounded by the
+                # prompt buckets over the chunk size.
+                c = min(self.prefill_chunk, int(req.embeds.shape[1]) - off)
+                chunk = req.embeds[:, off : off + c]
+                positions = jnp.broadcast_to(jnp.arange(off, off + c)[None, :], (1, c))
+                valid = jnp.asarray([min(job.length, off + c)], jnp.int32)
+                job.last_logits, job.caches = self.gen._prefill_chunk(
+                    self.params, job.caches, chunk, positions,
+                    jnp.asarray(off, jnp.int32), valid,
+                )
+                job.last_off = off
+                job.offset = off + c
+                self.chunks_run += 1
+                return  # one chunk per turn: decode gets the next slice
+            # All live chunks ran: admit when pages allow, else wait.
+            if not self.kv.can_admit(job.length):
+                return
+            sub = jax.random.fold_in(req.rng, 0)
+            tok0, seen = self.gen._chunk_finish(
+                job.last_logits, jnp.asarray([job.length - 1 - job.last_off], jnp.int32),
+                req.prompt_ids, req.length, sub,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jnp.asarray([req.do_sample]),
+                jnp.asarray([req.repetition_penalty], jnp.float32),
+            )
+            self._prefill_jobs.popleft()
+            try:
+                self._install_row(req, job.caches, tok0, seen, req.length)
+            except Exception as e:  # noqa: BLE001
+                _fail(req, e)
+                if self._pool_invalid():
+                    raise RuntimeError(
+                        "slot pool invalidated by failed admission"
+                    ) from e
+            return
+
+    # -- decode blocks ------------------------------------------------------
+
+    def _preempt_newest(self, protect: int) -> bool:
+        """Evict the newest live row (except ``protect``) back to the
+        queue head: pages freed, generation restarts from the prompt.
+        Greedy requests reproduce their tokens exactly (``delivered`` is
+        deliberately NOT reset, so a resumed stream never re-sends its
+        prefix). A sampled row re-draws on restart — splicing a fresh
+        draw onto already-streamed tokens would emit a sequence no
+        sampling run ever produced, so victims that have streamed sampled
+        output are preempted LAST and failed rather than resumed."""
+        victims = [i for i in self._slots if i != protect]
+        if not victims:
+            return False
+
+        def resumable(i: int) -> bool:
+            req = self._slots[i].request
+            return not (req.do_sample and req.delivered > 0)
+
+        clean = [i for i in victims if resumable(i)]
+        idx = max(clean or victims, key=lambda i: self._slots[i].seq)
+        resume = resumable(idx)  # decided BEFORE the pop drops the slot
+        import jax.numpy as jnp
+
+        self.pool = dict(
+            self.pool, done=self.pool["done"].at[jnp.asarray([idx], jnp.int32)].set(True)
+        )
+        with self._cond:
+            slot = self._slots.pop(idx)
+        self.kv.release(idx)
+        self.preemptions += 1
+        metrics.count("vlm_paged_preemptions")
+        logger.warning(
+            "paged KV pool exhausted: preempting slot %d (%d tokens in, "
+            "restarts from prompt)", idx, len(slot.tokens),
+        )
+        if resume:
+            self._requeue_front([slot.request])
+        else:
+            _fail(slot.request, RuntimeError(
+                "request preempted by KV pool exhaustion mid-stream; a "
+                "sampled stream cannot resume without splicing draws — retry"
+            ))
+        return True
+
+    def _row_need(self, slot: "_Slot") -> int:
+        """KV tokens a row needs covered before the next block: the
+        block's writes, clamped to the row's own budget (it stops at
+        ``max_new``) and to what a block table can address (a row at
+        capacity keeps overwriting its clamped last slot — matching the
+        decode program's position clamp). Without the clamps, a feasible
+        request ending within ``block`` tokens of the pool bound would
+        ask for pages past the table and crash the loop."""
+        return min(
+            slot.prompt_len + len(slot.tokens) + self.block,
+            slot.prompt_len + slot.request.max_new + 1,
+            self.kv.row_capacity(),
+        )
+
+    def _ensure_growth(self) -> None:
+        """Before a block, every live row's pages must cover the next
+        block's writes; preempt the newest rows until the free list can
+        satisfy the rest. A lone row always fits — submit() checked
+        feasibility against the whole pool."""
+        for idx in sorted(self._slots, key=lambda i: self._slots[i].seq):
+            slot = self._slots.get(idx)
+            if slot is None:
+                continue
+            need = self._row_need(slot)
+            while not self.kv.grow(idx, need):
+                if not self._preempt_newest(protect=idx):
+                    raise RuntimeError(
+                        "paged pool cannot grow a lone row (feasibility bug)"
+                    )
+                if idx not in self._slots:  # we preempted ourselves? never
+                    break
 
     def _run_block(self) -> None:
         cancelled = [
@@ -373,30 +701,64 @@ class ContinuousScheduler:
             idx = jnp.asarray(cancelled, jnp.int32)
             self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
             for i in cancelled:
-                slot = self._slots.pop(i)
+                with self._cond:
+                    slot = self._slots.pop(i)
+                self.kv.release(i)
                 _retire(slot.request, slot.tokens, eos=False)
             if not self._slots:
                 return
+        self._ensure_growth()
+        active = len(self._slots)
+        t0 = time.perf_counter()
+        # Ragged page bucketing: ship only a power-of-2 prefix of the
+        # block tables covering the longest live row. The CPU reference
+        # gathers every table entry it is given, so a pool of short
+        # generations must not pay max_seq worth of gather per step (the
+        # page-granular twin of attention_cached's ragged KV ladder);
+        # bucketing keeps compiled step shapes at log2(max_pages).
+        maxp_live = max(
+            (self.kv.pages_for(self._row_need(s)) for s in self._slots.values()),
+            default=1,
+        )
+        bucket = 1
+        while bucket < maxp_live:
+            bucket *= 2
+        bucket = min(bucket, self.kv.max_pages)
         self.pool, self._rng, toks = self.gen._step_block(
-            self.params, self.pool, self._rng, block=self.block
+            self.params, self.pool,
+            jax.numpy.asarray(self.kv.block_tables[:, :bucket]),
+            self._rng, block=self.block,
         )
         self.blocks_run += 1
+        self._occ_rows += active
+        self._occ_blocks += 1
         # One fused device->host transfer for everything the bookkeeping
         # below needs (four separate np.asarray calls = four round trips
         # on the per-block hot path).
         toks_np, n_gen, done, eos = jax.device_get(
             (toks, self.pool["n_gen"], self.pool["done"], self.pool["eos"])
         )
+        t1 = time.perf_counter()
+        span_meta = {
+            "step": self.blocks_run,
+            "rows": active,
+            "fill_pct": round(100.0 * active / self.n_slots, 1),
+            "block": self.block,
+        }
         for idx in list(self._slots):
             slot = self._slots[idx]
+            req = slot.request
+            if req.trace is not None:
+                req.trace.add_span("batch.device", t0, t1, dict(span_meta))
             new = int(n_gen[idx]) - len(slot.tokens)
             if new > 0:
                 slot.tokens.extend(int(t) for t in toks_np[idx, :new])
-                if slot.request.stream_q is not None:
-                    for t in slot.tokens[slot.delivered :]:
-                        slot.request.stream_q.put(t)
-                    slot.delivered = len(slot.tokens)
+                if req.stream_q is not None:
+                    for t in slot.tokens[req.delivered :]:
+                        req.stream_q.put(t)
+                    req.delivered = len(slot.tokens)
             if done[idx]:
                 with self._cond:
                     del self._slots[idx]
-                _retire(slot.request, slot.tokens, bool(eos[idx]))
+                self.kv.release(idx)
+                _retire(req, slot.tokens, bool(eos[idx]))

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...ops.sampling import apply_repetition_penalty, sample
-from .modeling import VLMConfig, VLMModel, init_kv_cache
+from .modeling import VLMConfig, VLMModel, init_kv_cache, init_paged_kv_cache
 
 
 @dataclass
@@ -70,13 +70,21 @@ class Generator:
         self._generate = jax.jit(self._generate_impl, static_argnames=("kv_len",))
         self._prefill = jax.jit(self._prefill_impl, static_argnames=("kv_len",))
         self._step = jax.jit(self._step_impl)
-        # The continuous pool (slots x max_seq KV) is the dominant buffer;
-        # donating it lets XLA update in place instead of holding two
-        # copies across every admit/block dispatch.
+        # The paged KV pool is the dominant buffer; donating it lets XLA
+        # update in place instead of holding two copies across every
+        # admit/block dispatch. Block tables are host-managed (numpy in
+        # paged_kv.PagedKVPool) and ride in as a small fresh operand.
         self._admit = jax.jit(self._admit_impl, donate_argnames=("pool",))
         self._step_block = jax.jit(
             self._step_block_impl, static_argnames=("block",), donate_argnames=("pool",)
         )
+        # Chunked-prefill lane programs: one chunk of prompt through the
+        # decoder into a donated contiguous scratch cache, and the finish
+        # step that samples token 0 once the last live chunk ran.
+        self._prefill_chunk = jax.jit(
+            self._prefill_chunk_impl, donate_argnames=("caches",)
+        )
+        self._chunk_finish = jax.jit(self._chunk_finish_impl)
 
     # -- shared pieces ------------------------------------------------------
 
@@ -278,20 +286,40 @@ class Generator:
         ).astype(jnp.int32)
         return caches, nxt, seen
 
-    # -- continuous-batching pool programs ----------------------------------
+    # -- continuous-batching pool programs (paged KV) ------------------------
     #
     # A fixed pool of B decode slots advances together in k-step blocks;
-    # requests are admitted into free slots between blocks (prefill at
-    # batch 1) and retired on EOS/cap without stopping the others. This is
-    # the slot half of TPU continuous batching (paged attention minus the
-    # paging — the per-slot KV region is contiguous): arrivals no longer
-    # wait for the longest running generation to finish.
+    # requests are admitted into free slots between blocks and retired on
+    # EOS/cap without stopping the others. KV lives in a shared PAGED pool
+    # ([pages, kv_heads, page_size, dh] per layer) addressed through
+    # host-managed per-row block tables (``paged_kv.PagedKVPool``):
+    # admission scatters the prompt's prefill cache into freshly granted
+    # pages, decode writes one slot per step through the row's table, and
+    # retire returns the pages — long and short generations share the pool
+    # instead of every slot paying a contiguous max_seq region.
 
-    def init_pool(self, slots: int) -> dict:
-        """Fresh all-slots-free pool state (host-callable, device arrays)."""
+    def _decode_paged(self, params, embeds, positions, caches, block_tables, offset, kv_len):
+        return self.model.apply(
+            {"params": params},
+            embeds,
+            positions,
+            caches,
+            block_tables,
+            offset,
+            kv_len,
+            method=VLMModel.decode_paged,
+        )
+
+    def init_pool(self, slots: int, pages: int | None = None, page_size: int = 16) -> dict:
+        """Fresh all-slots-free paged pool state (host-callable, device
+        arrays). ``pages`` defaults to the slot-era footprint (every slot
+        could hold max_seq) — serving sizes it from HBM headroom instead
+        (``paged_kv.resolve_pool_pages``)."""
         cfg = self.cfg
+        if pages is None:
+            pages = slots * (-(-self.max_seq // page_size)) + 1
         return dict(
-            caches=init_kv_cache(cfg, slots, self.max_seq, self.cache_dtype),
+            caches=init_paged_kv_cache(cfg, pages, page_size, self.cache_dtype),
             cur_tok=jnp.zeros((slots,), jnp.int32),
             cur_len=jnp.zeros((slots,), jnp.int32),
             seen=jnp.zeros((slots, cfg.decoder.vocab_size), bool),
@@ -306,17 +334,28 @@ class Generator:
         )
 
     def _admit_impl(
-        self, pool, slot, caches1, tok0, seen1, length,
+        self, pool, slot, caches1, tok0, seen1, length, bt_row,
         max_new, temperature, top_p, do_sample, rep,
     ):
-        """Write one prefetched request (batch-1 prefill results) into slot."""
+        """Write one prefilled request into ``slot``: scatter its prompt
+        KV (contiguous [1, kvh, Lb, dh] prefill scratch, ``Lb`` a page
+        multiple) into the pages ``bt_row`` grants, page by page. Entries
+        past the prompt's live pages point at the dump page 0, so the
+        scatter needs no masking."""
         z = jnp.zeros((), jnp.int32)
         s = jnp.asarray(slot, jnp.int32)
-        caches = jax.tree.map(
-            lambda p, o: jax.lax.dynamic_update_slice(p, o.astype(p.dtype), (s, z, z, z)),
-            pool["caches"],
-            caches1,
-        )
+        page = pool["caches"][0]["k"].shape[2]
+        lb = caches1[0]["k"].shape[2]
+        nseg = lb // page
+        kvh = pool["caches"][0]["k"].shape[1]
+        dh = pool["caches"][0]["k"].shape[3]
+        dst = bt_row[:nseg]
+
+        def scatter(pages_arr, pre):
+            seg = pre[0].reshape(kvh, nseg, page, dh).transpose(1, 0, 2, 3)
+            return pages_arr.at[dst].set(seg.astype(pages_arr.dtype))
+
+        caches = jax.tree.map(scatter, pool["caches"], caches1)
         return dict(
             caches=caches,
             cur_tok=pool["cur_tok"].at[s].set(tok0[0]),
@@ -332,12 +371,16 @@ class Generator:
             rep=pool["rep"].at[s].set(jnp.asarray(rep, jnp.float32)),
         )
 
-    def _step_block_impl(self, params, pool, rng, *, block: int):
+    def _step_block_impl(self, params, pool, block_tables, rng, *, block: int):
         """Advance every live slot ``block`` tokens; emission semantics are
         identical to ``_generate_impl``'s while-loop body (per-slot budgets,
-        EOS, repetition penalty), with free/finished slots masked out."""
+        EOS, repetition penalty), with free/finished slots masked out. Each
+        step's K/V write and attention go through ``block_tables`` — the
+        host scheduler guarantees every live row's pages cover
+        ``cur_len + block`` before dispatching."""
         cfg = self.cfg
         b = pool["cur_tok"].shape[0]
+        capacity = block_tables.shape[1] * pool["caches"][0]["k"].shape[2]
 
         def body(carry, _):
             pool, rng = carry
@@ -349,10 +392,10 @@ class Generator:
             done = pool["done"] | eos | (n_gen >= pool["max_new"])
             tok_embed = self._embed(params, pool["cur_tok"][:, None]).astype(self.cache_dtype)
             # Free slots hold cur_len=0 and done rows stop advancing, so the
-            # clamp only guards a full slot writing past its buffer.
-            pos = jnp.minimum(pool["cur_len"], self.max_seq - 1)
-            logits, caches = self._decode(
-                params, tok_embed, pos[:, None], pool["caches"], pos, pos + 1
+            # clamp only guards a full slot writing past its block table.
+            pos = jnp.minimum(pool["cur_len"], capacity - 1)
+            logits, caches = self._decode_paged(
+                params, tok_embed, pos[:, None], pool["caches"], block_tables, pos, pos + 1
             )
             rng, sub = jax.random.split(rng)
             nxt = self._sample_next(
@@ -373,6 +416,41 @@ class Generator:
 
         (pool, rng), toks = jax.lax.scan(body, (pool, rng), None, length=block)
         return pool, rng, toks.T  # [B, block]
+
+    # -- chunked prefill lane ------------------------------------------------
+    #
+    # A long prompt prefilled in one shot would hold the scheduler loop
+    # (and every in-flight decode row) hostage for the whole forward; the
+    # chunk programs let the engine interleave one prompt chunk per decode
+    # block instead. Chunks write into a CONTIGUOUS per-request scratch
+    # cache (offset semantics identical to one-shot prefill — causal
+    # attention over earlier chunks already in the scratch), and the
+    # finished scratch admits into pages exactly like a one-shot prefill.
+
+    def new_prefill_cache(self, kv_len: int):
+        """Contiguous batch-1 scratch cache for one chunked prefill."""
+        return init_kv_cache(self.cfg, 1, kv_len, self.cache_dtype)
+
+    def _prefill_chunk_impl(self, params, caches, embeds, positions, offset, valid_len):
+        """One prompt chunk through the decoder: writes K/V at ``offset``
+        into the donated scratch, returns this chunk's logits."""
+        return self._decode(params, embeds, positions, caches, offset, valid_len)
+
+    def _chunk_finish_impl(
+        self, chunk_logits, idx, prompt_ids, lengths, rng,
+        temperature, top_p, do_sample, repetition_penalty,
+    ):
+        """Sample token 0 from the final live chunk's logits at in-chunk
+        index ``idx`` [B] — the tail of ``_prefill_impl`` split out for
+        the chunk lane (``idx`` is traced so tail positions don't compile
+        one program each)."""
+        b = chunk_logits.shape[0]
+        last = chunk_logits[jnp.arange(b), idx]  # [B, V]
+        seen = self._seen_from_prompt(prompt_ids, lengths)
+        tok0 = self._sample_next(
+            rng, last, seen, temperature, top_p, do_sample, repetition_penalty
+        ).astype(jnp.int32)
+        return tok0, seen
 
     def stream(
         self,

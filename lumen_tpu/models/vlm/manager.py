@@ -215,7 +215,7 @@ class VLMManager:
         warmup: bool = False,
         gen_batch_size: int = 4,
         gen_batch_latency_ms: float = 6.0,
-        scheduler: str = "coalesce",  # or "continuous"
+        scheduler: str = "continuous",  # or "coalesce"
         gen_slots: int = 8,
         gen_block: int = 8,
         quantize: str | None = None,  # None | "int8" (weight-only decoder quant)
@@ -231,24 +231,40 @@ class VLMManager:
         self.quant_route = "int8" if quantize else "bf16"
         self.quant_speedup: float | None = None  # measured q8/bf16 decode ratio
         self.model_dir = model_dir
-        from ...runtime.mesh import build_mesh
+        from ...utils.env import env_choice
+
+        # LUMEN_VLM_SCHEDULER overrides the constructor/config choice at
+        # boot (one-shot warning on malformed values) — flipping engines
+        # must not require a config rollout.
+        env_sched = env_choice("LUMEN_VLM_SCHEDULER", None, ("coalesce", "continuous"))
+        if env_sched is not None and env_sched != scheduler:
+            logger.info(
+                "VLM scheduler %r overridden to %r by LUMEN_VLM_SCHEDULER",
+                scheduler, env_sched,
+            )
+            scheduler = env_sched
+        from ...runtime.fleet import plan_replicas, replicas_for
 
         # Serving mesh: a ``model`` axis tensor-parallelizes the decoder, an
         # ``expert`` axis shards MoE expert banks (SURVEY §2.8); without
         # either the mesh is the trivial data mesh and weights replicate.
-        self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
-        from ...runtime.fleet import replicas_for
+        # The continuous engine is built PER REPLICA through the fleet
+        # plan (one engine + page pool per device slice, PR 7 semantics);
+        # the coalescing batcher stays a singleton over the full mesh.
+        if scheduler == "continuous":
+            self.fleet_plan = plan_replicas("vlm", mesh_axes)
+            self.mesh = self.fleet_plan.meshes[0]
+        else:
+            from ...runtime.mesh import build_mesh
 
-        # The generator is a stateful continuous/coalescing scheduler, not
-        # a stateless MicroBatcher — the replica fleet (runtime/fleet.py)
-        # does not slice it yet. Honor the knob honestly: say so once
-        # instead of silently serving one replica an operator thinks is N.
-        if replicas_for("vlm") != 1:  # includes the "max" sentinel (-1)
-            logger.warning(
-                "LUMEN_REPLICAS(_VLM) > 1 requested but the VLM generate "
-                "scheduler is not replica-fleeted yet; serving 1 replica "
-                "over the full mesh (continuous batching owns the devices)"
-            )
+            self.fleet_plan = None
+            self.mesh = build_mesh(mesh_axes) if mesh_axes else build_mesh()
+            if replicas_for("vlm") != 1:  # includes the "max" sentinel (-1)
+                logger.warning(
+                    "LUMEN_REPLICAS(_VLM) > 1 requested but the coalescing "
+                    "VLM scheduler is not replica-fleeted; serving 1 replica "
+                    "over the full mesh (use scheduler=continuous to fleet)"
+                )
         from ...ops.quant_matmul import note_mesh_model_axis
 
         # TP x int8: pl.pallas_call has no GSPMD sharding rule, so a
@@ -342,7 +358,7 @@ class VLMManager:
 
     # -- initialization ----------------------------------------------------
 
-    def _place_params(self, params, quantized: bool | None = None):
+    def _place_params(self, params, quantized: bool | None = None, mesh=None):
         """Place loaded weights on the serving mesh: TP rules when the mesh
         carries a ``model`` axis, EP rules first when it carries ``expert``
         (first-match-wins keeps expert banks on the expert axis), replicated
@@ -351,7 +367,8 @@ class VLMManager:
         axis as their q matrices) — TP x int8 is the advertised deployment
         shape for a quantized 2B on a multi-chip host. ``quantized``
         overrides the config-derived default (the warmup route A/B places
-        one tree of EACH kind)."""
+        one tree of EACH kind); ``mesh`` overrides the primary mesh (the
+        replica fleet places one tree per slice)."""
         from ...parallel.sharding import (
             INT8_TP_RULES,
             MOE_EP_RULES,
@@ -361,7 +378,8 @@ class VLMManager:
 
         if quantized is None:
             quantized = bool(self.quantize)
-        shape = dict(self.mesh.shape)
+        mesh = mesh if mesh is not None else self.mesh
+        shape = dict(mesh.shape)
         rules = []
         if shape.get("expert", 1) > 1:
             rules += MOE_EP_RULES
@@ -376,7 +394,7 @@ class VLMManager:
         # shard_params with no rules degrades every leaf to replication,
         # and NamedSharding placement on a 1-device mesh is device_put —
         # one call covers all cases.
-        return shard_params(params, self.mesh, rules)
+        return shard_params(params, mesh, rules)
 
     # -- quantization route -------------------------------------------------
 
@@ -428,11 +446,32 @@ class VLMManager:
             )
             chosen, params = "bf16", cast
         else:
-            qtree = quantize_decoder_int8(cast)
-            if route == "int8" or not self.warmup:
-                chosen, params = "int8", qtree
+            # Disk-tier verdict cache (next to the weights, keyed by
+            # model@revision): the warmup A/B measured q8 decode at 0.03x
+            # bf16 on v5e (BENCH_r05) — re-running the losing probe every
+            # boot costs two timed decode passes for a known answer. An
+            # explicit pin (route != auto) still bypasses the cache, and
+            # a cache miss (new revision) re-measures and re-persists.
+            cached = self._load_q8_verdict() if route == "auto" and self.warmup else None
+            if cached is not None:
+                chosen = cached["route"]
+                self.quant_speedup = cached.get("q8_speedup")
+                logger.info(
+                    "VLM q8 decode verdict for %s loaded from disk: %s "
+                    "(%.3fx bf16, measured %s); skipping warmup probe — "
+                    "delete %s or pin LUMEN_VLM_Q8_ROUTE to re-measure",
+                    self._q8_verdict_key(), chosen,
+                    self.quant_speedup if self.quant_speedup is not None else float("nan"),
+                    cached.get("measured_at", "?"), self._q8_verdict_path(),
+                )
+                params = quantize_decoder_int8(cast) if chosen == "int8" else cast
+            elif route == "int8" or not self.warmup:
+                chosen, params = "int8", quantize_decoder_int8(cast)
             else:
-                chosen, params = self._q8_decode_ab(base_cfg, cast, qtree)
+                chosen, params = self._q8_decode_ab(
+                    base_cfg, cast, quantize_decoder_int8(cast)
+                )
+                self._save_q8_verdict(chosen)
         if chosen == "bf16":
             self.cfg = base_cfg
             self.model = VLMModel(self.cfg)
@@ -453,6 +492,44 @@ class VLMManager:
         if vision_sub is not None:
             params["vision"] = vision_sub
         return params
+
+    def _q8_verdict_key(self) -> str:
+        return f"{self.info.name}@{self.info.version}"
+
+    def _q8_verdict_path(self) -> str:
+        return os.path.join(self.model_dir, ".lumen_q8_verdict.json")
+
+    def _load_q8_verdict(self) -> dict | None:
+        """Cached warmup A/B verdict for THIS model@revision, or None on
+        miss/mismatch/corruption (all of which fall through to a fresh
+        probe — a stale or mangled file must never pin a route)."""
+        try:
+            with open(self._q8_verdict_path(), "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if (
+            not isinstance(data, dict)
+            or data.get("model") != self._q8_verdict_key()
+            or data.get("route") not in ("int8", "bf16")
+        ):
+            return None
+        return data
+
+    def _save_q8_verdict(self, route: str) -> None:
+        """Best-effort persist (read-only model dirs lose the cache, not
+        the boot)."""
+        data = {
+            "model": self._q8_verdict_key(),
+            "route": route,
+            "q8_speedup": self.quant_speedup,
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        try:
+            with open(self._q8_verdict_path(), "w", encoding="utf-8") as f:
+                json.dump(data, f)
+        except OSError as e:
+            logger.debug("could not persist q8 verdict to %s: %s", self._q8_verdict_path(), e)
 
     def _q8_decode_ab(self, base_cfg, cast: dict, qtree: dict):
         """One-shot warmup decode A/B; returns ``(route, tree)``. Timed
@@ -664,13 +741,48 @@ class VLMManager:
         self._prepare_text = prepare_text
         self._batcher = None
         self._continuous = None
+        self._engines = []
         if self.scheduler == "continuous":
+            from ...runtime.fleet import batcher_name
+            from ...utils.env import env_int
             from .continuous import ContinuousScheduler
+            from .paged_kv import DEFAULT_PAGE_SIZE, resolve_pool_pages
 
-            self._continuous = ContinuousScheduler(
-                self.generator, self.params, slots=self.gen_slots,
-                block=self.gen_block, name=self.info.name,
+            self._page_size = env_int(
+                "LUMEN_VLM_PAGE_SIZE", DEFAULT_PAGE_SIZE, minimum=8, maximum=256
             )
+            self._pool_pages = resolve_pool_pages(
+                self.cfg, self._page_size, self.gen_slots, self.max_seq,
+                dtype_bytes=jnp.dtype(compute).itemsize,
+            )
+            plan = self.fleet_plan
+
+            def build_engine(rid: int | None, mesh, placed) -> ContinuousScheduler:
+                """Manager factory for one per-replica decode engine: its
+                own page pool + block tables on the replica's mesh slice,
+                per-replica gauge names (``vlm-continuous:<model>-rN``)."""
+                return ContinuousScheduler(
+                    self.generator, placed, slots=self.gen_slots,
+                    block=self.gen_block,
+                    name=batcher_name(self.info.name, rid),
+                    page_size=self._page_size, pages=self._pool_pages,
+                    mesh=mesh if plan.replicas > 1 else None,
+                )
+
+            self._engine_factory = build_engine
+            self._engines = [
+                build_engine(None if plan.replicas == 1 else 0, plan.meshes[0], self.params)
+            ]
+            for rid in range(1, plan.replicas):
+                placed = self._place_params(params, mesh=plan.meshes[rid])
+                self._engines.append(build_engine(rid, plan.meshes[rid], placed))
+            self._continuous = self._engines[0]
+            if plan.replicas > 1:
+                logger.info(
+                    "VLM continuous engine fleet: %d replicas x %d slots "
+                    "(%d devices each)",
+                    plan.replicas, self.gen_slots, plan.devices_per_replica,
+                )
         else:
             self._batcher = _GenBatcher(
                 self._run_gen_batch,
@@ -698,19 +810,43 @@ class VLMManager:
         if self._initialized:
             if self._batcher is not None:
                 self._batcher.close()
-            if self._continuous is not None:
-                self._continuous.close()
+            for engine in getattr(self, "_engines", []) or (
+                [self._continuous] if self._continuous is not None else []
+            ):
+                engine.close()
         if fn := getattr(self, "_route_gauge_fn", None):
             metrics.unregister_gauges(f"vlm-quant:{self.model_id}", fn)
         self._initialized = False
 
+    def _pick_engine(self):
+        """Least-loaded dispatch across the per-replica continuous
+        engines (queue depth + live rows + prefill lane)."""
+        if len(self._engines) == 1:
+            return self._engines[0]
+        return min(self._engines, key=lambda e: e.load())
+
+    def kv_layout(self) -> str:
+        """KV cache layout on the wire (capability ``extra``): operators
+        and clients can see whether decode is paged without reading logs."""
+        if self._continuous is not None:
+            kv = self._continuous.kv
+            return (
+                f"paged(page={kv.page_size},pages={kv.pages_total},"
+                f"slots={self.gen_slots})"
+            )
+        return f"contiguous(max_seq={self.max_seq})"
+
     def topology(self) -> dict[str, str]:
-        """Device topology for the capability ``extra`` — one replica over
-        the full serving mesh (the continuous generator owns all devices;
-        see the replica-fleet note in ``__init__``)."""
+        """Device topology for the capability ``extra``: the continuous
+        engine fleet reports one replica per device slice (built through
+        the manager factory); coalesce stays one replica over the full
+        mesh."""
         from ...runtime.fleet import topology_extra
 
-        return topology_extra(self.mesh)
+        out = topology_extra(self.mesh)
+        if len(getattr(self, "_engines", [])) > 1:
+            out["replicas"] = str(len(self._engines))
+        return out
 
     # -- prompt prep -------------------------------------------------------
 
@@ -953,7 +1089,7 @@ class VLMManager:
             max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
         )
         if self._continuous is not None:
-            future = self._continuous.submit(req)
+            future = self._pick_engine().submit(req)
         else:
             future = self._batcher.submit(req)
         row_tokens, n_gen, stopped_eos = future.result()
@@ -1034,8 +1170,19 @@ class VLMManager:
         emitted = ""
         finish = "length"
         final_text: str | None = None
+        # Time-to-first-emitted-chunk + per-stream decode rate, observed
+        # at the source (this generator feeds both the gRPC stream path
+        # and direct callers): cumulative histograms for /metrics,
+        # rolling-window twins via the telemetry tee inside observe().
+        first_emit_s: float | None = None
+
+        def _note_first_emit() -> None:
+            nonlocal first_emit_s
+            if first_emit_s is None:
+                first_emit_s = time.perf_counter()
+                metrics.observe("vlm.ttft", (first_emit_s - t0) * 1e3)
         if self._continuous is not None:
-            token_iter = self._continuous.submit_stream(
+            token_iter = self._pick_engine().submit_stream(
                 self._make_gen_request(
                     embeds, positions, lengths, prompt_ids,
                     max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
@@ -1077,6 +1224,7 @@ class VLMManager:
             delta = text[len(emitted) : max(len(text) - holdback, len(emitted))]
             if delta:
                 emitted += delta
+                _note_first_emit()
                 yield GenerationChunk(text=delta, tokens=[tok])
         if final_text is None:
             final_text = self.tokenizer.decode(tokens)
@@ -1084,19 +1232,24 @@ class VLMManager:
         if final_text.startswith(emitted) and len(final_text) > len(emitted):
             tail = final_text[len(emitted) :]
             emitted = final_text
+            _note_first_emit()
             yield GenerationChunk(text=tail, tokens=[])
         dt_ms = (time.perf_counter() - t0) * 1e3
-        yield GenerationChunk(
-            text="",
-            tokens=[],
-            is_final=True,
-            metadata={
-                "finish_reason": finish,
-                "generated_tokens": len(tokens),
-                "input_tokens": n_input,
-                "generation_time_ms": round(dt_ms, 2),
-            },
-        )
+        meta = {
+            "finish_reason": finish,
+            "generated_tokens": len(tokens),
+            "input_tokens": n_input,
+            "generation_time_ms": round(dt_ms, 2),
+        }
+        if tokens:
+            tps = len(tokens) / max(dt_ms / 1e3, 1e-9)
+            # Histogram buckets are ms-labeled but dimensionless; this
+            # series carries tokens/s (documented in OBSERVABILITY.md).
+            metrics.observe("vlm.decode_tps", tps)
+            meta["tokens_per_second"] = round(tps, 2)
+        if first_emit_s is not None:
+            meta["ttft_ms"] = round((first_emit_s - t0) * 1e3, 2)
+        yield GenerationChunk(text="", tokens=[], is_final=True, metadata=meta)
 
     # -- utils -------------------------------------------------------------
 

@@ -30,7 +30,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ...ops.attention import attention, attention_cached, repeat_kv
+from ...ops.attention import attention, attention_cached, paged_attention, repeat_kv
 from ...ops.quant import QDense
 
 
@@ -199,6 +199,21 @@ def init_kv_cache(cfg: VLMConfig, batch: int, max_seq: int, dtype=jnp.bfloat16) 
     ]
 
 
+def init_paged_kv_cache(
+    cfg: VLMConfig, pages: int, page_size: int, dtype=jnp.bfloat16
+) -> list[dict]:
+    """Per-layer PAGED cache: a pool of ``pages`` fixed-size pages shared
+    by every decode row, addressed through per-row block tables
+    (``models/vlm/paged_kv.py``) instead of one contiguous ``max_seq``
+    region per slot. Page 0 is the reserved dump page."""
+    d = cfg.decoder
+    shape = (pages, d.kv_heads, page_size, d.dim_per_head)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(d.layers)
+    ]
+
+
 # -- modules ----------------------------------------------------------------
 
 
@@ -245,11 +260,18 @@ class DecoderAttention(nn.Module):
         cache: dict | None,
         cache_offset: jax.Array | None,
         kv_valid_len: jax.Array,
+        block_tables: jax.Array | None = None,
     ) -> tuple[jax.Array, dict | None]:
         """``x``: [B, S, hidden]. With a cache, new K/V are written at
         ``cache_offset`` (scalar slot index; prefill uses 0, decode uses the
         current length) and attention runs against the full cache buffer
-        masked to ``kv_valid_len`` [B] live slots."""
+        masked to ``kv_valid_len`` [B] live slots.
+
+        With ``block_tables`` [B, max_pages], the cache is PAGED
+        (``{"k"/"v": [num_pages, kv_heads, page, dh]}``): the single new
+        token's K/V lands in the page+slot its row's table maps
+        ``cache_offset`` to, and attention runs the ragged paged kernel
+        (exact XLA gather reference off-TPU) over the row's pages only."""
         c = self.cfg
         b, s, _ = x.shape
         dh = c.dim_per_head
@@ -261,6 +283,28 @@ class DecoderAttention(nn.Module):
         v = v.reshape(b, s, c.kv_heads, dh).transpose(0, 2, 1, 3)
         q = rope_rotate(q, positions, c.rope_theta)
         k = rope_rotate(k, positions, c.rope_theta)
+
+        if block_tables is not None:
+            assert s == 1, "paged decode handles a single token per row"
+            page = cache["k"].shape[2]
+            off = jnp.asarray(cache_offset, jnp.int32)  # [B] write position
+            bidx = jnp.arange(b)
+            page_idx = block_tables[bidx, off // page]  # [B] page ids
+            slot = off % page
+            # Rows own their pages exclusively, so the scatter indices are
+            # unique across live rows; free/done rows dump into page 0.
+            new_k = cache["k"].at[page_idx, :, slot].set(k[:, :, 0].astype(cache["k"].dtype))
+            new_v = cache["v"].at[page_idx, :, slot].set(v[:, :, 0].astype(cache["v"].dtype))
+            cache = {"k": new_k, "v": new_v}
+            out = paged_attention(
+                q[:, :, 0],
+                new_k.astype(x.dtype),
+                new_v.astype(x.dtype),
+                block_tables,
+                kv_valid_len,
+            )[:, :, None, :]
+            out = out.transpose(0, 2, 1, 3).reshape(b, s, c.heads * dh)
+            return _dense(c, c.hidden_size, "o_proj", False, x.dtype)(out), cache
 
         if cache is not None:
             off = jnp.asarray(cache_offset, jnp.int32)
@@ -374,13 +418,14 @@ class DecoderLayer(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, positions, cache, cache_offset, kv_valid_len):
+    def __call__(self, x, positions, cache, cache_offset, kv_valid_len, block_tables=None):
         h, cache = DecoderAttention(self.cfg, name="attn")(
             RMSNorm(self.cfg.rms_norm_eps, name="input_norm")(x),
             positions,
             cache,
             cache_offset,
             kv_valid_len,
+            block_tables,
         )
         x = x + h
         mlp_cls = MoEFFN if self.cfg.is_moe_layer(self.layer_idx) else SwiGLU
@@ -419,12 +464,15 @@ class Decoder(nn.Module):
         caches: list[dict] | None,
         cache_offset: jax.Array | None,
         kv_valid_len: jax.Array,
+        block_tables: jax.Array | None = None,
     ) -> tuple[jax.Array, list[dict] | None]:
         x = embeds
         new_caches: list[dict] = []
         for i, block in enumerate(self.blocks):
             layer_cache = caches[i] if caches is not None else None
-            x, layer_cache = block(x, positions, layer_cache, cache_offset, kv_valid_len)
+            x, layer_cache = block(
+                x, positions, layer_cache, cache_offset, kv_valid_len, block_tables
+            )
             new_caches.append(layer_cache)
         x = self.final_norm(x)
         if self.cfg.tie_word_embeddings:
@@ -476,6 +524,14 @@ class VLMModel(nn.Module):
 
     def decode(self, embeds, positions, caches, cache_offset, kv_valid_len):
         return self.decoder(embeds, positions, caches, cache_offset, kv_valid_len)
+
+    def decode_paged(self, embeds, positions, caches, block_tables, cache_offset, kv_valid_len):
+        """Single-token decode against the paged KV pool (continuous
+        engine): ``caches`` from :func:`init_paged_kv_cache`,
+        ``block_tables`` [B, max_pages] per-row page maps."""
+        return self.decoder(
+            embeds, positions, caches, cache_offset, kv_valid_len, block_tables
+        )
 
     def __call__(self, input_ids: jax.Array, pixel_values: jax.Array | None = None):
         """Cacheless forward (tests / loss): embeds ids, optionally splices

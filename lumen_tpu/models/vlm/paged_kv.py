@@ -1,0 +1,224 @@
+"""Paged KV pool for continuous VLM decode: host-side page accounting.
+
+The slot-era continuous scheduler gave every decode row a contiguous
+``max_seq`` KV region, so an 8-slot pool paid ``8 x max_seq`` of HBM no
+matter how short the generations were, and admission needed a same-shape
+bucket. Here KV lives in fixed-size PAGES drawn from one shared pool
+(device arrays: ``[num_pages, kv_heads, page_size, head_dim]`` per layer,
+see ``generate.Generator.init_pool``); each sequence owns a BLOCK TABLE of
+page ids that grows a page at a time as decode crosses page boundaries and
+is returned to the free list at retire. Long and short generations share
+the pool, and a request admits the moment a slot and its prompt's pages
+are free — the Ragged Paged Attention recipe (PAPERS.md, arxiv 2604.15464)
+with the O(1)-per-step cache discipline of arxiv 2603.09555 kept portable:
+the same block tables drive the Pallas kernel on TPU and the exact XLA
+gather reference on CPU (``ops.attention.paged_attention``).
+
+This module is the HOST half: the free list, per-slot block tables, and
+the allocated/freed/live accounting the bench asserts balances at drain.
+Device-side page contents are owned by the scheduler's pool dict and only
+ever addressed through these tables.
+
+Page 0 is reserved as the DUMP page: unused block-table entries point at
+it so device-side scatters always have a safe target (free rows and the
+padded tail of a prompt scatter write garbage there; nothing ever reads
+it back — attention masks by per-row length).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: default tokens per KV page. 16 keeps the page's [page, head_dim] tile
+#: bf16-sublane aligned on TPU and the per-page waste (< page tokens per
+#: row) small against prompt lengths in the hundreds.
+DEFAULT_PAGE_SIZE = 16
+
+#: fraction of free HBM the pool may claim when sized from device stats.
+DEFAULT_HEADROOM_FRACTION = 0.6
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by :meth:`PagedKVPool.grow` callers that cannot free pages
+    (the scheduler catches this and preempts a row instead)."""
+
+
+@dataclass
+class PageStats:
+    pages_total: int
+    page_size: int
+    pages_free: int
+    pages_live: int
+    allocated_total: int  # cumulative grants since boot
+    freed_total: int  # cumulative returns since boot
+
+
+class PagedKVPool:
+    """Free-list page allocator with per-slot block tables.
+
+    NOT thread-safe: the continuous scheduler owns it from its single
+    loop thread. ``block_tables`` is the numpy source of truth shipped to
+    the device programs each dispatch (a [slots, max_pages] int32 is a
+    few hundred bytes — re-uploading per block is noise next to a decode
+    step).
+    """
+
+    def __init__(self, pages_total: int, page_size: int, slots: int, max_pages: int):
+        if pages_total < 2:
+            raise ValueError(f"pages_total must be >= 2 (page 0 is the dump page), got {pages_total}")
+        self.pages_total = pages_total
+        self.page_size = page_size
+        self.max_pages = max_pages
+        # LIFO free list: hot pages are reused first (their HBM lines are
+        # the most recently touched). Page 0 is never in the list.
+        self._free = list(range(pages_total - 1, 0, -1))
+        self._owned: dict[int, list[int]] = {}  # slot -> owned page ids
+        self.block_tables = np.zeros((slots, max_pages), np.int32)
+        self.allocated_total = 0
+        self.freed_total = 0
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_live(self) -> int:
+        return self.allocated_total - self.freed_total
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` KV slots."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    def row_capacity(self) -> int:
+        """Max tokens one row's block table can address."""
+        return self.max_pages * self.page_size
+
+    def fits(self, tokens: int) -> bool:
+        """Feasibility: could ``tokens`` EVER fit (full pool, one row)?
+        Admission must reject what can never run; mid-flight shortage is
+        handled by preemption instead."""
+        return tokens <= self.row_capacity() and self.pages_for(tokens) <= self.pages_total - 1
+
+    def can_admit(self, tokens: int) -> bool:
+        """Are enough pages free RIGHT NOW for a prompt of ``tokens``
+        (plus the first decode write)?"""
+        return self.pages_for(tokens + 1) <= len(self._free)
+
+    # -- transitions -------------------------------------------------------
+
+    def admit(self, slot: int, prompt_tokens: int) -> np.ndarray:
+        """Grant pages covering ``prompt_tokens`` + the first decode write
+        and install the slot's block table row. Returns the row (view)."""
+        if slot in self._owned:
+            raise RuntimeError(f"slot {slot} already owns pages (allocator bug)")
+        need = self.pages_for(prompt_tokens + 1)
+        if need > len(self._free):
+            raise PoolExhausted(f"need {need} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(need)]
+        row = np.zeros((self.max_pages,), np.int32)
+        row[: len(pages)] = pages
+        self.block_tables[slot] = row
+        self._owned[slot] = pages
+        self.allocated_total += len(pages)
+        return self.block_tables[slot]
+
+    def grow(self, slot: int, tokens: int) -> bool:
+        """Ensure the slot's pages cover ``tokens`` KV slots; allocate as
+        needed. False when the free list runs dry mid-growth (partial
+        grants stand — accounting stays balanced; the caller preempts a
+        row and retries). ``tokens`` beyond the block table's reach clamp
+        to ``row_capacity()`` — the decode program clamps its writes the
+        same way, so a full row keeps overwriting its last slot instead
+        of the allocator indexing past the table."""
+        pages = self._owned[slot]
+        need = min(self.pages_for(tokens), self.max_pages)
+        while len(pages) < need:
+            if not self._free:
+                return False
+            page = self._free.pop()
+            self.block_tables[slot, len(pages)] = page
+            pages.append(page)
+            self.allocated_total += 1
+        return True
+
+    def release(self, slot: int) -> int:
+        """Return a retired slot's pages to the free list; the block-table
+        row resets to the dump page. Returns the page count released."""
+        pages = self._owned.pop(slot, [])
+        self.block_tables[slot] = 0
+        self._free.extend(reversed(pages))
+        self.freed_total += len(pages)
+        return len(pages)
+
+    def stats(self) -> PageStats:
+        return PageStats(
+            pages_total=self.pages_total,
+            page_size=self.page_size,
+            pages_free=len(self._free),
+            pages_live=self.pages_live,
+            allocated_total=self.allocated_total,
+            freed_total=self.freed_total,
+        )
+
+
+def page_bytes(cfg, page_size: int, dtype_bytes: int) -> int:
+    """HBM cost of ONE page id across every decoder layer (each page id
+    indexes a [page_size, head_dim] K and V tile in all layers)."""
+    d = cfg.decoder
+    return 2 * d.layers * d.kv_heads * page_size * d.dim_per_head * dtype_bytes
+
+
+def resolve_pool_pages(
+    cfg,
+    page_size: int,
+    slots: int,
+    max_seq: int,
+    dtype_bytes: int = 2,
+) -> int:
+    """Pool size in pages: ``LUMEN_VLM_KV_PAGES`` pins it; otherwise size
+    against live HBM headroom from ``metrics.device_memory()`` (the PR 9
+    telemetry surface), claiming ``LUMEN_VLM_KV_HEADROOM`` of the free
+    bytes on the tightest device. Backends without memory stats (CPU
+    tier-1) fall back to the slot-era footprint — ``slots`` full-length
+    rows — so tests and laptops behave exactly as the contiguous pool did
+    memory-wise while still getting page sharing."""
+    from ...utils.env import env_float, env_int
+    from ...utils.metrics import metrics
+
+    maxp = -(-max_seq // page_size)
+    # Floor: every slot can hold at least one modest row (1/4 max_seq)
+    # concurrently; below that the pool thrashes on preemption.
+    floor = slots * max(1, maxp // 4) + 1
+    fallback = slots * maxp + 1
+    explicit = env_int("LUMEN_VLM_KV_PAGES", None, minimum=2)
+    if explicit is not None:
+        return max(explicit, 2)
+    frac = env_float(
+        "LUMEN_VLM_KV_HEADROOM", DEFAULT_HEADROOM_FRACTION, minimum=0.05, maximum=0.95
+    )
+    per_page = page_bytes(cfg, page_size, dtype_bytes)
+    headroom = None
+    for stats in metrics.device_memory().values():
+        limit, in_use = stats.get("bytes_limit"), stats.get("bytes_in_use")
+        if limit:
+            free = max(0, int(limit) - int(in_use or 0))
+            headroom = free if headroom is None else min(headroom, free)
+    if headroom is None:
+        return fallback
+    pages = int(headroom * frac) // max(per_page, 1)
+    # Cap at what block tables can even address (slots x max_pages) — a
+    # bigger pool than addressable is pure waste.
+    cap = slots * maxp + 1
+    sized = max(floor, min(pages, cap))
+    logger.info(
+        "VLM paged-KV pool: %d pages x %d tokens (%.1f MB of %.1f MB headroom, cap %d)",
+        sized, page_size, sized * per_page / 1e6, headroom / 1e6, cap,
+    )
+    return sized

@@ -586,3 +586,238 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
         return x
     b, h, s, d = x.shape
     return jnp.broadcast_to(x[:, :, None], (b, h, n_rep, s, d)).reshape(b, h * n_rep, s, d)
+
+
+# -- ragged paged-attention decode (paged KV pool path) ---------------------
+#
+# The continuous VLM engine keeps KV in a pool of fixed-size pages
+# ([num_pages, kv_heads, page_size, head_dim] per layer) with a per-row
+# block table instead of one contiguous max_seq region per slot, so a
+# decode step streams only the pages a row actually owns. The kernel grid
+# is (batch*kv_heads, max_pages): the page axis runs sequentially and each
+# step DMAs ONE page picked by the scalar-prefetched block table — the
+# "ragged" part: row lengths differ, and dead pages (j beyond the row's
+# live count) skip their matmul entirely. Per-page partial logits land in
+# a VMEM scratch row and V pages in a VMEM V-scratch; the LAST page step
+# runs one plain softmax over the assembled row. That finalize order (one
+# max, one exp, one sum, one divide — not online rescaling) is what makes
+# the kernel EXACTLY equal to the gathered XLA reference below, which the
+# interpret-mode tier-1 test asserts bitwise.
+
+
+def _q_group_pad(g: int) -> int:
+    """Query-head group size padded to the f32 sublane (8) so the
+    [Gp, ...] VMEM tiles are well-formed on real TPUs. The REFERENCE pads
+    too: at g=1, XLA's matvec special-case produces ulp-different logits
+    than the kernel's gemm, and the bitwise-equality contract between the
+    two paths is worth more than 7 wasted rows of a tiny decode dot."""
+    return max(8, -(-g // 8) * 8)
+
+
+def _paged_decode_kernel(
+    bt_ref,  # [B, MAXP] int32 block table (SMEM, prefetched)
+    kv_len_ref,  # [B] int32 live tokens per row (SMEM, prefetched)
+    q_ref,  # [1, 1, Gp, dh] query-head group for this (b, kv_head)
+    k_ref,  # [1, 1, page, dh] one K page
+    v_ref,  # [1, 1, page, dh] one V page
+    o_ref,  # [1, 1, Gp, dh]
+    s_ref,  # VMEM [Gp, MAXP*page] f32 raw logits
+    v_acc_ref,  # VMEM [MAXP*page, dh] f32 gathered V row
+    *,
+    kv_heads: int,
+    sm_scale: float,
+    page: int,
+    num_pages: int,
+):
+    i = pl.program_id(0)  # fused batch*kv_heads index
+    j = pl.program_id(1)  # page slot within the row's block table
+    b = i // kv_heads
+    kv_len = kv_len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        s_ref[...] = jnp.full_like(s_ref, NEG_INF)
+        v_acc_ref[...] = jnp.zeros_like(v_acc_ref)
+
+    # A page is live iff its first slot is below the row's live length;
+    # (partially) live pages mask stale tail slots at finalize.
+    @pl.when(j * page < kv_len)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [Gp, dh]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, dh]
+        # dot_general with the same (gd, sd -> gs) contraction the
+        # reference einsum uses — a q @ k.T spelling lowers to a different
+        # gemm microkernel on CPU and breaks the bitwise-equality test.
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s_ref[:, pl.dslice(j * page, page)] = s
+        v_acc_ref[pl.dslice(j * page, page), :] = v_ref[0, 0].astype(jnp.float32)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        s = s_ref[...]  # [Gp, MAXP*page]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < kv_len, s, NEG_INF)
+        # One-pass softmax in the reference's op order (max/exp/sum/div
+        # then weights @ V) — NEG_INF is finite, so an all-dead row (free
+        # slot) degrades to a uniform average of scratch garbage instead
+        # of NaN; the scheduler never reads those rows.
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        w = p / l
+        o_ref[0, 0] = jnp.dot(
+            w, v_acc_ref[...], preferred_element_type=jnp.float32
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_kernel(
+    q: jax.Array,  # [B, H, dh] one decode token per row
+    k_pages: jax.Array,  # [P, kv_heads, page, dh]
+    v_pages: jax.Array,  # [P, kv_heads, page, dh]
+    block_tables: jax.Array,  # [B, MAXP] int32 page ids (dead entries: 0)
+    kv_lens: jax.Array,  # [B] int32 live tokens (current token included)
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas ragged paged-attention (decode). See block comment above."""
+    b, h, d = q.shape
+    _, kv_heads, page, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    g = h // kv_heads
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    gp = _q_group_pad(g)
+    qg = q.reshape(b, kv_heads, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        kv_heads=kv_heads,
+        sm_scale=sm_scale,
+        page=page,
+        num_pages=maxp,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * kv_heads, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d), lambda i, j, bt, kl: (i // kv_heads, i % kv_heads, 0, 0)),
+            # The block table picks which page the DMA fetches — the ragged
+            # indirection lives in the index map, not the kernel body.
+            pl.BlockSpec((1, 1, page, d), lambda i, j, bt, kl: (bt[i // kv_heads, j], i % kv_heads, 0, 0)),
+            pl.BlockSpec((1, 1, page, d), lambda i, j, bt, kl: (bt[i // kv_heads, j], i % kv_heads, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, gp, d), lambda i, j, bt, kl: (i // kv_heads, i % kv_heads, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((gp, maxp * page), jnp.float32),
+            pltpu.VMEM((maxp * page, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, gp, d), q.dtype),
+        compiler_params=CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        kv_lens.astype(jnp.int32),
+        qg,
+        k_pages,
+        v_pages,
+    )
+    return out[:, :, :g].reshape(b, h, d)
+
+
+def paged_attention_reference(
+    q: jax.Array,  # [B, H, dh]
+    k_pages: jax.Array,  # [P, kv_heads, page, dh]
+    v_pages: jax.Array,  # [P, kv_heads, page, dh]
+    block_tables: jax.Array,  # [B, MAXP] int32
+    kv_lens: jax.Array,  # [B] int32
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact XLA reference for ragged paged decode attention: gather each
+    row's pages via its block table, mask slots past the row's live
+    length, plain softmax. This is the CPU/tier-1 serving path; the Pallas
+    kernel above must match it bitwise (interpret-mode test), which pins
+    two choices here: the query-head group is padded like the kernel's
+    (see :func:`_q_group_pad`) and the softmax is spelled max/exp/sum/div
+    in the kernel's op order. Contract: ``kv_lens >= 1`` per row (the
+    engine always counts the just-written token; an all-dead row's output
+    is unspecified garbage on both paths)."""
+    b, h, d = q.shape
+    _, kv_heads, page, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    g = h // kv_heads
+    gp = _q_group_pad(g)
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B, MAXP, kv_heads, page, dh] -> [B, kv_heads, MAXP*page, dh]
+    k = k_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, maxp * page, d)
+    v = v_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, maxp * page, d)
+    qg = q.reshape(b, kv_heads, g, d).astype(jnp.float32)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    s = jnp.einsum(
+        "bkgd,bksd->bkgs", qg, k.astype(jnp.float32), preferred_element_type=jnp.float32
+    ) * sm_scale
+    live = jnp.arange(maxp * page)[None, :] < kv_lens[:, None]  # [B, S]
+    s = jnp.where(live[:, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    w = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bkgs,bksd->bkgd", w, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    return out[:, :, :g].reshape(b, h, d).astype(q.dtype)
+
+
+def _paged_kernel_usable(head_dim: int, maxp: int, page: int) -> bool:
+    force = os.environ.get("LUMEN_PAGED_KERNEL")
+    if force == "0":
+        _log_fallback_once("paged kernel disabled by LUMEN_PAGED_KERNEL=0")
+        return False
+    if head_dim > 256:
+        _log_fallback_once(
+            f"paged kernel: head_dim {head_dim} > 256 exceeds the VMEM tile"
+        )
+        return False
+    if maxp * page > 8192:
+        # The finalize softmax keeps the whole assembled row in VMEM:
+        # [Gp, MAXP*page] f32 logits + [MAXP*page, dh] f32 V scratch.
+        _log_fallback_once(
+            f"paged kernel: row capacity {maxp * page} > 8192 exceeds the "
+            "VMEM scratch budget"
+        )
+        return False
+    if force == "1":  # tests force interpret mode on CPU
+        return True
+    if not _on_tpu():
+        _log_fallback_once("paged kernel: backend is not TPU")
+        return False
+    return True
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    scale: float | None = None,
+) -> jax.Array:
+    """Dispatch: Pallas ragged paged-attention on TPU, exact XLA reference
+    elsewhere (CPU tier-1 serves the reference so both paths are covered).
+    ``LUMEN_PAGED_KERNEL=0`` disables the kernel; ``=1`` forces it
+    (interpret mode off TPU, for tests)."""
+    if _paged_kernel_usable(q.shape[-1], block_tables.shape[1], k_pages.shape[2]):
+        return paged_attention_kernel(
+            q, k_pages, v_pages, block_tables, kv_lens,
+            scale=scale, interpret=_interpret_mode(),
+        )
+    return paged_attention_reference(q, k_pages, v_pages, block_tables, kv_lens, scale=scale)

@@ -95,12 +95,13 @@ class VlmService(BaseService):
 
     def capability(self):
         # Suggested client concurrency = the decode width the scheduler
-        # actually coalesces (slot-pool width for continuous, batcher
-        # width otherwise) — advertising 1 made clients serialize requests
-        # the server batches fine (reference field semantics: proto
-        # Capability.max_concurrency, "Suggested max concurrency").
+        # actually coalesces (slot-pool width x engine replicas for
+        # continuous, batcher width otherwise) — advertising 1 made
+        # clients serialize requests the server batches fine (reference
+        # field semantics: proto Capability.max_concurrency, "Suggested
+        # max concurrency").
         width = (
-            self.manager.gen_slots
+            self.manager.gen_slots * max(1, len(self.manager._engines))
             if self.manager.scheduler == "continuous"
             else self.manager.gen_batch_size
         )
@@ -124,6 +125,12 @@ class VlmService(BaseService):
                 # config (the gRPC-layer gate still applies to it).
                 "qos": qos_service_extra("vlm"),
                 "quant_route": self.manager.quant_route,
+                # Decode scheduling on the wire: which scheduler actually
+                # serves (env knob may have overridden the config) and how
+                # KV is laid out — previously constructor-only and
+                # invisible to clients/dashboards.
+                "scheduler": self.manager.scheduler,
+                "kv_layout": self.manager.kv_layout(),
                 **self.manager.topology(),
             },
         )

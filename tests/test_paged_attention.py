@@ -1,0 +1,251 @@
+"""CPU-CI coverage for the ragged paged-attention decode path.
+
+Three layers, mirroring ``test_quant_pallas.py``'s structure:
+
+- the Pallas kernel in interpret mode (``LUMEN_PAGED_KERNEL=1`` off-TPU)
+  must match the XLA gather reference EXACTLY — same bits, not "close":
+  both paths pad the query-head group identically and spell the softmax
+  in the same op order precisely so this assert can hold;
+- the dispatch gates (env kill-switch, head_dim / row-capacity VMEM
+  limits, off-TPU default) must route to the reference;
+- the host page allocator's invariants (exclusive ownership, balanced
+  accounting, dump-page reservation) and the page-table indirection's
+  row isolation must survive random admit/grow/retire orders.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import importlib
+
+# ``lumen_tpu.ops`` re-exports the ``attention`` FUNCTION over the
+# submodule attribute, so a plain ``import ... as`` grabs the wrong one.
+att_mod = importlib.import_module("lumen_tpu.ops.attention")
+
+from lumen_tpu.models.vlm.paged_kv import PagedKVPool, PoolExhausted
+
+
+def _case(b, h, kvh, d, page, maxp, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    n_pages = maxp * b + 1
+    q = jnp.asarray(rng.standard_normal((b, h, d)), dtype)
+    kp = jnp.asarray(rng.standard_normal((n_pages, kvh, page, d)), dtype)
+    vp = jnp.asarray(rng.standard_normal((n_pages, kvh, page, d)), dtype)
+    bt = jnp.asarray(rng.integers(0, n_pages, size=(b, maxp)), np.int32)
+    kl = jnp.asarray(rng.integers(1, maxp * page + 1, size=(b,)), np.int32)
+    return q, kp, vp, bt, kl
+
+
+class TestKernelInterpretExact:
+    @pytest.mark.parametrize(
+        "b,h,kvh,d,page,maxp",
+        [
+            (3, 4, 2, 8, 4, 5),  # tiny-config GQA shape
+            (2, 14, 2, 64, 16, 8),  # Qwen2-0.5B decode shape
+            (4, 4, 4, 16, 8, 3),  # MHA (group of 1: the matvec corner)
+            (1, 8, 2, 32, 8, 16),  # single row, long table
+            (5, 6, 3, 24, 4, 7),  # odd everything
+        ],
+    )
+    def test_matches_reference_exactly(self, monkeypatch, b, h, kvh, d, page, maxp):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "1")
+        q, kp, vp, bt, kl = _case(b, h, kvh, d, page, maxp, seed=b * 7 + maxp)
+        assert att_mod._paged_kernel_usable(d, maxp, page)
+        ref = att_mod.paged_attention_reference(q, kp, vp, bt, kl)
+        ker = att_mod.paged_attention(q, kp, vp, bt, kl)
+        assert ker.shape == (b, h, d) and ker.dtype == q.dtype
+        np.testing.assert_array_equal(np.asarray(ker), np.asarray(ref))
+
+    def test_matches_reference_bf16(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "1")
+        q, kp, vp, bt, kl = _case(2, 4, 2, 16, 8, 4, seed=9, dtype=jnp.bfloat16)
+        ref = att_mod.paged_attention_reference(q, kp, vp, bt, kl)
+        ker = att_mod.paged_attention(q, kp, vp, bt, kl)
+        np.testing.assert_array_equal(
+            np.asarray(ker, np.float32), np.asarray(ref, np.float32)
+        )
+
+    def test_reference_masks_by_row_length(self):
+        """Keys past kv_len must not influence the output: doubling the
+        garbage beyond the live prefix changes nothing."""
+        q, kp, vp, bt, kl = _case(3, 4, 2, 8, 4, 6, seed=3)
+        kl = jnp.asarray([5, 13, 20], np.int32)
+        out1 = att_mod.paged_attention_reference(q, kp, vp, bt, kl)
+        # Perturb every key/value slot at positions >= kv_len via a fresh
+        # pool where all pages differ; only the table entries mapping the
+        # live prefix are pinned to the originals.
+        page = 4
+        live_pages = [int(np.ceil(int(n) / page)) for n in np.asarray(kl)]
+        rng = np.random.default_rng(99)
+        kp2 = jnp.asarray(rng.standard_normal(kp.shape), kp.dtype)
+        vp2 = jnp.asarray(rng.standard_normal(vp.shape), vp.dtype)
+        bt_np = np.asarray(bt)
+        for row, n_live in enumerate(live_pages):
+            for j in range(n_live):
+                pid = bt_np[row, j]
+                kp2 = kp2.at[pid].set(kp[pid])
+                vp2 = vp2.at[pid].set(vp[pid])
+        # Partially-live last pages still carry stale tail slots inside a
+        # LIVE page; zero them in both pools so only dead PAGES differ.
+        for row, n_live in enumerate(live_pages):
+            n = int(np.asarray(kl)[row])
+            tail = n % page
+            if tail:
+                pid = bt_np[row, n_live - 1]
+                kp2 = kp2.at[pid, :, tail:].set(0)
+                vp2 = vp2.at[pid, :, tail:].set(0)
+                kp = kp.at[pid, :, tail:].set(0)
+                vp = vp.at[pid, :, tail:].set(0)
+        out1 = att_mod.paged_attention_reference(q, kp, vp, bt, kl)
+        out2 = att_mod.paged_attention_reference(q, kp2, vp2, bt, kl)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+class TestDispatchGates:
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "0")
+        assert not att_mod._paged_kernel_usable(64, 8, 16)
+
+    def test_off_tpu_default_is_reference(self, monkeypatch):
+        monkeypatch.delenv("LUMEN_PAGED_KERNEL", raising=False)
+        assert not att_mod._paged_kernel_usable(64, 8, 16)
+
+    def test_vmem_limits(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "1")
+        assert not att_mod._paged_kernel_usable(512, 8, 16)  # head_dim
+        assert not att_mod._paged_kernel_usable(64, 1024, 16)  # row capacity
+        assert att_mod._paged_kernel_usable(64, 128, 16)
+
+
+class TestPagedKVPool:
+    def test_admit_grow_release_accounting(self):
+        pool = PagedKVPool(pages_total=33, page_size=16, slots=4, max_pages=8)
+        row = pool.admit(0, prompt_tokens=30)  # 31 slots -> 2 pages
+        assert pool.pages_live == 2 and row[0] != 0 and row[1] != 0 and row[2] == 0
+        assert pool.grow(0, 33)  # 3 pages
+        assert pool.pages_live == 3
+        assert pool.grow(0, 33)  # idempotent
+        assert pool.pages_live == 3
+        released = pool.release(0)
+        assert released == 3
+        assert pool.pages_live == 0
+        assert pool.allocated_total == 3 and pool.freed_total == 3
+        assert pool.pages_free == 32  # page 0 never enters the free list
+        assert np.all(pool.block_tables[0] == 0)
+
+    def test_dump_page_never_granted(self):
+        pool = PagedKVPool(pages_total=8, page_size=4, slots=4, max_pages=4)
+        granted = []
+        for slot in range(3):
+            row = pool.admit(slot, prompt_tokens=5)  # 2 pages each
+            granted.extend(int(p) for p in row[row != 0])
+        assert 0 not in granted
+        assert len(set(granted)) == len(granted)  # exclusive ownership
+
+    def test_grow_clamps_at_row_capacity(self):
+        """Asking to cover more tokens than a block table can address must
+        clamp to max_pages, not index past the table: the decode program
+        clamps its writes the same way, so a row at capacity keeps
+        overwriting its last slot."""
+        pool = PagedKVPool(pages_total=20, page_size=4, slots=2, max_pages=4)
+        pool.admit(0, prompt_tokens=3)
+        assert pool.grow(0, pool.row_capacity() + 13)  # way past the table
+        assert len(pool._owned[0]) == 4  # capped at max_pages
+        assert pool.pages_live == 4
+
+    def test_exhaustion_and_double_admit(self):
+        pool = PagedKVPool(pages_total=4, page_size=4, slots=4, max_pages=4)
+        pool.admit(0, prompt_tokens=10)  # 3 pages: pool drained
+        assert not pool.grow(0, 32)
+        with pytest.raises(PoolExhausted):
+            pool.admit(1, prompt_tokens=10)
+        with pytest.raises(RuntimeError):
+            pool.admit(0, prompt_tokens=1)
+
+    def test_random_order_invariants(self):
+        """Property: under random admit/grow/release orders, no page is
+        ever owned by two slots, the dump page is never granted, and
+        allocated - freed == live owned pages at every step."""
+        rng = np.random.default_rng(1234)
+        pool = PagedKVPool(pages_total=40, page_size=8, slots=6, max_pages=10)
+        live: dict[int, int] = {}  # slot -> tokens covered
+        for _ in range(500):
+            op = rng.integers(0, 3)
+            if op == 0 and len(live) < 6:
+                slot = next(i for i in range(6) if i not in live)
+                tokens = int(rng.integers(1, 40))
+                if pool.can_admit(tokens):
+                    pool.admit(slot, tokens)
+                    live[slot] = tokens + 1
+            elif op == 1 and live:
+                slot = int(rng.choice(list(live)))
+                target = live[slot] + int(rng.integers(1, 16))
+                if target <= pool.row_capacity() and pool.grow(slot, target):
+                    live[slot] = target
+            elif op == 2 and live:
+                slot = int(rng.choice(list(live)))
+                pool.release(slot)
+                del live[slot]
+            # invariants
+            owned = [p for s in live for p in pool.block_tables[s] if p != 0]
+            assert 0 not in owned
+            assert len(set(owned)) == len(owned), "page owned twice"
+            assert pool.pages_live == len(owned)
+            assert pool.pages_live + pool.pages_free == pool.pages_total - 1
+        for slot in list(live):
+            pool.release(slot)
+        assert pool.pages_live == 0
+        assert pool.allocated_total == pool.freed_total
+
+    def test_row_isolation_under_random_tables(self):
+        """Page-table indirection must never mix rows: attention over a
+        row's pages equals attention over that row's own contiguous KV,
+        whatever interleaved order the allocator granted pages in."""
+        rng = np.random.default_rng(7)
+        b, h, kvh, d, page, maxp = 4, 4, 2, 16, 8, 6
+        pool = PagedKVPool(pages_total=b * maxp + 1, page_size=page, slots=b, max_pages=maxp)
+        kv_lens = [int(rng.integers(1, maxp * page)) for _ in range(b)]
+        # Interleaved growth: admit everyone, then grow rows in random
+        # order so page ids end up shuffled across rows.
+        for row in range(b):
+            pool.admit(row, 1)
+        targets = dict(enumerate(kv_lens))
+        grown = {row: 2 for row in range(b)}
+        order = list(range(b)) * maxp
+        rng.shuffle(order)
+        for row in order:
+            if grown[row] < targets[row]:
+                step = min(targets[row], grown[row] + page)
+                assert pool.grow(row, step)
+                grown[row] = step
+        # Fill each row's live KV with per-row content through its table.
+        k_pages = np.zeros((pool.pages_total, kvh, page, d), np.float32)
+        v_pages = np.zeros_like(k_pages)
+        own_k = [rng.standard_normal((kvh, n, d)).astype(np.float32) for n in kv_lens]
+        own_v = [rng.standard_normal((kvh, n, d)).astype(np.float32) for n in kv_lens]
+        for row in range(b):
+            for t in range(kv_lens[row]):
+                pid = pool.block_tables[row, t // page]
+                assert pid != 0
+                k_pages[pid, :, t % page] = own_k[row][:, t]
+                v_pages[pid, :, t % page] = own_v[row][:, t]
+        q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+        out = att_mod.paged_attention_reference(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(pool.block_tables), jnp.asarray(kv_lens, np.int32),
+        )
+        # Per-row ground truth: plain attention over the row's OWN kv.
+        for row in range(b):
+            k = np.repeat(own_k[row], h // kvh, axis=0)  # [h, n, d]
+            v = np.repeat(own_v[row], h // kvh, axis=0)
+            s = np.einsum("hd,hnd->hn", np.asarray(q[row], np.float32), k) / np.sqrt(d)
+            w = np.exp(s - s.max(-1, keepdims=True))
+            w /= w.sum(-1, keepdims=True)
+            want = np.einsum("hn,hnd->hd", w, v)
+            np.testing.assert_allclose(
+                np.asarray(out[row]), want, rtol=2e-5, atol=2e-5
+            )

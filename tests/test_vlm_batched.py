@@ -75,6 +75,9 @@ def manager(tmp_path_factory):
         prefill_buckets=(16, 32),
         gen_batch_size=4,
         gen_batch_latency_ms=30.0,
+        # This file tests the coalescing batcher specifically; the
+        # serving default moved to the paged continuous engine.
+        scheduler="coalesce",
     )
     mgr.initialize()
     yield mgr
