@@ -3548,7 +3548,8 @@ def _capacity_impl() -> dict:
             _grpc_round_robin(stub, pb, "clip_image_embed", payloads[:8], 16, 4)
             tele.reset_hub()
             tele.set_capacity("device:clip-image", 1.0, union=True)
-            tele.set_capacity("decode:decode_pool", float(get_decode_pool().workers))
+            pool = get_decode_pool()
+            tele.set_capacity("decode:decode_pool", float(pool.workers + pool.procs))
             os.environ["LUMEN_TRACE_SAMPLE"] = "1"
             reset_recorder()
             _state("capacity:c10")
@@ -3693,6 +3694,222 @@ def _capacity_impl() -> dict:
     return out
 
 
+def phase_host_lane() -> dict:
+    """Host-lane A/B (ISSUE 13): (1) thread- vs process-parallel decode
+    on camera-size JPEGs, (2) tensor/raw vs JPEG gRPC c10 rps through the
+    real serving stack, (3) per-stage attribution — the outside-
+    device+decode share of request time — plus the serialize-span delta
+    from the LUMEN_RPC_TRIM request-path trim.
+
+    Speedup assertions engage only on a multi-core host (os.cpu_count()
+    > 2): on 1-2 cores process decode cannot beat threads by construction
+    (there is no second core to un-GIL), so the numbers are measured and
+    reported without acceptance."""
+    _apply_platform_env()
+    with _cache_env("0"):  # identical payloads must DECODE, not hit cache
+        return _host_lane_impl()
+
+
+def _host_lane_impl() -> dict:
+    import shutil
+    import statistics
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from lumen_tpu.models.clip.manager import CLIPManager
+    from lumen_tpu.runtime.decode_pool import DecodePool, decode_workers
+    from lumen_tpu.serving.services.clip_service import ClipService
+    from lumen_tpu.utils import host_decode, tensorwire
+
+    cpus = os.cpu_count() or 1
+    multi_core = cpus > 2
+    out: dict = {
+        "platform": jax.devices()[0].platform,
+        "cpu_count": cpus,
+        "asserted": multi_core,
+    }
+
+    # -- (1) thread vs process decode on camera-size JPEGs ---------------
+    _state("host_lane:decode_ab")
+    import cv2
+
+    rng = np.random.default_rng(0)
+    jpegs = []
+    for i in range(16):
+        base = np.linspace(0, 220, 1600, dtype=np.uint8)[None, :, None]
+        img = np.clip(base + rng.integers(0, 35, (1200, 1600, 3)), 0, 255)
+        ok, buf = cv2.imencode(".jpg", img.astype(np.uint8),
+                               [cv2.IMWRITE_JPEG_QUALITY, 85])
+        assert ok
+        jpegs.append(buf.tobytes())
+    k = decode_workers()
+    spec, params = "clip_resize", {"size": 224}
+
+    def drive(pool) -> tuple[float, np.ndarray]:
+        warm = pool.run_decode(spec, jpegs[0], params)  # spawn/compile off-clock
+        first = np.copy(warm.array)
+        warm.release()
+        t0 = time.perf_counter()
+        for _ in range(2):
+            results = pool.map_decode(spec, jpegs, params)
+            for r in results:
+                r.release()
+        return (2 * len(jpegs)) / (time.perf_counter() - t0), first
+
+    tpool = DecodePool(workers=k, name="hl-bench-t", procs=0)
+    try:
+        thread_ips, thread_first = drive(tpool)
+    finally:
+        tpool.close()
+    ppool = DecodePool(workers=k, name="hl-bench-p", procs=max(1, cpus - 1))
+    try:
+        proc_ips, proc_first = drive(ppool)
+        arena = {k: v for k, v in ppool.gauges().items() if k.startswith("arena_")}
+    finally:
+        ppool.close()
+    assert np.array_equal(thread_first, proc_first), "thread/process decode diverged"
+    out["decode_ab"] = {
+        "jpeg_px": "1600x1200",
+        "workers": k,
+        "thread_img_s": round(thread_ips, 2),
+        "process_img_s": round(proc_ips, 2),
+        "process_vs_thread": round(proc_ips / thread_ips, 3),
+        "arena": arena,
+    }
+
+    # -- (2) tensor/raw vs JPEG gRPC c10 ---------------------------------
+    _state("host_lane:build_clip")
+    cpu = jax.default_backend() == "cpu"
+    n = 40 if cpu else 400
+    root = tempfile.mkdtemp(prefix="bench_hostlane_")
+    try:
+        mgr = CLIPManager(
+            _write_bench_clip_dir(root, tiny=cpu),
+            dtype="float32" if cpu else "bfloat16",
+            batch_size=4 if cpu else 16,
+            max_batch_latency_ms=2.0,
+            warmup=True,
+        )
+        svc = ClipService({"clip": mgr})
+        mgr.initialize()
+        server, channel, stub, pb = _start_grpc({"clip": svc})
+        try:
+            # Camera-size JPEG: the decode cost the tensor path deletes.
+            jpeg = jpegs[0]
+            size = mgr.cfg.image_size
+            pixels = host_decode._SPECS["clip_resize"](jpeg, {"size": size})
+            buf, tmeta = tensorwire.tensor_payload(pixels)
+            tensor_payload_bytes = bytes(buf)
+
+            _state("host_lane:grpc_jpeg_c10")
+            out["grpc_jpeg_c10"] = _grpc_measure(
+                stub, pb, "clip_image_embed", jpeg, "image/jpeg", {}, n, 10
+            )
+            from lumen_tpu.utils.metrics import metrics as _metrics
+
+            decode_tasks_after_jpeg = (
+                _metrics.snapshot()["gauges"].get("decode_pool", {}).get("tasks", 0)
+            )
+            _state("host_lane:grpc_tensor_c10")
+            out["grpc_tensor_c10"] = _grpc_measure(
+                stub, pb, "clip_image_embed", tensor_payload_bytes,
+                tensorwire.TENSOR_MIME, tmeta, n, 10,
+            )
+            decode_tasks_after_tensor = (
+                _metrics.snapshot()["gauges"].get("decode_pool", {}).get("tasks", 0)
+            )
+            ratio = out["grpc_tensor_c10"]["rps"] / max(
+                out["grpc_jpeg_c10"]["rps"], 1e-9
+            )
+            out["tensor_vs_jpeg_rps"] = round(ratio, 3)
+            # Wire proof of the zero-decode property: the tensor run adds
+            # NOTHING to the shared decode pool's task counter.
+            out["decode_pool_tasks_during_tensor_run"] = (
+                decode_tasks_after_tensor - decode_tasks_after_jpeg
+            )
+            assert out["decode_pool_tasks_during_tensor_run"] == 0
+
+            # -- (3) attribution + serialize-span trim delta -------------
+            import lumen_tpu.serving.base_service as base_service_mod
+            from lumen_tpu.utils import trace as utrace
+
+            def traced_run(trim: bool) -> dict:
+                prior = base_service_mod.RPC_TRIM
+                base_service_mod.RPC_TRIM = trim
+                os.environ["LUMEN_TRACE_SAMPLE"] = "1"
+                utrace.reset_recorder()
+                try:
+                    _grpc_measure(
+                        stub, pb, "clip_image_embed", jpeg, "image/jpeg",
+                        {}, 30, 10,
+                    )
+                    recs = [
+                        r for r in utrace.get_recorder().traces()
+                        if r["task"] == "clip_image_embed"
+                    ]
+                finally:
+                    os.environ.pop("LUMEN_TRACE_SAMPLE", None)
+                    base_service_mod.RPC_TRIM = prior
+                    utrace.reset_recorder()
+                ser, covered, total = [], [], []
+                for r in recs:
+                    spans = {}
+                    for s in r["spans"]:
+                        spans.setdefault(s["name"], 0.0)
+                        spans[s["name"]] += s["dur_ms"]
+                    if "serialize" in spans:
+                        ser.append(spans["serialize"])
+                    dev_dec = sum(
+                        v for k2, v in spans.items()
+                        if k2.startswith("decode") or k2 == "batch.device"
+                    )
+                    covered.append(dev_dec)
+                    total.append(r["duration_ms"])
+                return {
+                    "n_traces": len(recs),
+                    "serialize_p50_ms": round(statistics.median(ser), 4) if ser else None,
+                    "outside_device_decode_pct": round(
+                        100.0 * (1.0 - sum(covered) / max(sum(total), 1e-9)), 1
+                    ),
+                }
+
+            _state("host_lane:attribution_trim_on")
+            trim_on = traced_run(True)
+            _state("host_lane:attribution_trim_off")
+            trim_off = traced_run(False)
+            out["attribution"] = {
+                "trim_on": trim_on,
+                "trim_off": trim_off,
+                "serialize_delta_ms": (
+                    round(trim_off["serialize_p50_ms"] - trim_on["serialize_p50_ms"], 4)
+                    if trim_on["serialize_p50_ms"] is not None
+                    and trim_off["serialize_p50_ms"] is not None
+                    else None
+                ),
+            }
+        finally:
+            channel.close()
+            server.stop(0)
+            svc.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    out["acceptance"] = {
+        "thread_process_bitwise_identical": True,
+        "tensor_run_never_entered_decode_pool":
+            out["decode_pool_tasks_during_tensor_run"] == 0,
+    }
+    if multi_core:
+        out["acceptance"]["process_decode_2x"] = (
+            out["decode_ab"]["process_vs_thread"] >= 2.0
+        )
+        out["acceptance"]["tensor_rps_1_5x"] = out["tensor_vs_jpeg_rps"] >= 1.5
+        assert all(out["acceptance"].values()), f"host_lane acceptance: {out['acceptance']}"
+    return out
+
+
 PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
@@ -3706,6 +3923,7 @@ PHASES = {
     "flash_ab": phase_flash_ab,
     "clip_q8": phase_clip_q8,
     "bench_grpc": phase_bench_grpc,
+    "host_lane": phase_host_lane,
     "grpc_bulk": phase_grpc_bulk,
     "grpc_dup": phase_grpc_dup,
     "replica_scaling": phase_replica_scaling,

@@ -37,10 +37,43 @@ from google.protobuf import empty_pb2
 
 from lumen_tpu.serving.proto import ml_service_pb2 as pb
 from lumen_tpu.serving.proto import ml_service_pb2_grpc as pbg
+from lumen_tpu.utils import tensorwire
 from lumen_tpu.utils import trace as utrace
 from lumen_tpu.utils.qos import RETRY_AFTER_META, TENANT_META_KEY
 
 CHUNK = 1 << 20  # 1 MiB
+
+
+def _as_bytes(part) -> bytes:
+    """protobuf insists on ``bytes``: convert a memoryview slice at the
+    last moment so an ndarray payload is copied exactly once, at proto
+    construction — not once to serialize plus once per chunk."""
+    return part if isinstance(part, bytes) else bytes(part)
+
+
+def _tensor_item(payload, meta: dict[str, str]):
+    """Normalize one payload: ndarrays become ``tensor/raw`` wire items
+    (flat memoryview + dtype/shape meta merged over the caller's)."""
+    import numpy as np
+
+    if isinstance(payload, np.ndarray):
+        buf, tmeta = tensorwire.tensor_payload(payload)
+        return buf, tensorwire.TENSOR_MIME, {**meta, **tmeta}
+    return payload, None, meta
+
+
+def infer(stub, task: str, payload, mime: str = "application/octet-stream",
+          meta: dict[str, str] | None = None, timeout: float = 300.0,
+          stream: bool = False, tenant: str | None = None):
+    """One Infer call. ``payload`` may be raw bytes (``mime`` describes
+    them) or a numpy ndarray — arrays ride the ``tensor/raw`` wire path:
+    dtype/shape meta, one serialization copy, and on the server side ZERO
+    decode-pool work (the tensor goes straight to the batcher). Validate
+    shapes against the service's ``tensor_input:<task>`` capability key
+    before bulk traffic; a mismatch answers INVALID_ARGUMENT."""
+    payload, tmime, meta = _tensor_item(payload, meta or {})
+    return _infer(stub, task, payload, tmime or mime, meta, timeout,
+                  stream=stream, tenant=tenant)
 
 
 def get_stats(metrics_addr: str, window: float = 60.0, timeout: float = 10.0) -> dict:
@@ -141,11 +174,11 @@ def _begin_client_trace(task: str):
     return tr, ((utrace.TRACE_META_KEY, tr.trace_id),)
 
 
-def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
+def _requests(task: str, payload, mime: str, meta: dict[str, str]):
     """Yield chunked InferRequests (single message when small)."""
     if len(payload) <= CHUNK:
         yield pb.InferRequest(
-            correlation_id="cli", task=task, payload=payload,
+            correlation_id="cli", task=task, payload=_as_bytes(payload),
             payload_mime=mime, meta=meta,
         )
         return
@@ -153,40 +186,50 @@ def _requests(task: str, payload: bytes, mime: str, meta: dict[str, str]):
     for i in range(total):
         part = payload[i * CHUNK : (i + 1) * CHUNK]
         yield pb.InferRequest(
-            correlation_id="cli", task=task, payload=part, payload_mime=mime,
+            correlation_id="cli", task=task, payload=_as_bytes(part),
+            payload_mime=mime,
             meta=meta if i == 0 else {}, seq=i, total=total, offset=i * CHUNK,
         )
 
 
-def _bulk_requests(task: str, payloads, mime: str, meta: dict[str, str]):
+def _bulk_requests(task: str, items, mime: str, meta: dict[str, str]):
     """Chunked requests for N tagged items on ONE stream (correlation_id =
     item index; ``bulk: 1`` meta switches the server onto the concurrent
-    fan-out lane)."""
-    tagged = {**meta, "bulk": "1"}
-    for i, payload in enumerate(payloads):
+    fan-out lane). ndarray items ride ``tensor/raw`` with their own
+    dtype/shape meta (see :func:`_tensor_item`)."""
+    for i, raw_item in enumerate(items):
+        payload, item_mime, item_meta = _tensor_item(raw_item, meta)
         cid = str(i)
+        tagged = {**item_meta, "bulk": "1"}
+        wire_mime = item_mime or mime
         if len(payload) <= CHUNK:
             yield pb.InferRequest(
-                correlation_id=cid, task=task, payload=payload,
-                payload_mime=mime, meta=tagged,
+                correlation_id=cid, task=task, payload=_as_bytes(payload),
+                payload_mime=wire_mime, meta=tagged,
             )
             continue
         total = (len(payload) + CHUNK - 1) // CHUNK
         for j in range(total):
             part = payload[j * CHUNK : (j + 1) * CHUNK]
             yield pb.InferRequest(
-                correlation_id=cid, task=task, payload=part, payload_mime=mime,
+                correlation_id=cid, task=task, payload=_as_bytes(part),
+                payload_mime=wire_mime,
                 meta=tagged if j == 0 else {}, seq=j, total=total, offset=j * CHUNK,
             )
 
 
-def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream",
+def infer_bulk(stub, task: str, payloads=None, mime: str = "application/octet-stream",
                meta: dict[str, str] | None = None, timeout: float = 300.0,
-               tenant: str | None = None):
+               tenant: str | None = None, tensors=None):
     """Run MANY payloads through ONE ``Infer`` stream (the server's bulk
     fan-out lane): stream setup, admission and context bookkeeping are
     paid once, and the server coalesces the items into full device
     batches.
+
+    ``tensors=`` (instead of, or mixed into, ``payloads``) sends
+    pre-decoded ndarrays over the ``tensor/raw`` wire path — per-item
+    dtype/shape meta, one serialization copy each, zero server-side
+    decode. ``payloads`` items may themselves be ndarrays too.
 
     Yields ``(index, (result_bytes, mime, meta))`` per item AS RESPONSES
     ARRIVE — out of submission order. A per-item failure yields
@@ -194,6 +237,10 @@ def infer_bulk(stub, task: str, payloads, mime: str = "application/octet-stream"
     down its streammates."""
     from lumen_tpu.serving import ServiceError, reassemble_result
 
+    if payloads is None:
+        payloads = tensors if tensors is not None else []
+    elif tensors is not None:
+        payloads = list(payloads) + list(tensors)
     tr, md = _begin_client_trace(task)
     md = _with_tenant(md, tenant)
     # payloads may be any iterable (downstream only enumerates it) — a

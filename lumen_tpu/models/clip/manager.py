@@ -741,20 +741,61 @@ class CLIPManager:
     def _encode_image_uncached(
         self, image_bytes: bytes, fingerprint: str | None = None
     ) -> np.ndarray:
-        resized = get_decode_pool().run(self._decode_resize, image_bytes)
-        vec = self._image_batcher(resized, fingerprint=fingerprint)
+        # The "clip_resize" decode spec (scaled decode + square squash,
+        # lumen_tpu.utils.host_decode) runs on the shared pool — in
+        # process mode that is a worker process writing into a
+        # shared-memory arena slot, and `decoded.array` is a zero-copy
+        # view the batcher's collector stacks from directly; release()
+        # recycles the slot once the batcher has settled (the collector
+        # copied the row into its staging arena before dispatch).
+        size = self.cfg.image_size
+        decoded = get_decode_pool().run_decode(
+            "clip_resize", image_bytes, {"size": size}
+        )
+        try:
+            vec = self._image_batcher(decoded.array, fingerprint=fingerprint)
+        finally:
+            decoded.release()
         return self._check_vector(vec)
 
-    def _decode_resize(self, image_bytes: bytes) -> np.ndarray:
-        import cv2
-
-        # Scaled decode: a >=2x-oversized JPEG decodes at 1/2..1/8 scale
-        # (both dims kept >= image_size, so this resize only downscales) —
-        # the decode worker's cost drops ~4x on typical photos while the
-        # device-side normalize path sees the same uint8 contract.
+    def tensor_input_shape(self) -> tuple[int, int, int]:
+        """The pre-decoded pixel tensor this manager accepts on the
+        ``tensor/raw`` wire path: exactly what the ``clip_resize`` decode
+        spec produces, so tensor- and JPEG-path results are identical."""
         size = self.cfg.image_size
-        img = decode_image_bytes(image_bytes, color="rgb", max_edge=size)
-        return cv2.resize(img, (size, size), interpolation=cv2.INTER_LINEAR)
+        return (size, size, 3)
+
+    def encode_image_tensor(self, pixels: np.ndarray, raw: bytes | None = None) -> np.ndarray:
+        """Pre-decoded tensor -> unit-norm embedding: the zero-decode
+        serving path. ``pixels`` must be the uint8 (size, size, 3) tensor
+        the capability's input spec advertises; it goes STRAIGHT to the
+        batcher — no decode pool, no resize. ``raw`` is the wire payload
+        backing ``pixels`` (the same buffer, so passing it avoids a
+        re-serialization); the result cache keys on sha256 of that raw
+        buffer, hashed exactly once — the same single-hash guarantee the
+        JPEG path has, under a ``tensor``-qualified namespace (raw pixels
+        and JPEG bytes of one image are different byte strings and must
+        never answer for each other)."""
+        self._ensure_ready()
+        size = self.cfg.image_size
+        if pixels.dtype != np.uint8 or tuple(pixels.shape) != (size, size, 3):
+            raise ValueError(
+                f"tensor input must be uint8 of shape ({size}, {size}, 3); "
+                f"got {pixels.dtype} {tuple(pixels.shape)}"
+            )
+        payload = raw if raw is not None else pixels.tobytes()
+        ns = self._cache_ns("image_embed", "tensor")
+        key = guarded_key(ns, None, payload)
+        return get_result_cache().get_or_compute(
+            ns,
+            None,
+            payload,
+            lambda: self._check_vector(
+                self._image_batcher(np.ascontiguousarray(pixels), fingerprint=key)
+            ),
+            clone=np.copy,
+            key=key,
+        )
 
     def encode_text(self, text: str) -> np.ndarray:
         self._ensure_ready()

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.model_info import dataclass_from_extra, load_model_info
-from ...ops.image import decode_image_bytes, decode_image_bytes_scaled, letterbox_numpy
+from ...ops.image import letterbox_numpy
 from ...ops.nms import nms_jax
 from ...runtime.batcher import (
     MicroBatcher,
@@ -392,6 +392,77 @@ class FaceManager:
             max_faces, nms_threshold,
         )
 
+    @staticmethod
+    def _check_tensor(pixels: np.ndarray) -> np.ndarray:
+        if pixels.dtype != np.uint8 or pixels.ndim != 3 or pixels.shape[2] != 3:
+            raise ValueError(
+                "tensor input must be a uint8 HWC RGB image (H, W, 3); "
+                f"got {pixels.dtype} {tuple(pixels.shape)}"
+            )
+        return np.ascontiguousarray(pixels)
+
+    def detect_faces_tensor(
+        self, pixels: np.ndarray, raw: bytes | None = None, **det_kw
+    ) -> list[FaceDetection]:
+        """Pre-decoded RGB tensor (the ``tensor/raw`` wire path): zero
+        decode-pool hops — the letterbox runs on the caller's thread and
+        the pixels go straight to the detector batcher. Cached on the RAW
+        pixel buffer (one sha256, same single-hash guarantee as the JPEG
+        path) under a tensor-qualified namespace; coordinates come back
+        in the tensor's own frame (decode_scale is 1 by definition —
+        whoever decoded chose the resolution)."""
+        self._ensure_ready()
+        pixels = self._check_tensor(pixels)
+        options = {
+            "conf_threshold": None, "size_min": None, "size_max": None,
+            "max_faces": None, "nms_threshold": None, **det_kw,
+        }
+        payload = raw if raw is not None else pixels.tobytes()
+        ns = self._cache_ns("detect_tensor")
+        key = guarded_key(ns, options, payload)
+        return get_result_cache().get_or_compute(
+            ns,
+            options,
+            payload,
+            lambda: self._detect_faces_impl(
+                pixels, options["conf_threshold"], options["size_min"],
+                options["size_max"], options["max_faces"],
+                options["nms_threshold"], fingerprint=key,
+            ),
+            clone=copy.deepcopy,
+            key=key,
+        )
+
+    def detect_and_extract_tensor(
+        self, pixels: np.ndarray, raw: bytes | None = None,
+        max_faces: int | None = None, **det_kw
+    ) -> list[FaceDetection]:
+        """Tensor twin of :meth:`detect_and_extract`: detections WITH
+        embeddings from a pre-decoded RGB tensor, no decode pool."""
+        self._ensure_ready()
+        pixels = self._check_tensor(pixels)
+        options = {
+            "conf_threshold": None, "size_min": None, "size_max": None,
+            "nms_threshold": None, **det_kw, "max_faces": max_faces,
+        }
+        payload = raw if raw is not None else pixels.tobytes()
+        ns = self._cache_ns("detect_and_embed_tensor")
+        key = guarded_key(ns, options, payload)
+
+        def _compute() -> list[FaceDetection]:
+            faces = self._detect_faces_impl(
+                pixels, options["conf_threshold"], options["size_min"],
+                options["size_max"], max_faces, options["nms_threshold"],
+                fingerprint=key,
+            )
+            if faces:
+                self.embed_detections(pixels, faces)
+            return faces
+
+        return get_result_cache().get_or_compute(
+            ns, options, payload, _compute, clone=copy.deepcopy, key=key
+        )
+
     def _detect_faces_scaled(
         self, image_bytes: bytes, conf_threshold, size_min, size_max,
         max_faces, nms_threshold, fingerprint: str | None = None,
@@ -401,15 +472,19 @@ class FaceManager:
         factor is folded into the letterbox unmap, and results come back
         in ORIGINAL image coordinates — identical contract, ~4x less
         decode work."""
-        img, dscale, orig_hw = get_decode_pool().run(
-            decode_image_bytes_scaled, image_bytes, color="rgb",
-            max_edge=self.det_cfg.input_size,
+        decoded = get_decode_pool().run_decode(
+            "decode_scaled", image_bytes,
+            {"color": "rgb", "max_edge": self.det_cfg.input_size},
         )
-        return self._detect_faces_impl(
-            img, conf_threshold, size_min, size_max, max_faces,
-            nms_threshold, fingerprint=fingerprint,
-            decode_scale=dscale, orig_hw=orig_hw,
-        )
+        try:
+            dscale, oh, ow = decoded.extras
+            return self._detect_faces_impl(
+                decoded.array, conf_threshold, size_min, size_max, max_faces,
+                nms_threshold, fingerprint=fingerprint,
+                decode_scale=dscale, orig_hw=(oh, ow),
+            )
+        finally:
+            decoded.release()
 
     def _detect_faces_impl(
         self,
@@ -558,15 +633,22 @@ class FaceManager:
             payload = bytes(face_image)
             ns = self._cache_ns("embed")
             key = guarded_key(ns, options, payload)
+            def _decode_and_embed():
+                decoded = get_decode_pool().run_decode(
+                    "decode", face_image, {"color": "rgb"}
+                )
+                try:
+                    return self._extract_embedding_impl(
+                        decoded.array, landmarks, fingerprint=key
+                    )
+                finally:
+                    decoded.release()
+
             return get_result_cache().get_or_compute(
                 ns,
                 options,
                 payload,
-                lambda: self._extract_embedding_impl(
-                    get_decode_pool().run(decode_image_bytes, face_image, color="rgb"),
-                    landmarks,
-                    fingerprint=key,
-                ),
+                _decode_and_embed,
                 clone=np.copy,
                 key=key,
             )
@@ -617,19 +699,24 @@ class FaceManager:
         # size, and embedding crops are resized to the recognizer's input
         # anyway. Detection results stay in original coordinates; the
         # decode factor maps them back onto the decoded array for crops.
-        img, dscale, orig_hw = get_decode_pool().run(
-            decode_image_bytes_scaled, image_bytes, color="rgb",
-            max_edge=self.det_cfg.input_size,
+        decoded = get_decode_pool().run_decode(
+            "decode_scaled", image_bytes,
+            {"color": "rgb", "max_edge": self.det_cfg.input_size},
         )
-        faces = self._detect_faces_impl(
-            img, det_kw.get("conf_threshold"), det_kw.get("size_min"),
-            det_kw.get("size_max"), max_faces, det_kw.get("nms_threshold"),
-            decode_scale=dscale, orig_hw=orig_hw,
-        )
-        if not faces:
+        try:
+            dscale, oh, ow = decoded.extras
+            img = decoded.array
+            faces = self._detect_faces_impl(
+                img, det_kw.get("conf_threshold"), det_kw.get("size_min"),
+                det_kw.get("size_max"), max_faces, det_kw.get("nms_threshold"),
+                decode_scale=dscale, orig_hw=(oh, ow),
+            )
+            if not faces:
+                return faces
+            self.embed_detections(img, faces, coord_scale=dscale)
             return faces
-        self.embed_detections(img, faces, coord_scale=dscale)
-        return faces
+        finally:
+            decoded.release()
 
     def embed_detections(
         self, img: np.ndarray, faces: list[FaceDetection], coord_scale: float = 1.0
@@ -681,13 +768,21 @@ class FaceManager:
 
     @staticmethod
     def crop_face(image_bytes: bytes, bbox: np.ndarray, margin: float = 0.0) -> np.ndarray:
-        img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
-        h, w = img.shape[:2]
-        x1, y1, x2, y2 = bbox
-        mw, mh = (x2 - x1) * margin, (y2 - y1) * margin
-        x1, y1 = max(int(x1 - mw), 0), max(int(y1 - mh), 0)
-        x2, y2 = min(int(x2 + mw), w), min(int(y2 + mh), h)
-        return img[y1:y2, x1:x2]
+        decoded = get_decode_pool().run_decode("decode", image_bytes, {"color": "rgb"})
+        try:
+            img = decoded.array
+            h, w = img.shape[:2]
+            x1, y1, x2, y2 = bbox
+            mw, mh = (x2 - x1) * margin, (y2 - y1) * margin
+            x1, y1 = max(int(x1 - mw), 0), max(int(y1 - mh), 0)
+            x2, y2 = min(int(x2 + mw), w), min(int(y2 + mh), h)
+            # Copy out unconditionally: the decoded array may be a
+            # shared-memory arena view whose slot is recycled on release,
+            # and ascontiguousarray would return a full-width slice AS the
+            # view — a returned crop must own its pixels.
+            return np.array(img[y1:y2, x1:x2], copy=True)
+        finally:
+            decoded.release()
 
     def _ensure_ready(self) -> None:
         if not self._initialized:

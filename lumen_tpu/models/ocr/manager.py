@@ -29,7 +29,7 @@ import numpy as np
 
 from ...core.model_info import dataclass_from_extra, load_model_info
 from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
-from ...ops.image import decode_image_bytes, letterbox_numpy
+from ...ops.image import letterbox_numpy
 from ...runtime.batcher import bucket_for
 from ...runtime.decode_pool import get_decode_pool
 from ...runtime.quarantine import guarded_key
@@ -562,17 +562,72 @@ class OcrManager:
         unclip_ratio: float | None,
         use_angle_cls: bool,
     ) -> list[OcrResult]:
-        img = get_decode_pool().run(decode_image_bytes, image_bytes, color="rgb")
-        boxes = self.detect(
-            img,
-            det_threshold=det_threshold,
-            box_threshold=box_threshold,
-            unclip_ratio=unclip_ratio,
-        )
-        if not boxes:
-            return []
-        return self.recognize_boxes(
-            img, boxes, rec_threshold=rec_threshold, use_angle_cls=use_angle_cls
+        decoded = get_decode_pool().run_decode("decode", image_bytes, {"color": "rgb"})
+        try:
+            img = decoded.array
+            boxes = self.detect(
+                img,
+                det_threshold=det_threshold,
+                box_threshold=box_threshold,
+                unclip_ratio=unclip_ratio,
+            )
+            if not boxes:
+                return []
+            return self.recognize_boxes(
+                img, boxes, rec_threshold=rec_threshold, use_angle_cls=use_angle_cls
+            )
+        finally:
+            decoded.release()
+
+    def predict_tensor(
+        self,
+        pixels: np.ndarray,
+        raw: bytes | None = None,
+        det_threshold: float | None = None,
+        rec_threshold: float | None = None,
+        box_threshold: float | None = None,
+        unclip_ratio: float | None = None,
+        use_angle_cls: bool = False,
+    ) -> list[OcrResult]:
+        """Pre-decoded RGB tensor (the ``tensor/raw`` wire path): the full
+        OCR pipeline with ZERO decode-pool hops. Cached on the raw pixel
+        buffer (one sha256) under a tensor-qualified namespace — raw
+        pixels and encoded bytes of one page must never answer for each
+        other."""
+        self._ensure_ready()
+        if pixels.dtype != np.uint8 or pixels.ndim != 3 or pixels.shape[2] != 3:
+            raise ValueError(
+                "tensor input must be a uint8 HWC RGB image (H, W, 3); "
+                f"got {pixels.dtype} {tuple(pixels.shape)}"
+            )
+        pixels = np.ascontiguousarray(pixels)
+        options = {
+            "det_threshold": det_threshold,
+            "rec_threshold": rec_threshold,
+            "box_threshold": box_threshold,
+            "unclip_ratio": unclip_ratio,
+            "use_angle_cls": use_angle_cls,
+        }
+        payload = raw if raw is not None else pixels.tobytes()
+        ns = self._cache_ns("predict_tensor")
+        key = guarded_key(ns, options, payload)
+
+        def _compute() -> list[OcrResult]:
+            boxes = self.detect(
+                pixels,
+                det_threshold=det_threshold,
+                box_threshold=box_threshold,
+                unclip_ratio=unclip_ratio,
+            )
+            if not boxes:
+                return []
+            return self.recognize_boxes(
+                pixels, boxes, rec_threshold=rec_threshold,
+                use_angle_cls=use_angle_cls,
+            )
+
+        return get_result_cache().get_or_compute(
+            ns, options, payload, _compute, clone=copy.deepcopy, key=key
         )
 
     def recognize_boxes(

@@ -25,7 +25,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.model_info import ModelInfo, load_model_info
-from ...ops.image import decode_image_bytes
 from ...runtime.decode_pool import get_decode_pool
 from ...runtime.policy import get_policy
 from ...runtime.quarantine import guarded_key
@@ -868,24 +867,6 @@ class VLMManager:
                 return b
         raise ValueError(f"prompt of {n} tokens exceeds the largest bucket {self.prefill_buckets[-1]}")
 
-    def _decode_canvas(self, image_bytes: bytes) -> np.ndarray:
-        """Decode + pad-to-square letterbox (reference
-        ``_run_vision_encoder:661-729``); runs on the shared decode pool so
-        gRPC handler threads never do CPU-bound image work inline. Scaled
-        decode: an oversized photo decodes at reduced scale (never below
-        the vision tower's input size) before the letterbox resize."""
-        import cv2
-
-        size = self.cfg.vision.image_size
-        img = decode_image_bytes(image_bytes, color="rgb", max_edge=size)
-        h, w = img.shape[:2]
-        scale = size / max(h, w)
-        nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
-        resized = cv2.resize(img, (nw, nh), interpolation=cv2.INTER_LINEAR)
-        canvas = np.zeros((size, size, 3), np.uint8)
-        canvas[:nh, :nw] = resized
-        return canvas
-
     def _prepare_inputs(self, messages, image_bytes, add_generation_prompt: bool = True):
         has_image = bool(image_bytes)
         ids = self._encode_prompt(messages, has_image, add_generation_prompt)
@@ -895,10 +876,18 @@ class VLMManager:
         padded[0, :n] = ids
         length = jnp.asarray([n], jnp.int32)
         if has_image:
-            canvas = get_decode_pool().run(self._decode_canvas, image_bytes)
-            embeds, positions, lengths = self._prepare(
-                self.params, jnp.asarray(canvas[None]), jnp.asarray(padded), length
+            decoded = get_decode_pool().run_decode(
+                "vlm_canvas", image_bytes, {"size": self.cfg.vision.image_size}
             )
+            try:
+                # jnp.asarray copies host pixels onto the device before
+                # returning, so the arena slot can recycle right after.
+                embeds, positions, lengths = self._prepare(
+                    self.params, jnp.asarray(decoded.array[None]),
+                    jnp.asarray(padded), length,
+                )
+            finally:
+                decoded.release()
         else:
             embeds, positions, lengths = self._prepare_text(
                 self.params, jnp.asarray(padded), length

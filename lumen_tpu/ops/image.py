@@ -54,160 +54,17 @@ def clip_preprocess(
     return normalize(x, jnp.asarray(mean), jnp.asarray(std))
 
 
-def letterbox_params(h: int, w: int, target: int) -> tuple[float, int, int, int, int]:
-    """Aspect-preserving resize-with-padding geometry (host-side helper).
-
-    Returns ``(scale, new_h, new_w, pad_top, pad_left)``; the inverse maps
-    detector boxes back to original coordinates (reference face pipeline,
-    ``lumen_face/backends/onnxrt_backend.py:749-808``).
-    """
-    scale = min(target / h, target / w)
-    new_h, new_w = int(round(h * scale)), int(round(w * scale))
-    pad_top = (target - new_h) // 2
-    pad_left = (target - new_w) // 2
-    return scale, new_h, new_w, pad_top, pad_left
-
-
-def letterbox_numpy(img: np.ndarray, target: int, fill: int = 0) -> tuple[np.ndarray, float, int, int]:
-    """Host letterbox for a single decoded image [H, W, C] -> [target, target, C].
-
-    cv2 (SIMD resize) when present; otherwise the fused native C letterbox,
-    so the serving path also works in a no-OpenCV environment.
-    """
-    try:
-        import cv2
-    except ImportError:
-        cv2 = None
-    if cv2 is None and img.dtype == np.uint8:
-        from lumen_tpu import native
-
-        if native.available():
-            return native.letterbox_u8(img, target, fill)
-    if cv2 is None:
-        raise RuntimeError("letterbox requires cv2 or the native host-ops library")
-
-    h, w = img.shape[:2]
-    scale, new_h, new_w, pad_top, pad_left = letterbox_params(h, w, target)
-    resized = cv2.resize(img, (new_w, new_h), interpolation=cv2.INTER_LINEAR)
-    out = np.full((target, target, img.shape[2]), fill, dtype=img.dtype)
-    out[pad_top : pad_top + new_h, pad_left : pad_left + new_w] = resized
-    return out, scale, pad_top, pad_left
-
-
-#: result-cache namespace qualifier for the scaled-decode generation.
-#: Decode resolution changes result numerics (resampling, thresholded
-#: detections): disk-tier entries computed under one decode policy must
-#: not answer for another across deploys. Bump when the policy changes.
-DECODE_POLICY = "sd1"
-
-
-def probe_image_size(payload: bytes) -> tuple[int, int] | None:
-    """Header-only (h, w) probe — no pixel decode. PIL reads just the
-    container header lazily; anything unprobeable returns None (the caller
-    falls back to a full decode)."""
-    try:
-        from io import BytesIO
-
-        from PIL import Image
-
-        with Image.open(BytesIO(payload)) as im:
-            w, h = im.size
-        return (int(h), int(w))
-    except Exception:  # noqa: BLE001 - probe is best-effort by contract
-        return None
-
-
-def _factor_from_hw(hw: tuple[int, int] | None, max_edge: int) -> int:
-    """Largest scaled-decode factor in {2, 4, 8} that keeps BOTH decoded
-    dims >= ``max_edge`` (downstream resizes — square squash or letterbox
-    — must only ever downscale). 1 = decode full; engages only when the
-    target edge is <= half the source edge."""
-    if hw is None or max_edge <= 0:
-        return 1
-    short = min(hw)
-    factor = 1
-    while factor < 8 and short // (factor * 2) >= max_edge:
-        factor *= 2
-    return factor
-
-
-def _reduced_decode_factor(payload: bytes, max_edge: int) -> int:
-    """Header probe + :func:`_factor_from_hw`; an unprobeable payload
-    decodes full."""
-    if max_edge <= 0:
-        return 1
-    return _factor_from_hw(probe_image_size(payload), max_edge)
-
-
-def decode_image_bytes(
-    payload: bytes, color: str = "rgb", max_edge: int | None = None, _factor: int | None = None
-) -> np.ndarray:
-    """Host-side decode to [H, W, 3] uint8 (cv2; PIL fallback for exotic
-    formats).
-
-    ``max_edge`` opts into SCALED decode: when the image is at least 2x
-    oversized for the target edge, the JPEG is decoded directly at 1/2,
-    1/4 or 1/8 scale (cv2 ``IMREAD_REDUCED_COLOR_*`` / PIL ``draft``) —
-    the IDCT runs on a fraction of the blocks, cutting decode cost ~4x on
-    typical photos. Both decoded dims stay >= ``max_edge``, so downstream
-    resize/letterbox to the target only ever downscales. Callers that
-    must map coordinates back to the original frame use
-    :func:`decode_image_bytes_scaled` instead (``_factor`` lets it reuse
-    its one header probe instead of probing twice)."""
-    import cv2
-
-    if _factor is not None:
-        factor = _factor
-    else:
-        factor = _reduced_decode_factor(payload, max_edge) if max_edge else 1
-    flag = {1: cv2.IMREAD_COLOR, 2: cv2.IMREAD_REDUCED_COLOR_2,
-            4: cv2.IMREAD_REDUCED_COLOR_4, 8: cv2.IMREAD_REDUCED_COLOR_8}[factor]
-    buf = np.frombuffer(payload, dtype=np.uint8)
-    try:
-        img = cv2.imdecode(buf, flag)
-        if img is None:
-            from io import BytesIO
-
-            from PIL import Image
-
-            pil = Image.open(BytesIO(payload))
-            if factor > 1:
-                # draft() is JPEG-only and advisory; for other formats it
-                # is a no-op and the full-size image decodes (correct,
-                # just not reduced).
-                pil.draft("RGB", (pil.size[0] // factor, pil.size[1] // factor))
-            pil = pil.convert("RGB")
-            img = np.asarray(pil)
-            if color == "bgr":
-                img = img[:, :, ::-1]
-            return np.ascontiguousarray(img)
-    except ValueError:
-        raise
-    except Exception as e:  # noqa: BLE001 - normalize any decode failure
-        raise ValueError(f"cannot decode image payload: {e}") from e
-    if color == "rgb":
-        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-    return img
-
-
-def decode_image_bytes_scaled(
-    payload: bytes, color: str = "rgb", max_edge: int | None = None
-) -> tuple[np.ndarray, float, tuple[int, int]]:
-    """Scaled decode WITH provenance: returns ``(img, decode_scale,
-    orig_hw)`` where ``decode_scale = decoded_edge / original_edge``
-    (1.0 = full decode). Callers that report coordinates (face boxes,
-    OCR quads) fold ``decode_scale`` into their letterbox unmap so
-    results stay in ORIGINAL image coordinates."""
-    hw = probe_image_size(payload) if max_edge else None
-    factor = _factor_from_hw(hw, max_edge) if max_edge else 1
-    img = decode_image_bytes(payload, color=color, max_edge=max_edge, _factor=factor)
-    if hw is None or min(hw) <= 0:
-        return img, 1.0, img.shape[:2]
-    # Long-edge ratio: robust to decoders that apply a 90-degree EXIF
-    # rotation the header probe doesn't see; orig_hw is then derived from
-    # the DECODED orientation so callers unclip against consistent axes.
-    scale = max(img.shape[:2]) / max(hw)
-    if scale >= 0.999:  # full decode (or non-reducible format)
-        return img, 1.0, img.shape[:2]
-    h, w = img.shape[:2]
-    return img, scale, (round(h / scale), round(w / scale))
+# Host-side decode primitives now live in the jax-free
+# lumen_tpu.utils.host_decode (the process decode-pool workers import
+# THAT module — importing this one would drag jax into every worker).
+# Re-exported here so existing import sites keep working unchanged.
+from lumen_tpu.utils.host_decode import (  # noqa: E402,F401
+    DECODE_POLICY,
+    _factor_from_hw,
+    _reduced_decode_factor,
+    decode_image_bytes,
+    decode_image_bytes_scaled,
+    letterbox_numpy,
+    letterbox_params,
+    probe_image_size,
+)

@@ -114,7 +114,7 @@ class IngestStats:
 class _Batch:
     __slots__ = (
         "decoded", "inputs", "outputs", "n", "indices", "keys",
-        "trace", "qspan", "wspan",
+        "trace", "qspan", "wspan", "leases",
     )
 
     def __init__(
@@ -140,6 +140,16 @@ class _Batch:
         self.trace = None
         self.qspan = None
         self.wspan = None
+        # Shared-memory decode leases (process-mode decode pool): each
+        # decoded["img"] may be a zero-copy view over an arena slot. The
+        # slots recycle only after the LAST consumer of the pixels —
+        # postprocess (face crops, OCR warps) — has run; every exit path
+        # of the consumer releases (idempotently).
+        self.leases: list = []
+
+    def release(self) -> None:
+        for lease in self.leases:
+            lease.release()
 
 
 class IngestPipeline:
@@ -161,6 +171,8 @@ class IngestPipeline:
         annotate: Callable[[Any], dict] | None = None,
         cache_namespace: str | None = None,
         cache_options: Mapping[str, Any] | None = None,
+        decode_spec: tuple[str, dict] | None = None,
+        decode_adapter: Callable[[Any], Any] | None = None,
     ):
         if not stages:
             raise ValueError("need at least one stage")
@@ -197,6 +209,17 @@ class IngestPipeline:
         # offline; single-flight coalescing is for the serving path).
         self.cache_namespace = cache_namespace
         self.cache_options = dict(cache_options or {})
+        # Process-parallel decode: a ``(spec_name, params)`` pair names a
+        # registered decode recipe (lumen_tpu.utils.host_decode) that can
+        # run in the pool's worker PROCESSES — byte items then decode
+        # with no GIL anywhere and land in shared-memory arena slots the
+        # batch stacks from directly. ``decode_adapter(DecodedTensor)``
+        # turns one result into the per-item decoded value ``decode``
+        # would have produced. Engages only when the shared pool is in
+        # process mode AND a chunk is all-bytes; everything else uses the
+        # ``decode`` callable on the thread lane, unchanged.
+        self.decode_spec = decode_spec
+        self.decode_adapter = decode_adapter
         self._sharding = data_sharding(mesh)
         self.stats = IngestStats()  # stats of the most recent run()
         self._run_pool_tasks = 0
@@ -232,14 +255,19 @@ class IngestPipeline:
         tr = begin_request("ingest")
         dspan = tr.begin("decode", {"items": len(chunk)}) if tr is not None else None
         raw_items = [item for _, item, _ in chunk]
-        decoded = pool.map(self.decode, raw_items)
-        inputs: dict[str, Any] = {}
-        for stage in self.stages:
-            trees = pool.map(stage.preprocess, decoded)
-            stacked = stack_and_pad(trees, self.batch_size)
-            inputs[stage.name] = jax.tree_util.tree_map(
-                lambda leaf: jax.device_put(leaf, self._sharding), stacked
-            )
+        decoded, leases = self._decode_chunk(pool, raw_items)
+        try:
+            inputs: dict[str, Any] = {}
+            for stage in self.stages:
+                trees = pool.map(stage.preprocess, decoded)
+                stacked = stack_and_pad(trees, self.batch_size)
+                inputs[stage.name] = jax.tree_util.tree_map(
+                    lambda leaf: jax.device_put(leaf, self._sharding), stacked
+                )
+        except BaseException:
+            for lease in leases:
+                lease.release()
+            raise
         # Producer-side count (only the producer thread writes): the pool's
         # own `tasks` gauge is process-wide, so THIS run's decode work has
         # to be tallied where it is submitted.
@@ -251,11 +279,40 @@ class IngestPipeline:
             [idx for idx, _, _ in chunk],
             [key for _, _, key in chunk],
         )
+        batch.leases = leases
         if tr is not None:
             dspan.end()
             batch.trace = tr
             batch.qspan = tr.begin("queue")
         return batch
+
+    def _decode_chunk(self, pool: DecodePool, raw_items: list) -> tuple[list, list]:
+        """Decode one chunk -> ``(decoded_values, shm_leases)``. Routes
+        through the process lane (registered spec, all-bytes chunk,
+        process-mode pool) or the thread lane (the ``decode`` callable),
+        producing identical values either way."""
+        if (
+            self.decode_spec is not None
+            and pool.process_mode
+            and all(isinstance(it, (bytes, bytearray)) for it in raw_items)
+        ):
+            name, params = self.decode_spec
+            try:
+                results = pool.map_decode(name, raw_items, params)
+            except QueueFull as e:
+                # A decode worker died mid-chunk. The serving path sheds
+                # this as retryable; a bulk run retries ITSELF — on the
+                # thread lane, immediately — so one crashed codec worker
+                # never aborts a multi-hour ingest (map_decode already
+                # released any half-chunk leases).
+                logger.warning(
+                    "process decode of a %d-item chunk failed (%s); "
+                    "re-decoding on the thread lane", len(raw_items), e,
+                )
+                return pool.map(self.decode, raw_items), []
+            adapt = self.decode_adapter or (lambda r: r.array)
+            return [adapt(r) for r in results], results
+        return pool.map(self.decode, raw_items), []
 
     @staticmethod
     def _offer(out: queue.Queue, entry, stop: threading.Event) -> bool:
@@ -318,7 +375,10 @@ class IngestPipeline:
                 batch = self._prepare(pool, chunk)
                 self.stats.decode_s += time.perf_counter() - t0
                 chunk = []
-                return self._offer(out, batch, stop)
+                if not self._offer(out, batch, stop):
+                    batch.release()  # abandoned run: recycle shm slots
+                    return False
+                return True
 
             for item in items:
                 if stop.is_set():
@@ -418,6 +478,7 @@ class IngestPipeline:
         )
         producer.start()
         pending: deque[_Batch] = deque()
+        current: _Batch | None = None  # batch mid-postprocess (lease cleanup)
         # Reorder buffer: index -> finished record. Cache hits land here
         # directly from the queue; batch rows land when their batch
         # settles. Bounded by the producer's chunk-flush rule (a hit run
@@ -465,6 +526,7 @@ class IngestPipeline:
                                     got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     except Exception as e:  # noqa: BLE001 - contain, don't abort the run
                         self._salvage_batch(got, e, cache, fence, quarantine, finished)
+                        got.release()
                         continue
                     if got.trace is not None:
                         # Device compute overlaps this wait (async dispatch):
@@ -486,7 +548,7 @@ class IngestPipeline:
                     if done:
                         break
                     continue  # block in the fill loop for more input
-                batch = pending.popleft()
+                batch = current = pending.popleft()
                 t0 = time.perf_counter()
                 if batch.wspan is not None:
                     batch.wspan.end()
@@ -500,6 +562,7 @@ class IngestPipeline:
                         fspan.end(error=type(e).__name__)
                     self.stats.device_s += time.perf_counter() - t0
                     self._salvage_batch(batch, e, cache, fence, quarantine, finished)
+                    batch.release()
                     continue
                 if fspan is not None:
                     fspan.end()
@@ -550,15 +613,28 @@ class IngestPipeline:
                 if pspan is not None:
                     pspan.end()
                 finish_request(batch.trace)
+                # Postprocess (the last pixel consumer — face crops, OCR
+                # warps read decoded["img"]) is done: recycle shm slots.
+                batch.release()
+                current = None
                 self.stats.post_s += time.perf_counter() - t0
                 self.stats.batches += 1
         finally:
             stop.set()
+            # Abandoned run: batches dispatched-but-unfetched (and any
+            # still in the hand-off queue, drained below) hold arena
+            # leases — recycle them or the arena leaks until pool close.
+            if current is not None:
+                current.release()
+            for b in pending:
+                b.release()
             # Unblock a producer parked on a full queue; _offer's timeout
             # makes it observe `stop` within 100ms even if we drain nothing.
             while producer.is_alive():
                 try:
-                    ready.get(timeout=0.05)
+                    got = ready.get(timeout=0.05)
+                    if isinstance(got, _Batch):
+                        got.release()
                 except queue.Empty:
                     pass
                 producer.join(timeout=0.05)

@@ -180,9 +180,36 @@ class PhotoIngestPipeline:
                 # entries from one decode policy must not answer another.
                 "decode_max_edge": self.decode_max_edge,
             },
+            # Process-parallel decode: the "photo" spec is _decode's
+            # byte-path twin registered in lumen_tpu.utils.host_decode —
+            # with LUMEN_DECODE_PROCS the producer's JPEG decode runs in
+            # worker processes (no GIL) and pixels land in shared-memory
+            # arena slots this pipeline's batches stack from directly.
+            decode_spec=(
+                "photo",
+                {
+                    "max_edge": self.decode_max_edge or 0,
+                    "on_error": self.on_decode_error,
+                },
+            ),
+            decode_adapter=self._adapt_decoded,
         )
 
     # -- decode -----------------------------------------------------------
+
+    @staticmethod
+    def _adapt_decoded(result) -> dict:
+        """DecodedTensor from the "photo" spec -> the dict `_decode`
+        produces (same keys, same error policy)."""
+        dscale, oh, ow, err = result.extras
+        if err is not None:
+            return {"img": result.array, "meta": {}, "error": err}
+        return {
+            "img": result.array,
+            "meta": {},
+            "decode_scale": dscale,
+            "orig_hw": (oh, ow),
+        }
 
     def _decode(self, item) -> dict:
         try:

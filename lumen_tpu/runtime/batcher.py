@@ -1493,7 +1493,17 @@ def _tree_nbytes(tree: Any) -> int:
 def stack_and_pad(items: list[Any], size: int) -> Any:
     """Stack a list of same-structure pytrees into one tree with leading dim
     ``size``; rows past ``len(items)`` repeat the last item (repeating keeps
-    padding numerically harmless for ops like softmax over the batch)."""
+    padding numerically harmless for ops like softmax over the batch).
+
+    Items may be views over recycled buffers — notably the process decode
+    pool's shared-memory arena slots (``DecodePool.run_decode``): stacking
+    copies each row out, so the view is not needed AFTER the stack. But a
+    submitter must still hold its lease until ``submit()``'s future
+    settles, not just until dispatch: batch **bisection** re-stacks halves
+    from the ORIGINAL item references at dispatch or fetch time, and a
+    slot recycled early would feed the re-run garbage. The managers'
+    ``try: batcher(view) finally: release()`` shape satisfies this by
+    construction."""
     n = len(items)
     pad = size - n
 

@@ -54,6 +54,7 @@ from google.protobuf import empty_pb2
 
 from ..utils import deadline as request_deadline, request_notes
 from ..utils import qos as request_qos
+from ..utils import tensorwire
 from ..utils import trace as request_trace
 from ..utils.deadline import DeadlineExpired, PoisonInput, QueueFull, WatchdogTimeout
 from ..utils.env import env_int
@@ -100,6 +101,16 @@ def _get_bulk_pool() -> ThreadPoolExecutor:
     return _bulk_pool
 
 
+#: LUMEN_RPC_TRIM (default on): request-path micro-trims — response-proto
+#: reuse on the real-gRPC direct lane (the server serializes each yielded
+#: message before pulling the next, so one scratch proto per thread
+#: replaces an allocation + map copy per response). Read once at import;
+#: the bench A/Bs the serialize span by toggling the module flag.
+RPC_TRIM = env_int("LUMEN_RPC_TRIM", 1) != 0
+
+_proto_scratch = threading.local()
+
+
 def _response_chunk_bytes() -> int:
     """LUMEN_RESPONSE_CHUNK_BYTES, clamped to [1 MB, 60 MB]; malformed
     values fall back to the 48 MB default (degrade, not crash — with the
@@ -129,7 +140,8 @@ def reassemble_result(responses) -> tuple[bytes, str, dict[str, str]]:
         parts[r.seq] = r.result
         total = max(total, r.total)
         mime = r.result_mime or mime
-        meta = dict(r.meta) or meta
+        if r.meta:  # convert only populated maps (once per response at most)
+            meta = dict(r.meta)
     if total and len(parts) < total:
         raise ServiceError(
             0,
@@ -215,6 +227,10 @@ class _Assembly:
         return len(self.chunks) >= self.total
 
     def payload(self) -> bytes:
+        if len(self.chunks) == 1:
+            # The overwhelmingly common single-chunk request: hand the
+            # buffer straight through — no sort, no join, no copy.
+            return next(iter(self.chunks.values()))
         return b"".join(self.chunks[i] for i in sorted(self.chunks))
 
 
@@ -287,6 +303,11 @@ class BaseService(InferenceServicer):
     def Infer(self, request_iterator, context) -> Iterator[pb.InferResponse]:
         buffers: dict[str, _Assembly] = {}
         it = iter(request_iterator)
+        # Response-proto reuse is safe ONLY when each yielded message is
+        # serialized before the next is produced — true for the real gRPC
+        # server (it serializes per yield), NOT for in-process callers
+        # that collect responses into a list (tests, the bulk fan-out).
+        reuse = RPC_TRIM and isinstance(context, grpc.ServicerContext)
         for req in it:
             cid = req.correlation_id
             asm = buffers.setdefault(cid, _Assembly())
@@ -299,7 +320,7 @@ class BaseService(InferenceServicer):
                 # out concurrently; responses come back tagged, unordered.
                 yield from self._bulk_infer(cid, asm, it, buffers, context)
                 return
-            yield from self._dispatch(cid, asm, context)
+            yield from self._dispatch(cid, asm, context, reuse=reuse)
 
     def _bulk_infer(
         self,
@@ -328,6 +349,11 @@ class BaseService(InferenceServicer):
         # every buffered response list since the stream began.
         pending: set = set()
         pool = _get_bulk_pool()
+        # Request-path trim: the stream's gRPC request metadata (where the
+        # tenant id lives) is identical for every item — resolve it ONCE
+        # instead of scanning the metadata tuple per item (BENCH_r05
+        # attribution charges that per-item bookkeeping to rpc overhead).
+        stream_tenant = self._invocation_meta(context, request_qos.TENANT_META_KEY)
         # Backpressure: bound items submitted-but-unsettled so a 100k-item
         # stream cannot buffer every payload in the executor queue at once
         # (the unary path was naturally one-at-a-time; this restores gRPC
@@ -339,7 +365,7 @@ class BaseService(InferenceServicer):
         def run_one(cid: str, asm: _Assembly):
             if stop.is_set():
                 return None
-            return list(self._dispatch(cid, asm, context))
+            return list(self._dispatch(cid, asm, context, tenant=stream_tenant))
 
         def submit(cid: str, asm: _Assembly) -> bool:
             while not window.acquire(timeout=0.1):
@@ -455,15 +481,20 @@ class BaseService(InferenceServicer):
         return cls._invocation_meta(context, request_trace.TRACE_META_KEY)
 
     @classmethod
-    def _qos_identity(cls, asm: _Assembly, context) -> tuple[str, str]:
+    def _qos_identity(
+        cls, asm: _Assembly, context, tenant: str | None = None
+    ) -> tuple[str, str]:
         """Resolve the request's ``(tenant, lane)``. Tenant: the
         ``lumen-tenant`` gRPC request-metadata key, else a ``tenant``
         request-meta field (in-process/stub callers), else ``default``.
         Lane: an explicit ``priority`` meta (``interactive``/``bulk``)
         wins; otherwise the bulk streaming lane auto-tags ``bulk`` and
-        everything else is interactive."""
+        everything else is interactive. ``tenant`` short-circuits the
+        metadata scan when the caller already resolved it (the bulk lane
+        resolves once per STREAM — the metadata is stream-constant)."""
         tenant = (
-            cls._invocation_meta(context, request_qos.TENANT_META_KEY)
+            tenant
+            or cls._invocation_meta(context, request_qos.TENANT_META_KEY)
             or asm.meta.get("tenant")
             or request_qos.DEFAULT_TENANT
         )
@@ -476,7 +507,10 @@ class BaseService(InferenceServicer):
             lane = request_qos.LANE_INTERACTIVE
         return tenant, lane
 
-    def _dispatch(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
+    def _dispatch(
+        self, cid: str, asm: _Assembly, context=None,
+        tenant: str | None = None, reuse: bool = False,
+    ) -> Iterator[pb.InferResponse]:
         """Trace-lifecycle wrapper around :meth:`_dispatch_inner`. With
         tracing off (``LUMEN_TRACE_SAMPLE=0``, the default) the cost is
         one cached env check; with it on, the request gets a contextvar-
@@ -490,12 +524,12 @@ class BaseService(InferenceServicer):
                 asm.task, trace_id=self._trace_id_from(context), t0=asm.t0
             )
         if tr is None:
-            yield from self._dispatch_inner(cid, asm, context)
+            yield from self._dispatch_inner(cid, asm, context, tenant, reuse)
             return
         tr.add_span("rpc.recv", asm.t0, time.perf_counter())
         token = request_trace.activate(tr)
         try:
-            for resp in self._dispatch_inner(cid, asm, context):
+            for resp in self._dispatch_inner(cid, asm, context, tenant, reuse):
                 if resp.HasField("error"):
                     tr.set_error(resp.error.message or "error")
                 yield resp
@@ -508,7 +542,10 @@ class BaseService(InferenceServicer):
             request_trace.deactivate(token)
             request_trace.finish_request(tr)
 
-    def _dispatch_inner(self, cid: str, asm: _Assembly, context=None) -> Iterator[pb.InferResponse]:
+    def _dispatch_inner(
+        self, cid: str, asm: _Assembly, context=None,
+        tenant: str | None = None, reuse: bool = False,
+    ) -> Iterator[pb.InferResponse]:
         task = self.registry.get(asm.task)
         if task is None:
             yield self._error(
@@ -554,7 +591,7 @@ class BaseService(InferenceServicer):
         # admission queue, in O(1) (~10µs, same order as a breaker shed) —
         # with the RESOURCE_EXHAUSTED shape plus a ``lumen-retry-after-ms``
         # hint saying exactly when the next token lands.
-        tenant, lane = self._qos_identity(asm, context)
+        tenant, lane = self._qos_identity(asm, context, tenant)
         admitted, retry_after = request_qos.get_quota().gate(tenant)
         if not admitted:
             err = ResourceExhausted(
@@ -592,6 +629,33 @@ class BaseService(InferenceServicer):
                 f"payload exceeds limit ({len(payload)} > {task.max_payload_bytes} bytes)",
             )
             return
+        # tensor/raw gate: a pre-decoded tensor payload is validated
+        # against the task's ADVERTISED input spec (capability extra
+        # ``tensor_input:<task>``) right here — before the handler, the
+        # cache, the decode pool and the batcher. A mismatch is a client
+        # error with a precise message: it is never cached, never
+        # quarantined, and releases a held half-open probe slot exactly
+        # like the payload-limit gate above.
+        if asm.payload_mime == tensorwire.TENSOR_MIME:
+            if task.tensor_spec is None:
+                self._record_outcome(InvalidArgument("tensor input unsupported"))
+                metrics.count_error(asm.task)
+                yield self._error(
+                    cid,
+                    pb.ERROR_CODE_INVALID_ARGUMENT,
+                    f"task {asm.task!r} does not accept tensor/raw payloads",
+                    "tasks with a tensor_input:* capability key do",
+                )
+                return
+            try:
+                tensorwire.validate_tensor_meta(
+                    asm.meta, len(payload), task.tensor_spec
+                )
+            except ValueError as e:
+                self._record_outcome(InvalidArgument(str(e)))
+                metrics.count_error(asm.task)
+                yield self._error(cid, pb.ERROR_CODE_INVALID_ARGUMENT, str(e))
+                return
         # Deadline propagation (L2 -> L4): expired requests are answered
         # without touching the model, and the remaining budget rides a
         # contextvar so the micro-batcher can drop entries that expire
@@ -665,7 +729,7 @@ class BaseService(InferenceServicer):
                     # consumer-side sends (the generator resumes per chunk).
                     meta[request_trace.TRACE_RESPONSE_META] = tr.trace_id
                     ser = tr.begin("serialize", {"bytes": len(result)})
-                yield from self._chunked_response(cid, result, mime, meta)
+                yield from self._chunked_response(cid, result, mime, meta, reuse)
                 if ser is not None:
                     ser.end()
             else:
@@ -685,13 +749,46 @@ class BaseService(InferenceServicer):
     RESPONSE_CHUNK_BYTES = _response_chunk_bytes()
 
     def _chunked_response(
-        self, cid: str, result: bytes, mime: str, meta: dict[str, str]
+        self, cid: str, result: bytes, mime: str, meta: dict[str, str],
+        reuse: bool = False,
     ) -> Iterator[pb.InferResponse]:
         """One message when the result fits; otherwise seq/total/offset
         chunks with ``is_final`` on the last. meta rides every chunk so a
         client reading only the final message still sees it, and early
-        readers (progress UIs) see it too."""
+        readers (progress UIs) see it too.
+
+        ``reuse=True`` (the ``LUMEN_RPC_TRIM`` request-path trim, set only
+        on the real-gRPC direct lane where each yield is serialized before
+        the next message is built) recycles one thread-local scratch proto
+        instead of allocating per response; on the multi-chunk path the
+        meta map is populated ONCE and only result/seq/offset mutate per
+        chunk."""
         size = self.RESPONSE_CHUNK_BYTES
+        if reuse:
+            resp = getattr(_proto_scratch, "resp", None)
+            if resp is None:
+                resp = _proto_scratch.resp = pb.InferResponse()
+            resp.Clear()
+            resp.correlation_id = cid
+            resp.result_mime = mime
+            for k, v in meta.items():
+                resp.meta[k] = v
+            if len(result) <= size:
+                resp.is_final = True
+                resp.result = result
+                resp.total = 1
+                yield resp
+                return
+            n = (len(result) + size - 1) // size
+            resp.total = n
+            for i in range(n):
+                off = i * size
+                resp.is_final = i == n - 1
+                resp.result = result[off : off + size]
+                resp.seq = i
+                resp.offset = off
+                yield resp
+            return
         if len(result) <= size:
             yield pb.InferResponse(
                 correlation_id=cid,

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..utils.tensorwire import TENSOR_INPUT_EXTRA, TENSOR_MIME, TensorSpec
 from .proto import ml_service_pb2 as pb
 
 PROTOCOL_VERSION = "1.0.0"
@@ -28,13 +29,21 @@ class TaskDefinition:
     output_mime: str = "application/json"
     max_payload_bytes: int = DEFAULT_MAX_PAYLOAD
     metadata: dict[str, str] = field(default_factory=dict)
+    #: pre-decoded tensor input this task accepts on the ``tensor/raw``
+    #: wire path (None = JPEG/bytes only). Advertised in the capability
+    #: ``extra`` map under ``tensor_input:<task>`` and enforced by the
+    #: serving base class BEFORE the handler runs.
+    tensor_spec: TensorSpec | None = None
 
     def to_io_task(self) -> pb.IOTask:
         limits = {"max_payload_bytes": str(self.max_payload_bytes)}
         limits.update(self.metadata)
+        mimes = list(self.input_mimes)
+        if self.tensor_spec is not None and TENSOR_MIME not in mimes:
+            mimes.append(TENSOR_MIME)
         return pb.IOTask(
             name=self.name,
-            input_mimes=list(self.input_mimes),
+            input_mimes=mimes,
             output_mimes=[self.output_mime],
             limits=limits,
         )
@@ -70,13 +79,20 @@ class TaskRegistry:
         precisions: list[str] | None = None,
         extra: dict[str, str] | None = None,
     ) -> pb.Capability:
+        # Tensor input specs ride the extra map (``tensor_input:<task>``):
+        # a fleet-internal caller validates its pre-decoded tensors
+        # against these keys instead of probing with a request.
+        merged = dict(extra or {})
+        for name, task in self._tasks.items():
+            if task.tensor_spec is not None:
+                merged[f"{TENSOR_INPUT_EXTRA}{name}"] = task.tensor_spec.wire()
         return pb.Capability(
             service_name=self.service_name,
             model_ids=model_ids,
             runtime=runtime,
             max_concurrency=max_concurrency,
             precisions=precisions or [],
-            extra=extra or {},
+            extra=merged,
             tasks=[t.to_io_task() for _, t in sorted(self._tasks.items())],
             protocol_version=PROTOCOL_VERSION,
         )

@@ -25,6 +25,7 @@ from ...core.result_schemas import EmbeddingV1, LabelsV1, LabelItem
 from ...models.clip import CLIPManager
 from ...runtime.rknn import require_executable_runtime
 from ...utils.qos import service_extra as qos_service_extra
+from ...utils.tensorwire import TENSOR_MIME, TensorSpec, tensor_from_payload
 from ..base_service import BaseService, InvalidArgument, Unavailable, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -69,10 +70,14 @@ class ClipService(BaseService):
         registry.register(
             TaskDefinition(
                 name=f"{prefix}_image_embed",
-                handler=lambda p, m, meta, _mgr=mgr: self._image_embed(_mgr, p),
+                handler=lambda p, m, meta, _mgr=mgr: self._image_embed(_mgr, p, m, meta),
                 description="image -> unit-norm embedding",
                 input_mimes=IMAGE_MIMES,
                 output_mime=EmbeddingV1.mime(),
+                # tensor/raw wire path: accept the exact pre-decoded
+                # tensor the clip_resize decode spec produces — callers
+                # holding decoded pixels skip JPEG AND the decode pool.
+                tensor_spec=TensorSpec("uint8", mgr.tensor_input_shape()),
             )
         )
         if mgr.dataset_name:
@@ -208,7 +213,21 @@ class ClipService(BaseService):
         vec = mgr.encode_text(text)
         return self._embedding_result(mgr, vec)
 
-    def _image_embed(self, mgr: CLIPManager, payload: bytes):
+    def _image_embed(
+        self, mgr: CLIPManager, payload: bytes, mime: str = "",
+        meta: dict[str, str] | None = None,
+    ):
+        if mime == TENSOR_MIME:
+            # Pre-validated by the base class against this task's
+            # tensor_spec: materialize with one np.frombuffer and go
+            # straight to the batcher — the decode pool is never entered.
+            try:
+                vec = mgr.encode_image_tensor(
+                    tensor_from_payload(payload, meta or {}), raw=payload
+                )
+            except ValueError as e:
+                raise InvalidArgument(f"cannot process tensor: {e}") from e
+            return self._embedding_result(mgr, vec)
         vec = self._encode_image(mgr, payload)
         return self._embedding_result(mgr, vec)
 

@@ -21,12 +21,17 @@ from ...core.result_schemas import FaceItem, FaceV1
 from ...models.face import FaceManager
 from ...runtime.rknn import require_executable_runtime
 from ...utils.qos import service_extra as qos_service_extra
+from ...utils.tensorwire import TENSOR_MIME, TensorSpec, tensor_from_payload
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
 logger = logging.getLogger(__name__)
 
 IMAGE_MIMES = ("image/jpeg", "image/png", "image/webp", "application/octet-stream")
+
+#: tensor/raw input for the detection tasks: any pre-decoded uint8 HWC RGB
+#: image (coordinates come back in the tensor's own frame).
+FACE_TENSOR_SPEC = TensorSpec("uint8", (None, None, 3))
 
 
 class FaceService(BaseService):
@@ -40,6 +45,7 @@ class FaceService(BaseService):
                 description="detect faces: bboxes + landmarks + confidences",
                 input_mimes=IMAGE_MIMES,
                 output_mime=FaceV1.mime(),
+                tensor_spec=FACE_TENSOR_SPEC,
             )
         )
         registry.register(
@@ -58,6 +64,7 @@ class FaceService(BaseService):
                 description="detect all faces and embed each",
                 input_mimes=IMAGE_MIMES,
                 output_mime=FaceV1.mime(),
+                tensor_spec=FACE_TENSOR_SPEC,
             )
         )
         super().__init__(registry)
@@ -145,6 +152,17 @@ class FaceService(BaseService):
         return kw
 
     def _detect(self, payload: bytes, mime: str, meta: dict[str, str]):
+        if mime == TENSOR_MIME:
+            # Base class already validated against FACE_TENSOR_SPEC:
+            # materialize and go straight to letterbox + detector — no
+            # decode pool on this path.
+            pixels = tensor_from_payload(payload, meta)
+            faces = self._call(
+                lambda: self.manager.detect_faces_tensor(
+                    pixels, raw=payload, **self._det_kwargs(meta)
+                )
+            )
+            return self._result(faces)
         faces = self._call(lambda: self.manager.detect_faces(payload, **self._det_kwargs(meta)))
         return self._result(faces)
 
@@ -170,6 +188,14 @@ class FaceService(BaseService):
         return self._result_items([face])
 
     def _detect_and_embed(self, payload: bytes, mime: str, meta: dict[str, str]):
+        if mime == TENSOR_MIME:
+            pixels = tensor_from_payload(payload, meta)
+            faces = self._call(
+                lambda: self.manager.detect_and_extract_tensor(
+                    pixels, raw=payload, **self._det_kwargs(meta)
+                )
+            )
+            return self._result(faces)
         faces = self._call(
             lambda: self.manager.detect_and_extract(payload, **self._det_kwargs(meta))
         )

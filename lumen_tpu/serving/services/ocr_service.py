@@ -15,6 +15,7 @@ from ...core.result_schemas import OcrItem, OCRV1
 from ...models.ocr import OcrManager
 from ...runtime.rknn import require_executable_runtime
 from ...utils.qos import service_extra as qos_service_extra
+from ...utils.tensorwire import TENSOR_MIME, TensorSpec, tensor_from_payload
 from ..base_service import BaseService, InvalidArgument, first_meta_key
 from ..registry import TaskDefinition, TaskRegistry
 
@@ -34,6 +35,8 @@ class OcrService(BaseService):
                 description="detect and recognize text: boxes + strings + confidences",
                 input_mimes=IMAGE_MIMES,
                 output_mime=OCRV1.mime(),
+                # tensor/raw wire path: any pre-decoded uint8 HWC RGB page.
+                tensor_spec=TensorSpec("uint8", (None, None, 3)),
             )
         )
         super().__init__(registry)
@@ -119,7 +122,14 @@ class OcrService(BaseService):
                     f"meta {cls_key!r} must be a boolean (got {meta[cls_key]!r})"
                 )
         try:
-            results = self.manager.predict(payload, **kw)
+            if mime == TENSOR_MIME:
+                # Pre-validated tensor payload: full pipeline with zero
+                # decode-pool hops.
+                results = self.manager.predict_tensor(
+                    tensor_from_payload(payload, meta), raw=payload, **kw
+                )
+            else:
+                results = self.manager.predict(payload, **kw)
         except ValueError as e:
             raise InvalidArgument(f"cannot process image: {e}") from e
         items = [

@@ -77,6 +77,14 @@ for _k in [k for k in os.environ if k.startswith("LUMEN_SLO_")] + [
 ]:
     os.environ.pop(_k, None)
 
+# Decode pool: THREAD mode for the suite (LUMEN_DECODE_PROCS=0). On a
+# multi-core CI host the auto default would switch the shared pool to
+# process mode — correct, but every first decode would pay worker spawns
+# and the suite's timing-sensitive tests (batch windows, overhead guards)
+# would absorb that noise. Process-mode tests build their own pools with
+# an explicit ``procs=`` (tests/test_host_lane.py).
+os.environ["LUMEN_DECODE_PROCS"] = "0"
+
 # Circuit breakers: OFF for the suite (LUMEN_BREAKER_FAILURES=0). Several
 # tests drive deliberate failure bursts through serve()-built services; a
 # default-on breaker would flip their expected error codes to UNAVAILABLE
