@@ -3445,6 +3445,275 @@ def phase_qos() -> dict:
     return out
 
 
+def phase_autopilot() -> dict:
+    """Closed-loop autopilot chaos proof (ISSUE 14 acceptance; CPU-safe,
+    no model, real clock).
+
+    - **traffic shift**: two fake model families share a 3-chip ledger
+      (A: 2x1-chip replicas hot, B: 1 active + 1 parked). Traffic shifts
+      from A to B at 1.5x one replica's capacity; the autopilot must
+      converge to the new allocation (A=1, B=2 — A's park frees the chip
+      B claims) within the controller-window budget with ZERO SLO
+      breaches, while the **do-nothing counterfactual** (same shifted
+      load, B pinned at 1 replica) breaches from queue growth.
+    - **brownout from SLO burn**: a sustained overload (every request
+      over the objective) descends the ladder rung by rung — bulk
+      admissions shed — and a recovered burn ascends cleanly back to 0.
+    - **surfaces**: every actuation appears in the flight recorder
+      (typed ``autopilot_*`` events carrying sensor readings) and on
+      ``GET /autopilot`` from a real sidecar.
+    """
+    from lumen_tpu.utils import telemetry as tele
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("LUMEN_TELEMETRY_BUCKET_S", "LUMEN_SLO_AP_TASK_P95_MS")
+    }
+    os.environ["LUMEN_TELEMETRY_BUCKET_S"] = "1"  # sense windows of seconds
+    os.environ["LUMEN_SLO_AP_TASK_P95_MS"] = str(_AP_OBJECTIVE_MS)
+    tele.reset_hub()
+    try:
+        return _autopilot_impl()
+    finally:
+        # Restore on EVERY exit (a failed assertion mid-phase must not
+        # leak 1s buckets + a phantom SLO objective into later phases).
+        for key, prev in saved.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        tele.reset_hub()
+
+
+_AP_OBJECTIVE_MS = 2000.0
+
+
+def _autopilot_impl() -> dict:
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from lumen_tpu.runtime import autopilot as ap_mod
+    from lumen_tpu.runtime.autopilot import Autopilot
+    from lumen_tpu.runtime.batcher import MicroBatcher
+    from lumen_tpu.runtime.fleet import ReplicaSet
+    from lumen_tpu.serving.observability import MetricsServer
+    from lumen_tpu.utils import telemetry as tele
+    from lumen_tpu.utils.metrics import metrics
+    from lumen_tpu.utils.qos import LANE_BULK, WFQAdmissionQueue, qos_context
+
+    DEVICE_MS = 20.0     # fake per-batch device budget
+    MAX_BATCH = 4        # one replica serves ~MAX_BATCH/DEVICE_MS = 200/s
+    RATE = 300.0         # offered load: 1.5x one replica, 0.75x two
+    OBJECTIVE_MS = _AP_OBJECTIVE_MS
+    TASK = "ap_task"
+
+    def device_fn(tree, n):
+        time.sleep(DEVICE_MS / 1e3)
+        return tree
+
+    def build_family(name: str) -> ReplicaSet:
+        def build(rid, mesh):  # noqa: ARG001 - fake slice, no mesh
+            return MicroBatcher(
+                device_fn, max_batch=MAX_BATCH, max_latency_ms=2,
+                max_queue=4096, name=f"{name}-r{rid}",
+            ).start()
+
+        return ReplicaSet(
+            name, build, meshes=[None, None], policy="round_robin",
+            devices_per_replica=1,
+        )
+
+    def drive(rs: ReplicaSet, rate: float, duration_s: float) -> dict:
+        """Open-loop pacing at ``rate`` items/s: unlike a closed loop this
+        can genuinely overload a family, which is the whole point."""
+        lats: list[float] = []
+        lock = threading.Lock()
+        futs = []
+        sheds = 0
+        interval = 1.0 / rate
+        t_end = time.perf_counter() + duration_s
+        next_t = time.perf_counter()
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.002))
+                continue
+            next_t += interval
+            try:
+                fut = rs.submit(np.zeros(8, dtype=np.float32))
+            except Exception:  # noqa: BLE001 - sheds counted, pressure kept
+                sheds += 1
+                continue
+            t0 = now
+
+            def _done(f, t0=t0):
+                if f.cancelled() or f.exception() is not None:
+                    return
+                ms = (time.perf_counter() - t0) * 1e3
+                metrics.observe(TASK, ms)
+                with lock:
+                    lats.append(ms)
+
+            fut.add_done_callback(_done)
+            futs.append(fut)
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except Exception:  # noqa: BLE001 - drain errors are not the story
+                pass
+        lat = sorted(lats)
+        return {
+            "n": len(lat),
+            "sheds": sheds,
+            "p50_ms": round(_percentile(lat, 0.50), 1),
+            "p95_ms": round(_percentile(lat, 0.95), 1),
+        }
+
+    out: dict = {}
+
+    # -- traffic shift with the autopilot closing the loop ----------------
+    _state("autopilot:shift")
+    fam_a = build_family("ap-fam-a")
+    fam_b = build_family("ap-fam-b")
+    fam_b.park()  # boot allocation: A=2, B=1 (+1 parked); ledger latches 3
+    pilot = Autopilot(
+        tick_s=0.25, cooldown_s=0.5, sense_s=3.0, rate_per_min=240,
+        fleets=lambda: [fam_a, fam_b], batchers=lambda: [],
+        queues=lambda: [],
+    )
+    ap_mod.install_autopilot(pilot)
+    sidecar = MetricsServer(port=0)
+    sidecar_port = sidecar.start()
+    breaches_before = metrics.counter_value("slo_breaches")
+    try:
+        pilot.start()
+        warm = drive(fam_a, RATE, 2.0)  # A hot on 2 replicas: no actuation
+        assert fam_a.active_count() == 2, "warm phase must not scale A down"
+        # THE SHIFT: A goes silent, B takes 1.5x one replica's capacity.
+        shift_t0 = time.perf_counter()
+        converged: list[float] = []
+
+        def watch_convergence():
+            while time.perf_counter() - shift_t0 < 10.0:
+                if fam_a.active_count() == 1 and fam_b.active_count() == 2:
+                    converged.append(time.perf_counter() - shift_t0)
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=watch_convergence, daemon=True)
+        watcher.start()
+        shifted = drive(fam_b, RATE, 8.0)
+        watcher.join(timeout=5)
+        pilot.stop()
+        assert converged, (
+            f"no convergence: A={fam_a.active_count()} B={fam_b.active_count()}"
+        )
+        convergence_s = converged[0]
+        windows = convergence_s / pilot.tick_s
+        assert convergence_s <= 6.0, f"converged in {convergence_s:.1f}s (>6s)"
+        slo = tele.slo_status()
+        assert slo.get(TASK, {}).get("state") == "ok", slo
+        assert metrics.counter_value("slo_breaches") == breaches_before, (
+            "autopilot run must not breach the SLO"
+        )
+        decisions = pilot.status()["decisions"]
+        scale_acts = [d for d in decisions if d["loop"] == "scale"]
+        assert any(d["action"].startswith("park") for d in scale_acts)
+        assert any(d["action"].startswith("unpark") for d in scale_acts)
+        assert all(d["sensors"] for d in decisions), "decisions must carry sensors"
+        # Flight recorder + /autopilot carry every actuation.
+        events = [
+            e for e in tele.export_events()["events"]
+            if e["kind"].startswith("autopilot_")
+        ]
+        assert len(events) >= len(decisions)
+        assert all("sensors" in e for e in events)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{sidecar_port}/autopilot", timeout=10
+        ) as resp:
+            http_view = json.loads(resp.read().decode())
+        assert len(http_view["decisions"]) == len(decisions)
+        assert http_view["chips"]["capacity"] == 3
+        out["shift"] = {
+            "warm": warm,
+            "shifted": shifted,
+            "convergence_s": round(convergence_s, 2),
+            "controller_windows": round(windows, 1),
+            "allocation": {"a": fam_a.active_count(), "b": fam_b.active_count()},
+            "scale_actuations": len(scale_acts),
+            "slo_state": slo.get(TASK, {}).get("state"),
+        }
+    finally:
+        sidecar.stop()
+        ap_mod.install_autopilot(None)
+        pilot.stop()
+        fam_a.close()
+        fam_b.close()
+
+    # -- do-nothing counterfactual: same shift, no controller -------------
+    _state("autopilot:counterfactual")
+    tele.reset_hub()  # fresh burn windows; the objective env is still set
+    cf_b = build_family("ap-cf-b")
+    cf_b.park()  # pinned at 1 replica: nobody reallocates the chip back
+    try:
+        cf = drive(cf_b, RATE, 8.0)
+        cf_slo = tele.slo_status()
+        assert cf_slo.get(TASK, {}).get("state") == "breach", (
+            f"counterfactual must breach: {cf_slo}"
+        )
+        assert cf["p95_ms"] > OBJECTIVE_MS
+        out["counterfactual"] = {
+            **cf, "slo_state": cf_slo.get(TASK, {}).get("state"),
+            "burn_5m": cf_slo.get(TASK, {}).get("burn_5m"),
+        }
+    finally:
+        cf_b.close()
+
+    # -- brownout: descend on sustained burn, ascend on recovery ----------
+    _state("autopilot:brownout")
+    tele.reset_hub()
+    q = WFQAdmissionQueue(name="ap-brownout", max_queue=100)
+    pilot2 = Autopilot(
+        tick_s=0.25, cooldown_s=0.0, rate_per_min=240,
+        fleets=lambda: [], batchers=lambda: [], queues=lambda: [q],
+    )
+    rungs = [q.effective_rung()]
+    for _ in range(60):  # sustained overload: everything over the objective
+        metrics.observe(TASK, OBJECTIVE_MS * 4)
+    pilot2.tick()
+    rungs.append(q.effective_rung())
+    pilot2.tick()
+    rungs.append(q.effective_rung())
+    assert rungs == [0, 1, 2], rungs
+    shed = 0
+    try:
+        with qos_context("t", LANE_BULK):
+            q.put(("x", None, None, None))
+    except Exception:  # noqa: BLE001 - the expected brownout shed
+        shed = 1
+    assert shed == 1, "rung 2 must shed bulk admissions"
+    for _ in range(4000):  # recovery: burn falls under the ascend threshold
+        metrics.observe(TASK, 5.0)
+    pilot2.tick()
+    rungs.append(q.effective_rung())
+    pilot2.tick()
+    rungs.append(q.effective_rung())
+    assert rungs == [0, 1, 2, 1, 0], rungs
+    with qos_context("t", LANE_BULK):
+        q.put(("x", None, None, None))  # bulk admits again
+    brown_acts = [d for d in pilot2.status()["decisions"]]
+    assert len(brown_acts) == 4 and all(d["loop"] == "brownout" for d in brown_acts)
+    out["brownout"] = {
+        "rung_sequence": rungs,
+        "actuations": len(brown_acts),
+    }
+
+    out["platform"] = "host"  # the controller is host-side policy: no device
+    return out
+
+
 def phase_capacity() -> dict:
     """Capacity-telemetry acceptance (ISSUE 10): under a c10 gRPC CLIP
     load, ``GET /stats?window=30`` on a real sidecar must report device
@@ -3935,6 +4204,7 @@ PHASES = {
     "baseline_vlm": phase_baseline_vlm,
     "chaos": phase_chaos,
     "qos": phase_qos,
+    "autopilot": phase_autopilot,
     "tpu_tests": phase_tpu_tests,
 }
 

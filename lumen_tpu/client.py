@@ -11,6 +11,7 @@ Usage (server from `python -m lumen_tpu.serving.server --config ...`):
     python examples/client.py topology
     python examples/client.py health
     python examples/client.py stats --metrics-addr 127.0.0.1:9100 --window 60
+    python examples/client.py autopilot --metrics-addr 127.0.0.1:9100
     python examples/client.py embed-text "a photo of a cat"
     python examples/client.py embed-image photo.jpg
     python examples/client.py classify photo.jpg --top-k 5
@@ -141,6 +142,74 @@ def _print_stats(stats: dict) -> None:
             )
     else:
         print("slo: no objectives configured (set LUMEN_SLO_<TASK>_P95_MS)")
+
+
+def get_autopilot(metrics_addr: str, timeout: float = 10.0) -> dict:
+    """Fetch the capacity controller's state from the observability
+    sidecar (``GET /autopilot``): per-loop enable flags + latest sensor
+    readings, the chip ledger, and the recent actuation decisions with
+    the sensor readings that justified them."""
+    import urllib.request
+
+    base = metrics_addr if "://" in metrics_addr else f"http://{metrics_addr}"
+    with urllib.request.urlopen(f"{base.rstrip('/')}/autopilot", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _print_autopilot(out: dict) -> None:
+    """Operator view of the autopilot: policy header, one line per loop,
+    then the decision tail (newest last)."""
+    state = "running" if out.get("running") else (
+        "enabled (not running)" if out.get("enabled") else "OFF"
+    )
+    print(f"autopilot: {state}")
+    if not out.get("enabled") and not out.get("running"):
+        print("  set LUMEN_AUTOPILOT=1 on the server to close the loops")
+    if out.get("running"):
+        print(
+            f"  tick={out.get('tick_s', 0)}s cooldown={out.get('cooldown_s', 0)}s "
+            f"sense={out.get('sense_window_s', 0)}s "
+            f"rate<={out.get('rate_limit_per_min', 0)}/min "
+            f"ticks={out.get('ticks', 0)} actuations={out.get('actuations', 0)}"
+        )
+    chips = out.get("chips") or {}
+    if chips.get("capacity") is not None:
+        print(
+            f"  chip ledger: {chips.get('claimed', '?')} claimed "
+            f"of {chips['capacity']}"
+        )
+    loops = out.get("loops") or {}
+    for name, loop in loops.items():
+        flag = "on" if loop.get("enabled") else "off (manual override)"
+        detail = ""
+        if name == "scale":
+            fams = loop.get("families") or {}
+            parts = [
+                f"{fam}: duty={r.get('duty')} active={r.get('active')}"
+                f"+{r.get('parked', 0)} parked"
+                for fam, r in sorted(fams.items())
+            ]
+            detail = "; ".join(parts)
+        elif name == "brownout":
+            s = loop.get("sensors") or {}
+            detail = f"rung={loop.get('rung', 0)} burn_5m={s.get('burn_5m')}"
+        elif name == "window":
+            caps = loop.get("batchers") or {}
+            detail = "; ".join(
+                f"{b}: waste={r.get('waste_pct')}% cap={r.get('cap_ms')}ms"
+                for b, r in sorted(caps.items())
+            )
+        print(f"  loop {name}: {flag}" + (f" — {detail}" if detail else ""))
+    decisions = out.get("decisions") or []
+    if decisions:
+        print(f"decisions (last {len(decisions)}):")
+        for d in decisions:
+            print(
+                f"  [{d.get('loop')}] {d.get('component')}: {d.get('action')} "
+                f"— {d.get('reason')}"
+            )
+    else:
+        print("decisions: none recorded")
 
 
 def _with_tenant(md, tenant: str | None):
@@ -460,6 +529,18 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--window", type=float, default=60.0, help="window seconds")
     p.add_argument("--json", action="store_true", help="raw JSON instead of the summary")
+    p = sub.add_parser(
+        "autopilot",
+        help="capacity-controller state from the observability sidecar "
+        "(per-loop flags + sensors, chip ledger, recent actuation "
+        "decisions with their justifying readings)",
+    )
+    p.add_argument(
+        "--metrics-addr",
+        default="127.0.0.1:9100",
+        help="host:port (or URL) of the server's --metrics-port sidecar",
+    )
+    p.add_argument("--json", action="store_true", help="raw JSON instead of the summary")
     p = sub.add_parser("embed-text"); p.add_argument("text")
     p = sub.add_parser("embed-image"); p.add_argument("image")
     p = sub.add_parser("classify"); p.add_argument("image"); p.add_argument("--top-k", type=int, default=5); p.add_argument("--scene", action="store_true")
@@ -480,6 +561,14 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(stats, indent=2))
         else:
             _print_stats(stats)
+        return 0
+    if args.cmd == "autopilot":
+        # Sidecar HTTP like stats: the controller's state and decision ring.
+        out = get_autopilot(args.metrics_addr)
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            _print_autopilot(out)
         return 0
 
     from lumen_tpu.utils.retry import retry_call

@@ -5,13 +5,14 @@ backends (`SURVEY.md` §2 "native compute" note).
 """
 
 from .compile_cache import enable_persistent_cache
-from .batcher import MicroBatcher, bucket_for, default_buckets
+from .batcher import MicroBatcher, bucket_for, default_buckets, live_batchers
 from .decode_pool import DecodePool, get_decode_pool, shutdown_decode_pool
 from .fleet import (
     FleetPlan,
     ReplicaSet,
     build_fleet,
     each_batcher,
+    live_fleets,
     plan_replicas,
     register_policy,
     replicas_for,
@@ -45,6 +46,8 @@ __all__ = [
     "MicroBatcher",
     "bucket_for",
     "default_buckets",
+    "live_batchers",
+    "live_fleets",
     "DecodePool",
     "get_decode_pool",
     "shutdown_decode_pool",

@@ -523,6 +523,31 @@ class _Inflight:
         self.t_dispatch = t_dispatch
 
 
+#: live batchers by name (weakrefs — the registry must not pin a dropped
+#: batcher): the autopilot's window loop and any future controller read
+#: the process's batcher population from here, the same idiom as
+#: ``utils/qos.py``'s WFQ-queue registry.
+_batcher_registry: dict[str, "weakref.ref[MicroBatcher]"] = {}
+_batcher_reg_lock = threading.Lock()
+
+
+def live_batchers() -> list["MicroBatcher"]:
+    """Every started, not-yet-closed MicroBatcher in the process (dead
+    refs are pruned on the way out)."""
+    with _batcher_reg_lock:
+        items = list(_batcher_registry.items())
+    out: list[MicroBatcher] = []
+    for name, ref in items:
+        b = ref()
+        if b is None:
+            with _batcher_reg_lock:
+                if _batcher_registry.get(name) is ref:
+                    del _batcher_registry[name]
+        elif not b._closed.is_set():
+            out.append(b)
+    return out
+
+
 class MicroBatcher:
     """Batch single-item pytrees through a batched function.
 
@@ -587,6 +612,11 @@ class MicroBatcher:
         self.adaptive = batch_adaptive() if adaptive is None else adaptive
         cap_ms = batch_window_ms() if window_ms is None else max(0.0, window_ms)
         self.window_cap_s = (cap_ms / 1e3) if cap_ms is not None else self.max_latency_s
+        # The configured cap, remembered: the autopilot's window loop
+        # retunes window_cap_s around this anchor and returns to it when
+        # padding waste clears (never drifting from an already-drifted
+        # value).
+        self.base_window_cap_s = self.window_cap_s
         self._clock = clock
         self._window = AdaptiveWindow(
             max_batch, self.window_cap_s, self.max_latency_s, clock=clock
@@ -681,6 +711,11 @@ class MicroBatcher:
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"batcher:{self.name}", _gauges)
+        # Controller registry (last-writer-wins per name, like the gauge
+        # providers): a revive's fresh same-name batcher supersedes the
+        # wedge it replaces.
+        with _batcher_reg_lock:
+            _batcher_registry[self.name] = ref
         # Duty meter for this batcher's device stream: capacity 1 in
         # union mode (dispatch->settle envelopes overlap under
         # pipelining; settle order == dispatch order, so union-clamping
@@ -758,6 +793,12 @@ class MicroBatcher:
             metrics.unregister_gauges(f"batch-occupancy:{self.name}", fn)
         if fn := getattr(self, "_qos_gauge_fn", None):
             metrics.unregister_gauges(f"qos:{self.name}", fn)
+        # Same ownership guard for the controller registry: only drop the
+        # entry if it still points at THIS instance.
+        with _batcher_reg_lock:
+            ref = _batcher_registry.get(self.name)
+            if ref is not None and ref() is self:
+                del _batcher_registry[self.name]
 
     # -- client side ------------------------------------------------------
 
@@ -893,6 +934,23 @@ class MicroBatcher:
         with self._inflight_cv:
             inflight = sum(e.n for e in self._inflight)
         return self._queue.qsize() + inflight
+
+    def drain_estimate_s(self) -> float | None:
+        """Seconds the CURRENT backlog needs to clear at the measured
+        service rate (None before any batch settled) — the queue-drain
+        sensor the autopilot's scale loop reads, the same estimate the
+        ``QueueFull`` retry hint is built from."""
+        return self._drain.estimate_s(self.load())
+
+    def set_window_cap_s(self, cap_s: float) -> float:
+        """Retarget the adaptive window's cap (the autopilot's batch-window
+        actuator). Floored at 0; returns the applied value. Takes effect on
+        the collector's next ``window_s`` read — no lock needed, a float
+        store is atomic and the controller tick is the only writer."""
+        cap = max(0.0, float(cap_s))
+        self.window_cap_s = cap
+        self._window.cap_s = cap
+        return cap
 
     # -- collector thread -------------------------------------------------
 

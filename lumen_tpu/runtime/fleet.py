@@ -68,11 +68,16 @@ FAILURES_ENV = "LUMEN_REPLICA_FAILURES"
 REVIVE_ENV = "LUMEN_REPLICA_REVIVE_S"
 
 #: replica health states (surface in ``Health`` trailing metadata and the
-#: ``replica:{name}`` gauge set as the numeric codes below).
+#: ``replica:{name}`` gauge set as the numeric codes below). PARKED is
+#: voluntary idleness — the autopilot's scale-down released the replica's
+#: mesh slice (batcher closed, chips free for a hot sibling family);
+#: unlike DOWN it is healthy, never auto-revived, and only a scale-up
+#: (or an operator's :meth:`ReplicaSet.unpark`) brings it back.
 SERVING = "serving"
 REVIVING = "reviving"
 DOWN = "down"
-_STATE_CODES = {SERVING: 0, REVIVING: 1, DOWN: 2}
+PARKED = "parked"
+_STATE_CODES = {SERVING: 0, REVIVING: 1, DOWN: 2, PARKED: 3}
 
 
 # -- knobs -------------------------------------------------------------------
@@ -295,10 +300,35 @@ def build_fleet(plan: FleetPlan, name: str, build: Callable[[int | None, Any], M
     call again for a single replica long after initialization."""
     if plan.replicas <= 1:
         return build(None, plan.meshes[0])
-    return ReplicaSet(name, build, plan.meshes, policy=plan.policy)
+    return ReplicaSet(
+        name, build, plan.meshes, policy=plan.policy,
+        devices_per_replica=plan.devices_per_replica,
+    )
 
 
 # -- the replica set ---------------------------------------------------------
+
+#: live ReplicaSets by name (weakrefs, last-writer-wins): the autopilot's
+#: scale loop discovers the process's fleets here — same idiom as the WFQ
+#: queue registry in ``utils/qos.py`` and the batcher registry.
+_fleet_registry: dict[str, "weakref.ref[ReplicaSet]"] = {}
+_fleet_reg_lock = threading.Lock()
+
+
+def live_fleets() -> list["ReplicaSet"]:
+    """Every live (not-yet-closed) ReplicaSet in the process."""
+    with _fleet_reg_lock:
+        items = list(_fleet_registry.items())
+    out: list[ReplicaSet] = []
+    for name, ref in items:
+        fs = ref()
+        if fs is None:
+            with _fleet_reg_lock:
+                if _fleet_registry.get(name) is ref:
+                    del _fleet_registry[name]
+        elif not fs._closed:
+            out.append(fs)
+    return out
 
 
 @dataclass
@@ -353,6 +383,7 @@ class ReplicaSet:
         failures: int | None = None,
         revive_s: float | None = None,
         clock: Callable[[], float] = time.monotonic,
+        devices_per_replica: int = 1,
     ):
         if not meshes:
             raise ValueError("ReplicaSet needs at least one mesh/slot")
@@ -361,6 +392,9 @@ class ReplicaSet:
         self.policy = policy if policy is not None and not isinstance(policy, str) else make_policy(policy)
         self.failures = replica_failures() if failures is None else max(0, failures)
         self.revive_s = replica_revive_s() if revive_s is None else max(0.0, revive_s)
+        #: chips one replica's mesh slice claims — the unit the autopilot's
+        #: chip ledger accounts scale decisions in.
+        self.devices_per_replica = max(1, devices_per_replica)
         self._clock = clock
         self._lock = threading.Lock()
         self._closed = False
@@ -376,7 +410,8 @@ class ReplicaSet:
             with s._lock:
                 out: dict = {
                     "replicas": len(s.replicas),
-                    "down": sum(1 for r in s.replicas if r.state != SERVING),
+                    "down": sum(1 for r in s.replicas if r.state in (DOWN, REVIVING)),
+                    "parked": sum(1 for r in s.replicas if r.state == PARKED),
                 }
                 snap = list(s.replicas)
             for r in snap:
@@ -388,6 +423,8 @@ class ReplicaSet:
 
         self._gauge_fn = _gauges
         metrics.register_gauges(f"replica:{name}", _gauges)
+        with _fleet_reg_lock:
+            _fleet_registry[name] = ref
 
     # -- dispatch ---------------------------------------------------------
 
@@ -561,10 +598,13 @@ class ReplicaSet:
             for r in self._due():
                 self.revive(r.rid)
             with self._lock:
-                if self._closed or all(r.state == SERVING for r in self.replicas):
-                    # Retire; clear the slot under the lock BEFORE exiting
-                    # so _ensure_revive_thread never races a thread that
-                    # decided to exit but still reports is_alive().
+                # Retire when nothing is DOWN (a PARKED replica is
+                # voluntary idleness, never revived — it must not keep
+                # this thread polling forever); clear the slot under the
+                # lock BEFORE exiting so _ensure_revive_thread never races
+                # a thread that decided to exit but still reports
+                # is_alive().
+                if self._closed or all(r.state != DOWN for r in self.replicas):
                     self._revive_thread = None
                     return
 
@@ -621,6 +661,113 @@ class ReplicaSet:
                 logger.exception("%s: closing dead replica %s failed", self.name, r.tag)
         return True
 
+    # -- scale actuation (park / unpark) ----------------------------------
+
+    def active_count(self) -> int:
+        """Replicas currently SERVING (the chip-claim unit count)."""
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == SERVING)
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == PARKED)
+
+    def park(self, rid: int | None = None) -> int | None:
+        """Release one SERVING replica's mesh slice: close its batcher and
+        mark it PARKED (skipped by dispatch, exempt from auto-revival).
+        ``rid`` None parks the highest-rid serving replica — deterministic,
+        and the inverse of :meth:`unpark`'s lowest-parked-first. Refuses to
+        park the LAST serving replica (cold families keep a floor of 1 —
+        an empty fleet would turn every request into a watchdog error).
+        Returns the parked rid, or None when nothing was parked."""
+        with self._lock:
+            if self._closed:
+                return None
+            serving = [r for r in self.replicas if r.state == SERVING]
+            if len(serving) <= 1:
+                return None
+            if rid is None:
+                r = serving[-1]
+            else:
+                r = self.replicas[rid]
+                if r.state != SERVING:
+                    return None
+            old, r.batcher = r.batcher, None
+            r.state = PARKED
+            r.streak = 0
+            r.down_since = None
+            r.error = None
+        metrics.count("replica_parked")
+        metrics.count(f"replica_parked:{self.name}")
+        telemetry.record_event(
+            "replica_park", f"{self.name}/{r.tag}",
+            f"replica parked: {self.devices_per_replica} chip slice(s) "
+            "released; siblings keep serving",
+        )
+        logger.info("%s: replica %s PARKED (scale-down)", self.name, r.tag)
+        if old is not None:
+            try:
+                # close() drains the queue (queued entries settle loudly)
+                # and retires the collector/fetch threads — the slice's
+                # compiled programs go with it; an unpark recompiles or
+                # hits the persistent compile cache.
+                old.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                logger.exception("%s: closing parked replica %s failed", self.name, r.tag)
+        return r.rid
+
+    def unpark(self, rid: int | None = None) -> int | None:
+        """Claim a slice back: rebuild one PARKED replica's batcher through
+        the factory (the same ``build(rid, mesh)`` hook revival uses) and
+        return it to dispatch. ``rid`` None unparks the lowest-rid parked
+        replica. Returns the unparked rid, or None (nothing parked, closed,
+        or the rebuild failed — the replica stays parked; unlike a DOWN
+        replica there is no cooldown to re-arm, the next scale-up retries)."""
+        with self._lock:
+            if self._closed:
+                return None
+            parked = [r for r in self.replicas if r.state == PARKED]
+            if not parked:
+                return None
+            if rid is None:
+                r = parked[0]
+            else:
+                r = self.replicas[rid]
+                if r.state != PARKED:
+                    return None
+            r.state = REVIVING
+        try:
+            fresh = self.build(r.rid, r.mesh)
+        except Exception as e:  # noqa: BLE001 - rebuild failure keeps it parked
+            with self._lock:
+                r.state = PARKED
+                r.error = f"unpark failed: {type(e).__name__}: {e}"
+            metrics.count("replica_revive_failures")
+            metrics.count(f"replica_revive_failures:{self.name}")
+            logger.exception("%s: unpark of %s failed", self.name, r.tag)
+            return None
+        closed_late = False
+        with self._lock:
+            if self._closed:
+                closed_late = True
+            else:
+                r.batcher = fresh
+                r.state = SERVING
+                r.streak = 0
+                r.error = None
+        if closed_late:
+            fresh.close()
+            return None
+        metrics.count("replica_unparked")
+        metrics.count(f"replica_unparked:{self.name}")
+        telemetry.record_event(
+            "replica_unpark", f"{self.name}/{r.tag}",
+            f"parked replica rebuilt: {self.devices_per_replica} chip "
+            "slice(s) claimed",
+        )
+        logger.info("%s: replica %s unparked (scale-up)", self.name, r.tag)
+        return r.rid
+
     # -- telemetry / lifecycle --------------------------------------------
 
     def states(self) -> dict[str, str]:
@@ -661,6 +808,10 @@ class ReplicaSet:
                 except Exception:  # noqa: BLE001 - best-effort teardown
                     logger.exception("%s: closing replica %s failed", self.name, r.tag)
         metrics.unregister_gauges(f"replica:{self.name}", self._gauge_fn)
+        with _fleet_reg_lock:
+            ref = _fleet_registry.get(self.name)
+            if ref is not None and ref() is self:
+                del _fleet_registry[self.name]
 
 
 # -- capability surface ------------------------------------------------------

@@ -30,6 +30,7 @@ from ..utils.qos import (  # noqa: F401 - re-exported runtime surface
     current_tenant,
     deactivate,
     get_quota,
+    live_queues,
     qos_context,
     reset_quota,
     retry_after_ms,
@@ -54,6 +55,7 @@ __all__ = [
     "current_tenant",
     "deactivate",
     "get_quota",
+    "live_queues",
     "qos_context",
     "reset_quota",
     "retry_after_ms",

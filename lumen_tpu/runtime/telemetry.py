@@ -23,6 +23,7 @@ from ..utils.telemetry import (  # noqa: F401 - re-exported runtime surface
     capacity_stats,
     count,
     count_error,
+    duty_fraction,
     enabled,
     export_events,
     export_incidents,
@@ -35,6 +36,7 @@ from ..utils.telemetry import (  # noqa: F401 - re-exported runtime surface
     slo_report,
     slo_status,
     telemetry_enabled,
+    window_total,
 )
 
 __all__ = [
@@ -44,6 +46,7 @@ __all__ = [
     "capacity_stats",
     "count",
     "count_error",
+    "duty_fraction",
     "enabled",
     "export_events",
     "export_incidents",
@@ -56,4 +59,5 @@ __all__ = [
     "slo_report",
     "slo_status",
     "telemetry_enabled",
+    "window_total",
 ]

@@ -10,6 +10,9 @@ A stdlib ``http.server`` thread exposing:
   transfer bytes, XLA compile activity, HBM occupancy/headroom and the
   SLO summary (see ``utils/telemetry.py``),
 - ``GET  /slo``            — SLO objectives + multi-window burn state,
+- ``GET  /autopilot``      — the capacity controller's state: per-loop
+  enable flags + latest sensor readings, the chip ledger, and the last N
+  actuation decisions with the readings that justified them,
 - ``GET  /events?n=K``     — the incident flight recorder's event ring,
 - ``GET  /incidents``      — captured incident bundles (breaker-open /
   replica-down / SLO-breach context dumps),
@@ -36,6 +39,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -122,6 +126,25 @@ class MetricsServer:
                     self._send(200, json.dumps(telemetry.capacity_stats(window)))
                 elif path == "/slo":
                     self._send(200, json.dumps(telemetry.slo_report()))
+                elif path == "/autopilot":
+                    # Same no-jax rule as the router's Health probe: read
+                    # the controller only when its module is already
+                    # loaded; a jax-free sidecar answers "off" honestly.
+                    mod = sys.modules.get("lumen_tpu.runtime.autopilot")
+                    if mod is None:
+                        body = {
+                            "enabled": False, "running": False,
+                            "loops": {}, "decisions": [],
+                            "detail": "autopilot module not loaded in this process",
+                        }
+                    else:
+                        try:
+                            body = mod.export_status()
+                        except Exception as e:  # noqa: BLE001 - report, don't 500
+                            body = {"enabled": False, "running": False,
+                                    "loops": {}, "decisions": [],
+                                    "error": str(e)}
+                    self._send(200, json.dumps(body))
                 elif path == "/events":
                     q = parse_qs(parsed.query)
                     try:

@@ -58,7 +58,39 @@ class HubRouter(InferenceServicer):
         self.services = dict(services)
         self._lock = threading.Lock()
         self._route_table: dict[str, BaseService] = {}
+        # Graceful-drain gate: once set, new Infer streams answer
+        # UNAVAILABLE with a retry-after hint while queued/in-flight work
+        # completes (see ServerHandle.drain_and_stop). _active_streams
+        # counts forwarded Infer streams so the drain knows when the last
+        # one finished — gRPC itself does not expose this.
+        self._draining = False
+        self._drain_retry_ms = "1000"
+        self._active_streams = 0
         self._rebuild_routes()
+
+    def begin_drain(self, retry_after_s: float = 1.0) -> None:
+        """Stop admitting new RPCs: every subsequent Infer stream answers
+        UNAVAILABLE carrying ``lumen-retry-after-ms`` (sized to the drain
+        budget — by then this process is gone and the client's next
+        attempt lands on a live sibling). In-flight streams are untouched;
+        the gRPC server's grace period drains them."""
+        from ..utils.qos import retry_after_ms
+
+        self._drain_retry_ms = retry_after_ms(max(retry_after_s, 0.001))
+        self._draining = True
+        logger.info(
+            "drain: refusing new RPCs (retry-after %sms)", self._drain_retry_ms
+        )
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def active_streams(self) -> int:
+        """Forwarded Infer streams currently executing — the drain's
+        "is the house empty yet" probe."""
+        with self._lock:
+            return self._active_streams
 
     def _rebuild_routes(self) -> None:
         table: dict[str, BaseService] = {}
@@ -153,6 +185,23 @@ class HubRouter(InferenceServicer):
             first = next(iter(request_iterator))
         except StopIteration:
             return
+        if self._draining:
+            from ..utils.qos import RETRY_AFTER_META
+
+            yield pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                meta={RETRY_AFTER_META: self._drain_retry_ms},
+                error=pb.Error(
+                    code=pb.ERROR_CODE_UNAVAILABLE,
+                    message="server is draining for shutdown",
+                    detail=(
+                        "graceful drain in progress; retry with backoff "
+                        "(lumen-retry-after-ms) against another replica"
+                    ),
+                ),
+            )
+            return
         target = self._route(first.task)
         if target is None:
             degraded = {n: s for n, s in self._statuses().items() if s in ("degraded", "failed")}
@@ -184,7 +233,15 @@ class HubRouter(InferenceServicer):
             )
             return
         # Re-prepend the consumed first message; forward the stream as-is.
-        yield from target.Infer(itertools.chain([first], request_iterator), context)
+        # The active-stream count brackets the forward so a drain can tell
+        # "in-flight work still running" from "house empty".
+        with self._lock:
+            self._active_streams += 1
+        try:
+            yield from target.Infer(itertools.chain([first], request_iterator), context)
+        finally:
+            with self._lock:
+                self._active_streams -= 1
 
     def GetCapabilities(self, request, context) -> pb.Capability:
         # Aggregate: merge every child capability into one record (the
@@ -272,6 +329,20 @@ class HubRouter(InferenceServicer):
             return {}
 
     @staticmethod
+    def _autopilot_state() -> dict:
+        """Compact autopilot state WITHOUT importing the runtime package
+        (jax — same rule as the quarantine probe): only report when the
+        controller module is already loaded in-process. ``{}`` omits the
+        key."""
+        mod = sys.modules.get("lumen_tpu.runtime.autopilot")
+        if mod is None:
+            return {}
+        try:
+            return mod.health_status()
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            return {}
+
+    @staticmethod
     def _quarantine_size() -> int | None:
         """Entries currently quarantined, WITHOUT importing the runtime
         package (which drags in jax — this router must stay importable and
@@ -317,6 +388,13 @@ class HubRouter(InferenceServicer):
                     # browned-out bulk lane is a reported condition, not
                     # an outage.
                     trailing.append(("lumen-qos-status", json.dumps(qos_state)))
+                ap_state = self._autopilot_state()
+                if ap_state:
+                    # Whether the capacity controller is live, which loops
+                    # it holds, and its last actuation — so "who parked
+                    # that replica / forced that rung" is answerable from
+                    # a Health probe.
+                    trailing.append(("lumen-autopilot-status", json.dumps(ap_state)))
                 context.set_trailing_metadata(tuple(trailing))
             except Exception:  # noqa: BLE001 - test stubs may lack metadata support
                 pass

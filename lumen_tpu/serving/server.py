@@ -49,6 +49,19 @@ GRPC_OPTIONS = [
     ("grpc.max_receive_message_length", 64 * 1024 * 1024),
 ]
 
+DRAIN_ENV = "LUMEN_DRAIN_S"
+
+
+def drain_budget_s() -> float:
+    """``LUMEN_DRAIN_S``: seconds a SIGTERM/SIGINT shutdown spends
+    draining (default 10) — new RPCs answer UNAVAILABLE with a retry-after
+    hint while queued and in-flight work completes; stragglers past the
+    budget are aborted, then the process exits. ``0`` restores the
+    immediate-stop behavior."""
+    from ..utils.env import env_float
+
+    return env_float(DRAIN_ENV, 10.0, minimum=0.0)
+
 
 def build_one_service(config: LumenConfig, name: str) -> BaseService:
     """Load exactly one service via its ``import_info.registry_class``
@@ -154,6 +167,8 @@ class ServerHandle:
         metrics_server=None,
         services: dict | None = None,
         recovery: RecoveryManager | None = None,
+        router: HubRouter | None = None,
+        autopilot=None,
     ):
         self.server = server
         self.port = port
@@ -163,11 +178,71 @@ class ServerHandle:
         # (it is the router's), so teardown closes what is actually running.
         self.services = services if services is not None else {}
         self.recovery = recovery
+        self.router = router
+        self.autopilot = autopilot
         self._stopped = threading.Event()
 
+    def drain_and_stop(self, drain_s: float | None = None) -> None:
+        """Graceful shutdown: refuse new RPCs — the router gate answers
+        in-band UNAVAILABLE with a ``lumen-retry-after-ms`` hint while the
+        gRPC server keeps accepting, so late clients get a parseable
+        back-off instead of a torn connection — let queued and in-flight
+        streams complete for up to ``drain_s`` (``LUMEN_DRAIN_S``), flush
+        ``server_drain`` flight-recorder events, then tear down. The
+        SIGTERM/SIGINT path — shutdown used to drop in-flight work on the
+        floor."""
+        import time as _time
+
+        if drain_s is None:
+            drain_s = drain_budget_s()
+        if drain_s <= 0:
+            # LUMEN_DRAIN_S=0: the documented immediate-stop behavior —
+            # no drain gate, the legacy default grace, no drain events.
+            self.stop()
+            return
+        from ..utils import telemetry
+
+        started = _time.monotonic()
+        deadline = started + drain_s
+        if self.router is not None:
+            self.router.begin_drain(retry_after_s=max(drain_s, 1.0))
+        telemetry.record_event(
+            "server_drain", "server",
+            f"drain started: refusing new RPCs, draining in-flight work "
+            f"(budget {drain_s:.0f}s)",
+        )
+        # Hold the gRPC server OPEN while in-flight streams finish: once
+        # server.stop() runs, new RPCs die at the transport with no
+        # metadata — the in-band hint only exists during this window.
+        stragglers = 0
+        if self.router is not None:
+            while self.router.active_streams() > 0 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            stragglers = self.router.active_streams()
+        # Remaining budget (floored small) covers response bytes still on
+        # the wire; genuinely stuck streams are aborted at the floor.
+        self.stop(grace=max(deadline - _time.monotonic(), 0.5))
+        telemetry.record_event(
+            "server_drain", "server",
+            f"drain complete in {_time.monotonic() - started:.2f}s "
+            f"({stragglers} straggler stream(s) past the budget); exiting",
+        )
+
     def stop(self, grace: float = 5.0) -> None:
+        if self.autopilot is not None:
+            # First of all: the controller must not actuate (park, force a
+            # rung, retune a window) against services mid-teardown — and
+            # the process-global slot must not keep advertising a dead
+            # controller on /autopilot and Health if another server boots
+            # in this process later.
+            from ..runtime.autopilot import get_autopilot, install_autopilot
+
+            self.autopilot.stop()
+            if get_autopilot() is self.autopilot:
+                install_autopilot(None)
+            self.autopilot = None
         if self.recovery:
-            # First: a recovery attempt finishing mid-shutdown would swap a
+            # Next: a recovery attempt finishing mid-shutdown would swap a
             # fresh service in after the close pass below already ran.
             self.recovery.stop()
         if self.mdns:
@@ -306,6 +381,14 @@ def serve(
     else:
         logger.info("capacity telemetry off (LUMEN_TELEMETRY=0)")
 
+    # Autopilot boot wiring (one-shot log either way): with
+    # LUMEN_AUTOPILOT=1 the background controller closes the scale/
+    # brownout/window loops over the telemetry spine; default-off keeps
+    # tier-1 and unconfigured deployments byte-for-byte unchanged.
+    from ..runtime.autopilot import maybe_start_autopilot
+
+    autopilot = maybe_start_autopilot()
+
     logger.info("serving %d service(s) on %s:%d: %s", len(services), host, bound, sorted(services))
     for name, svc in services.items():
         logger.info("  %s [%s] tasks: %s", name, svc.status(), svc.registry.task_names())
@@ -320,7 +403,8 @@ def serve(
         )
         mdns.start()
     return ServerHandle(
-        server, bound, mdns, metrics_server, services=router.services, recovery=recovery
+        server, bound, mdns, metrics_server, services=router.services,
+        recovery=recovery, router=router, autopilot=autopilot,
     )
 
 
@@ -370,7 +454,10 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, _on_signal)
     while not stop_event.wait(timeout=1.0):
         pass
-    handle.stop()
+    # Graceful drain (LUMEN_DRAIN_S): late RPCs answer UNAVAILABLE with a
+    # retry-after hint, in-flight work completes, a server_drain event
+    # lands in the flight recorder, then the process exits.
+    handle.drain_and_stop()
     return 0
 
 

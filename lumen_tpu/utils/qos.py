@@ -364,6 +364,11 @@ class WFQAdmissionQueue:
         self._tenant_admits: dict[str, int] = {}
         self._tenant_sheds: dict[str, int] = {}
         self._last_rung = 0  # last observed brownout level (event edges)
+        #: controller floor on the ladder (None = occupancy-only): the
+        #: autopilot descends/ascends the ladder from SLO burn by pinning
+        #: this; occupancy can still push the effective rung HIGHER (a
+        #: genuinely full queue must brown out even if burn looks fine).
+        self._forced_rung: int | None = None
         if self.max_queue <= 0:
             _warn_brownout_unbounded()
         _register_queue(self)
@@ -380,7 +385,9 @@ class WFQAdmissionQueue:
         return 100.0 * self._total / self.max_queue
 
     def brownout_level(self) -> int:
-        """0 = normal, 1 = bulk share shrunk, 2 = bulk shedding."""
+        """0 = normal, 1 = bulk share shrunk, 2 = bulk shedding
+        (occupancy-derived; :meth:`effective_rung` folds the forced floor
+        in — that is what admissions actually use)."""
         with self._lock:
             return self._brownout_locked()
 
@@ -391,6 +398,30 @@ class WFQAdmissionQueue:
         if occ >= brownout_pct():
             return 1
         return 0
+
+    def _effective_locked(self) -> int:
+        forced = self._forced_rung
+        level = self._brownout_locked()
+        return level if forced is None else max(level, forced)
+
+    def effective_rung(self) -> int:
+        """The rung the NEXT admission will be judged by: the occupancy
+        ladder with the controller's forced floor folded in. This is the
+        single value the autopilot, ``/stats`` readers and the ladder
+        itself must agree on (the ``brownout_rung`` gauge field)."""
+        with self._lock:
+            return self._effective_locked()
+
+    def force_rung(self, level: int | None) -> None:
+        """Pin the ladder's FLOOR to ``level`` (clamped 0-2); ``None`` (or
+        0) returns control to occupancy alone. The autopilot's brownout
+        loop actuates through here so descents driven by SLO burn use the
+        exact same shed/share mechanics as occupancy-driven ones."""
+        with self._cv:
+            if level is None or level <= 0:
+                self._forced_rung = None
+            else:
+                self._forced_rung = min(2, int(level))
 
     def _bump(self, table: dict[str, int], tenant: str) -> None:
         if tenant not in table and len(table) >= _MAX_TENANT_STATS:
@@ -424,6 +455,9 @@ class WFQAdmissionQueue:
         with self._cv:
             occ = self._occupancy_locked()
             level = 2 if occ >= shed_pct else (1 if occ >= brown_pct else 0)
+            forced = self._forced_rung
+            if forced is not None and forced > level:
+                level = forced
             if level != self._last_rung:
                 rung_change = (self._last_rung, level)
                 self._last_rung = level
@@ -459,9 +493,13 @@ class WFQAdmissionQueue:
             from . import telemetry
 
             old, new = rung_change
+            via = (
+                f"autopilot floor {forced}" if forced is not None and new == forced
+                else f"{occ:.0f}% queue occupancy"
+            )
             telemetry.record_event(
                 "brownout", self.name,
-                f"brownout rung {old} -> {new} at {occ:.0f}% queue occupancy",
+                f"brownout rung {old} -> {new} at {via}",
             )
         if shed_at is not None:
             occ, waiting = shed_at
@@ -533,6 +571,11 @@ class WFQAdmissionQueue:
                 **self.stats,
                 "queued": self._total,
                 "brownout": self._brownout_locked(),
+                # The rung admissions are ACTUALLY judged by (occupancy
+                # ladder + the autopilot's forced floor) — the one value
+                # the controller, dashboards and the ladder share.
+                "brownout_rung": self._effective_locked(),
+                "forced_rung": -1 if self._forced_rung is None else self._forced_rung,
                 "occupancy_pct": round(self._occupancy_locked(), 1),
             }
             lane_totals = {LANE_INTERACTIVE: 0, LANE_BULK: 0}
@@ -727,6 +770,14 @@ def _live_queues() -> Iterator[WFQAdmissionQueue]:
         yield q
 
 
+def live_queues() -> list[WFQAdmissionQueue]:
+    """Every live WFQ admission queue in the process — the autopilot's
+    brownout loop actuates the whole set (one ladder policy per process,
+    applied per queue so new batchers pick the floor up on the next
+    tick)."""
+    return list(_live_queues())
+
+
 def get_quota() -> TenantQuota:
     """The process-wide quota gate (lazily built)."""
     global _quota
@@ -758,6 +809,7 @@ def status() -> dict:
         queues[q.name] = {
             "queued": q.qsize(),
             "brownout": q.brownout_level(),
+            "rung": q.effective_rung(),
             "shed_bulk": q.stats["shed_bulk"],
         }
     if queues:

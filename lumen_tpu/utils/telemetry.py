@@ -782,6 +782,23 @@ class TelemetryHub:
             )
         meter.add(t0, t1)
 
+    # -- point sensors (the autopilot's read surface) ----------------------
+
+    def duty_fraction(self, name: str, window_s: float) -> float | None:
+        """One duty meter's busy fraction over the last ``window_s``
+        seconds, or ``None`` when the meter does not exist yet — the
+        controller treats "no sensor" as "no actuation", never as 0."""
+        meter = self._duties.get(name)
+        if meter is None:
+            return None
+        return meter.window(window_s, self.clock())["fraction"]
+
+    def window_total(self, name: str, window_s: float) -> float:
+        """One rolling counter's total over the last ``window_s`` seconds
+        (0.0 when the counter does not exist)."""
+        ctr = self._counters.get(name)
+        return 0.0 if ctr is None else ctr.total(window_s, self.clock())
+
     # -- export ------------------------------------------------------------
 
     def window_stats(self, window_s: float) -> dict:
@@ -903,6 +920,28 @@ def set_capacity(name: str, capacity: float, union: bool = False) -> None:
     if not telemetry_enabled():
         return
     get_hub().set_capacity(name, capacity, union=union)
+
+
+def duty_fraction(name: str, window_s: float) -> float | None:
+    """Windowed busy fraction of one duty meter (``None`` = no meter yet,
+    or telemetry disabled — the autopilot's no-sensor/no-actuation rule
+    covers both)."""
+    if not telemetry_enabled():
+        return None
+    hub = _hub
+    if hub is None:
+        return None  # nothing has fed yet; don't build a hub to say so
+    return hub.duty_fraction(name, window_s)
+
+
+def window_total(name: str, window_s: float) -> float:
+    """Windowed total of one rolling counter (0.0 when absent/disabled)."""
+    if not telemetry_enabled():
+        return 0.0
+    hub = _hub
+    if hub is None:
+        return 0.0
+    return hub.window_total(name, window_s)
 
 
 def record_event(

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Run every doc-gate script in one command with a summary table.
+
+The four gates (`check_knobs`, `check_metrics`, `check_meta_keys`,
+`check_endpoints`) each police one operator-API surface against the docs;
+until this runner, each was only exercised by its own test and a local
+pre-push check meant four invocations. One command, one table, one exit
+code::
+
+    python scripts/check_all.py
+
+Exit status is 0 only when EVERY gate passes. The aggregate is itself
+tier-1-enforced (``tests/test_check_all.py``), so a new gate added to
+``GATES`` is automatically part of the suite's single-command story.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import io
+import os
+import sys
+from contextlib import redirect_stdout
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: gate module names, run in this order (each must expose ``main() -> int``
+#: and print its own detail lines).
+GATES = ("check_knobs", "check_metrics", "check_meta_keys", "check_endpoints")
+
+
+def load_gate(name: str):
+    """Import one gate script by path (the scripts directory is not a
+    package — same loader idiom the per-gate tests use)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(SCRIPTS_DIR, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_gate(name: str) -> tuple[int, str]:
+    """Run one gate, capturing its stdout. Returns ``(exit_code, output)``;
+    a gate that crashes counts as failed with the traceback as detail —
+    one broken scanner must not silently pass the other three."""
+    mod = load_gate(name)
+    buf = io.StringIO()
+    try:
+        with redirect_stdout(buf):
+            rc = int(mod.main())
+    except Exception as e:  # noqa: BLE001 - report the crash as a failure
+        return 1, f"{buf.getvalue()}gate crashed: {type(e).__name__}: {e}"
+    return rc, buf.getvalue()
+
+
+def run_all() -> tuple[int, list[tuple[str, int, str]]]:
+    results = [(name, *run_gate(name)) for name in GATES]
+    worst = max((rc for _, rc, _ in results), default=0)
+    return worst, results
+
+
+def main() -> int:
+    worst, results = run_all()
+    width = max(len(name) for name in GATES)
+    print(f"{'gate'.ljust(width)}  status  detail")
+    for name, rc, output in results:
+        status = "ok" if rc == 0 else "FAIL"
+        first = output.strip().splitlines()[0] if output.strip() else ""
+        print(f"{name.ljust(width)}  {status.ljust(6)}  {first}")
+    for name, rc, output in results:
+        if rc != 0:
+            print(f"\n--- {name} ---")
+            print(output.rstrip())
+    if worst:
+        print("\ndoc gates FAILED — fix the rows above before shipping")
+    else:
+        print(f"\nall {len(results)} doc gates pass")
+    return 1 if worst else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

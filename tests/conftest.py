@@ -77,6 +77,16 @@ for _k in [k for k in os.environ if k.startswith("LUMEN_SLO_")] + [
 ]:
     os.environ.pop(_k, None)
 
+# Autopilot: OFF for the suite (its own tier-1 default), plus no leaked
+# threshold/drain knobs — a developer's armed controller would park
+# replicas and force brownout rungs under unrelated serving tests.
+# Autopilot tests opt in with monkeypatched env or explicit constructor
+# args (tests/test_autopilot.py).
+for _k in [k for k in os.environ if k.startswith("LUMEN_AUTOPILOT")] + [
+    "LUMEN_DRAIN_S",
+]:
+    os.environ.pop(_k, None)
+
 # Decode pool: THREAD mode for the suite (LUMEN_DECODE_PROCS=0). On a
 # multi-core CI host the auto default would switch the shared pool to
 # process mode — correct, but every first decode would pay worker spawns

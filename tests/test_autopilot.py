@@ -1,0 +1,585 @@
+"""Autopilot controller tests (ISSUE 14): fake-clock stability proofs —
+no-flap under oscillating sensors, per-actuator cooldowns, the global
+rate limit, manual-override precedence, chip-ledger conservation — plus
+the disabled-path guarantees (zero actuations, <2µs per-request), the
+``/autopilot`` sidecar endpoint and the client subcommand."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from lumen_tpu.runtime import autopilot as ap_mod
+from lumen_tpu.runtime.autopilot import Autopilot
+from lumen_tpu.utils import telemetry as tele
+from lumen_tpu.utils.metrics import metrics
+from lumen_tpu.utils.qos import WFQAdmissionQueue, qos_context
+from lumen_tpu.utils.telemetry import TelemetryHub
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeBatcher:
+    """window_cap/drain/load surface of a MicroBatcher, no threads."""
+
+    def __init__(self, name: str, base_cap_s: float = 0.005, drain_s: float | None = 0.0):
+        self.name = name
+        self.base_window_cap_s = base_cap_s
+        self.window_cap_s = base_cap_s
+        self._drain_s = drain_s
+        self._load = 0
+
+    def drain_estimate_s(self):
+        return self._drain_s
+
+    def load(self):
+        return self._load
+
+    def set_window_cap_s(self, cap_s: float) -> float:
+        self.window_cap_s = max(0.0, float(cap_s))
+        return self.window_cap_s
+
+
+class FakeReplica:
+    def __init__(self, rid: int, state: str, batcher):
+        self.rid, self.state, self.batcher = rid, state, batcher
+
+
+class FakeFleet:
+    """park/unpark surface of a ReplicaSet, bookkeeping only."""
+
+    def __init__(self, name: str, active: int, parked: int = 0, per: int = 1):
+        self.name = name
+        self.devices_per_replica = per
+        self.replicas = [
+            FakeReplica(i, "serving", FakeBatcher(f"{name}-r{i}"))
+            for i in range(active)
+        ] + [
+            FakeReplica(active + i, "parked", None) for i in range(parked)
+        ]
+        self.parks: list[int] = []
+        self.unparks: list[int] = []
+
+    def _count(self, state):
+        return sum(1 for r in self.replicas if r.state == state)
+
+    def park(self, rid=None):
+        serving = [r for r in self.replicas if r.state == "serving"]
+        if len(serving) <= 1:
+            return None
+        r = serving[-1]
+        r.state, r.batcher = "parked", None
+        self.parks.append(r.rid)
+        return r.rid
+
+    def unpark(self, rid=None):
+        parked = [r for r in self.replicas if r.state == "parked"]
+        if not parked:
+            return None
+        r = parked[0]
+        r.state = "serving"
+        r.batcher = FakeBatcher(f"{self.name}-r{r.rid}")
+        self.unparks.append(r.rid)
+        return r.rid
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture()
+def hub(clock):
+    h = TelemetryHub(clock=clock)
+    tele.install_hub(h)
+    yield h
+    tele.reset_hub()
+
+
+def make_ap(clock, **kw):
+    kw.setdefault("tick_s", 1.0)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("sense_s", 30.0)
+    kw.setdefault("fleets", lambda: [])
+    kw.setdefault("batchers", lambda: [])
+    kw.setdefault("queues", lambda: [])
+    return Autopilot(clock=clock, **kw)
+
+
+def busy_for(hub, clock, name: str, frac: float, span: float = 30.0):
+    """Credit ``frac`` busy over the trailing ``span`` seconds (span = the
+    controller's sense window, so the duty fraction reads ~frac)."""
+    hub.set_capacity(name, 1.0, union=True)
+    if frac > 0:
+        hub.busy(name, clock.t - span * frac, clock.t)
+
+
+# -- scale loop: reallocation, floor, ledger, cooldown ------------------------
+
+
+class TestScaleLoop:
+    def test_traffic_shift_reallocates_chips_in_one_tick(self, hub, clock):
+        a = FakeFleet("fam-a", active=2)
+        b = FakeFleet("fam-b", active=1, parked=1)
+        ap = make_ap(clock, fleets=lambda: [a, b])
+        busy_for(hub, clock, "device:fam-a-r0", 0.0)
+        busy_for(hub, clock, "device:fam-a-r1", 0.0)
+        busy_for(hub, clock, "device:fam-b-r0", 0.95)
+        made = ap.tick()
+        acts = [(d["loop"], d["component"], d["action"]) for d in made]
+        assert ("scale", "fam-a", "park r1") in acts
+        assert ("scale", "fam-b", "unpark r1") in acts
+        assert a._count("serving") == 1 and b._count("serving") == 2
+        # Ledger conserved: boot claims latched as capacity, and the swap
+        # is claim-neutral.
+        assert ap.chip_capacity == 3
+        # Sensors ride every decision.
+        for d in made:
+            assert d["sensors"] and "duty" in d["sensors"]
+
+    def test_floor_of_one_never_parked(self, hub, clock):
+        a = FakeFleet("fam-a", active=1)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a])
+        busy_for(hub, clock, "device:fam-a-r0", 0.0)
+        for _ in range(5):
+            ap.tick()
+            clock.advance(5)
+        assert a.parks == [] and a._count("serving") == 1
+
+    def test_ledger_blocks_unpark_until_sibling_releases(self, hub, clock):
+        # B is hot with a parked slot, but A holds every chip and is busy:
+        # no free slice, no unpark. When A goes idle and parks, B claims.
+        a = FakeFleet("fam-a", active=2)
+        b = FakeFleet("fam-b", active=1, parked=1)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a, b])
+        for name in ("device:fam-a-r0", "device:fam-a-r1"):
+            busy_for(hub, clock, name, 0.9)
+        busy_for(hub, clock, "device:fam-b-r0", 0.95)
+        ap.tick()
+        assert b.unparks == []  # everyone hot: nothing to reallocate
+        clock.advance(40)  # A's busy window ages out -> duty ~0
+        busy_for(hub, clock, "device:fam-b-r0", 0.95)
+        ap.tick()
+        assert a.parks == [1] and b.unparks == [1]
+
+    def test_down_replica_keeps_its_chip_claim(self, hub, clock):
+        # A DOWN replica never released its mesh slice (only park frees
+        # chips), so its claim must stay in the ledger: B hot with a
+        # parked slot must NOT be allowed to double-allocate the dead
+        # replica's chips out from under the pending revive.
+        a = FakeFleet("fam-a", active=2)
+        b = FakeFleet("fam-b", active=1, parked=1)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a, b])
+        busy_for(hub, clock, "device:fam-a-r0", 0.9)
+        busy_for(hub, clock, "device:fam-a-r1", 0.9)
+        busy_for(hub, clock, "device:fam-b-r0", 0.95)
+        ap.tick()  # latch capacity (A holds 2 + B holds 1) while healthy
+        assert ap.chip_capacity == 3
+        a.replicas[1].state = "down"  # crash, revive pending
+        busy_for(hub, clock, "device:fam-b-r0", 0.95)
+        ap.tick()
+        assert b.unparks == [], "down replica's chips were double-allocated"
+
+    def test_window_loop_skips_non_adaptive_batchers(self, hub, clock):
+        b = FakeBatcher("fixed-wb", base_cap_s=0.010)
+        b.adaptive = False  # LUMEN_BATCH_ADAPTIVE=0: cap is never read
+        ap = make_ap(clock, cooldown_s=0.0, batchers=lambda: [b])
+        hub.count("batch_items:fixed-wb", 40)
+        hub.count("batch_padded:fixed-wb", 40)
+        assert ap.tick() == []
+        assert b.window_cap_s == b.base_window_cap_s
+
+    def test_held_rung_reasserted_while_cooldown_blocks(self, monkeypatch, clock, hub):
+        # Sustained burn with the descend branch cooldown-blocked: a queue
+        # built AFTER the transition (revive/unpark builds a fresh
+        # batcher+queue) must still inherit the held floor within a tick.
+        self._burn_stub(monkeypatch, 2.0)
+        ap = make_ap(clock, cooldown_s=100.0)
+        ap.tick()  # descend to rung 1; cooldown now blocks rung 2
+        late_q = WFQAdmissionQueue(name="late-q", max_queue=10)
+        ap._queues = lambda: [late_q]
+        clock.advance(2)
+        assert ap.tick() == []  # blocked transition, no actuation...
+        assert late_q.effective_rung() == 1  # ...but the floor still lands
+
+    @staticmethod
+    def _burn_stub(monkeypatch, value):
+        monkeypatch.setattr(
+            tele, "slo_status",
+            lambda: {"t": {"burn_5m": value, "burn_1h": 0.1, "state": "ok"}},
+        )
+
+    def test_cooldown_spaces_consecutive_parks(self, hub, clock):
+        a = FakeFleet("fam-a", active=3)
+        other = FakeFleet("fam-z", active=1)  # keeps the ledger honest
+        ap = make_ap(clock, cooldown_s=10.0, fleets=lambda: [a, other])
+        for i in range(3):
+            busy_for(hub, clock, f"device:fam-a-r{i}", 0.0)
+        busy_for(hub, clock, "device:fam-z-r0", 0.0)
+        ap.tick()
+        assert a.parks == [2]
+        clock.advance(5)  # inside the cooldown
+        ap.tick()
+        assert a.parks == [2]
+        clock.advance(6)  # past it
+        ap.tick()
+        assert a.parks == [2, 1]
+
+    def test_no_sensor_means_no_actuation(self, clock, hub):
+        # No duty meter was ever fed for fam-a (e.g. LUMEN_TELEMETRY=0):
+        # the controller is blind there and must not act on a guess.
+        a = FakeFleet("fam-a", active=2)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a])
+        for _ in range(3):
+            ap.tick()
+            clock.advance(5)
+        assert a.parks == []
+
+    def test_global_rate_limit_bounds_a_tick(self, hub, clock):
+        fleets = [FakeFleet(f"fam-{i}", active=2) for i in range(6)]
+        for f in fleets:
+            busy_for(hub, clock, f"device:{f.name}-r0", 0.0)
+            busy_for(hub, clock, f"device:{f.name}-r1", 0.0)
+        ap = make_ap(clock, cooldown_s=0.0, rate_per_min=3, fleets=lambda: fleets)
+        made = ap.tick()
+        assert len(made) == 3  # 6 park candidates, rate cap wins
+        assert ap.actuations == 3
+
+
+# -- brownout loop: hysteresis, no-flap, real ladder actuation ----------------
+
+
+class TestBrownoutLoop:
+    def _with_burn(self, monkeypatch, values):
+        """slo_status() stub yielding successive burn_5m readings (last
+        one repeats)."""
+        it = iter(values)
+        state = {"cur": values[0]}
+
+        def fake_slo():
+            try:
+                state["cur"] = next(it)
+            except StopIteration:
+                pass
+            return {"ap_task": {"burn_5m": state["cur"], "burn_1h": 0.2,
+                                "state": "ok"}}
+
+        monkeypatch.setattr(tele, "slo_status", fake_slo)
+
+    def test_descend_and_ascend_with_hysteresis(self, monkeypatch, clock, hub):
+        q = WFQAdmissionQueue(name="ap-q", max_queue=100)
+        self._with_burn(monkeypatch, [2.0, 2.0, 0.3, 0.3])
+        ap = make_ap(clock, cooldown_s=1.0, queues=lambda: [q])
+        ap.tick()
+        assert ap.status()["loops"]["brownout"]["rung"] == 1
+        assert q.effective_rung() == 1
+        clock.advance(2)
+        ap.tick()  # still burning: rung 2 — bulk sheds outright
+        assert q.effective_rung() == 2
+        with qos_context("t", "bulk"), pytest.raises(Exception):
+            q.put(("x", None, None, None))
+        clock.advance(2)
+        ap.tick()  # burn 0.3 <= ascend 0.5: one rung back
+        assert q.effective_rung() == 1
+        clock.advance(2)
+        ap.tick()
+        assert q.effective_rung() == 0  # fully ascended, force cleared
+        with qos_context("t", "bulk"):
+            q.put(("x", None, None, None))  # bulk admits again
+
+    def test_no_flap_inside_the_band(self, monkeypatch, clock, hub):
+        # Oscillating across the DESCEND threshold but never under the
+        # ASCEND one: the hysteresis band makes the response MONOTONE —
+        # the ladder may descend (the budget genuinely keeps burning) but
+        # never bounces back up, and once at the bottom it goes quiet.
+        self._with_burn(monkeypatch, [1.1, 0.9] * 40)
+        ap = make_ap(clock, cooldown_s=1.0)
+        actions = []
+        for _ in range(80):
+            actions.extend(d["action"] for d in ap.tick())
+            clock.advance(2)
+        assert all(a.startswith("descend") for a in actions), actions
+        assert len(actions) <= 2  # bounded by ladder depth, not by time
+        assert ap.status()["loops"]["brownout"]["rung"] == 2
+
+    def test_cooldown_bounds_full_range_oscillation(self, monkeypatch, clock, hub):
+        # Sensor swinging across BOTH thresholds every tick: the cooldown
+        # is the only thing between the ladder and a flap — actuations are
+        # spaced >= cooldown_s.
+        self._with_burn(monkeypatch, [2.0, 0.1] * 30)
+        ap = make_ap(clock, cooldown_s=10.0)
+        times = []
+        for _ in range(60):
+            for d in ap.tick():
+                times.append(clock.t)
+            clock.advance(1)
+        assert times, "expected at least one actuation"
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        assert all(g >= 10.0 for g in gaps), gaps
+
+    def test_no_objectives_means_idle_loop(self, clock, hub):
+        ap = make_ap(clock, cooldown_s=0.0)
+        assert ap.tick() == []
+        assert ap.status()["loops"]["brownout"]["rung"] == 0
+
+
+# -- window loop --------------------------------------------------------------
+
+
+class TestWindowLoop:
+    def test_grow_on_waste_then_shrink_back(self, hub, clock):
+        b = FakeBatcher("wb", base_cap_s=0.010)
+        ap = make_ap(clock, cooldown_s=1.0, batchers=lambda: [b])
+        hub.count("batch_items:wb", 40)
+        hub.count("batch_padded:wb", 40)  # 50% waste
+        made = ap.tick()
+        assert len(made) == 1 and made[0]["loop"] == "window"
+        assert b.window_cap_s == pytest.approx(0.015)
+        # Still wasteful next tick: keeps growing, clamped at 4x base.
+        for _ in range(8):
+            clock.advance(2)
+            hub.count("batch_items:wb", 40)
+            hub.count("batch_padded:wb", 40)
+            ap.tick()
+        assert b.window_cap_s <= 0.040 + 1e-9
+        # Waste clears: cap returns to base, never below.
+        for _ in range(8):
+            clock.advance(40)  # age the padded counters out of the window
+            hub.count("batch_items:wb", 200)
+            ap.tick()
+        assert b.window_cap_s == pytest.approx(b.base_window_cap_s)
+
+    def test_thin_traffic_is_ignored(self, hub, clock):
+        b = FakeBatcher("wb2", base_cap_s=0.010)
+        ap = make_ap(clock, cooldown_s=0.0, batchers=lambda: [b])
+        hub.count("batch_items:wb2", 3)
+        hub.count("batch_padded:wb2", 5)  # 62% waste but only 8 slots
+        assert ap.tick() == []
+        assert b.window_cap_s == b.base_window_cap_s
+
+
+# -- manual override + disabled path ------------------------------------------
+
+
+class TestOverridesAndDisabled:
+    def test_per_loop_manual_override_precedence(self, monkeypatch, hub, clock):
+        # Operator holds the scale actuator (LUMEN_AUTOPILOT_SCALE=0):
+        # screaming scale sensors produce ZERO scale actuations while the
+        # window loop still runs.
+        monkeypatch.setenv("LUMEN_AUTOPILOT_SCALE", "0")
+        a = FakeFleet("fam-a", active=3)
+        busy_for(hub, clock, "device:fam-a-r0", 0.0)
+        b = FakeBatcher("wb3", base_cap_s=0.010)
+        hub.count("batch_items:wb3", 40)
+        hub.count("batch_padded:wb3", 40)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a], batchers=lambda: [b])
+        made = ap.tick()
+        assert a.parks == []
+        assert {d["loop"] for d in made} == {"window"}
+        st = ap.status()
+        assert st["loops"]["scale"]["enabled"] is False
+        assert st["loops"]["window"]["enabled"] is True
+
+    def test_disabled_autopilot_is_never_built(self, monkeypatch):
+        monkeypatch.delenv("LUMEN_AUTOPILOT", raising=False)
+        ap_mod.reset_autopilot()
+        assert ap_mod.maybe_start_autopilot() is None
+        assert ap_mod.get_autopilot() is None
+        out = ap_mod.export_status()
+        assert out == {"enabled": False, "running": False, "loops": {},
+                       "decisions": []}
+        assert ap_mod.health_status() == {}
+        assert metrics  # (no actuation counters could have moved: no instance)
+
+    def test_disabled_path_per_request_overhead_under_2us(self, monkeypatch):
+        """ISSUE 14 acceptance: LUMEN_AUTOPILOT=0 (the tier-1 default)
+        adds <2µs/request. The controller is a background tick and is
+        never on the request path — the request path IS the telemetry
+        observe, so the guard re-measures it with the autopilot off
+        (same best-of-short-windows method as the trace/telemetry
+        guards)."""
+        import gc
+
+        monkeypatch.delenv("LUMEN_AUTOPILOT", raising=False)
+        ap_mod.reset_autopilot()
+        tele.reset_hub()
+        tele.observe("ap_overhead_guard", 1.0)
+        n = 4000
+        best = float("inf")
+        gc.disable()
+        try:
+            for _ in range(12):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    tele.observe("ap_overhead_guard", 1.0)
+                best = min(best, (time.perf_counter() - t0) / n)
+        finally:
+            gc.enable()
+        tele.reset_hub()
+        assert best < 2e-6, f"disabled-autopilot cost {best * 1e6:.2f}µs/request"
+
+    def test_maybe_start_and_stop_clears_forced_rung(self, monkeypatch, hub, clock):
+        monkeypatch.setenv("LUMEN_AUTOPILOT", "1")
+        monkeypatch.setenv("LUMEN_AUTOPILOT_TICK_S", "30")
+        ap_mod.reset_autopilot()
+        ap = ap_mod.maybe_start_autopilot()
+        try:
+            assert ap is not None and ap.running
+            assert ap_mod.get_autopilot() is ap
+            # A held rung is released on stop: a dead controller must not
+            # leave the ladder browned out.
+            q = WFQAdmissionQueue(name="ap-stop-q", max_queue=10)
+            ap._queues = lambda: [q]
+            ap._rung = 2
+            ap._apply_rung()
+            assert q.effective_rung() == 2
+        finally:
+            ap_mod.reset_autopilot()
+        assert not ap.running
+        assert q.effective_rung() == 0
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+class TestSurfaces:
+    def test_events_and_counters_per_actuation(self, hub, clock):
+        a = FakeFleet("fam-ev", active=2)
+        busy_for(hub, clock, "device:fam-ev-r0", 0.0)
+        busy_for(hub, clock, "device:fam-ev-r1", 0.0)
+        before = metrics.counter_value("autopilot_actions")
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a])
+        made = ap.tick()
+        assert len(made) == 1
+        assert metrics.counter_value("autopilot_actions") == before + 1
+        events = tele.export_events()["events"]
+        ev = [e for e in events if e["kind"] == "autopilot_scale"]
+        assert ev and ev[-1]["component"] == "fam-ev"
+        assert "sensors" in ev[-1] and ev[-1]["sensors"]["duty"] is not None
+
+    def test_autopilot_endpoint_and_health_summary(self, hub, clock):
+        from lumen_tpu.serving.observability import MetricsServer
+
+        a = FakeFleet("fam-http", active=2)
+        busy_for(hub, clock, "device:fam-http-r0", 0.0)
+        busy_for(hub, clock, "device:fam-http-r1", 0.0)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a])
+        ap.tick()
+        old = ap_mod.install_autopilot(ap)
+        server = MetricsServer(port=0)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/autopilot", timeout=10
+            ) as r:
+                out = json.loads(r.read().decode())
+            assert out["enabled"] and out["ticks"] == 1
+            assert out["chips"]["capacity"] == 2
+            (dec,) = out["decisions"]
+            assert dec["loop"] == "scale" and dec["sensors"]
+            hs = ap_mod.health_status()
+            assert hs["actuations"] == 1 and hs["last"]["loop"] == "scale"
+        finally:
+            server.stop()
+            ap_mod.install_autopilot(old)
+
+    def test_decision_ring_is_bounded(self, monkeypatch, hub, clock):
+        monkeypatch.setenv("LUMEN_AUTOPILOT_DECISIONS", "4")
+        ap = make_ap(clock, cooldown_s=0.0)
+        for i in range(10):
+            ap._record("window", f"b{i}", "grow", "r", {}, clock.t)
+        assert len(ap.status()["decisions"]) == 4
+        assert ap.status()["decisions"][-1]["component"] == "b9"
+
+    def test_router_health_carries_autopilot_key(self, hub, clock):
+        from lumen_tpu.serving.router import HubRouter
+
+        ap = make_ap(clock)
+        old = ap_mod.install_autopilot(ap)
+        try:
+            state = HubRouter._autopilot_state()
+            assert state["loops"] == {"scale": "on", "brownout": "on",
+                                      "window": "on"}
+        finally:
+            ap_mod.install_autopilot(old)
+
+
+# -- client subcommand (satellite) --------------------------------------------
+
+
+class TestClientAutopilot:
+    def test_cli_against_fake_sidecar(self, capsys):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from lumen_tpu import client
+
+        payload = {
+            "enabled": True, "running": True, "tick_s": 5.0,
+            "cooldown_s": 30.0, "sense_window_s": 30.0,
+            "rate_limit_per_min": 12, "ticks": 120, "actuations": 3,
+            "chips": {"capacity": 8, "claimed": 7},
+            "loops": {
+                "scale": {"enabled": True, "up_duty": 0.75, "down_duty": 0.2,
+                          "families": {"clip": {"duty": 0.91, "active": 3,
+                                                "parked": 1}}},
+                "brownout": {"enabled": True, "rung": 1,
+                             "sensors": {"burn_5m": 1.4}},
+                "window": {"enabled": False,
+                           "batchers": {"clip-image": {"waste_pct": 12.0,
+                                                       "cap_ms": 5.0}}},
+            },
+            "decisions": [
+                {"loop": "scale", "component": "clip", "action": "unpark r3",
+                 "reason": "duty 0.91 over threshold",
+                 "sensors": {"duty": 0.91}},
+            ],
+        }
+        seen = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: A002
+                pass
+
+            def do_GET(self):  # noqa: N802
+                seen["path"] = self.path
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            out = client.get_autopilot(f"127.0.0.1:{port}")
+            assert out["chips"]["capacity"] == 8
+            assert seen["path"] == "/autopilot"
+            rc = client.main(["autopilot", "--metrics-addr", f"127.0.0.1:{port}"])
+            assert rc == 0
+            printed = capsys.readouterr().out
+            assert "autopilot: running" in printed
+            assert "chip ledger: 7 claimed of 8" in printed
+            assert "loop window: off (manual override)" in printed
+            assert "unpark r3" in printed
+            assert "burn_5m=1.4" in printed
+            rc = client.main(["autopilot", "--metrics-addr",
+                              f"127.0.0.1:{port}", "--json"])
+            assert rc == 0
+            assert json.loads(capsys.readouterr().out)["actuations"] == 3
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

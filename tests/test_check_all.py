@@ -1,0 +1,57 @@
+"""Tier-1 gate: the aggregate doc-gate runner (scripts/check_all.py) runs
+all four surface checks and fails when ANY of them does — one command is
+the whole pre-push story."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_all",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_all.py"),
+)
+check_all = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_all)
+
+
+def test_every_gate_passes():
+    worst, results = check_all.run_all()
+    failing = [(name, out) for name, rc, out in results if rc != 0]
+    assert worst == 0 and not failing, (
+        "doc gates failing:\n"
+        + "\n".join(f"--- {name} ---\n{out}" for name, out in failing)
+    )
+
+
+def test_covers_all_four_gates():
+    # The aggregate must not silently drop a gate: the registry names all
+    # four known scanners, and each produced SOME output when run.
+    assert set(check_all.GATES) == {
+        "check_knobs", "check_metrics", "check_meta_keys", "check_endpoints"
+    }
+    _, results = check_all.run_all()
+    assert len(results) == 4
+    for name, _rc, out in results:
+        assert out.strip(), f"gate {name} produced no output"
+
+
+def test_failure_detection(monkeypatch):
+    # A gate whose main() fails (or crashes) must fail the aggregate —
+    # simulated by pointing the loader at a stub, not by undocumenting a
+    # real knob.
+    class FailingGate:
+        @staticmethod
+        def main() -> int:
+            print("synthetic gap")
+            return 1
+
+    real_load = check_all.load_gate
+    monkeypatch.setattr(
+        check_all, "load_gate",
+        lambda name: FailingGate if name == "check_knobs" else real_load(name),
+    )
+    worst, results = check_all.run_all()
+    assert worst == 1
+    by_name = {name: rc for name, rc, _ in results}
+    assert by_name["check_knobs"] == 1
+    assert by_name["check_endpoints"] == 0

@@ -1,0 +1,221 @@
+"""Graceful drain on SIGTERM/SIGINT (ISSUE 14 satellite): a real
+``serving.server`` boot whose shutdown completes in-flight requests,
+answers late ones UNAVAILABLE with a ``lumen-retry-after-ms`` hint,
+flushes ``server_drain`` flight-recorder events, and exits within the
+``LUMEN_DRAIN_S`` budget — shutdown used to drop in-flight work on the
+floor."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import grpc
+import pytest
+
+from lumen_tpu.core.config import validate_config_dict
+from lumen_tpu.serving.proto import ml_service_pb2 as pb
+from lumen_tpu.serving.proto.ml_service_pb2_grpc import InferenceStub
+from lumen_tpu.utils import telemetry as tele
+from lumen_tpu.utils.qos import RETRY_AFTER_META
+
+
+def drain_config_dict(tmp_path, port: int = 50952) -> dict:
+    # A fixed port satisfies config validation; serve() falls back to an
+    # OS-assigned one if it is taken, and both tests read the BOUND port.
+    return {
+        "metadata": {
+            "version": "1.0.0",
+            "region": "other",
+            "cache_dir": str(tmp_path / "cache"),
+        },
+        "deployment": {"mode": "hub", "services": ["echo", "slow"]},
+        "server": {"port": port, "host": "127.0.0.1"},
+        "services": {
+            "echo": {
+                "enabled": True,
+                "package": "lumen_tpu",
+                "import_info": {
+                    "registry_class": "lumen_tpu.serving.echo.EchoService"
+                },
+                "models": {"echo": {"model": "test/model-echo"}},
+            },
+            "slow": {
+                "enabled": True,
+                "package": "lumen_tpu",
+                "import_info": {
+                    "registry_class": "lumen_tpu.testing.services.SlowEchoService"
+                },
+                "models": {"slow": {"model": "test/model-slow"}},
+            },
+        },
+    }
+
+
+def _req(task: str, cid: str = "c1", meta: dict | None = None) -> pb.InferRequest:
+    return pb.InferRequest(
+        correlation_id=cid, task=task, payload=b"x",
+        payload_mime="text/plain", meta=meta or {},
+    )
+
+
+@pytest.mark.integration
+class TestGracefulDrainInProcess:
+    def test_drain_completes_inflight_rejects_late_and_records(self, tmp_path):
+        from lumen_tpu.serving.server import serve
+
+        handle = serve(
+            validate_config_dict(drain_config_dict(tmp_path)), skip_download=True
+        )
+        chan = None
+        try:
+            chan = grpc.insecure_channel(f"127.0.0.1:{handle.port}")
+            grpc.channel_ready_future(chan).result(timeout=10)
+            stub = InferenceStub(chan)
+
+            results: dict = {}
+
+            def inflight():
+                (r,) = stub.Infer(
+                    iter([_req("slow_echo", meta={"sleep_s": "1.0"})])
+                )
+                results["r"] = r
+
+            t = threading.Thread(target=inflight, daemon=True)
+            t.start()
+            time.sleep(0.3)  # the handler is now inside its sleep
+            assert handle.router.active_streams() == 1
+
+            handle.router.begin_drain(retry_after_s=5.0)
+            # Late request: in-band UNAVAILABLE + parseable retry hint —
+            # the server is still accepting, so the client gets metadata,
+            # not a torn connection.
+            (late,) = stub.Infer(iter([_req("echo", cid="late")]))
+            assert late.error.code == pb.ERROR_CODE_UNAVAILABLE
+            assert "drain" in late.error.message
+            assert int(late.meta[RETRY_AFTER_META]) >= 1
+
+            t0 = time.monotonic()
+            handle.drain_and_stop(drain_s=8.0)
+            elapsed = time.monotonic() - t0
+            # In-flight work had ~0.7s left: the drain waited for it, then
+            # exited well inside the budget.
+            assert elapsed < 8.0, f"drain took {elapsed:.1f}s"
+            t.join(timeout=5)
+            r = results["r"]
+            assert not r.HasField("error") and r.result == b"x"
+            assert r.meta.get("slow") == "1"
+
+            drains = [
+                e for e in tele.export_events()["events"]
+                if e["kind"] == "server_drain"
+            ]
+            assert len(drains) >= 2
+            assert "drain started" in drains[-2]["message"]
+            assert "drain complete" in drains[-1]["message"]
+        finally:
+            if chan is not None:
+                chan.close()
+            handle.stop(grace=0.2)  # idempotent if drain already ran
+
+
+_CHILD = """\
+import json, sys
+from lumen_tpu.core.config import validate_config_dict
+from lumen_tpu.serving import server as srv
+sys.exit(srv.main(["--config", sys.argv[1], "--skip-download", "--platform", "cpu"]))
+"""
+
+
+@pytest.mark.integration
+class TestSigtermEndToEnd:
+    def test_sigterm_drains_and_exits_within_budget(self, tmp_path):
+        """Real process, real SIGTERM: boot ``serving.server`` as a child,
+        hold a slow request in flight, SIGTERM it — the in-flight request
+        completes, a late request gets the retry-after answer, and the
+        process exits 0 within the drain budget."""
+        cfg_path = tmp_path / "drain.json"  # JSON is valid YAML
+        cfg_path.write_text(json.dumps(drain_config_dict(tmp_path)))
+        child_path = tmp_path / "child.py"
+        child_path.write_text(_CHILD)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "LUMEN_DRAIN_S": "10",
+            "LUMEN_BREAKER_FAILURES": "0",
+            # The child is a bare interpreter: it gets the repo on its
+            # path explicitly (the parent got it from tests/conftest.py).
+            "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        }
+        proc = subprocess.Popen(
+            [sys.executable, str(child_path), str(cfg_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env,
+        )
+        try:
+            # The readiness line carries the bound port.
+            import re
+
+            port = None
+            deadline = time.monotonic() + 120
+            for line in proc.stderr:
+                m = re.search(r"service\(s\) on 127\.0\.0\.1:(\d+)", line)
+                if m:
+                    port = int(m.group(1))
+                    break
+                if time.monotonic() > deadline:
+                    break
+            assert port, "child never reached the readiness line"
+            # Drain the rest of stderr in the background so the child
+            # never blocks on a full pipe.
+            threading.Thread(
+                target=lambda: proc.stderr.read(), daemon=True
+            ).start()
+
+            chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+            grpc.channel_ready_future(chan).result(timeout=20)
+            stub = InferenceStub(chan)
+
+            results: dict = {}
+
+            def inflight():
+                (r,) = stub.Infer(
+                    iter([_req("slow_echo", meta={"sleep_s": "3.0"})]),
+                    timeout=30,
+                )
+                results["r"] = r
+
+            t = threading.Thread(target=inflight, daemon=True)
+            t.start()
+            time.sleep(0.5)
+            t_term = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+            # main() polls its stop event at 1 Hz, then begins the drain;
+            # by +1.5s the gate is up while the slow stream (3s) still
+            # holds the server open.
+            time.sleep(1.5)
+            (late,) = stub.Infer(iter([_req("echo", cid="late")]), timeout=10)
+            assert late.error.code == pb.ERROR_CODE_UNAVAILABLE
+            assert int(late.meta[RETRY_AFTER_META]) >= 1
+
+            t.join(timeout=20)
+            r = results.get("r")
+            assert r is not None and not r.HasField("error") and r.result == b"x"
+
+            rc = proc.wait(timeout=20)
+            elapsed = time.monotonic() - t_term
+            assert rc == 0
+            # Budget 10s + the 1s signal poll + margin: well under a
+            # kill -9 escalation window.
+            assert elapsed < 15.0, f"exit took {elapsed:.1f}s after SIGTERM"
+            chan.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
