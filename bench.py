@@ -4179,6 +4179,474 @@ def _host_lane_impl() -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Fleet federation (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+_FEDBENCH_DEVICE_MS = "80"  # simulated per-unique-payload device time
+
+#: env the federation phase sets on itself for the in-process front tier
+#: (saved/restored around the phase).
+_FED_ENV_KEYS = (
+    "LUMEN_FED_PEERS", "LUMEN_FED_SELF", "LUMEN_FED_POLL_S",
+    "LUMEN_FED_FAILURES", "LUMEN_FED_EJECT_S", "LUMEN_FED_HOPS",
+    "LUMEN_GRPC_WORKERS", "LUMEN_CACHE_BYTES", "LUMEN_CACHE_DIR",
+)
+
+
+def _fedbench_config(cache_dir: str, port: int, enabled: bool = True) -> dict:
+    return {
+        "metadata": {
+            "version": "1.0.0", "region": "other", "cache_dir": cache_dir,
+        },
+        "deployment": {"mode": "hub", "services": ["fedbench"]},
+        "server": {"port": port, "host": "127.0.0.1"},
+        "services": {
+            "fedbench": {
+                "enabled": enabled,
+                "package": "lumen_tpu",
+                "import_info": {
+                    "registry_class":
+                        "lumen_tpu.testing.services.FederationBenchService"
+                },
+                "models": {"fedbench": {"model": "test/model-fedbench"}},
+            },
+        },
+    }
+
+
+def phase_federation_worker() -> dict:
+    """One simulated host for phase_federation: a REAL ``serve()`` boot
+    (router, base service, result cache, federation wiring — everything
+    but a model) with the FederationBenchService, on the port/env the
+    parent passed. Prints a ready line, serves until SIGTERM/SIGKILL."""
+    import signal as _signal
+    import threading as _threading
+
+    from lumen_tpu.core.config import validate_config_dict
+    from lumen_tpu.serving.server import serve
+
+    port = int(os.environ["FEDBENCH_PORT"])
+    metrics_port = int(os.environ["FEDBENCH_METRICS_PORT"])
+    cache_dir = os.environ["FEDBENCH_CACHE_DIR"]
+    handle = serve(
+        validate_config_dict(_fedbench_config(cache_dir, port)),
+        skip_download=True,
+        metrics_port=metrics_port,
+    )
+    print(json.dumps({"ready": 1, "port": handle.port,
+                      "metrics_port": handle.metrics_server.port}), flush=True)
+    stop = _threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_a: stop.set())
+    while not stop.wait(0.5):
+        pass
+    handle.drain_and_stop()
+    return {"platform": "host"}
+
+
+def _fed_drive(addr: str, payloads: list[bytes], n: int, concurrency: int,
+               retries: int = 4) -> dict:
+    """c{concurrency} open client over ONE channel with the client-side
+    retry contract (UNAVAILABLE -> backoff floored on the server's
+    lumen-retry-after-ms hint, transport errors -> backoff) — the
+    "zero client-visible errors after retry" arbiter for the peer-kill
+    segment. Counts the cache flags riding response meta."""
+    import threading as _threading
+
+    import grpc as _grpc
+
+    from lumen_tpu.serving.proto import ml_service_pb2 as pb
+    from lumen_tpu.serving.proto.ml_service_pb2_grpc import InferenceStub
+    from lumen_tpu.utils.qos import RETRY_AFTER_META
+
+    chan = _grpc.insecure_channel(addr)
+    _grpc.channel_ready_future(chan).result(timeout=30)
+    stub = InferenceStub(chan)
+    lat: list[float] = []
+    flags = {"cache_hit": 0, "cache_peer_hit": 0, "cache_coalesced": 0}
+    unrecovered: list[str] = []
+    retried = [0]
+    lock = _threading.Lock()
+    counts = [n // concurrency + (1 if i < n % concurrency else 0)
+              for i in range(concurrency)]
+
+    def one(cid: str, payload: bytes) -> tuple[float, dict] | None:
+        last_err = "no attempt"
+        for attempt in range(retries):
+            t0 = time.perf_counter()
+            try:
+                resps = list(stub.Infer(iter([pb.InferRequest(
+                    correlation_id=cid, task="fedbench_embed", payload=payload,
+                    payload_mime="application/octet-stream",
+                    meta={"device_ms": _FEDBENCH_DEVICE_MS},
+                )]), timeout=60))
+            except _grpc.RpcError as e:
+                last_err = f"transport {e.code()}"
+                with lock:
+                    retried[0] += 1
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            if not resps:
+                last_err = "empty stream"
+                continue
+            last = resps[-1]
+            if last.HasField("error") and (last.error.code or last.error.message):
+                last_err = f"[{last.error.code}] {last.error.message}"
+                if last.error.code == pb.ERROR_CODE_UNAVAILABLE and attempt < retries - 1:
+                    try:
+                        hint_s = int(last.meta.get(RETRY_AFTER_META, "0")) / 1000.0
+                    except ValueError:
+                        hint_s = 0.0
+                    with lock:
+                        retried[0] += 1
+                    time.sleep(max(hint_s, 0.05 * (attempt + 1)))
+                    continue
+                return None
+            return (time.perf_counter() - t0) * 1e3, dict(last.meta)
+        with lock:
+            unrecovered.append(last_err)
+        return None
+
+    def worker(wid: int, count: int) -> None:
+        mine, mine_flags = [], dict.fromkeys(flags, 0)
+        for i in range(count):
+            got = one(f"w{wid}-{i}", payloads[(wid + i * concurrency) % len(payloads)])
+            if got is None:
+                continue
+            ms, meta = got
+            mine.append(ms)
+            for key in mine_flags:
+                mine_flags[key] += meta.get(key) == "1"
+        with lock:
+            lat.extend(mine)
+            for key in flags:
+                flags[key] += mine_flags[key]
+
+    t0 = time.perf_counter()
+    threads = [_threading.Thread(target=worker, args=(i, c))
+               for i, c in enumerate(counts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    chan.close()
+    lat.sort()
+    return {
+        "n_ok": len(lat),
+        "n": n,
+        "unrecovered_errors": len(unrecovered),
+        "unrecovered_sample": unrecovered[:3],
+        "retries": retried[0],
+        "rps": round(len(lat) / wall, 2),
+        "p50_ms": round(_percentile(lat, 0.50), 1),
+        "p95_ms": round(_percentile(lat, 0.95), 1),
+        "concurrency": concurrency,
+        "unique_payloads": len(set(payloads)),
+        "client_hits": flags["cache_hit"],
+        "client_peer_hits": flags["cache_peer_hit"],
+        "client_coalesced": flags["cache_coalesced"],
+    }
+
+
+def _fed_sidecar_counters(port: int) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics.json", timeout=10
+    ) as resp:
+        snap = json.loads(resp.read().decode())
+    c = snap.get("counters", {})
+    return {
+        "fedbench_device_calls": c.get("fedbench_device_calls", 0),
+        "fed_cache_peer_hits": c.get("fed_cache_peer_hits", 0),
+        "fed_cache_peer_misses": c.get("fed_cache_peer_misses", 0),
+        "fed_cache_serves": c.get("fed_cache_serves", 0),
+    }
+
+
+def phase_federation() -> dict:
+    """Fleet-federation acceptance (ISSUE 15; CPU-safe, no model, real
+    clock): 3 subprocess lumen-tpu hosts (+1 unfederated baseline host)
+    behind an in-process consistent-hash front tier, all running the real
+    serving stack with a content-addressed sleep "device" (80ms/unique
+    payload — sleeps, not spins, so N hosts on one box scale like N
+    hosts). Asserted:
+
+    - duplicate-heavy c100 through the front tier >= 2.2x the SAME
+      workload against one unfederated host;
+    - a payload entering the fleet through two different doors computes
+      on-device exactly ONCE fleet-wide (summed fedbench_device_calls
+      across hosts == 1; fed_cache_peer_hits >= 1);
+    - SIGKILLing a peer mid-run finishes the workload with ZERO
+      unrecovered client errors (front-tier failover + client retry) and
+      lands a fed_peer_down event + incident bundle in the front's
+      flight recorder.
+
+    Results also land in BENCH_FEDERATION.json.
+    """
+    import shutil
+    import socket
+    import tempfile
+    import threading as _threading
+    import urllib.request
+
+    from lumen_tpu.core.config import validate_config_dict
+    from lumen_tpu.runtime.federation import EJECTED
+    from lumen_tpu.serving.server import serve
+    from lumen_tpu.utils import telemetry as tele
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    rng = __import__("random").Random(20260804)
+
+    def payload_set(tag: str, unique: int, dup_payloads: int, dup_each: int) -> list[bytes]:
+        """`unique` one-shot payloads + `dup_payloads` payloads repeated
+        `dup_each` times (the duplicate-heavy shape), shuffled."""
+        uniq = [f"{tag}-u{i}".encode() + rng.randbytes(1024) for i in range(unique)]
+        dups = [f"{tag}-d{i}".encode() + rng.randbytes(1024) for i in range(dup_payloads)]
+        out = uniq + [p for p in dups for _ in range(dup_each)]
+        rng.shuffle(out)
+        return out
+
+    n_hosts = 3
+    grpc_ports = [free_port() for _ in range(n_hosts + 1)]
+    side_ports = [free_port() for _ in range(n_hosts + 1)]
+    peers_env = ",".join(
+        f"127.0.0.1:{g}@{s}" for g, s in zip(grpc_ports[:n_hosts], side_ports[:n_hosts])
+    )
+    root = tempfile.mkdtemp(prefix="bench_fed_")
+    saved = {k: os.environ.get(k) for k in _FED_ENV_KEYS}
+    workers: list = []
+    front = None
+    out: dict = {"platform": "host", "cpu_count": os.cpu_count() or 1,
+                 "n_hosts": n_hosts, "device_ms": float(_FEDBENCH_DEVICE_MS)}
+
+    def spawn_worker(i: int, federated: bool):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "FEDBENCH_PORT": str(grpc_ports[i]),
+            "FEDBENCH_METRICS_PORT": str(side_ports[i]),
+            "FEDBENCH_CACHE_DIR": os.path.join(root, f"w{i}"),
+            "LUMEN_CACHE_BYTES": str(256 << 20),
+            # 4 handler threads: the per-host concurrency ceiling that
+            # makes one host sleep-bound (4/0.08s = 50 rps) so fleet
+            # scaling measures host count, not this box's core count.
+            "LUMEN_GRPC_WORKERS": "4",
+        })
+        env.pop("LUMEN_CACHE_DIR", None)
+        if federated:
+            env.update({
+                "LUMEN_FED_PEERS": peers_env,
+                "LUMEN_FED_SELF": f"127.0.0.1:{grpc_ports[i]}",
+                "LUMEN_FED_POLL_S": "1.0",
+                "LUMEN_FED_FAILURES": "2",
+                "LUMEN_FED_EJECT_S": "60",
+            })
+        else:
+            for k in list(env):
+                if k.startswith("LUMEN_FED_"):
+                    env.pop(k)
+        # stderr goes to a FILE, not a pipe: nobody drains it, and a
+        # logging burst (tracebacks during the kill segment) filling the
+        # ~64KB pipe buffer would block the worker mid-write and wedge
+        # the phase. The boot-failure path reads the file's tail.
+        err_path = os.path.join(root, f"w{i}.err")
+        with open(err_path, "w") as err_file:  # Popen dups the fd
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--phase", "federation_worker"],
+                stdout=subprocess.PIPE, stderr=err_file, text=True,
+                env=env, cwd=REPO,
+            )
+        proc._lumen_err_path = err_path
+        ready: dict = {}
+
+        def read_ready():
+            for line in proc.stdout:
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if parsed.get("ready"):
+                    ready.update(parsed)
+                # keep draining so the pipe never blocks the worker
+
+        _threading.Thread(target=read_ready, daemon=True).start()
+        return proc, ready
+
+    try:
+        _state("federation:boot")
+        spawned = [spawn_worker(i, federated=True) for i in range(n_hosts)]
+        spawned.append(spawn_worker(n_hosts, federated=False))  # baseline host
+        workers = [p for p, _ in spawned]
+        deadline = time.time() + 120
+        for i, (proc, ready) in enumerate(spawned):
+            while not ready and time.time() < deadline:
+                if proc.poll() is not None:
+                    try:
+                        with open(proc._lumen_err_path) as ef:
+                            tail = ef.read()[-500:]
+                    except OSError:
+                        tail = "<no stderr captured>"
+                    raise RuntimeError(f"fed worker {i} died at boot: {tail}")
+                time.sleep(0.1)
+            if not ready:
+                raise RuntimeError(f"fed worker {i} not ready in 120s")
+
+        # Front tier in-process (so ITS flight recorder is assertable).
+        os.environ.update({
+            "LUMEN_FED_PEERS": peers_env,
+            "LUMEN_FED_POLL_S": "0.5",
+            "LUMEN_FED_FAILURES": "2",
+            "LUMEN_FED_EJECT_S": "60",
+            "LUMEN_GRPC_WORKERS": "64",
+        })
+        os.environ.pop("LUMEN_FED_SELF", None)
+        tele.reset_hub()
+        front = serve(
+            validate_config_dict(
+                _fedbench_config(os.path.join(root, "front"), free_port(),
+                                 enabled=False)
+            ),
+            skip_download=True, metrics_port=0,
+        )
+        front_addr = f"127.0.0.1:{front.port}"
+        baseline_addr = f"127.0.0.1:{grpc_ports[n_hosts]}"
+
+        # -- single unfederated host vs the fleet, same workload shape ----
+        _state("federation:single")
+        single = _fed_drive(
+            baseline_addr, payload_set("s", 160, 16, 5), n=240, concurrency=100
+        )
+        out["single_host_c100"] = single
+        _state("federation:fleet")
+        fleet = _fed_drive(
+            front_addr, payload_set("f", 160, 16, 5), n=240, concurrency=100
+        )
+        out["fleet_c100"] = fleet
+        out["fleet_speedup_x"] = round(fleet["rps"] / max(single["rps"], 1e-9), 2)
+        assert single["unrecovered_errors"] == 0, single
+        assert fleet["unrecovered_errors"] == 0, fleet
+        assert out["fleet_speedup_x"] >= 2.2, (
+            f"fleet {fleet['rps']} rps vs single {single['rps']} rps = "
+            f"{out['fleet_speedup_x']}x < 2.2x"
+        )
+
+        # -- fleet-wide dedupe: two entry doors, ONE device computation ---
+        _state("federation:dedupe")
+        before = [_fed_sidecar_counters(p) for p in side_ports[:n_hosts]]
+        dd = payload_set("z", 1, 0, 0)  # one fresh payload
+        via_front = _fed_drive(front_addr, dd, n=1, concurrency=1)
+        assert via_front["unrecovered_errors"] == 0
+        direct = [
+            _fed_drive(f"127.0.0.1:{g}", dd, n=1, concurrency=1)
+            for g in grpc_ports[:n_hosts]
+        ]
+        after = [_fed_sidecar_counters(p) for p in side_ports[:n_hosts]]
+        device_calls = sum(
+            a["fedbench_device_calls"] - b["fedbench_device_calls"]
+            for a, b in zip(after, before)
+        )
+        peer_hits = sum(
+            a["fed_cache_peer_hits"] - b["fed_cache_peer_hits"]
+            for a, b in zip(after, before)
+        )
+        out["dedupe"] = {
+            "entry_points": 1 + n_hosts,
+            "device_calls_fleet_wide": device_calls,
+            "fed_cache_peer_hits": peer_hits,
+            "client_peer_hits": sum(d["client_peer_hits"] for d in direct),
+            "per_host_counters": after,
+        }
+        assert device_calls == 1, (
+            f"duplicate payload cost {device_calls} device calls fleet-wide"
+        )
+        assert peer_hits >= 1, out["dedupe"]
+
+        # -- peer kill mid-run: zero unrecovered errors + incident --------
+        _state("federation:kill")
+        victim_i = n_hosts - 1
+        victim_addr = f"127.0.0.1:{grpc_ports[victim_i]}"
+        kill_box: dict = {}
+
+        def run_kill_pass():
+            kill_box["res"] = _fed_drive(
+                front_addr, payload_set("k", 160, 16, 5), n=240, concurrency=100
+            )
+
+        runner = _threading.Thread(target=run_kill_pass)
+        runner.start()
+        time.sleep(1.2)  # the run is in full flight
+        workers[victim_i].kill()
+        runner.join(timeout=180)
+        assert not runner.is_alive(), "kill pass wedged"
+        kill_res = kill_box["res"]
+        out["peer_kill_c100"] = kill_res
+        assert kill_res["unrecovered_errors"] == 0, (
+            f"{kill_res['unrecovered_errors']} unrecovered client errors "
+            f"after peer kill: {kill_res['unrecovered_sample']}"
+        )
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if front.federation.peers[victim_addr].state == EJECTED:
+                break
+            time.sleep(0.2)
+        assert front.federation.peers[victim_addr].state == EJECTED
+        kinds = [e["kind"] for e in tele.export_events()["events"]]
+        assert "fed_peer_down" in kinds, kinds
+        incidents = tele.export_incidents()["incidents"]
+        assert any(i["trigger"]["kind"] == "fed_peer_down" for i in incidents)
+        out["peer_kill_event"] = {
+            "ejected": victim_addr,
+            "fed_peer_down_events": kinds.count("fed_peer_down"),
+            "incident_bundles": len(incidents),
+        }
+
+        # -- surfaces: the /peers fleet view from the front sidecar -------
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{front.metrics_server.port}/peers", timeout=10
+        ) as resp:
+            out["peers_view"] = json.loads(resp.read().decode())
+
+        out["acceptance"] = {
+            "fleet_2_2x_single": out["fleet_speedup_x"] >= 2.2,
+            "duplicate_computes_once_fleet_wide": device_calls == 1,
+            "peer_cache_hits_nonzero": peer_hits >= 1,
+            "peer_kill_zero_unrecovered": kill_res["unrecovered_errors"] == 0,
+            "peer_down_incident_recorded": True,
+        }
+        assert all(out["acceptance"].values()), out["acceptance"]
+    finally:
+        for proc in workers:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if front is not None:
+            try:
+                front.stop(grace=0.5)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        for key, prev in saved.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        tele.reset_hub()
+        shutil.rmtree(root, ignore_errors=True)
+    try:
+        with open(os.path.join(REPO, "BENCH_FEDERATION.json"), "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+    return out
+
+
 PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
@@ -4197,6 +4665,8 @@ PHASES = {
     "grpc_dup": phase_grpc_dup,
     "replica_scaling": phase_replica_scaling,
     "replica_scaling_worker": phase_replica_scaling_worker,
+    "federation": phase_federation,
+    "federation_worker": phase_federation_worker,
     "attribution": phase_attribution,
     "capacity": phase_capacity,
     "bench_grpc_ref": phase_bench_grpc_ref,

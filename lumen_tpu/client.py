@@ -12,6 +12,7 @@ Usage (server from `python -m lumen_tpu.serving.server --config ...`):
     python examples/client.py health
     python examples/client.py stats --metrics-addr 127.0.0.1:9100 --window 60
     python examples/client.py autopilot --metrics-addr 127.0.0.1:9100
+    python examples/client.py peers --metrics-addr 127.0.0.1:9100
     python examples/client.py embed-text "a photo of a cat"
     python examples/client.py embed-image photo.jpg
     python examples/client.py classify photo.jpg --top-k 5
@@ -77,18 +78,24 @@ def infer(stub, task: str, payload, mime: str = "application/octet-stream",
                   stream=stream, tenant=tenant)
 
 
+def _sidecar_get(metrics_addr: str, path: str, timeout: float = 10.0) -> dict:
+    """One JSON GET against the observability sidecar. ``metrics_addr``
+    is the sidecar's ``host:port`` (the server's ``--metrics-port``) or a
+    full URL — the one place that normalization lives for every sidecar
+    subcommand (stats, autopilot, peers)."""
+    import urllib.request
+
+    base = metrics_addr if "://" in metrics_addr else f"http://{metrics_addr}"
+    with urllib.request.urlopen(f"{base.rstrip('/')}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
 def get_stats(metrics_addr: str, window: float = 60.0, timeout: float = 10.0) -> dict:
     """Fetch the observability sidecar's rolling-window capacity view
     (``GET /stats?window=N``): last-N-seconds task latencies, device and
     decode-pool duty cycles, batch padding waste, HBM occupancy/headroom
-    and the SLO burn summary. ``metrics_addr`` is the sidecar's
-    ``host:port`` (the server's ``--metrics-port``) or a full URL."""
-    import urllib.request
-
-    base = metrics_addr if "://" in metrics_addr else f"http://{metrics_addr}"
-    url = f"{base.rstrip('/')}/stats?window={int(window)}"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    and the SLO burn summary."""
+    return _sidecar_get(metrics_addr, f"/stats?window={int(window)}", timeout)
 
 
 def _print_stats(stats: dict) -> None:
@@ -149,11 +156,7 @@ def get_autopilot(metrics_addr: str, timeout: float = 10.0) -> dict:
     sidecar (``GET /autopilot``): per-loop enable flags + latest sensor
     readings, the chip ledger, and the recent actuation decisions with
     the sensor readings that justified them."""
-    import urllib.request
-
-    base = metrics_addr if "://" in metrics_addr else f"http://{metrics_addr}"
-    with urllib.request.urlopen(f"{base.rstrip('/')}/autopilot", timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    return _sidecar_get(metrics_addr, "/autopilot", timeout)
 
 
 def _print_autopilot(out: dict) -> None:
@@ -212,6 +215,44 @@ def _print_autopilot(out: dict) -> None:
         print("decisions: none recorded")
 
 
+def get_peers(metrics_addr: str, timeout: float = 10.0) -> dict:
+    """Fetch the federation fleet view from the observability sidecar
+    (``GET /peers``): per-peer state (serving/ejected), dispatch/failover
+    counters, ring ownership share, and the peer-cache hit rate."""
+    return _sidecar_get(metrics_addr, "/peers", timeout)
+
+
+def _print_peers(out: dict) -> None:
+    """Operator view of the fleet: one line per peer, worst news first in
+    each line (state), then traffic and cache columns."""
+    if not out.get("enabled"):
+        print("federation: not configured"
+              + (f" ({out['detail']})" if out.get("detail") else ""))
+        print("  set LUMEN_FED_PEERS (or LUMEN_FED_DISCOVER=1) on the server")
+        return
+    mode = out.get("mode", "?")
+    print(f"federation: {mode} mode"
+          + (f", self={out['self']}" if out.get("self") else "")
+          + f", hop budget {out.get('hops', '?')}")
+    peers = out.get("peers") or {}
+    for name, p in peers.items():
+        state = p.get("state", "?")
+        line = (
+            f"  {name}: {state}"
+            f" share={100 * p.get('ring_share', 0):.1f}%"
+            f" dispatches={p.get('dispatches', 0)}"
+            f" failovers={p.get('failovers', 0)}"
+            f" sheds={p.get('sheds', 0)}"
+        )
+        hits, misses = p.get("cache_hits", 0), p.get("cache_misses", 0)
+        if hits or misses:
+            line += f" cache_hits={hits}/{hits + misses}"
+        if state != "serving" and p.get("last_error"):
+            line += f" last_error={p['last_error']!r}"
+        print(line)
+    print(f"peer-cache hit rate: {out.get('cache_peer_hit_rate', 0.0)}")
+
+
 def _with_tenant(md, tenant: str | None):
     """Append the ``lumen-tenant`` request-metadata pair to the (possibly
     None) trace metadata — None stays None when there is nothing to send,
@@ -221,12 +262,36 @@ def _with_tenant(md, tenant: str | None):
     return (*(md or ()), (TENANT_META_KEY, tenant))
 
 
-def _shed_retry_after_s(meta) -> float | None:
-    """Parse the server's ``lumen-retry-after-ms`` response-meta hint
-    (sent on quota/queue/breaker sheds) into seconds."""
+def _shed_retry_after_s(meta, call=None) -> float | None:
+    """Parse the server's ``lumen-retry-after-ms`` hint (sent on
+    quota/queue/breaker/drain sheds) into seconds. Checked in response
+    meta first; when absent there and ``call`` is the live RPC, the
+    call's TRAILING metadata is scanned too — a federation front tier
+    relaying an exhausted failover echoes the last peer's hint in the
+    trailer, and the backoff floor must survive that hop exactly like a
+    direct shed."""
     try:
         ms = int(meta[RETRY_AFTER_META])
     except (KeyError, TypeError, ValueError):
+        ms = None
+    if ms is None and call is not None:
+        tm = getattr(call, "trailing_metadata", None)
+        if callable(tm):
+            try:
+                for item in tm() or ():
+                    key = getattr(item, "key", None)
+                    if key is None and isinstance(item, (tuple, list)) and len(item) == 2:
+                        key, value = item
+                    else:
+                        value = getattr(item, "value", None)
+                    if key == RETRY_AFTER_META:
+                        ms = int(value)
+                        break
+            except (TypeError, ValueError):
+                ms = None
+            except Exception:  # noqa: BLE001 - fakes without real metadata
+                ms = None
+    if ms is None:
         return None
     return ms / 1000.0 if ms > 0 else None
 
@@ -450,11 +515,12 @@ def _infer_attempt(stub, task: str, payload: bytes, mime: str, meta: dict[str, s
             if resp.error.code == pb.ERROR_CODE_UNAVAILABLE:
                 # Shed / degraded-service answer: retryable by contract
                 # (the server refused before dispatch; see _InbandUnavailable).
-                # The response meta may say exactly when to come back.
+                # The response meta — or, for a front-tier relay, the RPC
+                # trailer — may say exactly when to come back.
                 raise _InbandUnavailable(
                     resp.error.code,
                     resp.error.message,
-                    retry_after_s=_shed_retry_after_s(resp.meta),
+                    retry_after_s=_shed_retry_after_s(resp.meta, call=responses),
                 )
             raise SystemExit(f"server error [{resp.error.code}]: {resp.error.message}")
         # Disambiguate the two total>1 shapes on the wire: a STREAMING
@@ -541,6 +607,18 @@ def main(argv: list[str] | None = None) -> int:
         help="host:port (or URL) of the server's --metrics-port sidecar",
     )
     p.add_argument("--json", action="store_true", help="raw JSON instead of the summary")
+    p = sub.add_parser(
+        "peers",
+        help="federation fleet view from the observability sidecar "
+        "(per-peer serving/ejected state, ring ownership share, "
+        "dispatch/failover counters, peer-cache hit rate)",
+    )
+    p.add_argument(
+        "--metrics-addr",
+        default="127.0.0.1:9100",
+        help="host:port (or URL) of the server's --metrics-port sidecar",
+    )
+    p.add_argument("--json", action="store_true", help="raw JSON instead of the summary")
     p = sub.add_parser("embed-text"); p.add_argument("text")
     p = sub.add_parser("embed-image"); p.add_argument("image")
     p = sub.add_parser("classify"); p.add_argument("image"); p.add_argument("--top-k", type=int, default=5); p.add_argument("--scene", action="store_true")
@@ -569,6 +647,14 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(out, indent=2))
         else:
             _print_autopilot(out)
+        return 0
+    if args.cmd == "peers":
+        # Sidecar HTTP like stats: the federation fleet view.
+        out = get_peers(args.metrics_addr)
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            _print_peers(out)
         return 0
 
     from lumen_tpu.utils.retry import retry_call

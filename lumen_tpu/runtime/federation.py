@@ -1,0 +1,779 @@
+"""Fleet federation: consistent-hash peer routing + cross-host cache tier.
+
+Everything below this module serves from ONE process on one host's chips —
+the actual ceiling for the ROADMAP's "millions of users" north star. This
+module is the first subsystem where the *process boundary* is the unit of
+scale: a set of lumen-tpu servers (peers) becomes one fleet, glued by
+three ideas that all reuse machinery the stack already has, one level up:
+
+- **consistent-hash ring keyed by the content address.** The result cache
+  already addresses work by ``sha256(payload bytes)`` — that digest is
+  network-portable by construction, so hashing it onto a ring of peers
+  gives *cache affinity for free*: identical payloads always land on the
+  same peer, whose RAM/disk tiers therefore concentrate the hits. The
+  ring uses virtual nodes (64 per peer) so 3 peers split the keyspace
+  within a few percent, and membership changes move only the
+  departed/arrived peer's arcs (the classic consistent-hashing property —
+  tested by ``tests/test_federation_props.py``).
+
+- **per-peer health, breaker-style, one level up.** Each peer carries the
+  same failure-streak → eject → background-probe → readmit lifecycle a
+  :class:`~lumen_tpu.serving.breaker.CircuitBreaker` gives one service and
+  a :class:`~lumen_tpu.runtime.fleet.ReplicaSet` gives one replica:
+  in-band forward failures and Health-poll failures feed one streak
+  (``LUMEN_FED_FAILURES``), an ejected peer's ring segment spills to its
+  successors, and a background probe (``LUMEN_FED_POLL_S`` cadence, after
+  ``LUMEN_FED_EJECT_S``) readmits it. Ejection records a ``fed_peer_down``
+  flight-recorder event that captures an incident bundle; readmission
+  records ``fed_peer_readmit``.
+
+- **a peer-cache lookup protocol.** Before computing a missed request, a
+  non-owner peer asks the ring owner's cache over the unchanged gRPC
+  protocol (the reserved ``fed_cache_lookup`` task answered by the hub
+  router, O(1) on the owner, before any admission accounting).
+  Owner-side single-flight extends across the tier: the lookup can wait
+  (``wait_ms``) on the owner's in-flight computation instead of
+  duplicating it. Dedupe is **owner-anchored** (lookup-only, no
+  write-back): traffic routed through a front tier always lands on the
+  owner first, so a duplicate payload costs device work exactly once
+  fleet-wide there (the bench-asserted guarantee); a result computed AT
+  a non-owner (direct traffic that bypassed the front) stays in that
+  host's local cache, so worst case is one compute per first-touch side.
+
+A server with ``LUMEN_FED_PEERS`` **unset boots byte-identical to the
+single-host path**: :func:`maybe_federation` returns ``None``, no thread
+starts, no gauge registers, and the per-request serving path gains only a
+task-name compare (tier-1 guard in ``tests/test_federation.py``).
+
+Deliberately jax-free (like :mod:`~lumen_tpu.runtime.result_cache`): pure
+host plumbing over gRPC, usable by a front tier that owns no models.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import pickle
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import grpc
+from google.protobuf import empty_pb2
+
+from ..utils import telemetry
+from ..utils.deadline import remaining
+from ..utils.env import env_float, env_int, env_list
+from ..utils.metrics import metrics
+from ..utils.trace import current_trace
+
+logger = logging.getLogger(__name__)
+
+PEERS_ENV = "LUMEN_FED_PEERS"
+SELF_ENV = "LUMEN_FED_SELF"
+DISCOVER_ENV = "LUMEN_FED_DISCOVER"
+
+# The reserved cache-lookup task name and the owner-side wait clamp live
+# with their server half in the jax-free router (this module cannot be
+# imported there); re-exported so federation callers have one local name
+# for the protocol.
+from ..serving.router import (  # noqa: E402,F401
+    FED_CACHE_MAX_WAIT_S,
+    FED_CACHE_TASK,
+)
+
+#: per-peer virtual nodes on the ring — enough that 3 peers split the
+#: keyspace within a few percent, cheap enough that membership changes
+#: rebuild in microseconds.
+VNODES = 64
+
+SERVING = "serving"
+EJECTED = "ejected"
+_STATE_CODES = {SERVING: 0, EJECTED: 2}
+
+
+
+def fed_hops() -> int:
+    """``LUMEN_FED_HOPS``: forward attempts per request through the front
+    tier (first ring owner + failover successors; default 3)."""
+    return env_int("LUMEN_FED_HOPS", 3, minimum=1)
+
+
+def fed_failures() -> int:
+    """``LUMEN_FED_FAILURES``: consecutive transport/poll failures that
+    eject a peer from the ring (default 3)."""
+    return env_int("LUMEN_FED_FAILURES", 3, minimum=1)
+
+
+def fed_eject_s() -> float:
+    """``LUMEN_FED_EJECT_S``: how long an ejected peer sheds ring traffic
+    before the background probe may readmit it (default 5s)."""
+    return env_float("LUMEN_FED_EJECT_S", 5.0, minimum=0.1)
+
+
+def fed_poll_s() -> float:
+    """``LUMEN_FED_POLL_S``: health-poll cadence over the peer set
+    (default 2s; each tick Health-probes every non-ejected peer and any
+    ejected peer whose eject window elapsed)."""
+    return env_float("LUMEN_FED_POLL_S", 2.0, minimum=0.1)
+
+
+def fed_lookup_timeout_s() -> float:
+    """``LUMEN_FED_LOOKUP_TIMEOUT_S``: RPC deadline for one peer-cache
+    lookup (default 2s) — a lookup must always be much cheaper than the
+    device work it tries to avoid."""
+    return env_float("LUMEN_FED_LOOKUP_TIMEOUT_S", 2.0, minimum=0.05)
+
+
+def fed_lookup_wait_ms() -> int:
+    """``LUMEN_FED_LOOKUP_WAIT_MS``: how long the OWNER may hold a cache
+    lookup on its in-flight computation of the same key (default 10000) —
+    this is what extends single-flight coalescing across the tier. 0
+    disables the wait (pure cache peek)."""
+    return env_int("LUMEN_FED_LOOKUP_WAIT_MS", 10000, minimum=0)
+
+
+def fed_forward_timeout_s() -> float:
+    """``LUMEN_FED_FORWARD_TIMEOUT_S``: front-tier forward deadline per
+    hop when the client set none (default 300s, the client default)."""
+    return env_float("LUMEN_FED_FORWARD_TIMEOUT_S", 300.0, minimum=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+class HashRing:
+    """Consistent-hash ring over peer names, keyed by sha256 hex digests.
+
+    Positions are the first 8 bytes of ``sha256(f"{name}#{vnode}")``; a
+    key (a sha256 hexdigest — the result cache's content address) maps to
+    the first vnode clockwise from ``int(key[:16], 16)``. Deterministic
+    across processes and insertion orders by construction — the front
+    tier and every peer build the SAME ring from the same peer list, so
+    ownership agrees fleet-wide with zero coordination.
+    """
+
+    def __init__(self, names: list[str], vnodes: int = VNODES):
+        self.names = sorted(set(names))
+        self.vnodes = vnodes
+        points: list[tuple[int, str]] = []
+        for name in self.names:
+            for i in range(vnodes):
+                digest = hashlib.sha256(f"{name}#{i}".encode()).digest()
+                points.append((int.from_bytes(digest[:8], "big"), name))
+        points.sort()
+        self._points = points
+        self._positions = [p for p, _ in points]
+
+    @staticmethod
+    def key_position(key_hex: str) -> int:
+        """Ring position of a content address (sha256 hexdigest or any
+        hex string; shorter strings are zero-extended)."""
+        return int((key_hex[:16] or "0").ljust(16, "0"), 16)
+
+    def owners(self, key_hex: str, n: int = 1, skip: frozenset | set = frozenset()) -> list[str]:
+        """Up to ``n`` DISTINCT peer names in preference order (the ring
+        owner first, then clockwise successors), skipping names in
+        ``skip`` — an ejected peer's arc spills to its successors."""
+        if not self._points or n <= 0:
+            return []
+        out: list[str] = []
+        start = bisect.bisect_right(self._positions, self.key_position(key_hex))
+        total = len(self._points)
+        for step in range(total):
+            name = self._points[(start + step) % total][1]
+            if name in skip or name in out:
+                continue
+            out.append(name)
+            if len(out) >= n:
+                break
+        return out
+
+    def owner(self, key_hex: str, skip: frozenset | set = frozenset()) -> str | None:
+        owners = self.owners(key_hex, 1, skip)
+        return owners[0] if owners else None
+
+    def shares(self) -> dict[str, float]:
+        """Fraction of the keyspace each peer owns (arc-length exact,
+        not sampled) — the ``ring_share`` gauge and the ``peers``
+        subcommand's ownership column."""
+        if not self._points:
+            return {}
+        out = {name: 0 for name in self.names}
+        span = 1 << 64
+        prev = self._points[-1][0] - span  # wrap: last point opens the first arc
+        for pos, name in self._points:
+            out[name] += pos - prev
+            prev = pos
+        return {name: width / span for name, width in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# Peer set
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PeerSpec:
+    """One configured peer: gRPC address plus an optional observability
+    sidecar. Spelled ``host:port`` or ``host:port@sidecar`` in
+    ``LUMEN_FED_PEERS``, where ``sidecar`` is a bare port (same host) or
+    its own ``host:port``."""
+
+    addr: str
+    sidecar: str | None = None
+
+    @property
+    def name(self) -> str:
+        return self.addr
+
+
+def parse_peer_spec(entry: str) -> PeerSpec | None:
+    entry = entry.strip()
+    if not entry:
+        return None
+    addr, _, sidecar = entry.partition("@")
+    addr = addr.strip()
+    if ":" not in addr:
+        logger.warning("malformed %s entry %r (need host:port); ignored", PEERS_ENV, entry)
+        return None
+    sidecar = sidecar.strip() or None
+    if sidecar and ":" not in sidecar:
+        sidecar = f"{addr.rsplit(':', 1)[0]}:{sidecar}"
+    return PeerSpec(addr=addr, sidecar=sidecar)
+
+
+def parse_peer_specs() -> list[PeerSpec]:
+    """The resolved static peer set from ``LUMEN_FED_PEERS`` (empty when
+    unset — federation stays entirely off)."""
+    specs = [parse_peer_spec(e) for e in env_list(PEERS_ENV)]
+    out: list[PeerSpec] = []
+    seen: set[str] = set()
+    for spec in specs:
+        if spec is not None and spec.addr not in seen:
+            seen.add(spec.addr)
+            out.append(spec)
+    return out
+
+
+class Peer:
+    """Live state for one peer: lazy channel/stub, breaker-style health,
+    and dispatch accounting surfaced as ``federation:{addr}`` gauges."""
+
+    def __init__(self, spec: PeerSpec, stub_factory: Callable[[str], Any]):
+        self.spec = spec
+        self.name = spec.name
+        self._stub_factory = stub_factory
+        self._stub = None
+        self._stub_lock = threading.Lock()
+        self.state = SERVING
+        self.streak = 0
+        self.ejected_at = 0.0
+        self.last_ok = 0.0
+        self.last_error = ""
+        self.slo: dict = {}
+        # Incremented lock-free from handler threads: int += is fine for
+        # telemetry (same convention as ResultCache.stats) — health
+        # decisions never read these, only streak/state, which ARE
+        # taken under the manager lock.
+        self.stats = {
+            "dispatches": 0,
+            "failovers": 0,
+            "sheds": 0,
+            "failures": 0,
+            "cache_hits": 0,
+            "cache_misses": 0,
+        }
+
+    @property
+    def stub(self):
+        if self._stub is None:
+            with self._stub_lock:
+                if self._stub is None:
+                    self._stub = self._stub_factory(self.spec.addr)
+        return self._stub
+
+    def close(self) -> None:
+        stub = self._stub
+        self._stub = None
+        chan = getattr(stub, "_lumen_channel", None)
+        if chan is not None:
+            try:
+                chan.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+
+
+def _default_stub_factory(addr: str):
+    from ..serving.proto.ml_service_pb2_grpc import InferenceStub
+
+    channel = grpc.insecure_channel(
+        addr,
+        options=[
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+        ],
+    )
+    stub = InferenceStub(channel)
+    stub._lumen_channel = channel  # teardown handle (Peer.close)
+    return stub
+
+
+# ---------------------------------------------------------------------------
+# Federation manager
+# ---------------------------------------------------------------------------
+
+
+class FederationManager:
+    """The fleet view one server holds: the ring, per-peer health, the
+    background poller, and the peer-cache lookup client.
+
+    Two roles share this one class:
+
+    - a **front tier** (no local models) uses :meth:`plan` +
+      :meth:`record_*` from the routing loop in
+      :class:`~lumen_tpu.serving.router.FederationRouter`;
+    - a **peer-aware backend** (``LUMEN_FED_SELF`` set) installs
+      :meth:`peer_cache_lookup` as the result cache's pre-compute hook so
+      its misses consult the ring owner's cache first.
+    """
+
+    def __init__(
+        self,
+        specs: list[PeerSpec],
+        self_name: str | None = None,
+        stub_factory: Callable[[str], Any] | None = None,
+        hops: int | None = None,
+        failures: int | None = None,
+        eject_s: float | None = None,
+        poll_s: float | None = None,
+    ):
+        if not specs:
+            raise ValueError("federation needs at least one peer")
+        factory = stub_factory or _default_stub_factory
+        self.peers: dict[str, Peer] = {s.name: Peer(s, factory) for s in specs}
+        self.self_name = self_name or None
+        # A self that matches no listed peer is NOT benign for lookups:
+        # the ring still owns arcs under this host's LISTED name, so the
+        # `owner == self` guard would fail and every owned-key miss
+        # would RPC this host's own address and ride its own unresolved
+        # flight until the wait times out (~10s/unique payload). The
+        # server only installs the cache hook when `self_listed`.
+        self.self_listed = self.self_name in self.peers
+        if self.self_name and not self.self_listed:
+            logger.warning(
+                "%s=%r matches no %s entry %s — peer-cache lookups are "
+                "DISABLED on this host (spell self exactly as it appears "
+                "in the peer list)",
+                SELF_ENV, self.self_name, PEERS_ENV, sorted(self.peers),
+            )
+        self.ring = HashRing(list(self.peers))
+        self.hops = fed_hops() if hops is None else max(1, hops)
+        self.failures = fed_failures() if failures is None else max(1, failures)
+        self.eject_s = fed_eject_s() if eject_s is None else max(0.1, eject_s)
+        self.poll_s = fed_poll_s() if poll_s is None else max(0.1, poll_s)
+        self.lookup_timeout_s = fed_lookup_timeout_s()
+        self.lookup_wait_ms = fed_lookup_wait_ms()
+        self.forward_timeout_s = fed_forward_timeout_s()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        shares = self.ring.shares()
+        ref = weakref.ref(self)
+        for name, peer in self.peers.items():
+            share = shares.get(name, 0.0)
+
+            def _gauges(p=peer, share=share) -> dict:
+                m = ref()
+                if m is None:
+                    return {}
+                return {
+                    **p.stats,
+                    "state": _STATE_CODES[p.state],
+                    "streak": p.streak,
+                    "ring_share": round(share, 4),
+                }
+
+            peer._gauge_fn = _gauges
+            metrics.register_gauges(f"federation:{name}", _gauges)
+
+    # -- routing -----------------------------------------------------------
+
+    def _ejected_names(self) -> set[str]:
+        with self._lock:
+            return {n for n, p in self.peers.items() if p.state == EJECTED}
+
+    def plan(self, digest_hex: str) -> list[Peer]:
+        """Forward attempts for one content address, in preference order:
+        live ring owner first, then live successors, up to the hop
+        budget. With every peer ejected the raw owner order is returned
+        anyway — trying a possibly-dead peer beats refusing outright (it
+        doubles as the dispatch-path probe)."""
+        ejected = self._ejected_names()
+        names = self.ring.owners(digest_hex, self.hops, skip=ejected)
+        if not names:
+            names = self.ring.owners(digest_hex, self.hops)
+        return [self.peers[n] for n in names]
+
+    def owner_of(self, digest_hex: str) -> Peer | None:
+        name = self.ring.owner(digest_hex, skip=self._ejected_names())
+        return self.peers.get(name) if name else None
+
+    # -- health accounting (breaker semantics one level up) ----------------
+
+    def record_dispatch(self, peer: Peer, failover: bool = False) -> None:
+        peer.stats["dispatches"] += 1
+        metrics.count("fed_dispatches")
+        if failover:
+            peer.stats["failovers"] += 1
+            metrics.count("fed_failovers")
+
+    def record_success(self, peer: Peer) -> None:
+        with self._lock:
+            peer.streak = 0
+            peer.last_ok = time.monotonic()
+            readmitted = peer.state == EJECTED
+            if readmitted:
+                peer.state = SERVING
+        if readmitted:
+            self._announce_readmit(peer, "dispatch succeeded")
+
+    def record_shed(self, peer: Peer) -> None:
+        """An in-band UNAVAILABLE answer (quota/queue/breaker/drain shed):
+        the peer is ALIVE and talking — overload is not a health verdict
+        (the same neutrality rule the service breaker applies), so the
+        streak is untouched; the request just spills to a successor."""
+        peer.stats["sheds"] += 1
+        metrics.count("fed_sheds")
+
+    def record_unreachable(self, peer: Peer, exc: BaseException, what: str) -> bool:
+        """The ONE filter between an RPC exception and the ejection
+        streak, shared by every dispatch surface (forward, caps, cache
+        lookup): only a transport-unreachable verdict (UNAVAILABLE, or a
+        non-gRPC error from a broken stub) counts. DEADLINE_EXCEEDED and
+        CANCELLED describe the CALLER's budget or patience — ejecting a
+        busy healthy peer for them is the one thing peer health must
+        never do. Returns True when the failure was recorded."""
+        code = (
+            exc.code()
+            if isinstance(exc, grpc.RpcError) and callable(getattr(exc, "code", None))
+            else None
+        )
+        if code is None or code == grpc.StatusCode.UNAVAILABLE:
+            self.record_failure(peer, f"{what}: {type(exc).__name__}: {code or exc}")
+            return True
+        return False
+
+    def record_failure(self, peer: Peer, reason: str) -> None:
+        """A transport-level forward/poll failure — the peer may be gone.
+        ``LUMEN_FED_FAILURES`` consecutive ones eject it from the ring."""
+        peer.stats["failures"] += 1
+        peer.last_error = reason[:200]
+        with self._lock:
+            peer.streak += 1
+            eject = peer.state == SERVING and peer.streak >= self.failures
+            if eject:
+                peer.state = EJECTED
+                peer.ejected_at = time.monotonic()
+        if eject:
+            metrics.count("fed_peer_down")
+            logger.error(
+                "federation peer %s EJECTED after %d consecutive failures "
+                "(%s); ring segment spills to successors, probe in %.1fs",
+                peer.name, peer.streak, reason, self.eject_s,
+            )
+            # Incident-grade: fed_peer_down is in telemetry.INCIDENT_KINDS,
+            # so this captures a flight-recorder bundle (events + traces +
+            # device memory) exactly like a breaker-open or replica-down.
+            telemetry.record_event(
+                "fed_peer_down", peer.name,
+                f"peer ejected after {self.failures} consecutive failures: "
+                f"{reason}",
+                streak=peer.streak,
+            )
+
+    def _announce_readmit(self, peer: Peer, how: str) -> None:
+        metrics.count("fed_peer_readmits")
+        logger.info("federation peer %s readmitted (%s)", peer.name, how)
+        telemetry.record_event(
+            "fed_peer_readmit", peer.name, f"peer readmitted: {how}"
+        )
+
+    # -- background health poll --------------------------------------------
+
+    def start(self) -> None:
+        """Start the one poll thread (idempotent). Never called on the
+        single-host path — :func:`maybe_federation` returns None before
+        any thread exists."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="fed-poll", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None
+        for peer in self.peers.values():
+            metrics.unregister_gauges(f"federation:{peer.name}", peer._gauge_fn)
+            peer.close()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            for peer in list(self.peers.values()):
+                if self._stop.is_set():
+                    return
+                if peer.name == self.self_name:
+                    continue
+                with self._lock:
+                    ejected = peer.state == EJECTED
+                    waiting = ejected and (
+                        time.monotonic() - peer.ejected_at < self.eject_s
+                    )
+                if waiting:
+                    continue  # still inside the eject window: no probe yet
+                self._probe(peer, ejected)
+
+    def _probe(self, peer: Peer, ejected: bool) -> None:
+        try:
+            stub = peer.stub
+            call = stub.Health.with_call(empty_pb2.Empty(), timeout=2.0)
+        except AttributeError:
+            # Test stubs without with_call: plain Health is probe enough.
+            try:
+                peer.stub.Health(empty_pb2.Empty(), timeout=2.0)
+                call = None
+            except Exception as e:  # noqa: BLE001 - probe failure is the signal
+                self.record_failure(peer, f"health probe: {type(e).__name__}: {e}")
+                return
+        except Exception as e:  # noqa: BLE001 - probe failure is the signal
+            self.record_failure(peer, f"health probe: {type(e).__name__}: {e}")
+            return
+        if call is not None:
+            try:
+                # SLO burn + service status ride Health trailing metadata;
+                # stash them so /peers answers "how is that host doing"
+                # without another hop.
+                trailing = call[1].trailing_metadata() or ()
+                for item in trailing:
+                    if item.key == telemetry.SLO_META_KEY:
+                        peer.slo = json.loads(item.value)
+            except Exception:  # noqa: BLE001 - telemetry, never a verdict
+                pass
+        with self._lock:
+            peer.streak = 0
+            peer.last_ok = time.monotonic()
+            readmitted = peer.state == EJECTED
+            if readmitted:
+                peer.state = SERVING
+        if readmitted:
+            self._announce_readmit(peer, "health probe succeeded")
+
+    # -- peer cache lookup (the ResultCache pre-compute hook) --------------
+
+    def peer_cache_lookup(self, key: str, payload: bytes) -> tuple[bool, Any]:
+        """Ask the ring owner's cache for ``key`` before computing
+        locally. Installed as ``ResultCache.peer_lookup`` on peer-aware
+        backends; returns ``(False, None)`` whenever the owner is self,
+        ejected, or unreachable — the caller then computes as before."""
+        if not self.self_listed:
+            # Without a verified self identity the `owner == self` guard
+            # below cannot work — a lookup could land on our own address
+            # and ride our own unresolved flight. Defense in depth for
+            # callers that bypass the server's install gate.
+            return False, None
+        digest = hashlib.sha256(payload).hexdigest()
+        owner = self.owner_of(digest)
+        if owner is None or owner.name == self.self_name:
+            return False, None
+        # The RPC deadline must COVER the owner-side flight wait we are
+        # about to request (plus the probe itself), or the call always
+        # dies DEADLINE_EXCEEDED before the owner's compute resolves and
+        # cross-host coalescing can never engage for slow computes. Still
+        # bounded by our own caller's remaining request deadline.
+        wait_s = min(self.lookup_wait_ms / 1000.0, FED_CACHE_MAX_WAIT_S)
+        timeout = self.lookup_timeout_s + wait_s
+        rem = remaining()
+        if rem is not None:
+            if rem <= 0.01:
+                return False, None
+            timeout = min(timeout, rem)
+        tr = current_trace()
+        span = tr.begin("fed.peer_cache", {"peer": owner.name}) if tr else None
+        found, value = self._lookup_once(owner, key, timeout)
+        if span is not None:
+            span.end(hit="1" if found else "0")
+        return found, value
+
+    def _lookup_once(self, owner: Peer, key: str, timeout: float) -> tuple[bool, Any]:
+        from ..serving.proto import ml_service_pb2 as pb
+
+        try:
+            req = pb.InferRequest(
+                correlation_id="fedcache",
+                task=FED_CACHE_TASK,
+                payload=key.encode("utf-8"),
+                meta={"wait_ms": str(self.lookup_wait_ms)},
+            )
+            resps = list(owner.stub.Infer(iter([req]), timeout=timeout))
+        except Exception as e:  # noqa: BLE001 - a failed lookup is a miss
+            owner.stats["cache_misses"] += 1
+            metrics.count("fed_cache_peer_misses")
+            # Streak only on transport-unreachable (see record_unreachable):
+            # a DEADLINE_EXCEEDED means the peer answered at the TCP level
+            # but our own budget ran out (slow flight, caller's deadline).
+            self.record_unreachable(owner, e, "cache lookup")
+            return False, None
+        last = resps[-1] if resps else None
+        if (
+            last is None
+            or last.HasField("error")
+            or last.meta.get("fed_cache") != "hit"
+        ):
+            owner.stats["cache_misses"] += 1
+            metrics.count("fed_cache_peer_misses")
+            return False, None
+        try:
+            value = pickle.loads(b"".join(r.result for r in resps))
+        except Exception as e:  # noqa: BLE001 - a torn blob is a miss
+            logger.warning("peer cache blob from %s undecodable: %s", owner.name, e)
+            owner.stats["cache_misses"] += 1
+            metrics.count("fed_cache_peer_misses")
+            return False, None
+        owner.stats["cache_hits"] += 1
+        self.record_success(owner)
+        metrics.count("fed_cache_peer_hits")
+        return True, value
+
+    # -- status surfaces ----------------------------------------------------
+
+    def health_status(self) -> dict:
+        """Compact per-peer state for the ``lumen-fed-status`` Health
+        trailing-metadata key."""
+        with self._lock:
+            states = {n: p.state for n, p in sorted(self.peers.items())}
+        return {"self": self.self_name, "peers": states}
+
+    def export_status(self) -> dict:
+        """Full per-peer view for ``GET /peers`` and the client ``peers``
+        subcommand."""
+        shares = self.ring.shares()
+        now = time.monotonic()
+        peers: dict[str, dict] = {}
+        hits = misses = 0
+        with self._lock:
+            for name, p in sorted(self.peers.items()):
+                hits += p.stats["cache_hits"]
+                misses += p.stats["cache_misses"]
+                peers[name] = {
+                    "state": p.state,
+                    "streak": p.streak,
+                    **p.stats,
+                    "ring_share": round(shares.get(name, 0.0), 4),
+                    "sidecar": p.spec.sidecar,
+                    "last_ok_s_ago": (
+                        round(now - p.last_ok, 1) if p.last_ok else None
+                    ),
+                    "last_error": p.last_error or None,
+                    "slo": p.slo or None,
+                }
+        return {
+            "enabled": True,
+            "mode": "peer" if self.self_name else "front",
+            "self": self.self_name,
+            "hops": self.hops,
+            "peers": peers,
+            "cache_peer_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses
+            else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide instance + boot wiring
+# ---------------------------------------------------------------------------
+
+_manager: FederationManager | None = None
+_manager_lock = threading.Lock()
+
+
+def get_federation() -> FederationManager | None:
+    return _manager
+
+
+def install_federation(manager: FederationManager | None) -> None:
+    global _manager
+    with _manager_lock:
+        _manager = manager
+
+
+def export_status() -> dict:
+    """Module-level status for the observability sidecar's ``GET /peers``
+    (read via ``sys.modules`` so a jax-free sidecar never imports this)."""
+    m = _manager
+    if m is None:
+        return {"enabled": False, "peers": {}, "detail": "federation not configured"}
+    return m.export_status()
+
+
+def health_status() -> dict:
+    m = _manager
+    return m.health_status() if m is not None else {}
+
+
+def maybe_federation() -> FederationManager | None:
+    """Build (and install) the fleet view from the environment, or None.
+
+    Peer sources: the ``LUMEN_FED_PEERS`` comma list, plus (with
+    ``LUMEN_FED_DISCOVER=1``) a one-shot mDNS browse for ``_lumen._tcp``
+    advertisers on the LAN. With neither configured this returns None
+    having done NOTHING — no thread, no gauge, no socket — which is the
+    whole single-host overhead story. The resolved peer set is logged
+    once. The poll thread starts only when the caller says so
+    (``manager.start()``)."""
+    import os
+
+    specs = parse_peer_specs()
+    if os.environ.get(DISCOVER_ENV) == "1":
+        from ..serving.mdns import discover_peers
+
+        known = {s.addr for s in specs}
+        discovered = [a for a in discover_peers() if a not in known]
+        if discovered:
+            # Trust posture, stated where the decision lands: mDNS is
+            # unauthenticated and the peer protocol (insecure gRPC +
+            # pickled cache blobs) assumes fleet-internal trust — any
+            # LAN host that advertises _lumen._tcp joins the ring and
+            # can answer cache lookups. Only enable discovery on
+            # networks where every host is already trusted to serve.
+            logger.warning(
+                "federation: adding %d UNAUTHENTICATED mDNS-discovered "
+                "peer(s) %s — the peer protocol assumes a trusted "
+                "network (insecure gRPC, pickled cache payloads); use "
+                "%s on untrusted LANs instead",
+                len(discovered), discovered, PEERS_ENV,
+            )
+        for addr in discovered:
+            specs.append(PeerSpec(addr=addr))
+    if not specs:
+        return None
+    manager = FederationManager(specs, self_name=os.environ.get(SELF_ENV) or None)
+    logger.info(
+        "federation: %d peer(s) resolved: %s%s (hops=%d, failures=%d, "
+        "eject=%.1fs, poll=%.1fs)",
+        len(specs),
+        [s.addr for s in specs],
+        f"; self={manager.self_name}" if manager.self_name else " (front tier)",
+        manager.hops, manager.failures, manager.eject_s, manager.poll_s,
+    )
+    install_federation(manager)
+    return manager

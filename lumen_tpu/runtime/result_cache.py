@@ -181,7 +181,23 @@ class ResultCache:
     ``get_or_compute`` is the whole API surface the serving path uses; the
     lower-level ``get``/``put``/``invalidate`` exist for the ingest
     pipeline (bulk peek/store without single-flight) and the hot-swap hook.
+
+    **Fleet federation hook** (:mod:`~lumen_tpu.runtime.federation`):
+    ``peer_lookup`` — when set (peer-aware backends with
+    ``LUMEN_FED_SELF``), a local miss consults the consistent-hash ring
+    owner's cache over the wire BEFORE computing — owner-anchored
+    dedupe: duplicates that reach the ring owner first (all
+    front-tier-routed traffic) cost device work once fleet-wide; a
+    result computed at a non-owner stays local (lookup-only protocol,
+    no write-back). The hook is ``(key, payload) -> (found, value)``
+    and must never raise into the serving path (failures are treated as
+    a miss). ``None`` (the default, and the only state when federation
+    is unconfigured) keeps the miss path byte-identical to single-host.
     """
+
+    #: optional cross-host lookup consulted on the owner path of a miss
+    #: (set by the federation boot wiring; None = single-host behavior).
+    peer_lookup: Callable[[str, bytes], tuple[bool, Any]] | None = None
 
     def __init__(
         self,
@@ -545,7 +561,25 @@ class ResultCache:
         self._count("misses", "cache_misses")
         fence = self.current_fence()
         try:
-            value = compute()
+            value = None
+            served_by_peer = False
+            hook = self.peer_lookup
+            if hook is not None:
+                # Cross-host dedupe: ask the ring owner's cache before
+                # burning device time. A hook failure of ANY kind is a
+                # miss — federation must never break local serving.
+                try:
+                    served_by_peer, value = hook(key, payload)
+                except Exception:  # noqa: BLE001 - peer lookup is best-effort
+                    logger.exception("peer cache lookup failed; computing locally")
+                    served_by_peer = False
+            if served_by_peer:
+                # Surfaces as ``cache_peer_hit`` response meta — the
+                # client-observed proof that a duplicate cost no device
+                # work anywhere in the fleet.
+                _mark("peer_hit")
+            else:
+                value = compute()
         except BaseException as e:
             flight.set_exception(e)
             raise
@@ -574,6 +608,34 @@ class ResultCache:
             with self._lock:
                 if self._inflight.get(key) is flight:
                     self._inflight.pop(key)
+
+    def peek_or_wait(self, key: str, wait_s: float = 0.0) -> tuple[bool, Any]:
+        """Tier probe for the federation cache-lookup RPC: RAM-then-disk
+        ``get``, and — when ``wait_s`` > 0 and an identical computation is
+        in flight HERE — ride that flight instead of answering miss. This
+        is what extends single-flight coalescing across the fleet: N hosts
+        asking the owner for a key the owner is currently computing get
+        ONE device submission total. Owner-overload failures on the flight
+        (shed/deadline/poison) answer miss — those verdicts are the
+        owner's, never the remote requester's."""
+        found, value = self.get(key)
+        if found or wait_s <= 0:
+            return found, value
+        with self._lock:
+            flight = self._inflight.get(key)
+        if flight is None:
+            return False, None
+        with self._lock:
+            self._waiting += 1
+        try:
+            value = flight.result(timeout=min(wait_s, 86400.0))
+        except BaseException:  # noqa: BLE001 - any flight failure is a miss here
+            return False, None
+        finally:
+            with self._lock:
+                self._waiting -= 1
+        self._count("coalesced", "cache_coalesced")
+        return True, value
 
     # -- invalidation ------------------------------------------------------
 
@@ -815,6 +877,49 @@ def reset_result_cache() -> None:
         cache, _shared = _shared, None
     if cache is not None:
         cache.close()
+
+
+def peer_export(key: str, wait_s: float = 0.0) -> bytes | None:
+    """Wire-format (pickle) export of one entry for the federation
+    cache-lookup RPC — ``None`` is a miss. Reads the shared cache WITHOUT
+    instantiating one (a process that never cached owns nothing to
+    export), honors the bounded flight wait (:meth:`ResultCache.peek_or_wait`),
+    and answers miss for unpicklable values. Jax-free and cheap: this is
+    answered by the hub router before any admission accounting."""
+    with _shared_lock:
+        cache = _shared
+    if cache is None or not cache.enabled:
+        return None
+    found, value = cache.peek_or_wait(key, wait_s=wait_s)
+    if not found:
+        return None
+    blob = cache._encode(value)
+    if blob is not None:
+        metrics.count("fed_cache_serves")
+    return blob
+
+
+def detach_peer_lookup(hook) -> None:
+    """Remove a federation peer-lookup hook IF it is still the installed
+    one (server teardown; a later boot may have installed its own).
+    Bound methods are compared by (__self__, __func__): CPython
+    materializes a FRESH bound-method object per attribute access, so a
+    plain ``is`` on ``manager.peer_cache_lookup`` never matches the one
+    installed at boot — and a stale hook left behind would keep routing
+    every cache miss at a torn-down fleet."""
+    with _shared_lock:
+        cache = _shared
+    if cache is None:
+        return
+    cur = cache.peer_lookup
+    if cur is None:
+        return
+    same = cur is hook or (
+        getattr(cur, "__func__", None) is getattr(hook, "__func__", object())
+        and getattr(cur, "__self__", None) is getattr(hook, "__self__", object())
+    )
+    if same:
+        cache.peer_lookup = None
 
 
 def invalidate_namespace(prefix: str) -> int:

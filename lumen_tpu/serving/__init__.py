@@ -16,7 +16,7 @@ from .base_service import (
 from .breaker import CircuitBreaker
 from .registry import TaskDefinition, TaskRegistry
 from .resilience import DegradedService, RecoveryManager
-from .router import HubRouter
+from .router import FederationRouter, HubRouter
 
 __all__ = [
     "BaseService",
@@ -31,5 +31,6 @@ __all__ = [
     "TaskDefinition",
     "TaskRegistry",
     "HubRouter",
+    "FederationRouter",
     "reassemble_result",
 ]

@@ -721,6 +721,10 @@ class BaseService(InferenceServicer):
                     meta["cache_hit"] = "1"
                 if marks.get("coalesced"):
                     meta["cache_coalesced"] = "1"
+                if marks.get("peer_hit"):
+                    # Served from a PEER host's cache via the federation
+                    # lookup: no device work anywhere in the fleet.
+                    meta["cache_peer_hit"] = "1"
                 tr = request_trace.current_trace()
                 ser = None
                 if tr is not None:

@@ -214,3 +214,140 @@ class MdnsAdvertiser:
             if qname.lower() in ours:
                 return True
         return False
+
+
+def parse_mdns_response(data: bytes) -> list[dict]:
+    """Parse one mDNS RESPONSE packet into advertised ``_lumen._tcp``
+    instances: ``[{instance, host, ip, port, properties}]``.
+
+    The inverse of :meth:`MdnsAdvertiser._response_packet` (and of any
+    zeroconf-compliant advertiser): walk the answer records, join SRV
+    (port + target host) with A (host -> IP) and TXT (properties) per
+    instance. Records for other service types are ignored. Malformed
+    packets return ``[]`` — discovery is best-effort by construction."""
+    if len(data) < 12:
+        return []
+    try:
+        _tid, flags, qdcount, ancount, nscount, arcount = struct.unpack(
+            "!HHHHHH", data[:12]
+        )
+    except struct.error:
+        return []
+    if not flags & 0x8000:  # a query, not a response
+        return []
+    off = 12
+    try:
+        for _ in range(qdcount):  # skip the (usually absent) question section
+            _q, off = _decode_name(data, off)
+            off += 4
+        srv: dict[str, tuple[str, int]] = {}  # instance -> (target host, port)
+        txt: dict[str, dict[str, str]] = {}
+        a_records: dict[str, str] = {}  # host name -> dotted quad
+        for _ in range(ancount + nscount + arcount):
+            name, off = _decode_name(data, off)
+            if off + 10 > len(data):
+                break
+            rtype, _rclass, _ttl, rdlen = struct.unpack(
+                "!HHIH", data[off : off + 10]
+            )
+            off += 10
+            rdata_off, off = off, off + rdlen
+            if off > len(data):
+                break
+            if rtype == _TYPE_SRV and rdlen >= 6:
+                _prio, _weight, port = struct.unpack(
+                    "!HHH", data[rdata_off : rdata_off + 6]
+                )
+                target, _ = _decode_name(data, rdata_off + 6)
+                srv[name.lower()] = (target.lower(), port)
+            elif rtype == _TYPE_A and rdlen == 4:
+                a_records[name.lower()] = socket.inet_ntoa(
+                    data[rdata_off : rdata_off + 4]
+                )
+            elif rtype == _TYPE_TXT:
+                props: dict[str, str] = {}
+                p = rdata_off
+                while p < rdata_off + rdlen:
+                    ln = data[p]
+                    kv = data[p + 1 : p + 1 + ln].decode("utf-8", "replace")
+                    p += 1 + ln
+                    if "=" in kv:
+                        k, _, v = kv.partition("=")
+                        props[k] = v
+                txt[name.lower()] = props
+    except Exception:  # noqa: BLE001 - malformed packet: nothing discovered
+        return []
+    out = []
+    for instance, (target, port) in srv.items():
+        if not instance.endswith(SERVICE_TYPE.lower()):
+            continue
+        ip = a_records.get(target)
+        if ip is None and a_records:
+            # Single-advertiser packets (ours) carry exactly one A record.
+            ip = next(iter(a_records.values()))
+        if ip is None:
+            continue
+        out.append({
+            "instance": instance[: -len(SERVICE_TYPE) - 1] or instance,
+            "host": target,
+            "ip": ip,
+            "port": port,
+            "properties": txt.get(instance, {}),
+        })
+    return out
+
+
+class MdnsBrowser:
+    """One-shot LAN browse for ``_lumen._tcp`` advertisers — the matching
+    half of :class:`MdnsAdvertiser` (which only answers queries). Used by
+    federation peer discovery (``LUMEN_FED_DISCOVER=1``): send one PTR
+    query for the service type, collect responses for ``timeout_s``,
+    return the parsed instances."""
+
+    def __init__(self, timeout_s: float = 1.5):
+        self.timeout_s = timeout_s
+
+    def _query_packet(self) -> bytes:
+        header = struct.pack("!HHHHHH", 0, 0, 1, 0, 0, 0)
+        question = _encode_name(SERVICE_TYPE) + struct.pack("!HH", _TYPE_PTR, _CLASS_IN)
+        return header + question
+
+    def browse(self) -> list[dict]:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM, socket.IPPROTO_UDP)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        found: dict[tuple[str, int], dict] = {}
+        try:
+            try:
+                sock.bind(("", MDNS_PORT))
+                mreq = socket.inet_aton(MDNS_GROUP) + socket.inet_aton("0.0.0.0")
+                sock.setsockopt(socket.IPPROTO_IP, socket.IP_ADD_MEMBERSHIP, mreq)
+            except OSError as e:
+                logger.warning("mDNS browse unavailable (%s)", e)
+                return []
+            sock.settimeout(0.25)
+            sock.sendto(self._query_packet(), (MDNS_GROUP, MDNS_PORT))
+            deadline = time.monotonic() + self.timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    data, _addr = sock.recvfrom(4096)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                for rec in parse_mdns_response(data):
+                    found[(rec["ip"], rec["port"])] = rec
+        finally:
+            sock.close()
+        return list(found.values())
+
+
+def discover_peers(timeout_s: float = 1.5) -> list[str]:
+    """One-shot federation peer discovery: browse the LAN and return
+    ``host:port`` gRPC addresses of advertised lumen servers, sorted for
+    deterministic ring membership across hosts that ran the same browse."""
+    peers = sorted(f"{r['ip']}:{r['port']}" for r in MdnsBrowser(timeout_s).browse())
+    if peers:
+        logger.info("mDNS discovery resolved %d peer(s): %s", len(peers), peers)
+    else:
+        logger.info("mDNS discovery found no lumen advertisers on the LAN")
+    return peers

@@ -145,6 +145,23 @@ class MetricsServer:
                                     "loops": {}, "decisions": [],
                                     "error": str(e)}
                     self._send(200, json.dumps(body))
+                elif path == "/peers":
+                    # Same no-jax rule as /autopilot: read the federation
+                    # module only when it is already loaded in-process; a
+                    # non-federated (or jax-free) sidecar answers
+                    # "not configured" honestly.
+                    mod = sys.modules.get("lumen_tpu.runtime.federation")
+                    if mod is None:
+                        body = {
+                            "enabled": False, "peers": {},
+                            "detail": "federation module not loaded in this process",
+                        }
+                    else:
+                        try:
+                            body = mod.export_status()
+                        except Exception as e:  # noqa: BLE001 - report, don't 500
+                            body = {"enabled": False, "peers": {}, "error": str(e)}
+                    self._send(200, json.dumps(body))
                 elif path == "/events":
                     q = parse_qs(parsed.query)
                     try:

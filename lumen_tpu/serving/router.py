@@ -36,6 +36,7 @@ Resilience semantics on top of the reference:
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import logging
@@ -46,14 +47,64 @@ from typing import Iterable, Iterator
 import grpc
 from google.protobuf import empty_pb2
 
-from .base_service import BaseService
+from ..utils import trace as request_trace
+from ..utils.metrics import metrics
+from .base_service import BaseService, _Assembly
 from .proto import ml_service_pb2 as pb
 from .proto.ml_service_pb2_grpc import InferenceServicer
 
 logger = logging.getLogger(__name__)
 
+#: Reserved task name of the federation cache-lookup RPC. Answered HERE —
+#: before routing, before the drain gate, before any admission accounting —
+#: because a cache read is a cheap read-only probe that must keep working
+#: on a draining peer and costs O(1) on the owner. Payload = the exact
+#: result-cache key (UTF-8); response meta ``fed_cache`` = ``hit``/``miss``
+#: with the pickle blob as the result on a hit. The client half lives in
+#: :mod:`lumen_tpu.runtime.federation`.
+FED_CACHE_TASK = "fed_cache_lookup"
+
+#: cap one cache-lookup answer under the gRPC message limit (with
+#: protobuf headroom); larger entries answer miss and the requester
+#: computes — correctness first, the dedupe win is for typical results.
+_FED_CACHE_MAX_BLOB = 48 * 1024 * 1024
+
+#: hard cap on how long the OWNER parks a handler thread riding its own
+#: in-flight computation for a cache lookup (the requester asks via
+#: ``wait_ms``; the effective wait is further clamped to the lookup
+#: RPC's own remaining deadline — a waiter whose caller is gone must not
+#: keep a thread). Re-exported by :mod:`lumen_tpu.runtime.federation`.
+FED_CACHE_MAX_WAIT_S = 30.0
+
+
+def _fed_wait_slots() -> threading.Semaphore:
+    """Process-wide cap on CONCURRENTLY-PARKED cache-lookup waits — the
+    per-RPC deadline clamp bounds each wait, this bounds the aggregate:
+    with the default 10-thread gRPC pool, a handful of slow flights each
+    attracting one waiting lookup per non-owner peer could otherwise park
+    every handler thread and starve this host's own Health probes into a
+    fleet-wide ejection. Over the cap, lookups degrade to an immediate
+    peek (miss if not cached) — the requester computes, which is always
+    correct. Sized to half the handler pool, floor 1."""
+    global _FED_WAIT_SLOTS
+    if _FED_WAIT_SLOTS is None:
+        from ..utils.env import env_int
+
+        workers = env_int("LUMEN_GRPC_WORKERS", 10, minimum=1)
+        _FED_WAIT_SLOTS = threading.Semaphore(max(1, workers // 2))
+    return _FED_WAIT_SLOTS
+
+
+_FED_WAIT_SLOTS: threading.Semaphore | None = None
+
 
 class HubRouter(InferenceServicer):
+    #: Fleet view (:class:`~lumen_tpu.runtime.federation.FederationManager`)
+    #: attached by the server on peer-aware boots; None (the default and
+    #: the only state when ``LUMEN_FED_PEERS`` is unset) keeps every
+    #: request path byte-identical to single-host.
+    federation = None
+
     def __init__(self, services: dict[str, BaseService]):
         self.services = dict(services)
         self._lock = threading.Lock()
@@ -165,6 +216,26 @@ class HubRouter(InferenceServicer):
                 "hot-swap of %r invalidated %d cached result(s)", name, dropped
             )
 
+    def _drain_response(self, first: pb.InferRequest) -> pb.InferResponse:
+        """The drain-gate refusal: in-band UNAVAILABLE with a parseable
+        retry hint. ONE definition — the hub and the federation front
+        tier must never drift on the drain contract."""
+        from ..utils.qos import RETRY_AFTER_META
+
+        return pb.InferResponse(
+            correlation_id=first.correlation_id,
+            is_final=True,
+            meta={RETRY_AFTER_META: self._drain_retry_ms},
+            error=pb.Error(
+                code=pb.ERROR_CODE_UNAVAILABLE,
+                message="server is draining for shutdown",
+                detail=(
+                    "graceful drain in progress; retry with backoff "
+                    "(lumen-retry-after-ms) against another replica"
+                ),
+            ),
+        )
+
     def _route(self, task: str) -> BaseService | None:
         with self._lock:
             return self._route_table.get(task)
@@ -180,27 +251,77 @@ class HubRouter(InferenceServicer):
 
     # -- rpcs -------------------------------------------------------------
 
+    def _answer_cache_lookup(
+        self, first: pb.InferRequest, context=None
+    ) -> pb.InferResponse:
+        """Server half of the federation cache-lookup protocol: probe the
+        local result cache (and, with a ``wait_ms`` meta, ride a live
+        single-flight) for the requested key. Reads the cache module via
+        ``sys.modules`` — a process that never loaded the runtime package
+        (jax-free echo deployments, the front tier itself) answers miss
+        without importing anything."""
+        blob = None
+        mod = sys.modules.get("lumen_tpu.runtime.result_cache")
+        if mod is not None:
+            try:
+                wait_ms = int(first.meta.get("wait_ms", "0") or "0")
+            except ValueError:
+                wait_ms = 0
+            wait_s = min(max(wait_ms, 0) / 1000.0, FED_CACHE_MAX_WAIT_S)
+            # Never wait past the lookup RPC's own deadline: once the
+            # requester's call has expired, riding the flight further
+            # only parks this handler thread for nobody (handler-pool
+            # exhaustion on the owner is how a HEALTHY host gets its
+            # Health probes starved and ejected).
+            rem_fn = getattr(context, "time_remaining", None)
+            if callable(rem_fn):
+                try:
+                    rem = rem_fn()
+                except Exception:  # noqa: BLE001 - stub contexts
+                    rem = None
+                if rem is not None:
+                    wait_s = max(0.0, min(wait_s, rem - 0.1))
+            key = bytes(first.payload).decode("utf-8", "replace")
+            slots = _fed_wait_slots()
+            parked = wait_s > 0 and slots.acquire(blocking=False)
+            if wait_s > 0 and not parked:
+                wait_s = 0.0  # wait budget spent: peek-only, never park
+            try:
+                blob = mod.peer_export(key, wait_s=wait_s)
+            except Exception:  # noqa: BLE001 - a lookup must never 500 the peer
+                logger.exception("federation cache export failed")
+                blob = None
+            finally:
+                if parked:
+                    slots.release()
+        if blob is None or len(blob) > _FED_CACHE_MAX_BLOB:
+            return pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                meta={"fed_cache": "miss"},
+            )
+        return pb.InferResponse(
+            correlation_id=first.correlation_id,
+            is_final=True,
+            result=blob,
+            result_mime="application/x-python-pickle",
+            meta={"fed_cache": "hit"},
+            total=1,
+        )
+
     def Infer(self, request_iterator: Iterable[pb.InferRequest], context) -> Iterator[pb.InferResponse]:
         try:
             first = next(iter(request_iterator))
         except StopIteration:
             return
+        if first.task == FED_CACHE_TASK:
+            # Peer-cache protocol: answered before the drain gate and the
+            # route table on purpose (read-only, O(1), and a draining or
+            # modelless peer must still serve its cache).
+            yield self._answer_cache_lookup(first, context)
+            return
         if self._draining:
-            from ..utils.qos import RETRY_AFTER_META
-
-            yield pb.InferResponse(
-                correlation_id=first.correlation_id,
-                is_final=True,
-                meta={RETRY_AFTER_META: self._drain_retry_ms},
-                error=pb.Error(
-                    code=pb.ERROR_CODE_UNAVAILABLE,
-                    message="server is draining for shutdown",
-                    detail=(
-                        "graceful drain in progress; retry with backoff "
-                        "(lumen-retry-after-ms) against another replica"
-                    ),
-                ),
-            )
+            yield self._drain_response(first)
             return
         target = self._route(first.task)
         if target is None:
@@ -342,6 +463,18 @@ class HubRouter(InferenceServicer):
         except Exception:  # noqa: BLE001 - health must never fail on telemetry
             return {}
 
+    def _fed_status(self) -> dict:
+        """Per-peer federation state for the ``lumen-fed-status``
+        trailing-metadata key. ``{}`` (no fleet attached) omits the key —
+        single-host Health payloads stay byte-identical."""
+        fed = self.federation
+        if fed is None:
+            return {}
+        try:
+            return fed.health_status()
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            return {}
+
     @staticmethod
     def _quarantine_size() -> int | None:
         """Entries currently quarantined, WITHOUT importing the runtime
@@ -388,6 +521,12 @@ class HubRouter(InferenceServicer):
                     # browned-out bulk lane is a reported condition, not
                     # an outage.
                     trailing.append(("lumen-qos-status", json.dumps(qos_state)))
+                fed_state = self._fed_status()
+                if fed_state:
+                    # Fleet view next to the containment keys: an ejected
+                    # peer is a reported condition (its ring segment
+                    # spilled to successors), not an outage of THIS host.
+                    trailing.append(("lumen-fed-status", json.dumps(fed_state)))
                 ap_state = self._autopilot_state()
                 if ap_state:
                     # Whether the capacity controller is live, which loops
@@ -411,5 +550,298 @@ class HubRouter(InferenceServicer):
             context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 f"all services degraded: {sorted(broken)}",
+            )
+        return empty_pb2.Empty()
+
+
+class FederationRouter(HubRouter):
+    """Front tier: a lumen-tpu server that owns NO models and routes every
+    Infer stream over N peer servers speaking the unchanged gRPC protocol
+    (so a front tier can itself be fronted — tiers compose).
+
+    Routing is consistent-hash by the request payload's sha256 — the same
+    content address the result cache keys on — so identical payloads
+    always land on the same peer and its cache concentrates the hits.
+    Per-request resilience: the hop budget (``LUMEN_FED_HOPS``) walks the
+    ring owner's live successors on a transport failure (peer dead —
+    feeds the ejection streak) or an in-band UNAVAILABLE shed (peer alive
+    but refusing — neutral, the request just spills); when every hop is
+    exhausted the LAST peer's answer is relayed verbatim so the
+    ``lumen-retry-after-ms`` hint survives the front-tier hop (and is
+    echoed as trailing metadata for clients that only read that).
+
+    The request stream is buffered before the first forward: failover
+    must be able to replay it, and replay is only safe while no response
+    byte has been seen (the same contract the client's own stream-setup
+    retry keeps). After the first forwarded response reaches the client,
+    failures propagate — blind re-dispatch could double-run a task.
+    """
+
+    def __init__(self, federation):
+        super().__init__({})
+        self.federation = federation
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _forward_metadata(context) -> tuple | None:
+        """Propagate every ``lumen-*`` request-metadata pair (tenant id,
+        trace id) to the chosen peer — QoS identity and trace stitching
+        must survive the hop."""
+        md = getattr(context, "invocation_metadata", None)
+        if not callable(md):
+            return None
+        out: list[tuple[str, str]] = []
+        try:
+            for item in md() or ():
+                key = getattr(item, "key", None)
+                value = getattr(item, "value", None)
+                if key is None and isinstance(item, (tuple, list)) and len(item) == 2:
+                    key, value = item
+                if key and str(key).startswith("lumen-"):
+                    out.append((str(key), str(value)))
+        except Exception:  # noqa: BLE001 - metadata must never break routing
+            return None
+        return tuple(out) or None
+
+    @staticmethod
+    def _reroutable_shed(resp: pb.InferResponse) -> bool:
+        """An in-band UNAVAILABLE as the FIRST response: the peer refused
+        before dispatch (drain, breaker, quota, queue shed) and said so
+        parseably — re-sending elsewhere is explicitly safe."""
+        return bool(
+            resp.HasField("error")
+            and resp.error.code == pb.ERROR_CODE_UNAVAILABLE
+        )
+
+    def _relay_exhausted(
+        self, context, cid: str, last_shed: pb.InferResponse | None, tried: int
+    ) -> pb.InferResponse:
+        """Every hop failed: relay the last in-band answer verbatim (its
+        response meta — retry hint included — is the peer's own words),
+        echoing the hint into trailing metadata so it survives for
+        clients that only read the RPC trailer."""
+        from ..utils.qos import RETRY_AFTER_META, retry_after_ms
+
+        metrics.count("fed_exhausted")
+        if last_shed is not None:
+            hint = last_shed.meta.get(RETRY_AFTER_META, "")
+        else:
+            hint = ""
+        if not hint:
+            hint = retry_after_ms(1.0)
+        if context is not None:
+            try:
+                context.set_trailing_metadata(((RETRY_AFTER_META, hint),))
+            except Exception:  # noqa: BLE001 - stubs may lack metadata support
+                pass
+        if last_shed is not None:
+            return last_shed
+        return pb.InferResponse(
+            correlation_id=cid,
+            is_final=True,
+            meta={RETRY_AFTER_META: hint},
+            error=pb.Error(
+                code=pb.ERROR_CODE_UNAVAILABLE,
+                message=f"all {tried} federation peer(s) unavailable",
+                detail=(
+                    "front tier exhausted its hop budget; retry with "
+                    "backoff (lumen-retry-after-ms)"
+                ),
+            ),
+        )
+
+    # -- rpcs --------------------------------------------------------------
+
+    def Infer(self, request_iterator: Iterable[pb.InferRequest], context) -> Iterator[pb.InferResponse]:
+        try:
+            first = next(iter(request_iterator))
+        except StopIteration:
+            return
+        if first.task == FED_CACHE_TASK:
+            # A cache lookup must NEVER be consistent-hash-forwarded: the
+            # ring is keyed on the original payload's digest, not on the
+            # key STRING this request carries, so a forward would land on
+            # a random peer and park its handler for nothing. A front
+            # tier owns no cache — answer miss honestly, right here.
+            yield self._answer_cache_lookup(first, context)
+            return
+        if self._draining:
+            yield self._drain_response(first)
+            return
+        tr = None
+        if request_trace.enabled():
+            tr = request_trace.begin_request(
+                f"fed:{first.task}",
+                trace_id=BaseService._trace_id_from(context),
+            )
+        if tr is None:
+            yield from self._route_and_forward(first, request_iterator, context, None)
+            return
+        token = request_trace.activate(tr)
+        try:
+            for resp in self._route_and_forward(first, request_iterator, context, tr):
+                if resp.HasField("error"):
+                    tr.set_error(resp.error.message or "error")
+                yield resp
+        except BaseException as e:
+            tr.set_error(f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            request_trace.deactivate(token)
+            request_trace.finish_request(tr)
+
+    def _route_and_forward(
+        self, first: pb.InferRequest, request_iterator, context, tr
+    ) -> Iterator[pb.InferResponse]:
+        fed = self.federation
+        # Buffer the whole request stream: the ring key needs the full
+        # payload (chunked uploads), and failover needs an exact replay.
+        msgs: list[pb.InferRequest] = [first]
+        asm = _Assembly()
+        asm.add(first)
+        for req in request_iterator:
+            msgs.append(req)
+            if not asm.complete and req.correlation_id == first.correlation_id:
+                asm.add(req)
+        rspan = tr.begin("fed.route") if tr is not None else None
+        digest = hashlib.sha256(asm.payload()).hexdigest()
+        plan = fed.plan(digest)
+        if rspan is not None:
+            rspan.end(
+                owner=plan[0].name if plan else "none", candidates=str(len(plan))
+            )
+        if not plan:
+            yield self._relay_exhausted(context, first.correlation_id, None, 0)
+            return
+        timeout = None
+        tr_fn = getattr(context, "time_remaining", None)
+        if callable(tr_fn):
+            try:
+                timeout = tr_fn()
+            except Exception:  # noqa: BLE001 - stub contexts
+                timeout = None
+        if timeout is None or timeout <= 0:
+            timeout = fed.forward_timeout_s
+        # Clamp: a no-deadline client surfaces as a HUGE time_remaining()
+        # on some gRPC stacks, and that number fed raw into the forward's
+        # deadline overflows C time — the call dies instantly instead of
+        # never (same trap the result cache's flight wait hit).
+        timeout = min(timeout, 86400.0)
+        md = self._forward_metadata(context)
+        kwargs = {"timeout": timeout} if md is None else {
+            "timeout": timeout, "metadata": md,
+        }
+        with self._lock:
+            self._active_streams += 1
+        try:
+            last_shed = None
+            for attempt, peer in enumerate(plan):
+                fed.record_dispatch(peer, failover=attempt > 0)
+                fspan = (
+                    tr.begin("fed.forward", {"peer": peer.name, "hop": str(attempt)})
+                    if tr is not None
+                    else None
+                )
+                got_any = False
+                shed = None
+                try:
+                    for resp in peer.stub.Infer(iter(msgs), **kwargs):
+                        if not got_any and self._reroutable_shed(resp):
+                            shed = resp
+                            break
+                        got_any = True
+                        yield resp
+                except grpc.RpcError as e:
+                    code = e.code() if callable(getattr(e, "code", None)) else None
+                    # Only transport-unreachable feeds the ejection
+                    # streak; DEADLINE_EXCEEDED/CANCELLED describe the
+                    # CLIENT's budget or patience, and failing over on
+                    # them would burn hops a dead client can't use.
+                    unreachable = fed.record_unreachable(peer, e, "forward")
+                    if fspan is not None:
+                        fspan.end(error=str(code or type(e).__name__))
+                    if got_any or not unreachable:
+                        # Bytes already forwarded (replay unsafe), or the
+                        # client itself gave up — propagate the break.
+                        raise
+                    continue
+                if fspan is not None:
+                    fspan.end(shed="1" if shed is not None else "0")
+                if shed is not None:
+                    fed.record_shed(peer)
+                    last_shed = shed
+                    continue
+                fed.record_success(peer)
+                return
+            yield self._relay_exhausted(
+                context, first.correlation_id, last_shed, len(plan)
+            )
+        finally:
+            with self._lock:
+                self._active_streams -= 1
+
+    def GetCapabilities(self, request, context) -> pb.Capability:
+        """Aggregate the LIVE peers' capabilities into one record (the
+        same merge the hub applies to its child services, one level up)."""
+        fed = self.federation
+        agg = pb.Capability(
+            service_name="fed-front",
+            runtime="jax-tpu",
+            protocol_version="1.0.0",
+        )
+        for peer in fed.peers.values():
+            if peer.state != "serving":
+                continue
+            try:
+                cap = peer.stub.GetCapabilities(request, timeout=5.0)
+            except Exception as e:  # noqa: BLE001 - a dead peer is not a caps error
+                fed.record_unreachable(peer, e, "caps")
+                continue
+            for mid in cap.model_ids:
+                if mid not in agg.model_ids:
+                    agg.model_ids.append(mid)
+            known = {t.name for t in agg.tasks}
+            for task in cap.tasks:
+                if task.name not in known:
+                    agg.tasks.append(task)
+            for p in cap.precisions:
+                if p not in agg.precisions:
+                    agg.precisions.append(p)
+            agg.max_concurrency += cap.max_concurrency
+        return agg
+
+    def StreamCapabilities(self, request, context) -> Iterator[pb.Capability]:
+        fed = self.federation
+        for peer in fed.peers.values():
+            if peer.state != "serving":
+                continue
+            try:
+                for cap in peer.stub.StreamCapabilities(request, timeout=5.0):
+                    # Stamp provenance so a topology client sees WHICH
+                    # host each capability record came from.
+                    cap.extra["fed_peer"] = peer.name
+                    yield cap
+            except Exception as e:  # noqa: BLE001 - skip dead peers
+                fed.record_unreachable(peer, e, "caps")
+                continue
+
+    def Health(self, request, context):
+        status = self._fed_status()
+        if context is not None and status:
+            try:
+                context.set_trailing_metadata(
+                    (("lumen-fed-status", json.dumps(status)),)
+                )
+            except Exception:  # noqa: BLE001 - test stubs may lack metadata support
+                pass
+        peers = status.get("peers", {})
+        live = [n for n, s in peers.items() if s == "serving"]
+        if peers and not live:
+            # A front tier with every peer ejected serves nothing: fail
+            # health exactly like a hub of only degraded placeholders.
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"all federation peers ejected: {sorted(peers)}",
             )
         return empty_pb2.Empty()

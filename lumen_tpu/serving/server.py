@@ -10,6 +10,14 @@ Unlike the reference, ``single`` and ``hub`` modes share this one entry
 point (the reference duplicates a per-package server runner in each of the
 four model packages); single mode is simply a hub with one service.
 
+A third mode exists beyond the reference: with ``LUMEN_FED_PEERS`` set
+and no enabled services, this server boots as a federation **front
+tier** — it owns no models and consistent-hash-routes every request over
+its peer servers on the unchanged protocol (docs/ARCHITECTURE.md "Fleet
+federation"). With services AND peers it is a *peer-aware backend*:
+local result-cache misses consult the ring owner's cache before
+computing.
+
 Unlike the reference (and this repo's seed), startup failure of ONE
 service no longer aborts the hub: a failed download or ``from_config``
 boots that service as a :class:`~lumen_tpu.serving.resilience.DegradedService`
@@ -40,7 +48,7 @@ from .breaker import CircuitBreaker, breaker_failures
 from .loader import resolve
 from .mdns import MdnsAdvertiser
 from .resilience import DegradedService, RecoveryManager, expected_tasks_for
-from .router import HubRouter
+from .router import FederationRouter, HubRouter
 
 logger = logging.getLogger(__name__)
 
@@ -50,6 +58,16 @@ GRPC_OPTIONS = [
 ]
 
 DRAIN_ENV = "LUMEN_DRAIN_S"
+
+
+def grpc_workers() -> int:
+    """``LUMEN_GRPC_WORKERS``: gRPC handler threads (default 10, the
+    reference's ThreadPoolExecutor size). A federation front tier wants
+    more — each forwarded stream parks one handler thread on a peer RPC,
+    so the front's concurrency ceiling is exactly this number."""
+    from ..utils.env import env_int
+
+    return env_int("LUMEN_GRPC_WORKERS", 10, minimum=1)
 
 
 def drain_budget_s() -> float:
@@ -169,6 +187,7 @@ class ServerHandle:
         recovery: RecoveryManager | None = None,
         router: HubRouter | None = None,
         autopilot=None,
+        federation=None,
     ):
         self.server = server
         self.port = port
@@ -180,6 +199,7 @@ class ServerHandle:
         self.recovery = recovery
         self.router = router
         self.autopilot = autopilot
+        self.federation = federation
         self._stopped = threading.Event()
 
     def drain_and_stop(self, drain_s: float | None = None) -> None:
@@ -241,6 +261,18 @@ class ServerHandle:
             if get_autopilot() is self.autopilot:
                 install_autopilot(None)
             self.autopilot = None
+        if self.federation is not None:
+            # Fleet teardown next: the poller must stop probing (and the
+            # process-global slot stop advertising on /peers) before the
+            # services it might mark healthy are closed underneath it.
+            from ..runtime.federation import get_federation, install_federation
+            from ..runtime.result_cache import detach_peer_lookup
+
+            detach_peer_lookup(self.federation.peer_cache_lookup)
+            self.federation.close()
+            if get_federation() is self.federation:
+                install_federation(None)
+            self.federation = None
         if self.recovery:
             # Next: a recovery attempt finishing mid-shutdown would swap a
             # fresh service in after the close pass below already ran.
@@ -276,46 +308,91 @@ def serve(
     metrics_port: int | None = None,
 ) -> ServerHandle:
     from ..runtime import enable_persistent_cache
+    from ..runtime.federation import maybe_federation
 
     enable_persistent_cache()  # warm restarts hit compiled buckets on disk
+    # Fleet federation (LUMEN_FED_PEERS / LUMEN_FED_DISCOVER): resolved
+    # once at boot, logged once. Unset -> None having done NOTHING — no
+    # thread, no gauge, no per-request cost beyond one task-name compare
+    # (tier-1 guard) — the single-host path boots byte-identical.
+    federation = maybe_federation()
     failed: dict[str, str] = {}
     if not skip_download:
         failed = ensure_models(config)
     services = build_services(config, failed=failed)
+    recovery: RecoveryManager | None = None
     if not services:
-        logger.error("no enabled services selected by deployment config")
-        raise SystemExit(1)
-    router = HubRouter(services)
-
-    degraded = sorted(n for n, s in services.items() if isinstance(s, DegradedService))
-
-    def rebuild(n: str) -> BaseService:
-        # Recovered/reloaded services get a fresh breaker too: the swap
-        # replaces the instance whose breaker (and possibly watchdog-wedged
-        # batchers) tripped, and its gauge registration supersedes the old
-        # one (last-writer-wins in the metrics registry).
-        return attach_breaker(
-            recovery, n, rebuild_service(config, n, skip_download=skip_download)
+        if federation is None:
+            logger.error("no enabled services selected by deployment config")
+            raise SystemExit(1)
+        # Front-tier mode: this server owns no models; every Infer stream
+        # consistent-hash-routes over the peer set (a front tier speaks
+        # the same protocol, so tiers compose).
+        router: HubRouter = FederationRouter(federation)
+        logger.info(
+            "front-tier mode: no local services; routing %d peer(s) with "
+            "hop budget %d", len(federation.peers), federation.hops,
         )
+    else:
+        router = HubRouter(services)
 
-    # Always built (not only on a degraded boot): the per-service circuit
-    # breakers can hand a service over for reload at ANY point in the
-    # process's life (LUMEN_BREAKER_RELOAD=1).
-    recovery = RecoveryManager(router, rebuild=rebuild)
-    for name, svc in services.items():
-        attach_breaker(recovery, name, svc)
-    if degraded:
-        logger.warning(
-            "booting with %d degraded service(s): %s — healthy siblings keep "
-            "serving; background recovery is retrying the failed loads",
-            len(degraded), degraded,
-        )
-        for name in degraded:
-            recovery.register(name)
-    recovery.start()
+        degraded = sorted(n for n, s in services.items() if isinstance(s, DegradedService))
+
+        def rebuild(n: str) -> BaseService:
+            # Recovered/reloaded services get a fresh breaker too: the swap
+            # replaces the instance whose breaker (and possibly watchdog-wedged
+            # batchers) tripped, and its gauge registration supersedes the old
+            # one (last-writer-wins in the metrics registry).
+            return attach_breaker(
+                recovery, n, rebuild_service(config, n, skip_download=skip_download)
+            )
+
+        # Always built (not only on a degraded boot): the per-service circuit
+        # breakers can hand a service over for reload at ANY point in the
+        # process's life (LUMEN_BREAKER_RELOAD=1).
+        recovery = RecoveryManager(router, rebuild=rebuild)
+        for name, svc in services.items():
+            attach_breaker(recovery, name, svc)
+        if degraded:
+            logger.warning(
+                "booting with %d degraded service(s): %s — healthy siblings keep "
+                "serving; background recovery is retrying the failed loads",
+                len(degraded), degraded,
+            )
+            for name in degraded:
+                recovery.register(name)
+        recovery.start()
+        if federation is not None:
+            # Peer-aware backend: fleet state rides this hub's Health
+            # (lumen-fed-status), and — when this server knows which ring
+            # member it is — local cache misses consult the ring owner's
+            # cache before computing (the cross-host dedupe tier).
+            router.federation = federation
+            if federation.self_listed:
+                from ..runtime.result_cache import get_result_cache
+
+                get_result_cache().peer_lookup = federation.peer_cache_lookup
+                logger.info(
+                    "federation: peer-cache lookups enabled (self=%s)",
+                    federation.self_name,
+                )
+            else:
+                # Unset OR mislisted: either way the `owner == self`
+                # guard cannot work, so no hook (the manager already
+                # warned loudly on a mislisted self).
+                logger.info(
+                    "federation: %s %s — peer-cache lookups disabled on "
+                    "this backend (health gossip + Health surfacing only)",
+                    "LUMEN_FED_SELF",
+                    "unset" if not federation.self_name else "not in peer list",
+                )
+    if federation is not None:
+        federation.start()  # the one background health-poll thread
 
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=10, thread_name_prefix="grpc"),
+        futures.ThreadPoolExecutor(
+            max_workers=grpc_workers(), thread_name_prefix="grpc"
+        ),
         options=GRPC_OPTIONS,
     )
     router.attach_to_server(server)
@@ -405,6 +482,7 @@ def serve(
     return ServerHandle(
         server, bound, mdns, metrics_server, services=router.services,
         recovery=recovery, router=router, autopilot=autopilot,
+        federation=federation,
     )
 
 

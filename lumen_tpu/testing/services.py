@@ -92,3 +92,69 @@ class SlowEchoService(BaseService):
 
         time.sleep(float(meta.get("sleep_s", "0.3")))
         return payload, mime or "application/octet-stream", {"slow": "1"}
+
+
+class FederationBenchService(BaseService):
+    """CPU-only federation backend: a content-addressed "model" whose
+    compute is a plain sleep (``device_ms`` request meta, default 20) run
+    through the REAL result cache — ``get_or_compute`` with single-flight
+    and, on peer-aware boots, the cross-host peer-lookup hook. Every
+    actual compute bumps the ``fedbench_device_calls`` counter, so
+    ``bench.py --phase federation`` can prove a duplicate payload sent to
+    two different fleet entry points cost device work exactly once
+    fleet-wide, with no model and no chip. The sleep (not a spin) is what
+    lets N subprocess hosts on one box scale like N hosts."""
+
+    def __init__(self, service_name: str = "fedbench"):
+        registry = TaskRegistry(service_name)
+        registry.register(
+            TaskDefinition(
+                name="fedbench_embed",
+                handler=self._embed,
+                description="sleep device_ms per unique payload, return its digest",
+                input_mimes=("application/octet-stream",),
+                output_mime="application/json",
+            )
+        )
+        super().__init__(registry)
+
+    @classmethod
+    def expected_tasks(cls, service_config: ServiceConfig) -> list[str]:  # noqa: ARG003
+        return ["fedbench_embed"]
+
+    @classmethod
+    def from_config(cls, service_config: ServiceConfig, cache_dir: str) -> "FederationBenchService":  # noqa: ARG003
+        return cls()
+
+    def capability(self):
+        return self.registry.build_capability(model_ids=["fedbench"], runtime="none")
+
+    def _embed(self, payload: bytes, mime: str, meta: dict[str, str]):  # noqa: ARG002
+        import hashlib
+        import time
+
+        from ..runtime.result_cache import get_result_cache, make_namespace
+        from ..utils.metrics import metrics
+
+        device_ms = float(meta.get("device_ms", "20"))
+
+        def compute() -> dict:
+            # The fleet-wide dedupe proof: this counter moving is the
+            # ONLY evidence of "device" work, so summing it across hosts
+            # counts exact computations per unique payload.
+            metrics.count("fedbench_device_calls")
+            time.sleep(device_ms / 1e3)
+            return {
+                "digest": hashlib.sha256(payload).hexdigest(),
+                "n_bytes": len(payload),
+            }
+
+        # device_ms deliberately stays OUT of the cache key (options=None):
+        # it shapes the simulated compute, not the result.
+        out = get_result_cache().get_or_compute(
+            make_namespace("fedbench", "fedbench_embed", "fedbench", "0"),
+            None,
+            payload,
+            compute,
+        )
+        return json.dumps(out).encode(), "application/json", {}

@@ -91,6 +91,18 @@ def env_float(
         return default
 
 
+def env_list(name: str, default: tuple[str, ...] = ()) -> tuple[str, ...]:
+    """Comma-list twin of :func:`env_int`: split on commas, strip
+    whitespace, drop empty entries. Unset -> ``default``. There is no
+    malformed shape for a string list, so no warning path — entry-level
+    validation (e.g. ``host:port`` syntax) belongs to the caller, which
+    knows what an entry means."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return tuple(part for part in (p.strip() for p in raw.split(",")) if part)
+
+
 def env_choice(
     name: str,
     default: str | None,

@@ -94,7 +94,7 @@ SLO_META_KEY = "lumen-slo-status"
 SLO_WINDOWS_S = (300.0, 3600.0)
 
 #: event kinds that automatically capture an incident bundle.
-INCIDENT_KINDS = ("breaker_open", "replica_down", "slo_breach")
+INCIDENT_KINDS = ("breaker_open", "replica_down", "slo_breach", "fed_peer_down")
 
 # Latched enabled flag: unlike utils/trace.py's per-call env re-read,
 # the always-on layer latches the knob at first use — ``os.environ.get``

@@ -87,6 +87,14 @@ for _k in [k for k in os.environ if k.startswith("LUMEN_AUTOPILOT")] + [
 ]:
     os.environ.pop(_k, None)
 
+# Fleet federation: OFF for the suite — a leaked LUMEN_FED_PEERS would
+# make every serve()-based test boot a peer poller (and a leaked
+# LUMEN_FED_SELF would route its cache misses at phantom hosts).
+# Federation tests opt in with monkeypatched env or explicit constructor
+# args (tests/test_federation.py).
+for _k in [k for k in os.environ if k.startswith("LUMEN_FED_")]:
+    os.environ.pop(_k, None)
+
 # Decode pool: THREAD mode for the suite (LUMEN_DECODE_PROCS=0). On a
 # multi-core CI host the auto default would switch the shared pool to
 # process mode — correct, but every first decode would pay worker spawns
