@@ -100,6 +100,9 @@ PHASE_EST_S = {
     # Two tiny managers (paged continuous + coalesce), a churny streamed
     # workload through each, plus the interpret-mode kernel check.
     "vlm_continuous": 420,
+    # Control + pressured streamed run on tiny managers, with one warm
+    # round compiling the spill export/resume programs in between.
+    "preempt_spill": 420,
     "face": 300,
     "ocr": 330,
     "ingest": 360,
@@ -889,6 +892,226 @@ def _vlm_continuous_impl(n_requests: int, slots: int, block: int) -> dict:
         ), f"page accounting does not balance at drain: {pool}"
         assert out["stream_parity"], "streamed text != generate() text"
         out["assertions_passed"] = True
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def phase_preempt_spill(n_requests: int = 24, slots: int = 4, block: int = 4) -> dict:
+    """KV spill/resume under Poisson overload: a page pool deliberately
+    too small for its slot count forces repeated preemptions, and every
+    victim must come back through the host spill tier. ASSERTED:
+
+    - the overload really preempted (>= 2 evictions) and every one of
+      them RESUMED (no requeue-and-redo, no typed sheds);
+    - resumed requests do ZERO re-prefill device work (prefill rows
+      dispatched == requests submitted, exactly);
+    - greedy tokens are identical to an unpressured control run of the
+      same seeded workload — spill/resume is invisible to output;
+    - page accounting balances at drain AND the spill ledger drains to
+      zero entries/bytes with lease acquire/release balanced.
+
+    TTFT percentiles for both runs are reported (the pressured run pays
+    the spill round trips; the contract is bounded degradation, not
+    parity). Results also land in BENCH_SPILL.json.
+    """
+    _apply_platform_env()
+    with _cache_env("0"):
+        return _preempt_spill_impl(n_requests, slots, block)
+
+
+def _preempt_spill_impl(n_requests: int, slots: int, block: int) -> dict:
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from lumen_tpu.models.vlm import ChatMessage, VLMManager
+    from lumen_tpu.models.vlm.continuous import ContinuousScheduler
+
+    cpu = jax.default_backend() == "cpu"
+    root = tempfile.mkdtemp(prefix="bench_spill_")
+    out: dict = {"platform": jax.devices()[0].platform, "n": n_requests}
+    try:
+        _state("preempt_spill:build")
+        model_dir = _write_bench_vlm_dir(root, tiny=cpu)
+        mgr = VLMManager(
+            model_dir,
+            dtype="float32" if cpu else "bfloat16",
+            max_seq=256, max_new_cap=32, prefill_buckets=(16, 32),
+            scheduler="continuous", gen_slots=slots, gen_block=block,
+        )
+        mgr.initialize()
+
+        # One seeded workload for both runs: long-budget greedy rows (the
+        # per-row page peak is what exhausts the tiny pool) arriving in a
+        # near-burst, so `slots` rows are always concurrently at peak.
+        rng = np.random.default_rng(11)
+        budgets = [int(b) for b in rng.integers(24, 33, size=n_requests)]
+        arrivals = np.cumsum(rng.exponential(scale=0.002, size=n_requests))
+        prompts = [f"describe the image {i}" for i in range(n_requests)]
+
+        def drive(sched) -> tuple[dict, list]:
+            ttft_ms = [0.0] * n_requests
+            toks: list = [None] * n_requests
+            errors: list[BaseException] = []
+            t0 = time.perf_counter()
+
+            def one(i: int) -> None:
+                try:
+                    delay = arrivals[i] - (time.perf_counter() - t0)
+                    if delay > 0:
+                        time.sleep(delay)
+                    e, p, ln, ids, _n = mgr._prepare_inputs(
+                        [ChatMessage(role="user", content=prompts[i])], None, True
+                    )
+                    req = mgr._make_gen_request(
+                        e, p, ln, ids, budgets[i], 0.0, 1.0, False, 1.0
+                    )
+                    t_req = time.perf_counter()
+                    first = None
+                    got: list[int] = []
+                    for tok in sched.submit_stream(req):
+                        if first is None:
+                            first = time.perf_counter()
+                        got.append(int(tok))
+                    toks[i] = got
+                    ttft_ms[i] = ((first or time.perf_counter()) - t_req) * 1e3
+                except BaseException as exc:  # noqa: BLE001 - after join
+                    errors.append(exc)
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(n_requests)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"preempt_spill worker failed: {errors[0]!r}")
+            lat = sorted(ttft_ms)
+            return {
+                "wall_s": round(wall, 3),
+                "total_tokens": int(sum(len(g) for g in toks)),
+                "tokens_per_sec": round(sum(len(g) for g in toks) / wall, 1),
+                "ttft_p50_ms": round(_percentile(lat, 0.50), 2),
+                "ttft_p95_ms": round(_percentile(lat, 0.95), 2),
+            }, toks
+
+        def warm_round() -> None:
+            # `slots` concurrent full-budget requests: compiles every
+            # prefill/decode/growth shape (and, on the tiny pool, the
+            # spill export/resume programs) before the measured pass — a
+            # mid-measure compile would corrupt TTFT p95.
+            ws = [
+                threading.Thread(
+                    target=mgr.generate,
+                    args=([ChatMessage(role="user", content=f"warm {j}")],),
+                    kwargs={"max_new_tokens": 32},
+                )
+                for j in range(slots)
+            ]
+            for t in ws:
+                t.start()
+            for t in ws:
+                t.join()
+
+        try:
+            # -- control: the default (ample) pool, no preemptions -------
+            _state("preempt_spill:control")
+            warm_round()
+            control_sched = mgr._continuous
+            out["control"], control_toks = drive(control_sched)
+            assert control_sched.preemptions == 0, (
+                "control run preempted — the default pool is not an "
+                "unpressured baseline on this host"
+            )
+
+            # -- pressured: a pool that cannot hold `slots` peak rows ----
+            # Peak per row: ceil((prompt + 32 gen + block)/16) = 3 pages;
+            # slots*3 = 12 wanted vs 7 usable -> sustained preemption.
+            _state("preempt_spill:pressured")
+            mgr._continuous.close()
+            tiny = ContinuousScheduler(
+                mgr.generator, mgr.params, slots=slots, block=block,
+                name=mgr.info.name, page_size=16, pages=8,
+            )
+            mgr._continuous = tiny
+            mgr._engines = [tiny]
+            warm_round()
+            warm_spills = tiny.spills
+            prefill_rows: list[int] = []
+            real_prefill = tiny.gen._prefill
+
+            def counting_prefill(params, embeds, *a, **kw):
+                prefill_rows.append(int(embeds.shape[0]))
+                return real_prefill(params, embeds, *a, **kw)
+
+            tiny.gen._prefill = counting_prefill
+            try:
+                out["pressured"], pressured_toks = drive(tiny)
+            finally:
+                tiny.gen._prefill = real_prefill
+
+            # -- assertions ----------------------------------------------
+            out["preemptions"] = tiny.preemptions
+            out["spills"] = tiny.spills
+            out["spill_resumes"] = tiny.spill_resumes
+            out["preempt_redone"] = tiny.preempt_redone
+            out["preempt_failed"] = tiny.preempt_failed
+            out["spill_fallbacks"] = tiny.spill_fallbacks
+            out["prefill_rows"] = int(sum(prefill_rows))
+            assert tiny.preemptions >= 2, (
+                f"overload produced only {tiny.preemptions} preemptions; "
+                "the pressured pool is not actually under pressure"
+            )
+            assert tiny.preempt_redone == 0 and tiny.preempt_failed == 0, (
+                f"{tiny.preempt_redone} redone + {tiny.preempt_failed} failed "
+                "victims — spill/resume fell back under a healthy tier"
+            )
+            assert tiny.spill_resumes == tiny.spills > 0, (
+                f"{tiny.spills} spills vs {tiny.spill_resumes} resumes"
+            )
+            # Zero re-prefill on resume: every prefill row in the measured
+            # window belongs to a fresh request, none to a resumed victim.
+            assert sum(prefill_rows) == n_requests, (
+                f"{sum(prefill_rows)} prefill rows for {n_requests} requests "
+                "— resumed victims re-prefilled"
+            )
+            for i in range(n_requests):
+                assert pressured_toks[i] == control_toks[i], (
+                    f"request {i} tokens diverged under spill/resume"
+                )
+            out["token_parity"] = True
+            stats = tiny.kv.stats()
+            out["paged_pool"] = {
+                "pages_total": stats.pages_total,
+                "pages_live_at_drain": stats.pages_live,
+                "allocated_total": stats.allocated_total,
+                "freed_total": stats.freed_total,
+            }
+            assert stats.pages_live == 0
+            assert stats.allocated_total == stats.freed_total > 0
+            assert not tiny._spill_ledger and tiny._spill_bytes_live == 0, (
+                "spill ledger did not drain"
+            )
+            if tiny._spill_arena is not None:
+                arena = tiny._spill_arena.stats()
+                out["spill_arena"] = arena
+                assert arena["live"] == 0, f"leaked spill leases: {arena}"
+            out["warm_spills"] = warm_spills
+            out["assertions_passed"] = True
+        finally:
+            mgr.close()
+        try:
+            with open(os.path.join(REPO, "BENCH_SPILL.json"), "w") as f:
+                json.dump(out, f, indent=1)
+                f.write("\n")
+        except OSError:
+            pass
         return out
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -4653,6 +4876,7 @@ PHASES = {
     "vlm": phase_vlm,
     "vlm_q8": phase_vlm_q8,
     "vlm_continuous": phase_vlm_continuous,
+    "preempt_spill": phase_preempt_spill,
     "face": phase_face,
     "ocr": phase_ocr,
     "ingest": phase_ingest,

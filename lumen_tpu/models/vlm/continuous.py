@@ -21,9 +21,21 @@ bucket. This engine is the paged rebuild:
   — one prompt chunk per scheduler turn — so a 1k-token prompt never
   stalls in-flight decode steps;
 - rows retire on EOS / per-request cap without stopping the others; if
-  the pool runs dry mid-decode the newest row is PREEMPTED back to the
-  queue (pages freed, generation restarts — greedy requests reproduce
-  their tokens exactly) instead of wedging the engine.
+  the pool runs dry mid-decode the newest row is PREEMPTED: its live KV
+  pages are exported to a host SPILL TIER (one fused ``jax.device_get``
+  into an shm-arena lease, ``utils/shm_arena.py``) together with the
+  row's exact decode state, and re-admission scatters the pages back
+  into a fresh grant — no re-prefill, greedy resume token-identical,
+  sampled mid-stream rows resume their own draw instead of failing. The
+  spill ledger is bounded (``LUMEN_VLM_SPILL_BYTES`` /
+  ``LUMEN_VLM_SPILL_MAX``); any spill/resume failure — arena exhaustion,
+  corrupt lease, export fault (``kv_spill``/``kv_resume`` fault points) —
+  degrades to the pre-spill ladder: requeue-and-redo for rows whose
+  restart is invisible (greedy, or nothing streamed yet), a typed
+  retryable :class:`~lumen_tpu.utils.deadline.PreemptionShed` carrying
+  the engine's drain estimate for sampled mid-stream rows. Lease and
+  page accounting balance at drain, and every spill/resume lands a
+  ``vlm_spill``/``vlm_resume`` flight-recorder event.
 
 Per-step occupancy (active rows / pool pages) is published as gauges and
 each decode block lands a ``batch.device`` span on every active request's
@@ -40,17 +52,23 @@ import queue as queue_mod
 import threading
 import time
 import weakref
+import zlib
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from ...testing.faults import KV_RESUME, KV_SPILL, faults
+from ...utils.deadline import PreemptionShed
 from ...utils.metrics import metrics
+from ...utils.shm_arena import ShmArena
+from ...utils.telemetry import record_event
 from ...utils.trace import current_trace
 from .manager import _PendingGen
-from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool
+from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool, PoolExhausted
 
 logger = logging.getLogger(__name__)
 
@@ -90,6 +108,9 @@ class _Request(_PendingGen):
     trace: object = None
     #: carried across preemption so a resumed stream never re-delivers.
     delivered: int = 0
+    #: parked :class:`_SpillRecord` while the request waits, preempted,
+    #: at the queue head for pages to free — None on the normal path.
+    spill: "object | None" = None
 
 
 @dataclass
@@ -111,6 +132,40 @@ class _PrefillJob:
     length: int = 0  # live prompt tokens (host int)
     last_logits: object = None  # logits of the most recent chunk
     last_off: int = 0  # offset of that chunk
+
+
+@dataclass
+class _SpillRecord:
+    """Everything needed to resume a preempted row WITHOUT re-prefill.
+
+    The page payload (per-layer K/V page stacks, padded to a power-of-2
+    page count with dump-page garbage, plus the row's ``seen`` vocab
+    mask) lives OUT of line: in an shm-arena lease when the arena had
+    budget, else as plain host arrays (the "pickled spill" twin — same
+    bytes, just not recyclable segments). ``shapes``/``treedef`` rebuild
+    the payload pytree from the flat lease; ``crc`` (crc32 over the used
+    span) catches a torn or recycled-out-from-under-us lease at resume
+    time, turning silent token corruption into the degradation ladder.
+    The decode scalars are exact state, not hints: ``cur_tok`` is the
+    sampled-but-not-yet-written next token (it exists nowhere on the
+    host side), and ``rng`` snapshots the request's PRNG key so the
+    record is self-contained for a future cross-engine migration.
+    """
+
+    n_pages: int            # live pages exported — the resume grant size
+    n_pad: int              # power-of-2 padded page count in the payload
+    nbytes: int             # payload bytes — ledger budget accounting
+    shapes: list            # (shape, dtype-str) per payload leaf, flatten order
+    treedef: object         # payload pytree structure
+    crc: int                # crc32 over the lease's used span (0 = host arrays)
+    cur_tok: int            # pending next token (sampled, not yet emitted)
+    cur_len: int            # prompt + generated KV length
+    n_gen: int              # tokens generated so far (== len(tokens))
+    rng: object             # host snapshot of the request's PRNG key
+    prompt_len: int = 0
+    tokens: list = field(default_factory=list)
+    lease: object = None    # ArenaSlot when the shm path won
+    arrays: "list | None" = None  # host-array fallback payload
 
 
 class ContinuousScheduler:
@@ -181,6 +236,29 @@ class ContinuousScheduler:
         self.admitted = 0
         self.preemptions = 0
         self.chunks_run = 0
+        # -- KV spill tier: preemption victims park their pages on the
+        # host instead of re-prefilling. Bounded two ways: total payload
+        # bytes (also the shm arena's budget, so the lease path and the
+        # host-array fallback draw on ONE pool) and entry count.
+        # LUMEN_VLM_SPILL_BYTES=0 disables the tier — preemption then
+        # degrades exactly as the pre-spill engine did, minus the bare
+        # RuntimeError (sampled victims get the typed retryable shed).
+        self._spill_budget = env_int("LUMEN_VLM_SPILL_BYTES", 256 << 20, minimum=0)
+        self._spill_max = env_int("LUMEN_VLM_SPILL_MAX", 32, minimum=0)
+        self._spill_arena: ShmArena | None = None  # created on first spill
+        self._spill_ledger: dict[int, _SpillRecord] = {}  # id(req) -> record
+        self._spill_bytes_live = 0
+        self.spills = 0
+        self.spill_resumes = 0
+        self.spill_fallbacks = 0  # arena denied -> host-array payload
+        self.spill_denied = 0     # ledger full/disabled -> no spill attempt
+        self.preempt_redone = 0   # victim restarted from the prompt
+        self.preempt_failed = 0   # victim shed with the typed retryable error
+        # Per-token decode pace (EWMA over block wall time) feeds the
+        # retry-after hint on PreemptionShed — the same drain-estimate
+        # idea as the batcher's queue-full hint.
+        self._block_s_ewma = 0.0
+        self._preempt_log_t = 0.0  # 1/s warning throttle (shed-log cadence)
         # Decode-step occupancy accumulators: active-row fill per block
         # (every step in a block shares the block-start row count).
         self._occ_rows = 0
@@ -212,7 +290,27 @@ class ContinuousScheduler:
                 "pages_fill_pct": round(
                     100.0 * stats.pages_live / max(stats.pages_total - 1, 1), 1
                 ),
+                # Spill-tier occupancy + outcome split: resumed vs redone
+                # vs failed must add up to preempted once in-flight spills
+                # drain, and entries/bytes return to 0 — assertable
+                # invariants, same discipline as the page accounting.
+                "spill_entries": len(s._spill_ledger),
+                "spill_bytes": s._spill_bytes_live,
+                "spill_bytes_budget": s._spill_budget,
+                "spill_max_entries": s._spill_max,
+                "spilled": s.spills,
+                "spill_resumed": s.spill_resumes,
+                "spill_fallbacks": s.spill_fallbacks,
+                "spill_denied": s.spill_denied,
+                "preempt_redone": s.preempt_redone,
+                "preempt_failed": s.preempt_failed,
             }
+            if s._spill_arena is not None:
+                arena = s._spill_arena.stats()
+                out["spill_arena_segments"] = arena["segments"]
+                out["spill_arena_bytes"] = arena["bytes"]
+                out["spill_arena_live"] = arena["live"]
+                out["spill_arena_denied"] = arena["denied"]
             if s._occ_blocks:
                 out["occupancy_pct_mean"] = round(
                     100.0 * s._occ_rows / (s._occ_blocks * s.n_slots), 1
@@ -294,7 +392,10 @@ class ContinuousScheduler:
             jobs, self._prefill_jobs = list(self._prefill_jobs), deque()
         err = RuntimeError("continuous scheduler closed")
         for req in pending + [s.request for s in live] + [j.request for j in jobs]:
+            self._drop_spill(req)
             _fail(req, err)
+        if self._spill_arena is not None:
+            self._spill_arena.close()
         if fn := getattr(self, "_gauge_fn", None):
             metrics.unregister_gauges(f"vlm-continuous:{self.name}", fn)
 
@@ -345,7 +446,10 @@ class ContinuousScheduler:
                     if req.cancelled:
                         # Stream consumer disconnected while queued: retire
                         # without wasting a prefill dispatch on a dead row.
-                        _retire(req, [], eos=False)
+                        # A parked spill record's tokens are what the row
+                        # produced — deliver them, and free the lease.
+                        rec = self._drop_spill(req)
+                        _retire(req, list(rec.tokens) if rec else [], eos=False)
                     else:
                         live.append(req)
                 # Page gating: take requests in arrival order while the
@@ -359,8 +463,13 @@ class ContinuousScheduler:
                 placeable, deferred = [], []
                 budget = self.kv.pages_free - self._lane_reserved_pages()
                 for req in live:
-                    n = int(np.asarray(req.length)[0])
-                    need = self.kv.pages_for(n + 1)
+                    if req.spill is not None:
+                        # A parked victim resumes into exactly its exported
+                        # grant; growth past it is _ensure_growth's job.
+                        need = req.spill.n_pages
+                    else:
+                        n = int(np.asarray(req.length)[0])
+                        need = self.kv.pages_for(n + 1)
                     if deferred or need > budget:
                         deferred.append(req)
                     else:
@@ -369,7 +478,12 @@ class ContinuousScheduler:
                 self._requeue_front(deferred)
                 direct = []
                 for req in placeable:
-                    if req.embeds.shape[1] > self.prefill_chunk:
+                    if req.spill is not None:
+                        # Re-admission scatters the spilled pages back in —
+                        # no prefill group, no chunk lane, no device work
+                        # proportional to the prompt.
+                        self._resume_row(req)
+                    elif req.embeds.shape[1] > self.prefill_chunk:
                         self._prefill_jobs.append(self._start_chunk_job(req))
                     else:
                         direct.append(req)
@@ -406,6 +520,7 @@ class ContinuousScheduler:
                 live, self._slots = list(self._slots.values()), {}
                 jobs, self._prefill_jobs = list(self._prefill_jobs), deque()
             for req in pending + [s.request for s in live] + [j.request for j in jobs]:
+                self._drop_spill(req)
                 _fail(req, RuntimeError(f"continuous scheduler died: {e!r}"))
 
     def _pool_invalid(self) -> bool:
@@ -465,7 +580,7 @@ class ContinuousScheduler:
         try:
             self.pool = self.gen._admit(
                 self.pool, slot, caches1, tok0, seen1, length,
-                jax.numpy.asarray(bt_row), req.max_new, req.temperature,
+                jnp.asarray(bt_row), req.max_new, req.temperature,
                 req.top_p, req.do_sample, req.repetition_penalty,
             )
         except Exception:
@@ -483,8 +598,6 @@ class ContinuousScheduler:
         The group shares one sampling key (same semantics as the
         coalescing batcher, which fuses mixed requests into one generate
         with one key); per-request generation params stay per-row."""
-        import jax.numpy as jnp
-
         k = len(reqs)
         sub = jax.random.fold_in(reqs[0].rng, 0)
         if k == 1:
@@ -525,8 +638,6 @@ class ContinuousScheduler:
             # pages. If the pool was invalidated (donation consumed), skip
             # the device write; the caller escalates to fail-everything.
             if group_slots and not self._pool_invalid():
-                import jax.numpy as jnp
-
                 idx = jnp.asarray(group_slots, jnp.int32)
                 self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
             with self._cond:
@@ -564,8 +675,6 @@ class ContinuousScheduler:
         """Run ONE chunk of the head-of-lane prefill job (decode blocks
         interleave between chunks), admitting the job when its last live
         chunk has run and pages are free."""
-        import jax.numpy as jnp
-
         while self._prefill_jobs:
             job = self._prefill_jobs[0]
             req = job.request
@@ -617,27 +726,38 @@ class ContinuousScheduler:
     # -- decode blocks ------------------------------------------------------
 
     def _preempt_newest(self, protect: int) -> bool:
-        """Evict the newest live row (except ``protect``) back to the
-        queue head: pages freed, generation restarts from the prompt.
-        Greedy requests reproduce their tokens exactly (``delivered`` is
-        deliberately NOT reset, so a resumed stream never re-sends its
-        prefix). A sampled row re-draws on restart — splicing a fresh
-        draw onto already-streamed tokens would emit a sequence no
-        sampling run ever produced, so victims that have streamed sampled
-        output are preempted LAST and failed rather than resumed."""
+        """Evict the newest live row (except ``protect``): export its
+        pages + decode state into the spill tier and park it at the queue
+        head to RESUME (no re-prefill, token-identical continuation), or
+        — when the tier is full, disabled, or the export itself fails —
+        fall down the pre-spill ladder: requeue-and-redo for rows whose
+        restart is invisible (greedy, or nothing streamed yet; greedy
+        reproduces its tokens exactly and ``delivered`` is deliberately
+        NOT reset so a resumed stream never re-sends its prefix), and a
+        typed retryable :class:`PreemptionShed` for sampled mid-stream
+        rows — splicing a fresh draw onto already-streamed tokens would
+        emit a sequence no sampling run ever produced. With the spill
+        tier those rows are preferred LAST as victims and, when they must
+        go, usually resume instead of shedding."""
         victims = [i for i in self._slots if i != protect]
         if not victims:
             return False
 
-        def resumable(i: int) -> bool:
+        def redo_safe(i: int) -> bool:
             req = self._slots[i].request
             return not (req.do_sample and req.delivered > 0)
 
-        clean = [i for i in victims if resumable(i)]
+        clean = [i for i in victims if redo_safe(i)]
         idx = max(clean or victims, key=lambda i: self._slots[i].seq)
-        resume = resumable(idx)  # decided BEFORE the pop drops the slot
-        import jax.numpy as jnp
-
+        record = spill_err = None
+        try:
+            # Export happens BEFORE the pop/release while the row still
+            # owns its pages; _export_row does not donate the pool, so a
+            # failed export leaves every other row intact.
+            record = self._spill_victim(idx)
+        except Exception as e:  # noqa: BLE001 - spill is best-effort
+            spill_err = e
+            logger.warning("KV spill of slot %d failed (%s); degrading", idx, e)
         self.pool = dict(
             self.pool, done=self.pool["done"].at[jnp.asarray([idx], jnp.int32)].set(True)
         )
@@ -646,18 +766,244 @@ class ContinuousScheduler:
         self.kv.release(idx)
         self.preemptions += 1
         metrics.count("vlm_paged_preemptions")
-        logger.warning(
-            "paged KV pool exhausted: preempting slot %d (%d tokens in, "
-            "restarts from prompt)", idx, len(slot.tokens),
-        )
-        if resume:
-            self._requeue_front([slot.request])
+        now = time.monotonic()
+        if now - self._preempt_log_t >= 1.0:
+            # Throttled like the batcher's shed log: a preemption storm is
+            # one line a second, not a flood.
+            self._preempt_log_t = now
+            logger.warning(
+                "paged KV pool exhausted: preempting slot %d (%d tokens in, %s)",
+                idx, len(slot.tokens),
+                "spilled for resume" if record is not None else "restarts from prompt",
+            )
+        req = slot.request
+        if record is not None:
+            record.prompt_len = slot.prompt_len
+            record.tokens = slot.tokens
+            self._park_spill(req, record)
+        elif not (req.do_sample and req.delivered > 0):
+            self.preempt_redone += 1
+            metrics.count("vlm_preempt_redone")
+            self._requeue_front([req])
         else:
-            _fail(slot.request, RuntimeError(
-                "request preempted by KV pool exhaustion mid-stream; a "
-                "sampled stream cannot resume without splicing draws — retry"
-            ))
+            self._fail_preempted(req, spill_err)
         return True
+
+    # -- KV spill tier -------------------------------------------------------
+
+    def _get_arena(self) -> ShmArena:
+        """Lazily created so engines that never preempt never touch
+        /dev/shm; budget shared with the ledger byte bound."""
+        if self._spill_arena is None:
+            tag = "".join(c if c.isalnum() else "-" for c in self.name)
+            self._spill_arena = ShmArena(
+                name=f"vlmspill-{tag}", max_bytes=self._spill_budget
+            )
+        return self._spill_arena
+
+    def _spill_victim(self, idx: int) -> "_SpillRecord | None":
+        """Export slot ``idx``'s live pages + decode state into a spill
+        record. ``None`` = tier disabled or ledger full (counted, caller
+        degrades); raises on export/pack failure (incl. the ``kv_spill``
+        fault point). Runs BEFORE the caller releases the pages, and
+        ``_export_row`` does not donate, so failure leaves the pool
+        untouched."""
+        if self._spill_budget <= 0 or self._spill_max <= 0:
+            return None
+        if len(self._spill_ledger) >= self._spill_max:
+            self.spill_denied += 1
+            metrics.count("vlm_spill_denied")
+            return None
+        faults.check(KV_SPILL, f"{self.name}:{idx}")
+        owned = self.kv.owned_pages(idx)
+        # Power-of-2 padding (dump page 0 fills the tail) bounds compiled
+        # export/resume shapes at log2(max_pages), same as the decode
+        # block's table bucketing. Padded rows hold garbage nothing reads.
+        n_pad = 1
+        while n_pad < max(1, len(owned)):
+            n_pad *= 2
+        ids = np.zeros((n_pad,), np.int32)
+        ids[: len(owned)] = owned
+        req = self._slots[idx].request
+        exported = self.gen._export_row(self.pool, idx, jnp.asarray(ids))
+        # ONE fused device->host transfer per victim: pages, seen row,
+        # decode scalars, and the request's rng key all come back together.
+        host, rng = jax.device_get((exported, req.rng))
+        payload = {"pages": host["pages"], "seen": host["seen"]}
+        leaves, treedef = jax.tree.flatten(payload)
+        nbytes = sum(int(a.nbytes) for a in leaves)
+        if self._spill_bytes_live + nbytes > self._spill_budget:
+            self.spill_denied += 1
+            metrics.count("vlm_spill_denied")
+            return None
+        shapes = [(a.shape, a.dtype) for a in leaves]
+        lease = arrays = None
+        crc = 0
+        got = self._get_arena().acquire(nbytes)
+        if got is not None:
+            off = 0
+            for a in leaves:
+                got.view(a.shape, a.dtype, offset=off)[:] = a
+                off += int(a.nbytes)
+            crc = zlib.crc32(got.buf[:nbytes])
+            lease = got
+        else:
+            # Arena denied (budget pressure / no /dev/shm): keep plain
+            # host arrays — same bytes against the same ledger budget,
+            # just not recyclable shm segments.
+            arrays = leaves
+            self.spill_fallbacks += 1
+            metrics.count("vlm_spill_fallbacks")
+        return _SpillRecord(
+            n_pages=len(owned), n_pad=n_pad, nbytes=nbytes, shapes=shapes,
+            treedef=treedef, crc=crc, cur_tok=int(host["cur_tok"]),
+            cur_len=int(host["cur_len"]), n_gen=int(host["n_gen"]),
+            rng=rng, lease=lease, arrays=arrays,
+        )
+
+    def _park_spill(self, req: _Request, record: "_SpillRecord") -> None:
+        req.spill = record
+        self._spill_ledger[id(req)] = record
+        self._spill_bytes_live += record.nbytes
+        self.spills += 1
+        metrics.count("vlm_spills")
+        record_event(
+            "vlm_spill", self.name,
+            f"row spilled for resume: {record.n_pages} pages, "
+            f"{len(record.tokens)} tokens parked",
+            min_interval_s=1.0,
+            pages=record.n_pages, bytes=record.nbytes,
+            entries=len(self._spill_ledger),
+        )
+        self._requeue_front([req])
+
+    def _drop_spill(self, req: _Request) -> "_SpillRecord | None":
+        """Detach and free a request's spill record (lease back to the
+        arena, bytes off the ledger). Idempotent — every retirement path
+        calls it, so accounting balances at drain no matter which path a
+        spilled request leaves through."""
+        rec = getattr(req, "spill", None)
+        if rec is None:
+            return None
+        req.spill = None
+        self._spill_ledger.pop(id(req), None)
+        self._spill_bytes_live -= rec.nbytes
+        if rec.lease is not None:
+            rec.lease.release()
+            rec.lease = None
+        rec.arrays = None
+        return rec
+
+    def _drain_estimate_s(self) -> float:
+        """Retry-after hint for :class:`PreemptionShed`: the soonest
+        retire (min remaining budget across live rows) at the engine's
+        EWMA per-token pace — the batcher's queue-drain hint, page-pool
+        flavored. Pre-pace (no block run yet) falls back to a half
+        second so the client backoff floor still has a number."""
+        per_tok = self._block_s_ewma / max(self.block, 1)
+        if per_tok <= 0.0:
+            return 0.5
+        remaining = min(
+            (s.request.max_new - len(s.tokens) for s in self._slots.values()),
+            default=self.block,
+        )
+        return per_tok * max(remaining, self.block)
+
+    def _fail_preempted(self, req: _Request, cause: "BaseException | None") -> None:
+        err = PreemptionShed(
+            "preempted by KV pool exhaustion mid-stream and the spill tier "
+            "could not park the row; a sampled stream cannot restart "
+            "without splicing draws — retry after the pool drains"
+        )
+        err.retry_after_s = self._drain_estimate_s()
+        if cause is not None:
+            err.__cause__ = cause
+        self.preempt_failed += 1
+        metrics.count("vlm_preempt_failed")
+        _fail(req, err)
+
+    def _unpack_spill(self, rec: "_SpillRecord") -> list:
+        """The record's payload leaves as host arrays safe to ship to the
+        device. Lease views are COPIED out — the lease recycles right
+        after resume, and a zero-copy transfer could still be reading its
+        pages — after the crc gate turns a torn or recycled-out-from-
+        under-us lease into a clean degradation instead of silent token
+        corruption."""
+        if rec.lease is None:
+            if rec.arrays is None:
+                raise RuntimeError("spill record has no payload (double resume?)")
+            return list(rec.arrays)
+        if zlib.crc32(rec.lease.buf[: rec.nbytes]) != rec.crc:
+            raise RuntimeError("spill lease failed crc verification (torn write?)")
+        leaves, off = [], 0
+        for shape, dtype in rec.shapes:
+            view = rec.lease.view(shape, dtype, offset=off)
+            leaves.append(view.copy())
+            off += int(view.nbytes)
+        return leaves
+
+    def _resume_row(self, req: _Request) -> None:
+        """Scatter a parked spill record into a fresh page grant and
+        re-install the row — zero re-prefill; greedy continuation is
+        token-identical, sampled continuation carries on its own stream.
+        Failure anywhere degrades to the spill ladder (requeue-and-redo
+        or typed shed); the ONLY re-raise is pool invalidation (the
+        donation-based ``_resume`` consumed the pool's buffers before
+        dying), which must reach the loop's fail-everything handler."""
+        rec: _SpillRecord = req.spill
+        slot = granted = None
+        try:
+            faults.check(KV_RESUME, f"{self.name}:resume")
+            leaves = self._unpack_spill(rec)
+            payload = jax.tree.unflatten(rec.treedef, leaves)
+            slot = self._free_slot()
+            bt_row = self.kv.admit_exact(slot, rec.n_pages)
+            granted = slot
+            ids = np.zeros((rec.n_pad,), np.int32)
+            ids[: rec.n_pages] = bt_row[: rec.n_pages]
+            pages = jax.tree.map(jnp.asarray, payload["pages"])
+            self.pool = self.gen._resume(
+                self.pool, slot, pages, jnp.asarray(ids),
+                jnp.asarray(payload["seen"]), rec.cur_tok, rec.cur_len,
+                rec.n_gen, req.max_new, req.temperature, req.top_p,
+                req.do_sample, req.repetition_penalty,
+            )
+        except PoolExhausted:
+            # Lost a page race (lane reservation, same-turn admissions):
+            # keep the record parked and try again next turn.
+            self._requeue_front([req])
+            return
+        except Exception as e:  # noqa: BLE001 - degrade, never wedge the loop
+            if self._pool_invalid():
+                raise
+            if granted is not None:
+                self.kv.release(granted)
+            logger.warning("KV resume failed (%s); degrading", e)
+            self._drop_spill(req)
+            if not (req.do_sample and req.delivered > 0):
+                self.preempt_redone += 1
+                metrics.count("vlm_preempt_redone")
+                self._requeue_front([req])
+            else:
+                self._fail_preempted(req, e)
+            return
+        self._admit_seq += 1
+        with self._cond:
+            self._slots[slot] = _Slot(
+                request=req, prompt_len=rec.prompt_len,
+                seq=self._admit_seq, tokens=rec.tokens,
+            )
+        self.admitted += 1
+        self.spill_resumes += 1
+        metrics.count("vlm_spill_resumes")
+        self._drop_spill(req)
+        record_event(
+            "vlm_resume", self.name,
+            f"row resumed into slot {slot}: {rec.n_pages} pages "
+            f"re-installed, {len(rec.tokens)} tokens already out",
+            min_interval_s=1.0,
+            pages=rec.n_pages, tokens=len(rec.tokens),
+        )
 
     def _row_need(self, slot: "_Slot") -> int:
         """KV tokens a row needs covered before the next block: the
@@ -696,8 +1042,6 @@ class ContinuousScheduler:
             i for i, slot in self._slots.items() if slot.request.cancelled
         ]
         if cancelled:
-            import jax.numpy as jnp
-
             idx = jnp.asarray(cancelled, jnp.int32)
             self.pool = dict(self.pool, done=self.pool["done"].at[idx].set(True))
             for i in cancelled:
@@ -726,7 +1070,7 @@ class ContinuousScheduler:
         bucket = min(bucket, self.kv.max_pages)
         self.pool, self._rng, toks = self.gen._step_block(
             self.params, self.pool,
-            jax.numpy.asarray(self.kv.block_tables[:, :bucket]),
+            jnp.asarray(self.kv.block_tables[:, :bucket]),
             self._rng, block=self.block,
         )
         self.blocks_run += 1
@@ -739,6 +1083,12 @@ class ContinuousScheduler:
             (toks, self.pool["n_gen"], self.pool["done"], self.pool["eos"])
         )
         t1 = time.perf_counter()
+        # Decode pace for the PreemptionShed drain hint (first block seeds
+        # the EWMA; compile-heavy first blocks wash out within a few).
+        dt = t1 - t0
+        self._block_s_ewma = (
+            dt if self._block_s_ewma == 0.0 else 0.8 * self._block_s_ewma + 0.2 * dt
+        )
         span_meta = {
             "step": self.blocks_run,
             "rows": active,

@@ -75,6 +75,13 @@ class Generator:
         # admit/block dispatch. Block tables are host-managed (numpy in
         # paged_kv.PagedKVPool) and ride in as a small fresh operand.
         self._admit = jax.jit(self._admit_impl, donate_argnames=("pool",))
+        # KV spill tier programs: export gathers a victim row's live pages
+        # + decode scalars for ONE fused device->host transfer (read-only,
+        # no donation — a failed export must leave the pool intact);
+        # resume scatters exported pages into a fresh grant and restores
+        # the row's scalars, donating the pool exactly like _admit.
+        self._export_row = jax.jit(self._export_row_impl)
+        self._resume = jax.jit(self._resume_impl, donate_argnames=("pool",))
         self._step_block = jax.jit(
             self._step_block_impl, static_argnames=("block",), donate_argnames=("pool",)
         )
@@ -364,6 +371,55 @@ class Generator:
             n_gen=pool["n_gen"].at[s].set(0),
             eos=pool["eos"].at[s].set(False),
             done=pool["done"].at[s].set(max_new <= 0),
+            max_new=pool["max_new"].at[s].set(jnp.asarray(max_new, jnp.int32)),
+            temperature=pool["temperature"].at[s].set(jnp.asarray(temperature, jnp.float32)),
+            top_p=pool["top_p"].at[s].set(jnp.asarray(top_p, jnp.float32)),
+            do_sample=pool["do_sample"].at[s].set(jnp.asarray(do_sample, bool)),
+            rep=pool["rep"].at[s].set(jnp.asarray(rep, jnp.float32)),
+        )
+
+    def _export_row_impl(self, pool, slot, page_ids):
+        """Gather one decode row's spillable state: its live KV pages (in
+        block-table order) plus the per-slot decode scalars. ``page_ids``
+        is padded to a power-of-2 length with the dump page 0 so compiled
+        export shapes stay at log2(max_pages) — pad gathers read garbage
+        that the resume scatter writes straight back to the dump page.
+        The caller ships the result host-side with ONE ``jax.device_get``
+        (the spill tier's per-victim transfer budget)."""
+        s = jnp.asarray(slot, jnp.int32)
+        return dict(
+            pages=jax.tree.map(lambda c: c[page_ids], pool["caches"]),
+            seen=jax.lax.dynamic_slice_in_dim(pool["seen"], s, 1, axis=0)[0],
+            cur_tok=pool["cur_tok"][s],
+            cur_len=pool["cur_len"][s],
+            n_gen=pool["n_gen"][s],
+        )
+
+    def _resume_impl(
+        self, pool, slot, pages, page_ids, seen1, cur_tok, cur_len, n_gen,
+        max_new, temperature, top_p, do_sample, rep,
+    ):
+        """Re-install a spilled row into ``slot``: scatter the exported
+        pages into the fresh grant ``page_ids`` (same padded layout as
+        :meth:`_export_row_impl` — pad entries land on the dump page) and
+        restore the decode scalars exactly. ``cur_tok`` is the sampled
+        but not-yet-emitted next token, so a resumed greedy row continues
+        token-identically and a resumed sampled row continues its own
+        draw without splicing."""
+        s = jnp.asarray(slot, jnp.int32)
+        z = jnp.zeros((), jnp.int32)
+        caches = jax.tree.map(
+            lambda dst, src: dst.at[page_ids].set(src.astype(dst.dtype)),
+            pool["caches"], pages,
+        )
+        return dict(
+            caches=caches,
+            cur_tok=pool["cur_tok"].at[s].set(jnp.asarray(cur_tok, jnp.int32)),
+            cur_len=pool["cur_len"].at[s].set(jnp.asarray(cur_len, jnp.int32)),
+            seen=jax.lax.dynamic_update_slice(pool["seen"], seen1[None], (s, z)),
+            n_gen=pool["n_gen"].at[s].set(jnp.asarray(n_gen, jnp.int32)),
+            eos=pool["eos"].at[s].set(False),
+            done=pool["done"].at[s].set(False),
             max_new=pool["max_new"].at[s].set(jnp.asarray(max_new, jnp.int32)),
             temperature=pool["temperature"].at[s].set(jnp.asarray(temperature, jnp.float32)),
             top_p=pool["top_p"].at[s].set(jnp.asarray(top_p, jnp.float32)),

@@ -129,6 +129,31 @@ class PagedKVPool:
         self.allocated_total += len(pages)
         return self.block_tables[slot]
 
+    def owned_pages(self, slot: int) -> list[int]:
+        """The slot's owned page ids in block-table order (grant order) —
+        the spill tier exports page contents in exactly this order so a
+        resume can re-install them into a fresh grant positionally."""
+        return list(self._owned.get(slot, ()))
+
+    def admit_exact(self, slot: int, n_pages: int) -> np.ndarray:
+        """Grant exactly ``n_pages`` pages and install the slot's block
+        table row — the resume half of the spill tier, where the page
+        count is the victim's exported grant, not a prompt length.
+        Returns the row (view); same accounting as :meth:`admit`."""
+        if slot in self._owned:
+            raise RuntimeError(f"slot {slot} already owns pages (allocator bug)")
+        if not 1 <= n_pages <= self.max_pages:
+            raise ValueError(f"resume grant of {n_pages} pages outside [1, {self.max_pages}]")
+        if n_pages > len(self._free):
+            raise PoolExhausted(f"need {n_pages} pages, {len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n_pages)]
+        row = np.zeros((self.max_pages,), np.int32)
+        row[: len(pages)] = pages
+        self.block_tables[slot] = row
+        self._owned[slot] = pages
+        self.allocated_total += len(pages)
+        return self.block_tables[slot]
+
     def grow(self, slot: int, tokens: int) -> bool:
         """Ensure the slot's pages cover ``tokens`` KV slots; allocate as
         needed. False when the free list runs dry mid-growth (partial

@@ -47,6 +47,14 @@ The containment points (per-item match support):
   as exhausted, so every one of its requests sheds with the retry-after
   hint — a deterministic tenant flood with zero generated traffic.
   ``LUMEN_FAULTS="tenant_flood@team-a"`` floods tenant ``team-a`` only.
+- ``kv_spill`` / ``kv_resume`` — the paged VLM engine's KV spill tier
+  (``models/vlm/continuous.py``): ``kv_spill`` fails the page export of a
+  preemption victim (detail ``{engine}:{slot}``), forcing the
+  requeue-and-redo / typed-shed degradation ladder; ``kv_resume`` fails
+  the page re-install of a parked spill record (detail
+  ``{engine}:resume``) — a stand-in for a corrupt lease — which must
+  degrade the same way, never hang or leak pages/leases.
+  ``LUMEN_FAULTS="kv_spill:0.5"`` makes half of all spills fall back.
 
 Production hooks call :meth:`FaultInjector.check`; its disarmed fast path
 is one attribute read, so shipping the hooks costs nothing.
@@ -75,6 +83,8 @@ BATCH_EXECUTE = "batch_execute"
 BATCH_POISON = "batch_poison"
 BATCH_HANG = "batch_hang"
 TENANT_FLOOD = "tenant_flood"
+KV_SPILL = "kv_spill"
+KV_RESUME = "kv_resume"
 
 
 class FaultInjected(ResourceError):

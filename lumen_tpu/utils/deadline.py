@@ -34,6 +34,18 @@ class DeadlineExpired(RuntimeError):
     device slot; the batch executed without it."""
 
 
+class PreemptionShed(QueueFull):
+    """A live VLM decode row was evicted by KV-pool exhaustion and could
+    not be spilled/resumed (spill tier disabled, ledger full, or the
+    spill path itself failed on a sampled mid-stream row, where a
+    restart would splice a fresh draw onto already-delivered tokens).
+    Subclasses :class:`QueueFull` so the whole overload machinery applies
+    unchanged: the serving layer maps it to RESOURCE_EXHAUSTED and
+    surfaces ``retry_after_s`` — the engine's drain estimate — as the
+    ``lumen-retry-after-ms`` hint, which floors client backoff
+    (``utils/retry.py``)."""
+
+
 class PoisonInput(RuntimeError):
     """The input was isolated as the cause of a batch failure (batch
     bisection), or its fingerprint is quarantined from a previous

@@ -57,10 +57,21 @@ class ArenaSlot:
         self.capacity = capacity
         self._released = False
 
-    def view(self, shape, dtype):
+    def view(self, shape, dtype, offset: int = 0):
+        """Map ``shape``/``dtype`` over the slot's pages starting at byte
+        ``offset`` — several arrays can pack into one lease (the KV spill
+        tier lays a whole page export out back to back in one slot)."""
         import numpy as np
 
-        return np.ndarray(shape, dtype=np.dtype(dtype), buffer=self._shm.buf)
+        return np.ndarray(
+            shape, dtype=np.dtype(dtype), buffer=self._shm.buf, offset=offset
+        )
+
+    @property
+    def buf(self):
+        """The slot's raw buffer (memoryview) — checksum/packing helpers
+        read it directly instead of materializing a typed view."""
+        return self._shm.buf
 
     def release(self) -> None:
         """Return the slot to its free list (idempotent — a finally block

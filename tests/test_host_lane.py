@@ -137,6 +137,114 @@ class TestShmArena:
         finally:
             arena.close()
 
+    def test_offset_views_pack_one_lease(self):
+        """The KV spill tier lays several arrays back to back in ONE
+        lease; offset views must address them without overlap."""
+        arena = ShmArena(name="t6")
+        try:
+            a = np.arange(64, dtype=np.float32)
+            b = np.ones(100, dtype=bool)
+            slot = arena.acquire(a.nbytes + b.nbytes)
+            slot.view(a.shape, a.dtype, offset=0)[:] = a
+            slot.view(b.shape, b.dtype, offset=a.nbytes)[:] = b
+            np.testing.assert_array_equal(slot.view(a.shape, a.dtype), a)
+            np.testing.assert_array_equal(
+                slot.view(b.shape, b.dtype, offset=a.nbytes), b
+            )
+            slot.release()
+        finally:
+            arena.close()
+
+    def test_spill_load_balance_under_budget_pressure(self):
+        """Concurrent spill-shaped traffic against a tight budget: some
+        acquires are denied (callers fall back to the pickled path), the
+        rest recycle, and at drain acquired == released with zero live
+        leases and no leaked segments."""
+        import threading
+
+        arena = ShmArena(name="t7", max_bytes=4 << 16)  # 4 min-class slots
+        try:
+            def churn(seed: int) -> None:
+                rng = np.random.default_rng(seed)
+                for _ in range(50):
+                    slot = arena.acquire(int(rng.integers(1, 1 << 16)))
+                    if slot is None:
+                        continue  # budget denial — the fallback path
+                    slot.view((16,), np.uint8)[:] = seed
+                    slot.release()
+
+            threads = [
+                threading.Thread(target=churn, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = arena.stats()
+            assert stats["live"] == 0
+            assert stats["acquired"] == stats["recycled"] > 0
+            assert stats["bytes"] <= 4 << 16
+        finally:
+            arena.close()
+        assert _leaked_segments("t7") == []
+
+    def test_sigkill_during_spill_leaves_no_segments(self):
+        """A process SIGKILLed mid-spill (lease acquired, bytes half
+        written, never released) must not leak /dev/shm segments: the
+        multiprocessing resource tracker outlives the corpse and unlinks
+        everything it registered."""
+        import signal
+        import subprocess
+        import sys
+
+        code = (
+            "import os, signal\n"
+            "import numpy as np\n"
+            "from lumen_tpu.utils.shm_arena import ShmArena\n"
+            "arena = ShmArena(name='sigkill')\n"
+            "slots = [arena.acquire(1 << 16) for _ in range(3)]\n"
+            "for s in slots:\n"
+            "    s.view((64,), np.uint8)[:] = 7  # mid-write\n"
+            "print('\\n'.join(s.name for s in slots), flush=True)\n"
+            "os.kill(os.getpid(), signal.SIGKILL)\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == -signal.SIGKILL
+        names = [n for n in proc.stdout.split() if n]
+        assert len(names) == 3  # the spills really were in flight
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            left = [n for n in names if os.path.exists(f"/dev/shm/{n.lstrip('/')}")]
+            if not left:
+                break
+            time.sleep(0.2)
+        assert not left, f"SIGKILL leaked shm segments: {left}"
+
+    def test_unclosed_arena_cleans_up_at_exit(self):
+        """Dropping an arena without close() (crashed owner) still unlinks
+        its segments — weakref.finalize doubles as the atexit hook."""
+        import subprocess
+        import sys
+
+        code = (
+            "from lumen_tpu.utils.shm_arena import ShmArena\n"
+            "arena = ShmArena(name='noclose')\n"
+            "slot = arena.acquire(1 << 16)\n"
+            "print(slot.name, flush=True)\n"
+            # exit without release() or close(): finalize/atexit must run
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0, proc.stderr
+        name = proc.stdout.strip()
+        assert name
+        assert not os.path.exists(f"/dev/shm/{name.lstrip('/')}")
+
 
 # ---------------------------------------------------------------------------
 # process-mode decode pool
