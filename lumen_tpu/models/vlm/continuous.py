@@ -68,7 +68,8 @@ from ...utils.shm_arena import ShmArena
 from ...utils.telemetry import record_event
 from ...utils.trace import current_trace
 from .manager import _PendingGen
-from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool, PoolExhausted
+from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool, PoolExhausted, page_bytes
+from .prefix_cache import PrefixCache, chunk_keys, prefix_cache_enabled
 
 logger = logging.getLogger(__name__)
 
@@ -111,6 +112,17 @@ class _Request(_PendingGen):
     #: parked :class:`_SpillRecord` while the request waits, preempted,
     #: at the queue head for pages to free — None on the normal path.
     spill: "object | None" = None
+    #: [L] int64 content identity of the merged prompt (token ids, vision
+    #: positions substituted by image-digest ints) — the prefix cache's
+    #: key material. None when prefix caching is off.
+    prefix_content: "object | None" = None
+    #: fraction of the prompt served from shared prefix pages, set at
+    #: admission when the cache is enabled (None = cache off) — surfaced
+    #: in the final stream chunk metadata.
+    prefix_hit: "float | None" = None
+    #: per-request speculative decoding tally (stream metadata).
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 @dataclass
@@ -119,6 +131,10 @@ class _Slot:
     prompt_len: int = 0  # live prompt tokens (host mirror of pool cur_len base)
     seq: int = 0  # admission order; preemption evicts the newest first
     tokens: list = field(default_factory=list)
+    #: host mirrors for the n-gram drafter (spec decoding only): the live
+    #: TEXT prompt ids and the pending sampled-but-not-emitted token.
+    text_toks: "list | None" = None
+    pending_tok: "int | None" = None
 
 
 @dataclass
@@ -132,6 +148,9 @@ class _PrefillJob:
     length: int = 0  # live prompt tokens (host int)
     last_logits: object = None  # logits of the most recent chunk
     last_off: int = 0  # offset of that chunk
+    #: shared prefix pages seeded into the scratch; the JOB holds one
+    #: reference on each until admission or cancellation.
+    shared: list = field(default_factory=list)
 
 
 @dataclass
@@ -166,6 +185,11 @@ class _SpillRecord:
     tokens: list = field(default_factory=list)
     lease: object = None    # ArenaSlot when the shm path won
     arrays: "list | None" = None  # host-array fallback payload
+    #: shared prefix pages the row held at spill time. NOT exported —
+    #: their contents stay resident in the pool; the RECORD holds one
+    #: reference on each so eviction cannot free them while parked, and
+    #: resume re-attaches them ahead of the fresh grant.
+    shared_pages: list = field(default_factory=list)
 
 
 class ContinuousScheduler:
@@ -254,6 +278,35 @@ class ContinuousScheduler:
         self.spill_denied = 0     # ledger full/disabled -> no spill attempt
         self.preempt_redone = 0   # victim restarted from the prompt
         self.preempt_failed = 0   # victim shed with the typed retryable error
+        # -- copy-on-write prefix KV reuse: content-addressed cache of
+        # page-aligned prompt prefixes. Off (None) unless
+        # LUMEN_VLM_PREFIX_BYTES grants a budget — the unconfigured
+        # engine allocates no cache and admission is byte-identical.
+        self.prefix: PrefixCache | None = None
+        if prefix_cache_enabled():
+            dtype_bytes = jnp.dtype(generator.cache_dtype).itemsize
+            self.prefix = PrefixCache(
+                self.kv, page_bytes(generator.cfg, self.page_size, dtype_bytes)
+            )
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_pages = 0  # shared pages attached across all hits
+        # -- speculative decoding: host n-gram drafter + one-step verify.
+        # LUMEN_VLM_SPEC_K=0 (default) builds no drafter and never touches
+        # the verify program; acceptance below LUMEN_VLM_SPEC_MIN_RATE
+        # after warmup disables drafting for the engine's lifetime (the
+        # auto/off gate, like the q8 route).
+        from ...utils.env import env_float
+
+        self.spec_k = env_int("LUMEN_VLM_SPEC_K", 0, minimum=0, maximum=15)
+        self.spec_ngram = env_int("LUMEN_VLM_SPEC_NGRAM", 3, minimum=1, maximum=8)
+        self.spec_min_rate = env_float(
+            "LUMEN_VLM_SPEC_MIN_RATE", 0.2, minimum=0.0, maximum=1.0
+        )
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_turns = 0
+        self.spec_disabled = False
         # Per-token decode pace (EWMA over block wall time) feeds the
         # retry-after hint on PreemptionShed — the same drain-estimate
         # idea as the batcher's queue-full hint.
@@ -311,6 +364,21 @@ class ContinuousScheduler:
                 out["spill_arena_bytes"] = arena["bytes"]
                 out["spill_arena_live"] = arena["live"]
                 out["spill_arena_denied"] = arena["denied"]
+            if s.prefix is not None:
+                out.update(s.prefix.gauges())
+                out["prefix_hits"] = s.prefix_hits
+                out["prefix_misses"] = s.prefix_misses
+                out["prefix_hit_pages"] = s.prefix_hit_pages
+                out["pages_shared"] = stats.pages_shared
+            if s.spec_k > 0:
+                out["spec_k"] = s.spec_k
+                out["spec_turns"] = s.spec_turns
+                out["spec_proposed"] = s.spec_proposed
+                out["spec_accepted"] = s.spec_accepted
+                out["spec_accept_rate"] = round(
+                    s.spec_accepted / max(s.spec_proposed, 1), 3
+                )
+                out["spec_disabled"] = int(s.spec_disabled)
             if s._occ_blocks:
                 out["occupancy_pct_mean"] = round(
                     100.0 * s._occ_rows / (s._occ_blocks * s.n_slots), 1
@@ -394,6 +462,10 @@ class ContinuousScheduler:
         for req in pending + [s.request for s in live] + [j.request for j in jobs]:
             self._drop_spill(req)
             _fail(req, err)
+        for job in jobs:
+            self._drop_job_hold(job)
+        if self.prefix is not None:
+            self.prefix.clear()
         if self._spill_arena is not None:
             self._spill_arena.close()
         if fn := getattr(self, "_gauge_fn", None):
@@ -469,14 +541,22 @@ class ContinuousScheduler:
                         need = req.spill.n_pages
                     else:
                         n = int(np.asarray(req.length)[0])
-                        need = self.kv.pages_for(n + 1)
+                        # A cached prefix needs no fresh grant — coverage
+                        # is re-checked at admission (eviction between the
+                        # peek and the attach degrades to a requeue).
+                        covered = len(self._prefix_lookup(req, n))
+                        need = self.kv.pages_for(n + 1) - covered
+                    if need > budget and self.prefix is not None and not deferred:
+                        # Cached history yields to live admissions before
+                        # any request waits on retires.
+                        budget += self.prefix.reclaim(need - budget)
                     if deferred or need > budget:
                         deferred.append(req)
                     else:
                         budget -= need
                         placeable.append(req)
                 self._requeue_front(deferred)
-                direct = []
+                direct, hits = [], []
                 for req in placeable:
                     if req.spill is not None:
                         # Re-admission scatters the spilled pages back in —
@@ -485,14 +565,21 @@ class ContinuousScheduler:
                         self._resume_row(req)
                     elif req.embeds.shape[1] > self.prefill_chunk:
                         self._prefill_jobs.append(self._start_chunk_job(req))
+                    elif self._prefix_lookup(req, int(np.asarray(req.length)[0])):
+                        hits.append(req)
                     else:
                         direct.append(req)
-                groups = self._admit_groups(direct)
-                for gpos, group in enumerate(groups):
+                # Admission units: prefix hits go one by one (per-row
+                # coverage), misses keep the batched-prefill groups. Both
+                # fail like a group: the unit's requests on error, the
+                # whole engine if the donation consumed the pool.
+                units = [(self._admit_prefix_hit, req, [req]) for req in hits]
+                units += [(self._admit_group, g, g) for g in self._admit_groups(direct)]
+                for gpos, (admit_fn, arg, members) in enumerate(units):
                     try:
-                        self._admit_group(group)
-                    except Exception as e:  # noqa: BLE001 - fail ONE group
-                        for req in group:
+                        admit_fn(arg)
+                    except Exception as e:  # noqa: BLE001 - fail ONE unit
+                        for req in members:
                             _fail(req, e)
                         if self._pool_invalid():
                             # The failure hit the donation-based _admit call
@@ -503,8 +590,8 @@ class ContinuousScheduler:
                             # sweeps only _pending + _slots and this batch
                             # is already off _pending, so fail its
                             # unprocessed tail here first.
-                            for later_group in groups[gpos + 1 :]:
-                                for req in later_group:
+                            for _, _, later in units[gpos + 1 :]:
+                                for req in later:
                                     _fail(req, e)
                             raise RuntimeError(
                                 "slot pool invalidated by failed admission"
@@ -522,6 +609,8 @@ class ContinuousScheduler:
             for req in pending + [s.request for s in live] + [j.request for j in jobs]:
                 self._drop_spill(req)
                 _fail(req, RuntimeError(f"continuous scheduler died: {e!r}"))
+            for job in jobs:
+                self._drop_job_hold(job)
 
     def _pool_invalid(self) -> bool:
         """True when the page pool's buffers were deleted by a donation
@@ -570,17 +659,72 @@ class ContinuousScheduler:
         kv_len = max(kv_len, span)
         return -(-kv_len // self.page_size) * self.page_size
 
-    def _install_row(self, req: _Request, caches1, tok0, seen1, length) -> int:
+    # -- prefix cache helpers -----------------------------------------------
+
+    def _prefix_keys(self, req: _Request, n: int) -> list[bytes]:
+        """Chain-hash keys over the request's live content identity,
+        computed once per request (page-aligned, so a requeue reuses
+        them)."""
+        if self.prefix is None or req.prefix_content is None:
+            return []
+        keys = getattr(req, "_pfx_keys", None)
+        if keys is None:
+            content = np.asarray(req.prefix_content)[:n]
+            keys = chunk_keys(content, self.page_size)
+            req._pfx_keys = keys
+        return keys
+
+    def _prefix_lookup(self, req: _Request, n: int) -> list[int]:
+        """Longest cached prefix for this request, capped one token short
+        of the prompt so the write frontier always lands in a private
+        page (``admit_shared``'s contract)."""
+        keys = self._prefix_keys(req, n)
+        if not keys:
+            return []
+        return self.prefix.lookup(keys)[: (n - 1) // self.page_size]
+
+    def _prefix_insert(self, req: _Request, slot: int, n: int) -> None:
+        """Record an installed row's full prompt pages (hit rows refresh
+        their shared entries and extend coverage with the fresh suffix)."""
+        keys = self._prefix_keys(req, n)
+        if not keys:
+            return
+        pages = self.kv.owned_pages(slot)[: len(keys)]
+        self.prefix.insert(keys[: len(pages)], pages)
+
+    def _text_toks(self, req: _Request) -> list[int]:
+        """Host copy of the live text prompt ids (drafter context)."""
+        ids = [int(t) for t in np.asarray(req.prompt_ids)[0]]
+        pad = self.gen.cfg.pad_token_id
+        while ids and ids[-1] == pad:
+            ids.pop()
+        return ids
+
+    def _install_row(
+        self, req: _Request, caches1, tok0, seen1, length, shared_pages=None
+    ) -> int:
         """Grant pages + write one prefilled row into a free slot. The
         device write donates the pool, so a failure here may invalidate
-        it (callers escalate via ``_pool_invalid``)."""
+        it (callers escalate via ``_pool_invalid``). ``shared_pages``
+        attaches a cached prefix ahead of the fresh grant — the device
+        scatter then targets a DOCTORED table whose shared entries point
+        at the dump page, so the scratch's prefix segments (already
+        resident in the real pages) land harmlessly while the suffix
+        segments fill the private pages."""
         slot = self._free_slot()
         n = int(np.asarray(length)[0])
-        bt_row = self.kv.admit(slot, n)
+        shared = list(shared_pages or ())
+        if shared:
+            bt_row = self.kv.admit_shared(slot, shared, n)
+            bt_dev = bt_row.copy()
+            bt_dev[: len(shared)] = 0
+        else:
+            bt_row = self.kv.admit(slot, n)
+            bt_dev = bt_row
         try:
             self.pool = self.gen._admit(
                 self.pool, slot, caches1, tok0, seen1, length,
-                jnp.asarray(bt_row), req.max_new, req.temperature,
+                jnp.asarray(bt_dev), req.max_new, req.temperature,
                 req.top_p, req.do_sample, req.repetition_penalty,
             )
         except Exception:
@@ -588,10 +732,69 @@ class ContinuousScheduler:
             raise
         self._admit_seq += 1
         slot_state = _Slot(request=req, prompt_len=n, seq=self._admit_seq)
+        if self._spec_active():
+            slot_state.text_toks = self._text_toks(req)
+            slot_state.pending_tok = int(np.asarray(tok0)[0])
         with self._cond:
             self._slots[slot] = slot_state
         self.admitted += 1
+        if self.prefix is not None:
+            if shared:
+                self.prefix_hits += 1
+                self.prefix_hit_pages += len(shared)
+                metrics.count("vlm_prefix_hits")
+                req.prefix_hit = len(shared) * self.page_size / max(n, 1)
+            else:
+                self.prefix_misses += 1
+                metrics.count("vlm_prefix_misses")
+                req.prefix_hit = 0.0
+            self._prefix_insert(req, slot, n)
         return slot
+
+    def _admit_prefix_hit(self, req: _Request) -> None:
+        """Admit one request whose prompt prefix is cached: attach the
+        shared pages as a block-table copy, seed a prefill scratch with
+        their contents (a device gather — no decoder forward), and run
+        the decoder over the UNCOVERED SUFFIX only. The device prefill
+        cost of a hot prefix is zero. Coverage is re-resolved here (an
+        eviction since the admission peek shrinks it); losing the page
+        race degrades to a requeue, losing coverage entirely to a plain
+        batch-of-one admission."""
+        pages = self._prefix_lookup(req, int(np.asarray(req.length)[0]))
+        if not pages:
+            self._admit_group([req])
+            return
+        n = int(np.asarray(req.length)[0])
+        covered = len(pages) * self.page_size
+        span = int(req.embeds.shape[1])
+        scratch_len = self._admit_kv_len(span)
+        nseg = scratch_len // self.page_size
+        ids = np.zeros((nseg,), np.int32)
+        ids[: len(pages)] = pages
+        caches = self.gen.new_prefill_cache(scratch_len)
+        caches = self.gen._seed_prefix(caches, self.pool["caches"], jnp.asarray(ids))
+        c = span - covered
+        chunk = req.embeds[:, covered:span]
+        positions = jnp.broadcast_to(jnp.arange(covered, span)[None, :], (1, c))
+        logits, caches = self.gen._prefill_chunk(
+            self.params, caches, chunk, positions,
+            jnp.asarray(covered, jnp.int32), jnp.asarray([n], jnp.int32),
+        )
+        sub = jax.random.fold_in(req.rng, 0)
+        tok0, seen = self.gen._chunk_finish(
+            logits, jnp.asarray([n - 1 - covered], jnp.int32),
+            req.prompt_ids, req.length, sub,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.do_sample]),
+            jnp.asarray([req.repetition_penalty], jnp.float32),
+        )
+        try:
+            self._install_row(req, caches, tok0, seen, req.length, shared_pages=pages)
+        except PoolExhausted:
+            # Same-turn eviction shrank coverage and the fresh need no
+            # longer fits — park at the queue head and retry next turn.
+            self._requeue_front([req])
 
     def _admit_group(self, reqs: list[_Request]) -> None:
         """One batched prefill for the group, then per-row slot admission.
@@ -656,7 +859,7 @@ class ContinuousScheduler:
         job = self._prefill_jobs[0]
         if job.offset < job.length or job.request.cancelled:
             return 0
-        return self.kv.pages_for(job.length + 1)
+        return self.kv.pages_for(job.length + 1) - len(job.shared)
 
     def _start_chunk_job(self, req: _Request) -> _PrefillJob:
         n = int(np.asarray(req.length)[0])
@@ -664,12 +867,35 @@ class ContinuousScheduler:
         # Sized to the padded span only (tail chunks shrink to fit): the
         # scratch must stay within what a block-table row can address.
         scratch_len = self._admit_kv_len(span)
-        return _PrefillJob(
+        job = _PrefillJob(
             request=req,
             caches=self.gen.new_prefill_cache(scratch_len),
             scratch_len=scratch_len,
             length=n,
         )
+        # Lane jobs reuse cached prefixes too: seed the scratch from the
+        # shared pages and start chunking AFTER the covered span. The job
+        # holds its own reference on the pages (``shared``) so eviction
+        # during the multi-turn chunk run cannot free them mid-prefill;
+        # _drop_job_hold releases it on every exit path.
+        hit = self._prefix_lookup(req, n)
+        if hit:
+            self.kv.incref(hit)
+            job.shared = list(hit)
+            nseg = scratch_len // self.page_size
+            ids = np.zeros((nseg,), np.int32)
+            ids[: len(hit)] = hit
+            job.caches = self.gen._seed_prefix(
+                job.caches, self.pool["caches"], jnp.asarray(ids)
+            )
+            job.offset = len(hit) * self.page_size
+        return job
+
+    def _drop_job_hold(self, job: _PrefillJob) -> None:
+        """Release a lane job's prefix-page hold (idempotent)."""
+        if job.shared:
+            self.kv.decref(job.shared)
+            job.shared = []
 
     def _advance_prefill_lane(self) -> None:
         """Run ONE chunk of the head-of-lane prefill job (decode blocks
@@ -680,6 +906,7 @@ class ContinuousScheduler:
             req = job.request
             if req.cancelled:
                 self._prefill_jobs.popleft()
+                self._drop_job_hold(job)
                 _retire(req, [], eos=False)
                 continue
             if job.offset < job.length:
@@ -701,8 +928,21 @@ class ContinuousScheduler:
                 self.chunks_run += 1
                 return  # one chunk per turn: decode gets the next slice
             # All live chunks ran: admit when pages allow, else wait.
-            if not self.kv.can_admit(job.length):
-                return
+            # Shared prefix pages are already granted-by-reference, so
+            # only the fresh suffix competes for the free list; cached
+            # history yields (reclaim) before the job stalls.
+            if not self.kv.can_admit(job.length, shared_pages=len(job.shared)):
+                if self.prefix is not None:
+                    short = (
+                        self.kv.pages_for(job.length + 1)
+                        - len(job.shared) - self.kv.pages_free
+                    )
+                    if short <= 0 or not self.prefix.reclaim(short):
+                        return
+                    if not self.kv.can_admit(job.length, shared_pages=len(job.shared)):
+                        return
+                else:
+                    return
             sub = jax.random.fold_in(req.rng, 0)
             tok0, seen = self.gen._chunk_finish(
                 job.last_logits, jnp.asarray([job.length - 1 - job.last_off], jnp.int32),
@@ -714,13 +954,18 @@ class ContinuousScheduler:
             )
             self._prefill_jobs.popleft()
             try:
-                self._install_row(req, job.caches, tok0, seen, req.length)
+                self._install_row(
+                    req, job.caches, tok0, seen, req.length,
+                    shared_pages=job.shared,
+                )
             except Exception as e:  # noqa: BLE001
                 _fail(req, e)
                 if self._pool_invalid():
                     raise RuntimeError(
                         "slot pool invalidated by failed admission"
                     ) from e
+            finally:
+                self._drop_job_hold(job)
             return
 
     # -- decode blocks ------------------------------------------------------
@@ -816,14 +1061,20 @@ class ContinuousScheduler:
             return None
         faults.check(KV_SPILL, f"{self.name}:{idx}")
         owned = self.kv.owned_pages(idx)
+        # A row that attached a cached prefix does not need its shared
+        # pages exported — they stay resident under the cache's (and this
+        # record's) reference and re-attach on resume as a block-table
+        # copy. Only the PRIVATE suffix crosses to host memory.
+        n_shared = self.kv.shared_prefix_len(idx)
+        shared, private = owned[:n_shared], owned[n_shared:]
         # Power-of-2 padding (dump page 0 fills the tail) bounds compiled
         # export/resume shapes at log2(max_pages), same as the decode
         # block's table bucketing. Padded rows hold garbage nothing reads.
         n_pad = 1
-        while n_pad < max(1, len(owned)):
+        while n_pad < max(1, len(private)):
             n_pad *= 2
         ids = np.zeros((n_pad,), np.int32)
-        ids[: len(owned)] = owned
+        ids[: len(private)] = private
         req = self._slots[idx].request
         exported = self.gen._export_row(self.pool, idx, jnp.asarray(ids))
         # ONE fused device->host transfer per victim: pages, seen row,
@@ -854,11 +1105,18 @@ class ContinuousScheduler:
             arrays = leaves
             self.spill_fallbacks += 1
             metrics.count("vlm_spill_fallbacks")
+        # The record's hold on the shared prefix is taken LAST — every
+        # failure/denial path above returns before this line, so a record
+        # exists iff the incref happened and _drop_spill's decref always
+        # balances it. The caller's kv.release(idx) then drops the row's
+        # own references without freeing the prefix out from under us.
+        if shared:
+            self.kv.incref(shared)
         return _SpillRecord(
-            n_pages=len(owned), n_pad=n_pad, nbytes=nbytes, shapes=shapes,
+            n_pages=len(private), n_pad=n_pad, nbytes=nbytes, shapes=shapes,
             treedef=treedef, crc=crc, cur_tok=int(host["cur_tok"]),
             cur_len=int(host["cur_len"]), n_gen=int(host["n_gen"]),
-            rng=rng, lease=lease, arrays=arrays,
+            rng=rng, lease=lease, arrays=arrays, shared_pages=list(shared),
         )
 
     def _park_spill(self, req: _Request, record: "_SpillRecord") -> None:
@@ -892,6 +1150,9 @@ class ContinuousScheduler:
             rec.lease.release()
             rec.lease = None
         rec.arrays = None
+        if rec.shared_pages:
+            self.kv.decref(rec.shared_pages)
+            rec.shared_pages = []
         return rec
 
     def _drain_estimate_s(self) -> float:
@@ -957,10 +1218,17 @@ class ContinuousScheduler:
             leaves = self._unpack_spill(rec)
             payload = jax.tree.unflatten(rec.treedef, leaves)
             slot = self._free_slot()
-            bt_row = self.kv.admit_exact(slot, rec.n_pages)
+            # Shared prefix pages re-attach by reference (admit_exact
+            # increfs them ahead of the fresh grant); the scatter below
+            # only rewrites the PRIVATE suffix, so the resumed table is
+            # [shared… | scattered private…] — byte-identical history.
+            bt_row = self.kv.admit_exact(
+                slot, rec.n_pages, shared_pages=rec.shared_pages or None
+            )
             granted = slot
+            base = len(rec.shared_pages)
             ids = np.zeros((rec.n_pad,), np.int32)
-            ids[: rec.n_pages] = bt_row[: rec.n_pages]
+            ids[: rec.n_pages] = bt_row[base : base + rec.n_pages]
             pages = jax.tree.map(jnp.asarray, payload["pages"])
             self.pool = self.gen._resume(
                 self.pool, slot, pages, jnp.asarray(ids),
@@ -988,11 +1256,15 @@ class ContinuousScheduler:
                 self._fail_preempted(req, e)
             return
         self._admit_seq += 1
+        slot_state = _Slot(
+            request=req, prompt_len=rec.prompt_len,
+            seq=self._admit_seq, tokens=rec.tokens,
+        )
+        if self._spec_active():
+            slot_state.text_toks = self._text_toks(req)
+            slot_state.pending_tok = rec.cur_tok
         with self._cond:
-            self._slots[slot] = _Slot(
-                request=req, prompt_len=rec.prompt_len,
-                seq=self._admit_seq, tokens=rec.tokens,
-            )
+            self._slots[slot] = slot_state
         self.admitted += 1
         self.spill_resumes += 1
         metrics.count("vlm_spill_resumes")
@@ -1005,37 +1277,98 @@ class ContinuousScheduler:
             pages=rec.n_pages, tokens=len(rec.tokens),
         )
 
-    def _row_need(self, slot: "_Slot") -> int:
+    def _row_need(self, slot: "_Slot", horizon: "int | None" = None) -> int:
         """KV tokens a row needs covered before the next block: the
-        block's writes, clamped to the row's own budget (it stops at
-        ``max_new``) and to what a block table can address (a row at
-        capacity keeps overwriting its clamped last slot — matching the
-        decode program's position clamp). Without the clamps, a feasible
-        request ending within ``block`` tokens of the pool bound would
-        ask for pages past the table and crash the loop."""
+        block's writes (or a speculative verify turn's ``horizon``),
+        clamped to the row's own budget (it stops at ``max_new``) and to
+        what a block table can address (a row at capacity keeps
+        overwriting its clamped last slot — matching the decode program's
+        position clamp). Without the clamps, a feasible request ending
+        within ``block`` tokens of the pool bound would ask for pages
+        past the table and crash the loop."""
         return min(
-            slot.prompt_len + len(slot.tokens) + self.block,
+            slot.prompt_len + len(slot.tokens) + (horizon or self.block),
             slot.prompt_len + slot.request.max_new + 1,
             self.kv.row_capacity(),
         )
 
-    def _ensure_growth(self) -> None:
+    def _ensure_growth(self, horizon: "int | None" = None) -> None:
         """Before a block, every live row's pages must cover the next
-        block's writes; preempt the newest rows until the free list can
-        satisfy the rest. A lone row always fits — submit() checked
-        feasibility against the whole pool."""
+        block's writes; cached prefixes yield first (reclaim), then the
+        newest rows are preempted until the free list can satisfy the
+        rest. A lone row always fits — submit() checked feasibility
+        against the whole pool, and any unreclaimable cache page a lone
+        row's growth could collide with is, by construction, already in
+        that row's own block table (shared prefix pages never grow).
+
+        Growth into a SHARED frontier page would trigger copy-on-write
+        inside the pool; the engine's admission paths cap prefix
+        attachment one token short of the prompt, so the write frontier
+        is always private and a CoW here means an allocator invariant
+        broke — surfaced loudly rather than silently remapped."""
+        cow: list = []
         for idx in sorted(self._slots, key=lambda i: self._slots[i].seq):
             slot = self._slots.get(idx)
             if slot is None:
                 continue
-            need = self._row_need(slot)
-            while not self.kv.grow(idx, need):
+            need = self._row_need(slot, horizon)
+            while not self.kv.grow(idx, need, cow):
+                if self.prefix is not None and self.prefix.reclaim(1):
+                    continue
                 if not self._preempt_newest(protect=idx):
                     raise RuntimeError(
                         "paged pool cannot grow a lone row (feasibility bug)"
                     )
                 if idx not in self._slots:  # we preempted ourselves? never
                     break
+        if cow:
+            raise RuntimeError(
+                f"unexpected copy-on-write during decode growth: {cow} "
+                "(prefix attachment must leave the write frontier private)"
+            )
+
+    # -- speculative decoding -----------------------------------------------
+
+    def _spec_active(self) -> bool:
+        return self.spec_k > 0 and not self.spec_disabled
+
+    def _draft_row(self, slot: "_Slot") -> list[int]:
+        """Prompt-lookup draft for one row: the longest recent n-gram
+        (``spec_ngram`` down to 1) whose suffix matches the row's current
+        tail is replayed for up to ``spec_k`` tokens. No draft model —
+        the prompt plus the row's own output IS the drafter, which is
+        exactly the traffic (templates, citations, repetitive captions)
+        speculative decoding pays off on. Greedy rows only: verification
+        is token-identity against argmax; a sampled row would need draw
+        matching the verify program does not implement."""
+        req = slot.request
+        if req.do_sample or slot.pending_tok is None or slot.text_toks is None:
+            return []
+        ctx = slot.text_toks + slot.tokens + [slot.pending_tok]
+        for n in range(min(self.spec_ngram, len(ctx) - 1), 0, -1):
+            pat = ctx[-n:]
+            # EARLIEST occurrence: on cycling/template text every match
+            # continues identically, and the earliest one has the most
+            # room before it runs into the tail being drafted.
+            for start in range(len(ctx) - n):
+                if ctx[start : start + n] == pat:
+                    return ctx[start + n : start + n + self.spec_k]
+        return []
+
+    def _spec_try_disable(self) -> None:
+        """Permanent auto-off once acceptance proves the traffic wrong:
+        below ``LUMEN_VLM_SPEC_MIN_RATE`` after a fair sample every
+        verify turn is pure overhead (drafting, wider attention) with no
+        accepted tokens to show for it — same autopilot posture as the
+        q8 route's calibration gate."""
+        if self.spec_disabled or self.spec_proposed < 64:
+            return
+        if self.spec_accepted < self.spec_min_rate * self.spec_proposed:
+            self.spec_disabled = True
+            logger.warning(
+                "speculative decoding disabled: acceptance %d/%d below floor %.2f",
+                self.spec_accepted, self.spec_proposed, self.spec_min_rate,
+            )
 
     def _run_block(self) -> None:
         cancelled = [
@@ -1051,7 +1384,31 @@ class ContinuousScheduler:
                 _retire(slot.request, slot.tokens, eos=False)
             if not self._slots:
                 return
-        self._ensure_growth()
+        # A verify turn runs only when some row drafted AND every live
+        # row's window fits its table capacity — the verify program's
+        # position clamp must never engage on a live row (it would
+        # overwrite history; rows that near the edge finish on plain
+        # blocks whose per-step clamp matches the non-speculative path).
+        width = 0
+        drafts: dict[int, list[int]] = {}
+        if self._spec_active():
+            cap = self.kv.row_capacity()
+            if all(
+                s.prompt_len + len(s.tokens) + self.spec_k + 1 <= cap
+                for s in self._slots.values()
+            ):
+                drafts = {
+                    i: d for i, s in self._slots.items() if (d := self._draft_row(s))
+                }
+                if drafts:
+                    width = self.spec_k + 1
+        self._ensure_growth(horizon=width or None)
+        # Growth may have preempted a drafted row; verify only helps if a
+        # surviving row still carries a draft.
+        if width:
+            drafts = {i: d for i, d in drafts.items() if i in self._slots}
+            if not drafts:
+                width = 0
         active = len(self._slots)
         t0 = time.perf_counter()
         # Ragged page bucketing: ship only a power-of-2 prefix of the
@@ -1061,27 +1418,55 @@ class ContinuousScheduler:
         # page-granular twin of attention_cached's ragged KV ladder);
         # bucketing keeps compiled step shapes at log2(max_pages).
         maxp_live = max(
-            (self.kv.pages_for(self._row_need(s)) for s in self._slots.values()),
+            (
+                self.kv.pages_for(self._row_need(s, width or None))
+                for s in self._slots.values()
+            ),
             default=1,
         )
         bucket = 1
         while bucket < maxp_live:
             bucket *= 2
         bucket = min(bucket, self.kv.max_pages)
-        self.pool, self._rng, toks = self.gen._step_block(
-            self.params, self.pool,
-            jnp.asarray(self.kv.block_tables[:, :bucket]),
-            self._rng, block=self.block,
-        )
+        if width:
+            q = np.zeros((self.n_slots, width), np.int32)
+            ql = np.ones((self.n_slots,), np.int32)
+            for i, d in drafts.items():
+                q[i, 1 : 1 + len(d)] = d
+                ql[i] = 1 + len(d)
+            self.pool, self._rng, toks = self.gen._verify(
+                self.params, self.pool,
+                jnp.asarray(self.kv.block_tables[:, :bucket]),
+                self._rng, jnp.asarray(q), jnp.asarray(ql), width=width,
+            )
+            self.spec_turns += 1
+        else:
+            ql = None
+            self.pool, self._rng, toks = self.gen._step_block(
+                self.params, self.pool,
+                jnp.asarray(self.kv.block_tables[:, :bucket]),
+                self._rng, block=self.block,
+            )
         self.blocks_run += 1
         self._occ_rows += active
         self._occ_blocks += 1
         # One fused device->host transfer for everything the bookkeeping
         # below needs (four separate np.asarray calls = four round trips
-        # on the per-block hot path).
-        toks_np, n_gen, done, eos = jax.device_get(
-            (toks, self.pool["n_gen"], self.pool["done"], self.pool["eos"])
-        )
+        # on the per-block hot path). cur_tok rides along ONLY when
+        # speculation is configured — the unconfigured transfer is
+        # byte-identical to the non-speculative build.
+        if self.spec_k > 0:
+            toks_np, n_gen, done, eos, cur_tok = jax.device_get(
+                (
+                    toks, self.pool["n_gen"], self.pool["done"],
+                    self.pool["eos"], self.pool["cur_tok"],
+                )
+            )
+        else:
+            cur_tok = None
+            toks_np, n_gen, done, eos = jax.device_get(
+                (toks, self.pool["n_gen"], self.pool["done"], self.pool["eos"])
+            )
         t1 = time.perf_counter()
         # Decode pace for the PreemptionShed drain hint (first block seeds
         # the EWMA; compile-heavy first blocks wash out within a few).
@@ -1101,6 +1486,19 @@ class ContinuousScheduler:
             if req.trace is not None:
                 req.trace.add_span("batch.device", t0, t1, dict(span_meta))
             new = int(n_gen[idx]) - len(slot.tokens)
+            if width and int(ql[idx]) > 1:
+                # First emission of a verify turn is the pending token
+                # (not a draft); acceptance counts only the drafted tail.
+                prop = int(ql[idx]) - 1
+                acc = max(min(new - 1, prop), 0)
+                self.spec_proposed += prop
+                self.spec_accepted += acc
+                req.spec_proposed += prop
+                req.spec_accepted += acc
+                metrics.count("vlm_spec_proposed", prop)
+                metrics.count("vlm_spec_accepted", acc)
+            if cur_tok is not None:
+                slot.pending_tok = int(cur_tok[idx])
             if new > 0:
                 slot.tokens.extend(int(t) for t in toks_np[idx, :new])
                 if req.stream_q is not None:
@@ -1112,3 +1510,5 @@ class ContinuousScheduler:
                     del self._slots[idx]
                 self.kv.release(idx)
                 _retire(req, slot.tokens, bool(eos[idx]))
+        if width:
+            self._spec_try_disable()

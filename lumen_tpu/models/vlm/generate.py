@@ -92,6 +92,13 @@ class Generator:
             self._prefill_chunk_impl, donate_argnames=("caches",)
         )
         self._chunk_finish = jax.jit(self._chunk_finish_impl)
+        # Prefix-reuse + speculative-decoding programs: seed a prefill
+        # scratch from already-computed pool pages (prefix-cache hit), and
+        # verify a W-token draft window in one decode forward.
+        self._seed_prefix = jax.jit(self._seed_prefix_impl, donate_argnames=("caches",))
+        self._verify = jax.jit(
+            self._verify_impl, static_argnames=("width",), donate_argnames=("pool",)
+        )
 
     # -- shared pieces ------------------------------------------------------
 
@@ -507,6 +514,95 @@ class Generator:
             rng, last, seen, temperature, top_p, do_sample, repetition_penalty
         ).astype(jnp.int32)
         return tok0, seen
+
+    # -- prefix reuse + speculative decoding ---------------------------------
+
+    def _seed_prefix_impl(self, caches, pool_caches, page_ids):
+        """Prefix-cache hit: seed a chunked-prefill scratch cache with the
+        already-computed prefix KV gathered straight from the pool pages —
+        the scratch then looks exactly as if the covered prefix chunks had
+        run, so only the uncovered suffix pays device prefill. ``page_ids``
+        is the row's shared prefix pages padded to the scratch's page count
+        with the dump page 0; pad segments land on slots the suffix chunks
+        overwrite (decode writes K/V before attending) or the valid-length
+        mask hides."""
+        nseg = page_ids.shape[0]
+
+        def seed(dst, src):
+            seg = src[page_ids]  # [nseg, kvh, page, dh]
+            flat = seg.transpose(1, 0, 2, 3).reshape(
+                1, seg.shape[1], nseg * seg.shape[2], seg.shape[3]
+            )
+            return flat.astype(dst.dtype)
+
+        return jax.tree.map(seed, caches, pool_caches)
+
+    def _verify_impl(self, params, pool, block_tables, rng, draft, q_lens, *, width):
+        """Speculative verify: ONE decode forward over a ``width``-token
+        window per row (width = K+1), then an accept scan whose emission
+        semantics mirror ``_step_block_impl`` exactly. ``draft[:, 0]`` is
+        overwritten with the row's pending ``cur_tok`` (every turn starts
+        from the sampled, not-yet-emitted token); ``draft[:, 1:]`` are the
+        drafter's proposals. ``q_lens`` [B] in 1..width caps how many
+        window slots each row may consume — a row with no draft runs
+        q_len=1, which reduces to the plain one-token step. Greedy output
+        is token-identical to non-speculative decode because window slot t
+        attends over exactly the KV a sequential step at that position
+        would see (the varq kernel's per-slot causal mask), and rejected
+        slots' KV writes land above the row's final ``cur_len`` where the
+        valid-length mask hides them until real tokens overwrite them."""
+        cfg = self.cfg
+        b = pool["cur_tok"].shape[0]
+        capacity = block_tables.shape[1] * pool["caches"][0]["k"].shape[2]
+        toks_in = jnp.asarray(draft, jnp.int32).at[:, 0].set(pool["cur_tok"])
+        # Same spirit as _step_block's clamp: the host never dispatches a
+        # live row whose window would cross its block table's capacity.
+        pos0 = jnp.minimum(pool["cur_len"], capacity - width)
+        positions = pos0[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        embeds = self._embed(params, toks_in).astype(self.cache_dtype)
+        logits, caches = self._decode_paged(
+            params, embeds, positions, pool["caches"], block_tables, pos0, pos0 + 1
+        )
+
+        cur_tok, cur_len = pool["cur_tok"], pool["cur_len"]
+        seen, n_gen = pool["seen"], pool["n_gen"]
+        eos, done = pool["eos"], pool["done"]
+        accepting = jnp.ones((b,), bool)
+        toks_out = jnp.full((b, width), cfg.pad_token_id, jnp.int32)
+        for t in range(width):
+            step_active = ~done & accepting
+            tok = jnp.where(step_active, cur_tok, cfg.pad_token_id)
+            toks_out = toks_out.at[:, t].set(tok)
+            n_gen = n_gen + step_active.astype(jnp.int32)
+            seen = seen.at[jnp.arange(b), cur_tok].max(step_active)
+            eos = eos | (step_active & (cur_tok == cfg.eos_token_id))
+            done = done | eos | (n_gen >= pool["max_new"])
+            rng, sub = jax.random.split(rng)
+            nxt = self._sample_next(
+                sub, logits[:, t], seen,
+                pool["temperature"], pool["top_p"], pool["do_sample"], pool["rep"],
+            ).astype(jnp.int32)
+            cur_len = cur_len + step_active.astype(jnp.int32)
+            if t + 1 < width:
+                # Slot t+1 survives only if its drafted token IS what the
+                # target just sampled — then its precomputed logits are
+                # exactly the sequential step's logits.
+                accepting = step_active & ~done & (t + 1 < q_lens) & (toks_in[:, t + 1] == nxt)
+            else:
+                accepting = jnp.zeros((b,), bool)
+            cur_tok = jnp.where(step_active, nxt, cur_tok)
+
+        new_pool = dict(
+            pool,
+            caches=caches,
+            cur_tok=cur_tok,
+            cur_len=cur_len,
+            seen=seen,
+            n_gen=n_gen,
+            eos=eos,
+            done=done,
+        )
+        return new_pool, rng, toks_out
 
     def stream(
         self,

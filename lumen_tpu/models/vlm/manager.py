@@ -11,6 +11,7 @@ buckets so the number of distinct compiles is bounded.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -894,9 +895,35 @@ class VLMManager:
             )
         return embeds, positions, lengths, jnp.asarray(padded), n
 
+    def _prefix_content(self, prompt_ids, n: int, image_bytes) -> "np.ndarray | None":
+        """Content identity of the POST-SPLICE sequence for the prefix KV
+        cache: text token ids, with the ``<image>`` placeholder expanded
+        to ``V`` int64s derived from the image-bytes sha256 digest
+        (``(1<<62) | digest<<14 | position`` — far above any vocab id, so
+        a text prefix can never alias a vision prefix). Two requests get
+        equal content exactly when their merged embedding sequences are
+        byte-equal, which is what makes the cached KV pages reusable.
+        None when the cache is unconfigured — no hashing on the hot path."""
+        from .prefix_cache import prefix_cache_enabled
+
+        if self._continuous is None or not prefix_cache_enabled():
+            return None
+        ids = np.asarray(prompt_ids)[0, :n].astype(np.int64)
+        if not image_bytes:
+            return ids
+        pos = np.where(ids == self.cfg.image_token_id)[0]
+        if pos.size == 0:
+            return ids
+        i = int(pos[0])
+        v = self.cfg.vision.num_tokens
+        digest = int.from_bytes(hashlib.sha256(image_bytes).digest()[:6], "big")
+        vis = (1 << 62) + (digest << 14) + np.arange(v, dtype=np.int64)
+        return np.concatenate([ids[:i], vis, ids[i + 1 :]])
+
     def _make_gen_request(
         self, embeds, positions, lengths, prompt_ids,
         max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
+        prefix_content=None,
     ):
         """One construction site for both schedulers' request objects —
         adding a generation parameter means touching exactly here."""
@@ -914,7 +941,9 @@ class VLMManager:
         if self._continuous is not None:
             from .continuous import _Request
 
-            return _Request(rng=self._next_rng(), **common)
+            return _Request(
+                rng=self._next_rng(), prefix_content=prefix_content, **common
+            )
         return _PendingGen(**common)
 
     def _next_rng(self) -> jax.Array:
@@ -1076,6 +1105,7 @@ class VLMManager:
         req = self._make_gen_request(
             embeds, positions, lengths, prompt_ids,
             max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
+            prefix_content=self._prefix_content(prompt_ids, n_input, image_bytes),
         )
         if self._continuous is not None:
             future = self._pick_engine().submit(req)
@@ -1089,19 +1119,21 @@ class VLMManager:
         if hit:
             finish = "stop_sequence"
         dt_ms = (time.perf_counter() - t0) * 1e3
+        meta = {
+            "temperature": temperature,
+            "top_p": top_p,
+            "repetition_penalty": repetition_penalty,
+            "do_sample": do_sample,
+            "generation_time_ms": round(dt_ms, 2),
+            "tokens_per_second": round(n_gen / max(dt_ms / 1e3, 1e-9), 2),
+        }
+        meta.update(_reuse_meta(req))
         return GenerationResult(
             text=text.strip(),
             tokens=tokens,
             finish_reason=finish,
             input_tokens=n_input,
-            metadata={
-                "temperature": temperature,
-                "top_p": top_p,
-                "repetition_penalty": repetition_penalty,
-                "do_sample": do_sample,
-                "generation_time_ms": round(dt_ms, 2),
-                "tokens_per_second": round(n_gen / max(dt_ms / 1e3, 1e-9), 2),
-            },
+            metadata=meta,
         )
 
     def generate_stream(
@@ -1170,13 +1202,14 @@ class VLMManager:
             if first_emit_s is None:
                 first_emit_s = time.perf_counter()
                 metrics.observe("vlm.ttft", (first_emit_s - t0) * 1e3)
+        req = None
         if self._continuous is not None:
-            token_iter = self._pick_engine().submit_stream(
-                self._make_gen_request(
-                    embeds, positions, lengths, prompt_ids,
-                    max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
-                )
+            req = self._make_gen_request(
+                embeds, positions, lengths, prompt_ids,
+                max_new_tokens, temperature, top_p, do_sample, repetition_penalty,
+                prefix_content=self._prefix_content(prompt_ids, n_input, image_bytes),
             )
+            token_iter = self._pick_engine().submit_stream(req)
         else:
             token_iter = self.generator.stream(
                 self.params,
@@ -1238,6 +1271,8 @@ class VLMManager:
             meta["tokens_per_second"] = round(tps, 2)
         if first_emit_s is not None:
             meta["ttft_ms"] = round((first_emit_s - t0) * 1e3, 2)
+        if req is not None:
+            meta.update(_reuse_meta(req))
         yield GenerationChunk(text="", tokens=[], is_final=True, metadata=meta)
 
     # -- utils -------------------------------------------------------------
@@ -1263,6 +1298,21 @@ def _subtree_matches(sub, ref) -> bool:
     if not isinstance(sub, dict) or not sub:
         return False
     return _flat_shapes(sub) == _flat_shapes(ref)
+
+
+def _reuse_meta(req) -> dict:
+    """Per-request prefix-reuse / speculation outcomes for response
+    metadata. Keys appear only when the engine actually recorded the
+    feature for this request — an unconfigured engine's metadata is
+    byte-identical to the pre-feature build."""
+    out: dict[str, Any] = {}
+    hit = getattr(req, "prefix_hit", None)
+    if hit is not None:
+        out["prefix_hit"] = round(hit, 3)
+    proposed = getattr(req, "spec_proposed", 0)
+    if proposed > 0:
+        out["spec_accept_rate"] = round(req.spec_accepted / proposed, 3)
+    return out
 
 
 def _truncate_on_stop(text: str, stop_sequences: Sequence[str] | None) -> tuple[str, bool]:

@@ -285,24 +285,41 @@ class DecoderAttention(nn.Module):
         k = rope_rotate(k, positions, c.rope_theta)
 
         if block_tables is not None:
-            assert s == 1, "paged decode handles a single token per row"
             page = cache["k"].shape[2]
             off = jnp.asarray(cache_offset, jnp.int32)  # [B] write position
             bidx = jnp.arange(b)
-            page_idx = block_tables[bidx, off // page]  # [B] page ids
-            slot = off % page
-            # Rows own their pages exclusively, so the scatter indices are
-            # unique across live rows; free/done rows dump into page 0.
-            new_k = cache["k"].at[page_idx, :, slot].set(k[:, :, 0].astype(cache["k"].dtype))
-            new_v = cache["v"].at[page_idx, :, slot].set(v[:, :, 0].astype(cache["v"].dtype))
+            new_k, new_v = cache["k"], cache["v"]
+            # Rows own their decode-frontier pages exclusively, so the
+            # scatter indices are unique across live rows; free/done rows
+            # dump into page 0. s > 1 is the speculative verify window:
+            # token t of every row lands at its row's position off+t
+            # (static unroll — W is small and fixed per program).
+            for t in range(s):
+                off_t = off + t
+                page_idx = block_tables[bidx, off_t // page]  # [B] page ids
+                slot = off_t % page
+                new_k = new_k.at[page_idx, :, slot].set(k[:, :, t].astype(new_k.dtype))
+                new_v = new_v.at[page_idx, :, slot].set(v[:, :, t].astype(new_v.dtype))
             cache = {"k": new_k, "v": new_v}
-            out = paged_attention(
-                q[:, :, 0],
-                new_k.astype(x.dtype),
-                new_v.astype(x.dtype),
-                block_tables,
-                kv_valid_len,
-            )[:, :, None, :]
+            if s == 1:
+                out = paged_attention(
+                    q[:, :, 0],
+                    new_k.astype(x.dtype),
+                    new_v.astype(x.dtype),
+                    block_tables,
+                    kv_valid_len,
+                )[:, :, None, :]
+            else:
+                # [B, W, H, dh] query selects the variable-query-length
+                # verify path; kv_valid_len stays the t=0 visibility and
+                # window slot t sees kv_valid_len + t keys in-kernel.
+                out = paged_attention(
+                    q.transpose(0, 2, 1, 3),
+                    new_k.astype(x.dtype),
+                    new_v.astype(x.dtype),
+                    block_tables,
+                    kv_valid_len,
+                ).transpose(0, 2, 1, 3)
             out = out.transpose(0, 2, 1, 3).reshape(b, s, c.heads * dh)
             return _dense(c, c.hidden_size, "o_proj", False, x.dtype)(out), cache
 

@@ -777,6 +777,183 @@ def paged_attention_reference(
     return out[:, :, :g].reshape(b, h, d).astype(q.dtype)
 
 
+# -- variable-query-length paged attention (speculative verify path) --------
+#
+# Speculative decoding verifies K drafted tokens in ONE target step: each
+# row carries a WINDOW of W = K+1 query tokens written at consecutive
+# positions. Same grid and page streaming as the single-token kernel —
+# the window folds into the query-row axis ([W*Gp, dh] per (b, kv_head)
+# instead of [Gp, dh]) and the finalize mask becomes per-window-position
+# causal: window slot t (row r -> t = r // Gp) sees key j iff
+# j < kv_lens[b] + t, where kv_lens is the t=0 visibility (cur_len + 1,
+# the just-written token included — identical to the single-token
+# contract). W == 1 degenerates to the single-token kernel exactly.
+
+
+def _paged_verify_kernel(
+    bt_ref,  # [B, MAXP] int32 block table (SMEM, prefetched)
+    kv_len_ref,  # [B] int32 t=0 visibility per row (SMEM, prefetched)
+    q_ref,  # [1, 1, W*Gp, dh] window-folded query heads for this (b, kv_head)
+    k_ref,  # [1, 1, page, dh] one K page
+    v_ref,  # [1, 1, page, dh] one V page
+    o_ref,  # [1, 1, W*Gp, dh]
+    s_ref,  # VMEM [W*Gp, MAXP*page] f32 raw logits
+    v_acc_ref,  # VMEM [MAXP*page, dh] f32 gathered V row
+    *,
+    kv_heads: int,
+    sm_scale: float,
+    page: int,
+    num_pages: int,
+    window: int,
+    gp: int,
+):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    b = i // kv_heads
+    kv_len = kv_len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        s_ref[...] = jnp.full_like(s_ref, NEG_INF)
+        v_acc_ref[...] = jnp.zeros_like(v_acc_ref)
+
+    # Live bound for the WIDEST window position: slot W-1 sees
+    # kv_len + W - 1 keys, so pages past that are dead for every slot.
+    @pl.when(j * page < kv_len + (window - 1))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # [W*Gp, dh]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        s_ref[:, pl.dslice(j * page, page)] = s
+        v_acc_ref[pl.dslice(j * page, page), :] = v_ref[0, 0].astype(jnp.float32)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        s = s_ref[...]  # [W*Gp, MAXP*page]
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # row r belongs to window slot t = r // Gp and sees kv_len + t keys
+        t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // gp
+        s = jnp.where(pos < kv_len + t, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        w = p / l
+        o_ref[0, 0] = jnp.dot(
+            w, v_acc_ref[...], preferred_element_type=jnp.float32
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_varq_kernel(
+    q: jax.Array,  # [B, W, H, dh] verify window, position-ordered
+    k_pages: jax.Array,  # [P, kv_heads, page, dh]
+    v_pages: jax.Array,  # [P, kv_heads, page, dh]
+    block_tables: jax.Array,  # [B, MAXP] int32 page ids (dead entries: 0)
+    kv_lens: jax.Array,  # [B] int32 t=0 visibility (cur token included)
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas ragged paged-attention over a W-token verify window per row
+    (see block comment above)."""
+    b, w, h, d = q.shape
+    _, kv_heads, page, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    g = h // kv_heads
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    gp = _q_group_pad(g)
+    qg = q.reshape(b, w, kv_heads, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, gp - g), (0, 0)))
+    # Fold the window into the query-row axis: [B, kv_heads, W*Gp, dh].
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, w * gp, d)
+
+    kernel = functools.partial(
+        _paged_verify_kernel,
+        kv_heads=kv_heads,
+        sm_scale=sm_scale,
+        page=page,
+        num_pages=maxp,
+        window=w,
+        gp=gp,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * kv_heads, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, w * gp, d), lambda i, j, bt, kl: (i // kv_heads, i % kv_heads, 0, 0)),
+            pl.BlockSpec((1, 1, page, d), lambda i, j, bt, kl: (bt[i // kv_heads, j], i % kv_heads, 0, 0)),
+            pl.BlockSpec((1, 1, page, d), lambda i, j, bt, kl: (bt[i // kv_heads, j], i % kv_heads, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, w * gp, d), lambda i, j, bt, kl: (i // kv_heads, i % kv_heads, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((w * gp, maxp * page), jnp.float32),
+            pltpu.VMEM((maxp * page, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, w * gp, d), q.dtype),
+        compiler_params=CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        kv_lens.astype(jnp.int32),
+        qg,
+        k_pages,
+        v_pages,
+    )
+    out = out.reshape(b, kv_heads, w, gp, d)[:, :, :, :g]
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, w, h, d)
+
+
+def paged_attention_varq_reference(
+    q: jax.Array,  # [B, W, H, dh]
+    k_pages: jax.Array,  # [P, kv_heads, page, dh]
+    v_pages: jax.Array,  # [P, kv_heads, page, dh]
+    block_tables: jax.Array,  # [B, MAXP] int32
+    kv_lens: jax.Array,  # [B] int32 t=0 visibility
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact XLA reference for the verify-window kernel: same gather, same
+    window-folded [W*Gp, S] logits matrix, same per-slot causal mask and
+    max/exp/sum/div softmax order, so the interpret-mode kernel matches it
+    bitwise exactly like the single-token pair."""
+    b, w, h, d = q.shape
+    _, kv_heads, page, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    g = h // kv_heads
+    gp = _q_group_pad(g)
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    k = k_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, maxp * page, d)
+    v = v_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, maxp * page, d)
+    qg = q.reshape(b, w, kv_heads, g, d).astype(jnp.float32)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, gp - g), (0, 0)))
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(b, kv_heads, w * gp, d)
+    s = jnp.einsum(
+        "bkrd,bksd->bkrs", qg, k.astype(jnp.float32), preferred_element_type=jnp.float32
+    ) * sm_scale
+    t = jnp.arange(w * gp, dtype=jnp.int32) // gp  # window slot per folded row
+    live = (
+        jnp.arange(maxp * page, dtype=jnp.int32)[None, None, :]
+        < kv_lens.astype(jnp.int32)[:, None, None] + t[None, :, None]
+    )  # [B, R, S]
+    s = jnp.where(live[:, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    wgt = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum(
+        "bkrs,bksd->bkrd", wgt, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    out = out.reshape(b, kv_heads, w, gp, d)[:, :, :, :g]
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, w, h, d).astype(q.dtype)
+
+
 def _paged_kernel_usable(head_dim: int, maxp: int, page: int) -> bool:
     force = os.environ.get("LUMEN_PAGED_KERNEL")
     if force == "0":
@@ -814,8 +991,21 @@ def paged_attention(
     """Dispatch: Pallas ragged paged-attention on TPU, exact XLA reference
     elsewhere (CPU tier-1 serves the reference so both paths are covered).
     ``LUMEN_PAGED_KERNEL=0`` disables the kernel; ``=1`` forces it
-    (interpret mode off TPU, for tests)."""
-    if _paged_kernel_usable(q.shape[-1], block_tables.shape[1], k_pages.shape[2]):
+    (interpret mode off TPU, for tests). A 4-D ``q`` ([B, W, H, dh])
+    selects the variable-query-length verify-window path (speculative
+    decoding); ``kv_lens`` is then the t=0 visibility and slot t sees
+    ``kv_lens + t`` keys."""
+    usable = _paged_kernel_usable(q.shape[-1], block_tables.shape[1], k_pages.shape[2])
+    if q.ndim == 4:
+        if usable:
+            return paged_attention_varq_kernel(
+                q, k_pages, v_pages, block_tables, kv_lens,
+                scale=scale, interpret=_interpret_mode(),
+            )
+        return paged_attention_varq_reference(
+            q, k_pages, v_pages, block_tables, kv_lens, scale=scale
+        )
+    if usable:
         return paged_attention_kernel(
             q, k_pages, v_pages, block_tables, kv_lens,
             scale=scale, interpret=_interpret_mode(),

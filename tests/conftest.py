@@ -95,6 +95,16 @@ for _k in [k for k in os.environ if k.startswith("LUMEN_AUTOPILOT")] + [
 for _k in [k for k in os.environ if k.startswith("LUMEN_FED_")]:
     os.environ.pop(_k, None)
 
+# Prefix KV reuse + speculative decoding: OFF for the suite (their tier-1
+# defaults) — a leaked budget/K would flip the continuous engine's
+# admission and decode dispatch under every parity test. Feature tests
+# opt in with monkeypatched env (tests/test_vlm_continuous.py).
+for _k in [
+    k for k in os.environ
+    if k.startswith("LUMEN_VLM_PREFIX_") or k.startswith("LUMEN_VLM_SPEC_")
+]:
+    os.environ.pop(_k, None)
+
 # Decode pool: THREAD mode for the suite (LUMEN_DECODE_PROCS=0). On a
 # multi-core CI host the auto default would switch the shared pool to
 # process mode — correct, but every first decode would pay worker spawns

@@ -249,3 +249,162 @@ class TestPagedKVPool:
             np.testing.assert_allclose(
                 np.asarray(out[row]), want, rtol=2e-5, atol=2e-5
             )
+
+
+def _vcase(b, w, h, kvh, d, page, maxp, seed=0, dtype=jnp.float32):
+    """Verify-window case: q is [B, W, H, d]; kv_lens leaves room for the
+    window (slot t sees kv_len + t keys, which must stay addressable)."""
+    rng = np.random.default_rng(seed)
+    n_pages = maxp * b + 1
+    q = jnp.asarray(rng.standard_normal((b, w, h, d)), dtype)
+    kp = jnp.asarray(rng.standard_normal((n_pages, kvh, page, d)), dtype)
+    vp = jnp.asarray(rng.standard_normal((n_pages, kvh, page, d)), dtype)
+    bt = jnp.asarray(rng.integers(0, n_pages, size=(b, maxp)), np.int32)
+    kl = jnp.asarray(rng.integers(1, maxp * page - w + 1, size=(b,)), np.int32)
+    return q, kp, vp, bt, kl
+
+
+class TestVarqKernelExact:
+    """The verify-window path (speculative decoding) folds the window into
+    the query-row axis; its kernel must match its reference bitwise, and
+    each window slot must equal the single-token path at the slot's own
+    visibility — the contract that makes verified drafts token-identical
+    to sequential decode."""
+
+    @pytest.mark.parametrize(
+        "b,w,h,kvh,d,page,maxp",
+        [
+            (3, 4, 4, 2, 8, 4, 5),   # tiny-config GQA shape
+            (2, 5, 14, 2, 64, 16, 8),  # Qwen2-0.5B verify shape
+            (4, 2, 4, 4, 16, 8, 3),  # MHA (group of 1)
+            (1, 8, 8, 2, 32, 8, 16),  # single row, wide window
+        ],
+    )
+    def test_matches_reference_exactly(self, monkeypatch, b, w, h, kvh, d, page, maxp):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "1")
+        q, kp, vp, bt, kl = _vcase(b, w, h, kvh, d, page, maxp, seed=b * 13 + w)
+        ref = att_mod.paged_attention_varq_reference(q, kp, vp, bt, kl)
+        ker = att_mod.paged_attention(q, kp, vp, bt, kl)
+        assert ker.shape == (b, w, h, d) and ker.dtype == q.dtype
+        np.testing.assert_array_equal(np.asarray(ker), np.asarray(ref))
+
+    def test_matches_reference_bf16(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_PAGED_KERNEL", "1")
+        q, kp, vp, bt, kl = _vcase(2, 3, 4, 2, 16, 8, 4, seed=17, dtype=jnp.bfloat16)
+        ref = att_mod.paged_attention_varq_reference(q, kp, vp, bt, kl)
+        ker = att_mod.paged_attention(q, kp, vp, bt, kl)
+        np.testing.assert_array_equal(
+            np.asarray(ker).view(np.uint16), np.asarray(ref).view(np.uint16)
+        )
+
+    def test_window_slot_equals_single_token_at_extended_len(self):
+        """Slot t of the verify window == the single-token reference with
+        kv_lens + t: the window is EXACTLY w sequential decode steps whose
+        KV was pre-written, which is what lets one verify forward replace
+        w target steps without changing a single output bit."""
+        w = 4
+        q, kp, vp, bt, kl = _vcase(3, w, 4, 2, 8, 4, 5, seed=23)
+        out = att_mod.paged_attention_varq_reference(q, kp, vp, bt, kl)
+        for t in range(w):
+            single = att_mod.paged_attention_reference(
+                q[:, t], kp, vp, bt, kl + t
+            )
+            np.testing.assert_array_equal(np.asarray(out[:, t]), np.asarray(single))
+
+    def test_w1_degenerates_to_single_token(self):
+        q, kp, vp, bt, kl = _vcase(2, 1, 4, 2, 16, 8, 4, seed=31)
+        out = att_mod.paged_attention_varq_reference(q, kp, vp, bt, kl)
+        single = att_mod.paged_attention_reference(q[:, 0], kp, vp, bt, kl)
+        np.testing.assert_array_equal(np.asarray(out[:, 0]), np.asarray(single))
+
+
+class TestPagedKVPoolSharing:
+    """Copy-on-write page sharing: reference counts, shared admission, and
+    the CoW frontier swap must keep the pool's exclusive-ownership story
+    intact for WRITES while letting reads share."""
+
+    def test_admit_shared_attaches_and_balances(self):
+        pool = PagedKVPool(pages_total=16, page_size=4, slots=4, max_pages=4)
+        pool.admit(0, prompt_tokens=10)  # 3 pages (11 slots)
+        owner = pool.owned_pages(0)
+        # Second row shares the first two pages (prefix) + fresh tail.
+        pool.admit_shared(1, owner[:2], prompt_tokens=10)
+        assert pool.owned_pages(1)[:2] == owner[:2]
+        assert pool.refcount(owner[0]) == 2 and pool.refcount(owner[2]) == 1
+        assert pool.shared_prefix_len(1) == 2 and pool.shared_prefix_len(0) == 0
+        assert pool.stats().pages_shared == 2
+        # Releasing the sharer drops its three references but physically
+        # frees only its private page; the owner's pages stay resident.
+        free_before = pool.pages_free
+        assert pool.release(1) == 3  # references dropped
+        assert pool.pages_free == free_before + 1  # pages actually freed
+        assert pool.refcount(owner[0]) == 1
+        pool.release(0)
+        assert pool.pages_live == 0
+        assert pool.allocated_total == pool.freed_total
+
+    def test_admit_shared_must_leave_frontier_private(self):
+        """Shared coverage may never reach the prompt's write frontier:
+        the next decode write would land in a page someone else reads."""
+        pool = PagedKVPool(pages_total=16, page_size=4, slots=4, max_pages=4)
+        pool.admit(0, prompt_tokens=8)  # 3 pages (9 slots)
+        owner = pool.owned_pages(0)
+        with pytest.raises(ValueError):
+            pool.admit_shared(1, owner[:3], prompt_tokens=8)
+
+    def test_admit_shared_exhaustion_keeps_refcounts(self):
+        """PoolExhausted must fire BEFORE the shared incref — a failed
+        shared admission leaves every refcount untouched."""
+        pool = PagedKVPool(pages_total=4, page_size=4, slots=4, max_pages=4)
+        pool.admit(0, prompt_tokens=6)  # 2 pages: pool drained (3 usable)
+        owner = pool.owned_pages(0)
+        before = [pool.refcount(p) for p in owner]
+        with pytest.raises(PoolExhausted):
+            pool.admit_shared(1, owner[:1], prompt_tokens=14)  # needs 3 fresh
+        assert [pool.refcount(p) for p in owner] == before
+
+    def test_grow_into_shared_frontier_copies_on_write(self):
+        """Growing a row whose LAST owned page is shared must swap in a
+        private copy (CoW) and report the (old, new) pair; the shared
+        page keeps its other holder's reference. The ENGINE never builds
+        this state (prefix attachment stays behind the frontier) — the
+        pool-level contract is tested directly with an incref standing in
+        for a second holder."""
+        pool = PagedKVPool(pages_total=16, page_size=4, slots=2, max_pages=4)
+        pool.admit(0, prompt_tokens=3)  # 1 page
+        page = pool.owned_pages(0)[0]
+        pool.incref([page])  # cache-style second hold on the frontier
+        cow: list = []
+        assert pool.grow(0, 8, cow)
+        assert cow and cow[0][0] == page
+        old, new = cow[0]
+        assert pool.owned_pages(0)[0] == new != old
+        assert pool.refcount(old) == 1  # only the cache hold remains
+        assert pool.refcount(new) == 1  # the row owns its private copy
+        # The same growth with NO copy sink is an allocator-contract bug
+        # and must fail loudly, not silently remap.
+        pool.incref([pool.owned_pages(0)[-1]])
+        with pytest.raises(RuntimeError):
+            pool.grow(0, 16)
+
+    def test_grow_shared_frontier_with_dry_free_list_degrades(self):
+        """CoW needs a fresh page; a dry free list returns False (the
+        caller preempts/reclaims) without corrupting the shared page."""
+        pool = PagedKVPool(pages_total=2, page_size=4, slots=2, max_pages=2)
+        pool.admit(0, prompt_tokens=3)  # the single usable page
+        page = pool.owned_pages(0)[0]
+        pool.incref([page])
+        assert not pool.grow(0, 8, [])
+        assert pool.refcount(page) == 2  # untouched
+
+    def test_decref_double_free_raises(self):
+        pool = PagedKVPool(pages_total=8, page_size=4, slots=2, max_pages=4)
+        pool.admit(0, prompt_tokens=3)
+        page = pool.owned_pages(0)[0]
+        pool.incref([page])
+        assert pool.decref([page]) == 0  # still held by the slot
+        pool.release(0)
+        with pytest.raises(RuntimeError):
+            pool.decref([page])
+        with pytest.raises(RuntimeError):
+            pool.incref([page])  # resurrection of a freed page

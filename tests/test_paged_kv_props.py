@@ -1,0 +1,207 @@
+"""Property tests for the refcounted page pool's sharing invariants.
+
+``test_paged_attention.py::TestPagedKVPool*`` pins specific scenarios;
+this module sweeps RANDOM interleavings of every reference-creating and
+reference-dropping operation the engine performs — admission, shared
+(prefix-hit) admission, exact (spill-resume) admission, growth with
+copy-on-write, cache-style holds, release — against a model of what the
+reference counts must be:
+
+- **conservation** — the pool's refcount map always equals the model's;
+  ``pages_live`` equals the total outstanding references (reference-
+  granular accounting), and free + held partitions the usable pool;
+- **no double free** — dropping a dead reference raises instead of
+  corrupting the free list; a freed page cannot be resurrected by
+  incref;
+- **write isolation** — a page with more than one holder is never the
+  append frontier after ``grow`` returns (CoW swapped it);
+- **balance at drain** — releasing every slot and dropping every hold
+  returns the pool to zero live pages with allocated == freed, no matter
+  which interleaving produced the state.
+
+The fixed-seed walks below always run; when the optional ``hypothesis``
+dev dependency is present, the same walker also sweeps minimized random
+seeds (shrinking turns a failing walk into a short repro).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from lumen_tpu.models.vlm.paged_kv import PagedKVPool, PoolExhausted
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - optional dev dependency
+    HAVE_HYPOTHESIS = False
+
+SLOTS, PAGE, MAXP = 5, 4, 6
+USABLE = 24  # pages_total - 1 (dump page never granted)
+
+
+class _Model:
+    """Reference-count oracle mirrored op-by-op alongside the pool."""
+
+    def __init__(self):
+        self.refs: dict[int, int] = {}  # page -> outstanding references
+        self.rows: dict[int, list[int]] = {}  # slot -> pages in table order
+        self.shared: dict[int, int] = {}  # slot -> shared prefix length
+        self.holds: list[int] = []  # cache/spill-style extra references
+
+    def add(self, pages):
+        for p in pages:
+            self.refs[p] = self.refs.get(p, 0) + 1
+
+    def drop(self, pages):
+        for p in pages:
+            self.refs[p] -= 1
+            if not self.refs[p]:
+                del self.refs[p]
+
+
+def _check(pool: PagedKVPool, m: _Model) -> None:
+    assert pool._ref == m.refs, "refcount map diverged from the model"
+    assert pool.pages_live == sum(m.refs.values())
+    assert pool.pages_free == USABLE - len(m.refs)
+    assert 0 not in m.refs, "dump page acquired a reference"
+    for slot, pages in m.rows.items():
+        got = list(pool.block_tables[slot][: len(pages)])
+        assert got == pages, f"slot {slot} table diverged"
+        # Write isolation: the append frontier is private unless the slot
+        # has not grown past its shared prefix yet (the engine's shared
+        # admissions always grant >= 1 private page, modeled below).
+        assert m.refs[pages[-1]] >= 1
+
+
+def _walk(rng: np.random.Generator, steps: int = 300) -> None:
+    pool = PagedKVPool(
+        pages_total=USABLE + 1, page_size=PAGE, slots=SLOTS, max_pages=MAXP
+    )
+    m = _Model()
+    for _ in range(steps):
+        op = rng.integers(0, 6)
+        if op == 0 and len(m.rows) < SLOTS:  # plain admission
+            slot = next(i for i in range(SLOTS) if i not in m.rows)
+            tokens = int(rng.integers(1, MAXP * PAGE - 1))
+            if pool.can_admit(tokens):
+                pool.admit(slot, tokens)
+                m.rows[slot] = pool.owned_pages(slot)
+                m.add(m.rows[slot])
+        elif op == 1 and m.rows and len(m.rows) < SLOTS:  # prefix-hit admission
+            donor = int(rng.choice(list(m.rows)))
+            slot = next(i for i in range(SLOTS) if i not in m.rows)
+            # Shared coverage: full pages of the donor, capped one token
+            # short of the new prompt (the hit path's frontier rule).
+            tokens = int(rng.integers(1, MAXP * PAGE - 1))
+            n_share = min(len(m.rows[donor]), (tokens - 1) // PAGE)
+            if n_share < 1:
+                continue
+            shared = m.rows[donor][:n_share]
+            try:
+                pool.admit_shared(slot, shared, tokens)
+            except PoolExhausted:
+                continue
+            m.rows[slot] = pool.owned_pages(slot)
+            m.shared[slot] = n_share
+            m.add(m.rows[slot])
+        elif op == 2 and m.rows and len(m.rows) < SLOTS:  # spill-resume admission
+            donor = int(rng.choice(list(m.rows)))
+            slot = next(i for i in range(SLOTS) if i not in m.rows)
+            n_share = int(rng.integers(0, len(m.rows[donor]) + 1))
+            n_share = min(n_share, MAXP - 1)
+            shared = m.rows[donor][:n_share]
+            n_fresh = int(rng.integers(1, MAXP - n_share + 1))
+            try:
+                pool.admit_exact(slot, n_fresh, shared_pages=shared or None)
+            except PoolExhausted:
+                continue
+            m.rows[slot] = pool.owned_pages(slot)
+            if n_share:
+                m.shared[slot] = n_share
+            m.add(m.rows[slot])
+        elif op == 3 and m.rows:  # growth (with CoW sink)
+            slot = int(rng.choice(list(m.rows)))
+            before = list(m.rows[slot])
+            cow: list = []
+            grew = pool.grow(slot, int(rng.integers(1, MAXP * PAGE + 8)), cow)
+            after = pool.owned_pages(slot)
+            for old, new in cow:
+                m.drop([old])
+                m.add([new])
+            m.add(after[len(before):])
+            m.rows[slot] = after
+            if cow:
+                # CoW only ever swaps the frontier, and only when shared.
+                assert len(cow) == 1
+                assert cow[0][0] == before[-1]
+                assert m.refs.get(cow[0][0], 0) >= 1  # other holder survives
+            if not grew:
+                assert pool.pages_free == 0  # dry free list is the only False
+        elif op == 4 and m.rows:  # release
+            slot = int(rng.choice(list(m.rows)))
+            pool.release(slot)
+            m.drop(m.rows.pop(slot))
+            m.shared.pop(slot, None)
+        elif op == 5:  # cache/spill-record style hold churn
+            if m.holds and rng.integers(0, 2):
+                i = int(rng.integers(0, len(m.holds)))
+                page = m.holds.pop(i)
+                pool.decref([page])
+                m.drop([page])
+            elif m.refs:
+                page = int(rng.choice(list(m.refs)))
+                pool.incref([page])
+                m.holds.append(page)
+                m.add([page])
+        _check(pool, m)
+    # Drain: every interleaving must balance exactly.
+    for slot in list(m.rows):
+        pool.release(slot)
+        m.drop(m.rows.pop(slot))
+    for page in m.holds:
+        pool.decref([page])
+        m.drop([page])
+    assert not m.refs
+    assert pool.pages_live == 0
+    assert pool.pages_free == USABLE
+    assert pool.allocated_total == pool.freed_total
+
+
+class TestRefcountInvariants:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 17, 99, 1234, 777777])
+    def test_random_walk_fixed_seeds(self, seed):
+        _walk(np.random.default_rng(seed))
+
+    def test_double_free_and_resurrection_raise(self):
+        pool = PagedKVPool(pages_total=8, page_size=4, slots=2, max_pages=4)
+        pool.admit(0, prompt_tokens=3)
+        page = pool.owned_pages(0)[0]
+        pool.release(0)
+        with pytest.raises(RuntimeError):
+            pool.decref([page])
+        with pytest.raises(RuntimeError):
+            pool.incref([page])
+
+    def test_release_preserves_lifo_reuse_order(self):
+        """Refcounting must not perturb the pre-sharing allocator's LIFO
+        reuse order: release returns a row's pages so its FIRST page is
+        the next page granted (hot-page HBM reuse, and what keeps the
+        golden paging traces stable across the refcount change)."""
+        pool = PagedKVPool(pages_total=16, page_size=4, slots=2, max_pages=4)
+        pool.admit(0, prompt_tokens=10)
+        first = pool.owned_pages(0)[0]
+        pool.release(0)
+        pool.admit(1, prompt_tokens=1)
+        assert pool.owned_pages(1)[0] == first
+
+
+if HAVE_HYPOTHESIS:
+
+    class TestRefcountInvariantsHypothesis:
+        @settings(max_examples=25, deadline=None)
+        @given(seed=st.integers(min_value=0, max_value=2**32 - 1))
+        def test_random_walk_swept_seeds(self, seed):
+            _walk(np.random.default_rng(seed), steps=120)
