@@ -120,6 +120,9 @@ PHASE_EST_S = {
     "replica_scaling": 900,
     # ~5 small on-chip compiles (ragged/int8/grouped-GEMM/flash kernels).
     "tpu_tests": 300,
+    # Six subprocess VLM hosts (serialized tiny-model compiles on CPU)
+    # + three front-tier boots + the paced measurement segments.
+    "disagg": 900,
 }
 
 # In-phase estimate for bench_grpc's VLM half (manager init + prefill and
@@ -5187,6 +5190,707 @@ def phase_federation() -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+#: Paced decode floor (ms per decode step) armed on every disagg worker:
+#: decode wall-time becomes deterministic sleep, so aggregate tok/s
+#: measures topology (slots x decode hosts) instead of this box's core
+#: count — sleeps scale across host processes the way real chips do,
+#: spins don't (the _FEDBENCH_DEVICE_MS trick, applied to the engine).
+_DISAGG_STEP_FLOOR_MS = "20"
+_DISAGG_SLOTS = 4          # decode slots per host (batch_size -> gen_slots)
+_DISAGG_BLOCK = 4          # decode steps per compiled block
+_DISAGG_SCALE_X = 1.35     # 2 decode hosts vs 1: aggregate decode tok/s
+# TTFT p95 of the 2-decode fleet vs the SAME fleet with one decode host:
+# the disagg promise is that growing the decode fleet leaves first-token
+# latency flat (prefill capacity unchanged, decode adds zero prefill
+# interference) while decode throughput scales. Structurally ~1.0x; the
+# headroom absorbs single-core scheduling noise. The colocated control's
+# TTFT is recorded for reference but not asserted — its prefill spreads
+# over three hosts, so that ratio measures capacity asymmetry, not
+# interference.
+_DISAGG_TTFT_FLAT_X = 1.5
+
+_DISAGG_ENV_KEYS = _FED_ENV_KEYS + (
+    "LUMEN_FED_ROLE", "LUMEN_FED_KV_LANES", "LUMEN_GEN_STEP_FLOOR_MS",
+)
+
+#: In-vocab one-word request tags (``tok16``..``tok249``): every segment's
+#: prompts stay unique at the TOKEN level (filler words alone would
+#:  collide in the prefill host's greedy result cache across segments),
+#: and 250+ is off-limits — ``tok250`` tokenizes to the tiny config's
+#: image placeholder id.
+_DISAGG_TAG_LO, _DISAGG_TAG_HI = 16, 249
+
+
+def _disagg_config(cache_dir: str, port: int, enabled: bool = True) -> dict:
+    return {
+        "metadata": {
+            "version": "1.0.0", "region": "other", "cache_dir": cache_dir,
+        },
+        "deployment": {"mode": "hub", "services": ["vlm"]},
+        "server": {"port": port, "host": "127.0.0.1"},
+        "services": {
+            "vlm": {
+                "enabled": enabled,
+                "package": "lumen_tpu.models.vlm",
+                "import_info": {
+                    "registry_class":
+                        "lumen_tpu.serving.services.vlm_service.VlmService"
+                },
+                "backend_settings": {
+                    "batch_size": _DISAGG_SLOTS,
+                    "dtype": "float32",
+                    "scheduler": "continuous",
+                    "decode_block": _DISAGG_BLOCK,
+                    "batch_buckets": [64],
+                },
+                "models": {"vlm": {"model": "bench/BenchVLM", "runtime": "jax"}},
+            },
+        },
+    }
+
+
+def phase_disagg_worker() -> dict:
+    """One disaggregated-serving host: a REAL ``serve()`` boot with the
+    tiny BenchVLM behind the continuous paged engine, on the port/role
+    the parent passed (``DISAGG_PORT``/``DISAGG_METRICS_PORT``/
+    ``DISAGG_CACHE_DIR`` + ``LUMEN_FED_*``, ``LUMEN_FED_ROLE``,
+    ``LUMEN_GEN_STEP_FLOOR_MS``). Prints a ready line, serves until
+    SIGTERM/SIGKILL."""
+    _apply_platform_env()
+    import signal as _signal
+    import threading as _threading
+
+    from lumen_tpu.core.config import validate_config_dict
+    from lumen_tpu.serving.server import serve
+
+    port = int(os.environ["DISAGG_PORT"])
+    metrics_port = int(os.environ["DISAGG_METRICS_PORT"])
+    cache_dir = os.environ["DISAGG_CACHE_DIR"]
+    handle = serve(
+        validate_config_dict(_disagg_config(cache_dir, port)),
+        skip_download=True,
+        metrics_port=metrics_port,
+    )
+    print(json.dumps({"ready": 1, "port": handle.port,
+                      "metrics_port": handle.metrics_server.port}), flush=True)
+    stop = _threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_a: stop.set())
+    while not stop.wait(0.5):
+        pass
+    handle.drain_and_stop()
+    return {"platform": "host"}
+
+
+def _disagg_drive(addr: str, reqs: list[dict], *, arrivals: list[float] | None = None,
+                  timeout_s: float = 240.0) -> dict:
+    """Drive ``vlm_generate_stream`` requests over ONE channel, each on
+    its own thread at its arrival offset (None = all at once). Per
+    request: TTFT = first delta chunk, final text + token count from the
+    terminal ``TextGenerationV1`` frame. No client retry: the disagg
+    failure ladder's whole claim is that a dead decode peer is invisible
+    on an already-open stream."""
+    import threading as _threading
+
+    import grpc as _grpc
+
+    from lumen_tpu.serving.proto import ml_service_pb2 as pb
+    from lumen_tpu.serving.proto.ml_service_pb2_grpc import InferenceStub
+
+    chan = _grpc.insecure_channel(addr)
+    _grpc.channel_ready_future(chan).result(timeout=30)
+    stub = InferenceStub(chan)
+    rows: list[dict | None] = [None] * len(reqs)
+    t_start = time.perf_counter()
+
+    def one(i: int, spec: dict) -> None:
+        if arrivals is not None:
+            lag = t_start + arrivals[i] - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        t0 = time.perf_counter()
+        ttft = None
+        chunks = 0
+        final = None
+        err = None
+        try:
+            for resp in stub.Infer(iter([pb.InferRequest(
+                correlation_id=spec["cid"], task="vlm_generate_stream",
+                payload=b"", payload_mime="application/octet-stream",
+                meta={"messages": json.dumps(spec["messages"]),
+                      "max_new_tokens": str(spec["max_new"])},
+            )]), timeout=timeout_s):
+                if resp.HasField("error") and (resp.error.code or resp.error.message):
+                    err = f"[{resp.error.code}] {resp.error.message}"
+                    break
+                if resp.meta.get("chunk") == "delta":
+                    if ttft is None:
+                        ttft = (time.perf_counter() - t0) * 1e3
+                    chunks += 1
+                elif resp.result:
+                    final = json.loads(bytes(resp.result).decode())
+        except _grpc.RpcError as e:
+            err = f"transport {e.code()}"
+        rows[i] = {
+            "cid": spec["cid"],
+            "ok": err is None and final is not None,
+            "error": err,
+            "ttft_ms": ttft,
+            "chunks": chunks,
+            "text": (final or {}).get("text"),
+            "n_tokens": int((final or {}).get("generated_tokens", 0)),
+            "done_s": time.perf_counter() - t_start,
+        }
+
+    threads = [
+        _threading.Thread(target=one, args=(i, spec))
+        for i, spec in enumerate(reqs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    chan.close()
+    done = [r for r in rows if r is not None]
+    ok = [r for r in done if r["ok"]]
+    lat = sorted(r["ttft_ms"] for r in ok if r["ttft_ms"] is not None)
+    wall = max((r["done_s"] for r in done), default=1e-9)
+    toks = sum(r["n_tokens"] for r in ok)
+    return {
+        "n": len(reqs),
+        "n_ok": len(ok),
+        "errors": [r["error"] for r in done if r["error"]][:3],
+        "gen_tokens": toks,
+        "wall_s": round(wall, 2),
+        "decode_tok_s": round(toks / wall, 1),
+        "ttft_p50_ms": round(_percentile(lat, 0.50), 1),
+        "ttft_p95_ms": round(_percentile(lat, 0.95), 1),
+        "rows": rows,
+    }
+
+
+def _disagg_sidecar(port: int) -> dict:
+    """Counters + the vlm engine's gauge block from a worker sidecar."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics.json", timeout=10
+    ) as resp:
+        snap = json.loads(resp.read().decode())
+    engine = {}
+    for name, vals in (snap.get("gauges", {}) or {}).items():
+        if name.startswith("vlm-continuous:"):
+            engine = vals
+    return {"counters": snap.get("counters", {}), "engine": engine}
+
+
+def phase_disagg() -> dict:
+    """Disaggregated prefill/decode acceptance (ISSUE 18; CPU-safe, real
+    serving stack, paced decode): six subprocess lumen-tpu hosts running
+    the tiny BenchVLM on the continuous paged engine — a 3-host
+    colocated control fleet and a role-tagged disagg fleet (1 prefill +
+    2 decode) — behind in-process front tiers. The decode floor
+    (``LUMEN_GEN_STEP_FLOOR_MS``) makes decode sleep-bound, so tok/s on
+    one box measures topology, not cores. Asserted:
+
+    - aggregate decode tok/s SCALES with decode hosts: the same
+      slot-saturating burst through 1 prefill + 2 decode >=
+      ``_DISAGG_SCALE_X`` x the 1 prefill + 1 decode fleet;
+    - TTFT p95 under a mixed long-prompt/long-decode Poisson load stays
+      FLAT as the decode fleet grows (2-decode vs 1-decode <=
+      ``_DISAGG_TTFT_FLAT_X`` x; the colocated control's TTFT is
+      recorded for reference);
+    - every migrated request is token-identical to a single-host run
+      (greedy parity, with migrations proven by the decode hosts'
+      ``vlm_migrated_in`` counters);
+    - SIGKILLing a decode peer mid-migration recovers ALL in-flight
+      requests via the failure ladder — zero client-visible errors, no
+      lost or duplicated tokens (byte-equal to the single-host
+      baseline), and balanced page/spill accounting on the survivors.
+
+    Results also land in BENCH_DISAGG.json.
+    """
+    _apply_platform_env()
+    import itertools
+    import shutil
+    import socket
+    import tempfile
+    import threading as _threading
+
+    from lumen_tpu.core.config import validate_config_dict
+    from lumen_tpu.runtime.federation import SERVING
+    from lumen_tpu.serving.server import serve
+    from lumen_tpu.utils import telemetry as tele
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    rng = __import__("random").Random(20260806)
+    tag = itertools.count(_DISAGG_TAG_LO)
+
+    def req(n_words: int, max_new: int, text: str | None = None) -> dict:
+        """One request spec; ``text`` pins the exact prompt (identity /
+        kill baselines reuse the SAME prompt on another fleet)."""
+        if text is None:
+            t = next(tag)
+            assert t <= _DISAGG_TAG_HI, "out of unique prompt tags"
+            filler = ("describe the image a cat dog " * 16).split()
+            text = " ".join([f"tok{t}"] + filler[: max(0, n_words - 1)])
+        return {
+            "cid": f"dsg-{text.split()[0]}",
+            "messages": [{"role": "user", "content": text}],
+            "max_new": max_new,
+        }
+
+    def reuse(specs: list[dict]) -> list[dict]:
+        return [dict(s) for s in specs]
+
+    def poisson(n: int, rate_hz: float) -> list[float]:
+        offs, t = [], 0.0
+        for _ in range(n):
+            t += rng.expovariate(rate_hz)
+            offs.append(t)
+        return offs
+
+    # 6 workers: 3 colocated control (federated, no roles) + 1 prefill +
+    # 2 decode (role-tagged). Roles are boot-time env, so the 1-decode
+    # scaling point reuses the same workers through a front whose peer
+    # list simply omits the second decode host.
+    names = ["colo0", "colo1", "colo2", "pre", "dec0", "dec1"]
+    roles = {"pre": "prefill", "dec0": "decode", "dec1": "decode"}
+    grpc_ports = {n: free_port() for n in names}
+    side_ports = {n: free_port() for n in names}
+    addr = {n: f"127.0.0.1:{grpc_ports[n]}" for n in names}
+    fleet_of = {n: (["colo0", "colo1", "colo2"] if n.startswith("colo")
+                    else ["pre", "dec0", "dec1"]) for n in names}
+    peers_env_of = {
+        n: ",".join(f"{addr[p]}@{side_ports[p]}" for p in fleet_of[n])
+        for n in names
+    }
+
+    root = tempfile.mkdtemp(prefix="bench_disagg_")
+    saved = {k: os.environ.get(k) for k in _DISAGG_ENV_KEYS}
+    workers: dict[str, object] = {}
+    front = None
+    out: dict = {"platform": "host", "cpu_count": os.cpu_count() or 1,
+                 "step_floor_ms": float(_DISAGG_STEP_FLOOR_MS),
+                 "slots_per_host": _DISAGG_SLOTS, "block": _DISAGG_BLOCK}
+
+    _state("disagg:model")
+    shared = os.path.join(root, "shared")
+    _write_bench_vlm_dir(shared, tiny=True)
+
+    def spawn_worker(name: str):
+        wdir = os.path.join(root, name)
+        os.makedirs(wdir, exist_ok=True)
+        # Same weights everywhere — token identity across fleets depends
+        # on every host decoding the same checkpoint.
+        os.symlink(os.path.join(shared, "models"), os.path.join(wdir, "models"))
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "DISAGG_PORT": str(grpc_ports[name]),
+            "DISAGG_METRICS_PORT": str(side_ports[name]),
+            "DISAGG_CACHE_DIR": wdir,
+            "LUMEN_CACHE_BYTES": str(64 << 20),
+            "LUMEN_GRPC_WORKERS": "32",
+            "LUMEN_GEN_STEP_FLOOR_MS": _DISAGG_STEP_FLOOR_MS,
+            # A migration lane is held for the whole remote-decode
+            # stream; the default 4 would cap the decode fleet at 4
+            # concurrent rows and flatten the scaling curve.
+            "LUMEN_FED_KV_LANES": "64",
+            "LUMEN_FED_PEERS": peers_env_of[name],
+            "LUMEN_FED_SELF": addr[name],
+            # Hard to eject, quick to readmit: seven processes share ONE
+            # core here, so a 2s health probe can time out under a burst
+            # — spurious ejection of the prefill host would silently turn
+            # the fleet role-blind mid-measurement. Peer death still
+            # fails over IN-REQUEST (transport error walks the plan), so
+            # the kill segment does not depend on ejection at all.
+            "LUMEN_FED_POLL_S": "1.0",
+            "LUMEN_FED_FAILURES": "20",
+            "LUMEN_FED_EJECT_S": "2",
+        })
+        env.pop("LUMEN_CACHE_DIR", None)
+        if name in roles:
+            env["LUMEN_FED_ROLE"] = roles[name]
+        else:
+            env.pop("LUMEN_FED_ROLE", None)
+        # stderr to a FILE (see phase_federation: a pipe nobody drains
+        # wedges the worker once a logging burst fills it).
+        err_path = os.path.join(root, f"{name}.err")
+        with open(err_path, "w") as err_file:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--phase", "disagg_worker"],
+                stdout=subprocess.PIPE, stderr=err_file, text=True,
+                env=env, cwd=REPO,
+            )
+        proc._lumen_err_path = err_path
+        ready: dict = {}
+
+        def read_ready():
+            for line in proc.stdout:
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if parsed.get("ready"):
+                    ready.update(parsed)
+
+        _threading.Thread(target=read_ready, daemon=True).start()
+        return proc, ready
+
+    def boot_front(tag_: str, peers: list[str]):
+        os.environ.update({
+            "LUMEN_FED_PEERS": ",".join(
+                f"{addr[p]}@{side_ports[p]}" for p in peers
+            ),
+            # Same spurious-ejection hardening as the workers (one core,
+            # 2s probe deadline): routing must never go role-blind
+            # because a probe raced a prefill burst.
+            "LUMEN_FED_POLL_S": "0.5",
+            "LUMEN_FED_FAILURES": "20",
+            "LUMEN_FED_EJECT_S": "2",
+            "LUMEN_GRPC_WORKERS": "64",
+        })
+        for key in ("LUMEN_FED_SELF", "LUMEN_FED_ROLE",
+                    "LUMEN_GEN_STEP_FLOOR_MS"):
+            os.environ.pop(key, None)
+        tele.reset_hub()
+        handle = serve(
+            validate_config_dict(_disagg_config(
+                os.path.join(root, f"front_{tag_}"), free_port(), enabled=False,
+            )),
+            skip_download=True, metrics_port=0,
+        )
+        # The front must have LEARNED each peer's state and role before a
+        # measurement: disagg routing is driven by the advertised roles.
+        deadline = time.time() + 60
+        want = {addr[p]: roles.get(p, "both") for p in peers}
+        while time.time() < deadline:
+            peers_now = handle.federation.peers
+            if all(
+                peers_now[a].state == SERVING and peers_now[a].role == r
+                for a, r in want.items()
+            ):
+                return handle
+            time.sleep(0.2)
+        raise RuntimeError(
+            f"front {tag_} never learned peer roles: "
+            f"{ {a: (p.state, p.role) for a, p in handle.federation.peers.items()} }"
+        )
+
+    try:
+        _state("disagg:boot")
+        spawned = {n: spawn_worker(n) for n in names}
+        workers = {n: p for n, (p, _) in spawned.items()}
+        deadline = time.time() + 600
+        for name, (proc, ready) in spawned.items():
+            while not ready and time.time() < deadline:
+                if proc.poll() is not None:
+                    try:
+                        with open(proc._lumen_err_path) as ef:
+                            tail = ef.read()[-500:]
+                    except OSError:
+                        tail = "<no stderr captured>"
+                    raise RuntimeError(f"disagg worker {name} died at boot: {tail}")
+                time.sleep(0.2)
+            if not ready:
+                raise RuntimeError(f"disagg worker {name} not ready in 600s")
+
+        # Warm every engine DIRECTLY (prefill bucket + decode block +
+        # growth compiles happen off the measurement clock; text-only, so
+        # the vision tower never compiles at all).
+        _state("disagg:warm")
+        warm_errs: list[str] = []
+
+        def warm(name: str) -> None:
+            res = _disagg_drive(
+                addr[name], [req(12, 32), req(12, 32)], timeout_s=300,
+            )
+            if res["n_ok"] != 2:
+                warm_errs.append(f"{name}: {res['errors']}")
+
+        threads = [_threading.Thread(target=warm, args=(n,)) for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not warm_errs, f"warmup failed: {warm_errs}"
+
+        # -- colocated control: latency under Poisson + baselines --------
+        _state("disagg:colo")
+        front = boot_front("colo", ["colo0", "colo1", "colo2"])
+        front_addr = f"127.0.0.1:{front.port}"
+        # Mixed load: long-prompt/short-decode interleaved with
+        # short-prompt/long-decode — the prefill-vs-decode contention
+        # shape disaggregation exists for.
+        lat_specs = [
+            req(56, 8) if i % 2 == 0 else req(12, 40) for i in range(24)
+        ]
+        # 2/s keeps the single prefill lane below saturation: the flat-
+        # TTFT claim is about decode INTERFERENCE, not prefill capacity —
+        # one prefill host at 4/s measures queueing blow-up instead.
+        lat_arrivals = poisson(24, 2.0)
+        colo_lat = _disagg_drive(front_addr, lat_specs, arrivals=lat_arrivals)
+        out["colo_latency"] = {k: v for k, v in colo_lat.items() if k != "rows"}
+        assert colo_lat["n_ok"] == 24, colo_lat["errors"]
+
+        # Single-host baselines for token identity (driven on a control
+        # host directly, same checkpoint): the identity set and the
+        # kill set.
+        _state("disagg:baseline")
+        ident_specs = [req(40, 24) for _ in range(6)]
+        kill_specs = [req(12, 48) for _ in range(12)]
+        base_ident = _disagg_drive(addr["colo0"], reuse(ident_specs))
+        base_kill = _disagg_drive(addr["colo0"], reuse(kill_specs))
+        assert base_ident["n_ok"] == 6, base_ident["errors"]
+        assert base_kill["n_ok"] == 12, base_kill["errors"]
+
+        # Throughput shape on the control fleet (recorded, not asserted —
+        # 12 colocated slots vs 8 disagg decode slots is not the claim).
+        tput_specs = [req(16, 32) for _ in range(24)]
+        colo_tput = _disagg_drive(front_addr, reuse(tput_specs))
+        out["colo_throughput"] = {k: v for k, v in colo_tput.items() if k != "rows"}
+        front.stop(grace=0.5)
+        front = None
+
+        # -- decode-host scaling: 1 prefill + 1 decode ... ----------------
+        _state("disagg:d1")
+        front = boot_front("d1", ["pre", "dec0"])
+        front_addr = f"127.0.0.1:{front.port}"
+        warm_mig = _disagg_drive(front_addr, [req(12, 8) for _ in range(3)])
+        assert warm_mig["n_ok"] == 3, warm_mig["errors"]
+        d1 = _disagg_drive(front_addr, reuse(tput_specs))
+        out["disagg_1decode"] = {k: v for k, v in d1.items() if k != "rows"}
+        assert d1["n_ok"] == 24, d1["errors"]
+        # Latency control for the flatness claim: same prefill capacity,
+        # one decode host, same mixed shapes and arrival process as the
+        # 2-decode latency pass below.
+        d1_lat = _disagg_drive(
+            front_addr,
+            [req(56, 8) if i % 2 == 0 else req(12, 40) for i in range(24)],
+            arrivals=lat_arrivals,
+        )
+        out["disagg_1decode_latency"] = {
+            k: v for k, v in d1_lat.items() if k != "rows"
+        }
+        assert d1_lat["n_ok"] == 24, d1_lat["errors"]
+        front.stop(grace=0.5)
+        front = None
+
+        # -- ... vs 1 prefill + 2 decode ----------------------------------
+        _state("disagg:d2")
+        front = boot_front("d2", ["pre", "dec0", "dec1"])
+        front_addr = f"127.0.0.1:{front.port}"
+        warm_mig = _disagg_drive(front_addr, [req(12, 8) for _ in range(3)])
+        assert warm_mig["n_ok"] == 3, warm_mig["errors"]
+        mig_before = {n: _disagg_sidecar(side_ports[n]) for n in ("dec0", "dec1")}
+        d2 = _disagg_drive(front_addr, reuse(tput_specs))
+        out["disagg_2decode"] = {k: v for k, v in d2.items() if k != "rows"}
+        assert d2["n_ok"] == 24, d2["errors"]
+        mig_after = {n: _disagg_sidecar(side_ports[n]) for n in ("dec0", "dec1")}
+        split = {
+            n: mig_after[n]["counters"].get("vlm_migrated_in", 0)
+            - mig_before[n]["counters"].get("vlm_migrated_in", 0)
+            for n in ("dec0", "dec1")
+        }
+        out["decode_split"] = split
+        assert all(v > 0 for v in split.values()), (
+            f"burst never split across decode hosts: {split}"
+        )
+        out["decode_scaling_x"] = round(
+            d2["decode_tok_s"] / max(d1["decode_tok_s"], 1e-9), 2
+        )
+        assert out["decode_scaling_x"] >= _DISAGG_SCALE_X, (
+            f"2-decode fleet {d2['decode_tok_s']} tok/s vs 1-decode "
+            f"{d1['decode_tok_s']} tok/s = {out['decode_scaling_x']}x "
+            f"< {_DISAGG_SCALE_X}x"
+        )
+
+        # -- TTFT flatness under the mixed Poisson load -------------------
+        _state("disagg:latency")
+        dis_lat = _disagg_drive(
+            front_addr,
+            [req(56, 8) if i % 2 == 0 else req(12, 40) for i in range(24)],
+            arrivals=lat_arrivals,
+        )
+        out["disagg_latency"] = {k: v for k, v in dis_lat.items() if k != "rows"}
+        assert dis_lat["n_ok"] == 24, dis_lat["errors"]
+        out["ttft_flat_x"] = round(
+            dis_lat["ttft_p95_ms"] / max(d1_lat["ttft_p95_ms"], 1e-9), 2
+        )
+        out["ttft_vs_colo_x"] = round(
+            dis_lat["ttft_p95_ms"] / max(colo_lat["ttft_p95_ms"], 1e-9), 2
+        )
+        assert out["ttft_flat_x"] <= _DISAGG_TTFT_FLAT_X, (
+            f"2-decode TTFT p95 {dis_lat['ttft_p95_ms']}ms vs 1-decode "
+            f"{d1_lat['ttft_p95_ms']}ms = {out['ttft_flat_x']}x > "
+            f"{_DISAGG_TTFT_FLAT_X}x"
+        )
+
+        # -- migrated greedy output == single-host run --------------------
+        _state("disagg:identity")
+        mig_before = {n: _disagg_sidecar(side_ports[n]) for n in ("dec0", "dec1")}
+        pre_ident_before = _disagg_sidecar(side_ports["pre"])
+        dis_ident = _disagg_drive(front_addr, reuse(ident_specs))
+        assert dis_ident["n_ok"] == 6, dis_ident["errors"]
+        mig_after = {n: _disagg_sidecar(side_ports[n]) for n in ("dec0", "dec1")}
+        pre_ident_after = _disagg_sidecar(side_ports["pre"])
+        migrated = sum(
+            mig_after[n]["counters"].get("vlm_migrated_in", 0)
+            - mig_before[n]["counters"].get("vlm_migrated_in", 0)
+            for n in ("dec0", "dec1")
+        )
+        pre_delta = {
+            k: pre_ident_after["counters"].get(k, 0)
+            - pre_ident_before["counters"].get(k, 0)
+            for k in sorted(
+                set(pre_ident_before["counters"]) | set(pre_ident_after["counters"])
+            )
+            if pre_ident_after["counters"].get(k, 0)
+            != pre_ident_before["counters"].get(k, 0)
+        }
+        out["identity"] = {
+            "n": 6,
+            "migrated_in": migrated,
+            "gen_tokens": dis_ident["gen_tokens"],
+        }
+        assert migrated >= 6, (
+            f"identity set only migrated {migrated}/6 rows; prefill-host "
+            f"counter deltas: {pre_delta}; engine after: "
+            f"{pre_ident_after.get('engine')}"
+        )
+        for base_row, dis_row in zip(base_ident["rows"], dis_ident["rows"]):
+            assert dis_row["text"] == base_row["text"] and (
+                dis_row["n_tokens"] == base_row["n_tokens"]
+            ), f"migrated output diverged on {dis_row['cid']}"
+
+        # -- SIGKILL a decode peer mid-migration --------------------------
+        _state("disagg:kill")
+        pre_before = _disagg_sidecar(side_ports["pre"])
+        kill_box: dict = {}
+
+        def run_kill_pass():
+            kill_box["res"] = _disagg_drive(
+                front_addr, reuse(kill_specs),
+                arrivals=[i * 0.05 for i in range(len(kill_specs))],
+            )
+
+        runner = _threading.Thread(target=run_kill_pass)
+        runner.start()
+        time.sleep(1.2)  # streams admitted and mid-decode on both hosts
+        workers["dec1"].kill()
+        runner.join(timeout=240)
+        assert not runner.is_alive(), "kill pass wedged"
+        kill_res = kill_box["res"]
+        out["peer_kill"] = {k: v for k, v in kill_res.items() if k != "rows"}
+        assert kill_res["n_ok"] == 12, (
+            f"{12 - kill_res['n_ok']} stream(s) lost after decode-peer "
+            f"SIGKILL: {kill_res['errors']}"
+        )
+        # No lost or duplicated tokens: byte-equal to the single-host
+        # baseline (greedy replay + the delivered-counter suppression).
+        diverged = [
+            (f"{dis_row['cid']}: base {base_row['n_tokens']}tok "
+             f"{base_row['text']!r} != got {dis_row['n_tokens']}tok/"
+             f"{dis_row['chunks']}chunks {dis_row['text']!r}")
+            for base_row, dis_row in zip(base_kill["rows"], kill_res["rows"])
+            if dis_row["text"] != base_row["text"]
+            or dis_row["n_tokens"] != base_row["n_tokens"]
+        ]
+        assert not diverged, "post-kill output diverged: " + "; ".join(diverged)
+        pre_after = _disagg_sidecar(side_ports["pre"])
+        fallbacks = (
+            pre_after["counters"].get("vlm_migrate_fallbacks", 0)
+            - pre_before["counters"].get("vlm_migrate_fallbacks", 0)
+        )
+        out["peer_kill"]["migrate_fallbacks"] = fallbacks
+        assert fallbacks >= 1, (
+            "SIGKILL landed but no migration fell back to the local ladder"
+        )
+
+        # Balanced accounting on the survivors once everything drained.
+        _state("disagg:drain")
+        balance = {}
+        deadline = time.time() + 30
+        for name in ("pre", "dec0"):
+            while True:
+                eng = _disagg_sidecar(side_ports[name])["engine"]
+                bal = (
+                    eng.get("pages_live") == 0
+                    and eng.get("spill_entries") == 0
+                    and eng.get("pages_allocated_total") == eng.get("pages_freed_total")
+                )
+                balance[name] = {
+                    "pages_live": eng.get("pages_live"),
+                    "spill_entries": eng.get("spill_entries"),
+                    "allocated": eng.get("pages_allocated_total"),
+                    "freed": eng.get("pages_freed_total"),
+                    "balanced": bal,
+                }
+                if bal or time.time() > deadline:
+                    break
+                time.sleep(0.5)
+        out["accounting"] = balance
+        assert all(b["balanced"] for b in balance.values()), balance
+
+        out["acceptance"] = {
+            "decode_tok_s_scales": out["decode_scaling_x"] >= _DISAGG_SCALE_X,
+            "ttft_p95_flat": out["ttft_flat_x"] <= _DISAGG_TTFT_FLAT_X,
+            "migrated_token_identity": True,
+            "kill_all_recovered": kill_res["n_ok"] == 12,
+            "kill_token_identity": True,
+            "kill_hit_migration_ladder": fallbacks >= 1,
+            "survivor_accounting_balanced": True,
+        }
+        assert all(out["acceptance"].values()), out["acceptance"]
+    except BaseException:
+        # A failing assert without the workers' stderr is undebuggable —
+        # each host's log tail goes to OUR stderr before the tree dies.
+        for name, proc in workers.items():
+            path = getattr(proc, "_lumen_err_path", None)
+            if not path or not os.path.exists(path):
+                continue
+            with open(path, "rb") as ef:
+                ef.seek(0, os.SEEK_END)
+                ef.seek(max(0, ef.tell() - 8192))
+                tail = ef.read().decode(errors="replace")
+            print(f"----- {name} stderr tail -----\n{tail}", file=sys.stderr)
+        raise
+    finally:
+        for proc in workers.values():
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        if front is not None:
+            try:
+                front.stop(grace=0.5)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        for key, prev in saved.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
+        tele.reset_hub()
+        shutil.rmtree(root, ignore_errors=True)
+    try:
+        with open(os.path.join(REPO, "BENCH_DISAGG.json"), "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+    return out
+
+
 PHASES = {
     "probe": phase_probe,
     "clip": phase_clip,
@@ -5209,6 +5913,8 @@ PHASES = {
     "replica_scaling_worker": phase_replica_scaling_worker,
     "federation": phase_federation,
     "federation_worker": phase_federation_worker,
+    "disagg": phase_disagg,
+    "disagg_worker": phase_disagg_worker,
     "attribution": phase_attribution,
     "capacity": phase_capacity,
     "bench_grpc_ref": phase_bench_grpc_ref,

@@ -233,7 +233,8 @@ def _print_peers(out: dict) -> None:
     mode = out.get("mode", "?")
     print(f"federation: {mode} mode"
           + (f", self={out['self']}" if out.get("self") else "")
-          + f", hop budget {out.get('hops', '?')}")
+          + f", hop budget {out.get('hops', '?')}"
+          + (f", role={out['role']}" if out.get("role") else ""))
     peers = out.get("peers") or {}
     for name, p in peers.items():
         state = p.get("state", "?")
@@ -244,12 +245,34 @@ def _print_peers(out: dict) -> None:
             f" failovers={p.get('failovers', 0)}"
             f" sheds={p.get('sheds', 0)}"
         )
+        if p.get("fed_role"):
+            line += f" role={p['fed_role']}"
         hits, misses = p.get("cache_hits", 0), p.get("cache_misses", 0)
         if hits or misses:
             line += f" cache_hits={hits}/{hits + misses}"
         if state != "serving" and p.get("last_error"):
             line += f" last_error={p['last_error']!r}"
         print(line)
+    mig = out.get("kv_migration") or {}
+    if any(mig.values()):
+        print(
+            "kv migration:"
+            f" out={mig.get('puts', 0)}"
+            f" ({mig.get('put_bytes', 0)}B wire,"
+            f" {mig.get('ref_pages', 0)} pages by-ref,"
+            f" {mig.get('put_failures', 0)} failed,"
+            f" {mig.get('lane_busy', 0)} lane-busy)"
+            f" in={mig.get('in_commits', 0)}"
+            f" ({mig.get('in_bytes', 0)}B,"
+            f" {mig.get('in_rejected', 0)} rejected)"
+        )
+        puts, commits = mig.get("puts", 0), mig.get("in_commits", 0)
+        if puts + commits:
+            print(
+                "duty split:"
+                f" prefill {100 * puts / (puts + commits):.0f}%"
+                f" / decode {100 * commits / (puts + commits):.0f}%"
+            )
     print(f"peer-cache hit rate: {out.get('cache_peer_hit_rate', 0.0)}")
 
 

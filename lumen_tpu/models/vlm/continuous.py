@@ -52,7 +52,6 @@ import queue as queue_mod
 import threading
 import time
 import weakref
-import zlib
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -67,6 +66,7 @@ from ...utils.metrics import metrics
 from ...utils.shm_arena import ShmArena
 from ...utils.telemetry import record_event
 from ...utils.trace import current_trace
+from . import migration
 from .manager import _PendingGen
 from .paged_kv import DEFAULT_PAGE_SIZE, PagedKVPool, PoolExhausted, page_bytes
 from .prefix_cache import PrefixCache, chunk_keys, prefix_cache_enabled
@@ -123,6 +123,13 @@ class _Request(_PendingGen):
     #: per-request speculative decoding tally (stream metadata).
     spec_proposed: int = 0
     spec_accepted: int = 0
+    #: decode-lane peer address for disaggregated serving: the row is
+    #: exported right after prefill and its decode migrates there.
+    #: Cleared after one attempt — any failure decodes locally.
+    migrate_to: "str | None" = None
+    #: decode-host side of a migration: ``(manifest_keys, n_shared)``
+    #: pending prefix-cache resolution at resume. None otherwise.
+    migrate_in: "tuple | None" = None
 
 
 @dataclass
@@ -159,24 +166,26 @@ class _SpillRecord:
 
     The page payload (per-layer K/V page stacks, padded to a power-of-2
     page count with dump-page garbage, plus the row's ``seen`` vocab
-    mask) lives OUT of line: in an shm-arena lease when the arena had
-    budget, else as plain host arrays (the "pickled spill" twin — same
-    bytes, just not recyclable segments). ``shapes``/``treedef`` rebuild
-    the payload pytree from the flat lease; ``crc`` (crc32 over the used
-    span) catches a torn or recycled-out-from-under-us lease at resume
-    time, turning silent token corruption into the degradation ladder.
+    mask) lives OUT of line: as a self-describing
+    :func:`~lumen_tpu.models.vlm.migration.pack_payload` blob in an
+    shm-arena lease when the arena had budget, else as plain host
+    arrays (the "pickled spill" twin — same bytes, just not recyclable
+    segments). The blob carries each leaf's shape/dtype in-band (the
+    same frame train ``fed_kv_put`` ships to a decode peer), so only
+    ``treedef`` stays out of band; ``crc`` (crc32 over the blob)
+    catches a torn or recycled-out-from-under-us lease at resume time,
+    turning silent token corruption into the degradation ladder.
     The decode scalars are exact state, not hints: ``cur_tok`` is the
     sampled-but-not-yet-written next token (it exists nowhere on the
     host side), and ``rng`` snapshots the request's PRNG key so the
-    record is self-contained for a future cross-engine migration.
+    record is self-contained for cross-host migration.
     """
 
     n_pages: int            # live pages exported — the resume grant size
     n_pad: int              # power-of-2 padded page count in the payload
     nbytes: int             # payload bytes — ledger budget accounting
-    shapes: list            # (shape, dtype-str) per payload leaf, flatten order
     treedef: object         # payload pytree structure
-    crc: int                # crc32 over the lease's used span (0 = host arrays)
+    crc: int                # crc32 over the lease's blob (0 = host arrays)
     cur_tok: int            # pending next token (sampled, not yet emitted)
     cur_len: int            # prompt + generated KV length
     n_gen: int              # tokens generated so far (== len(tokens))
@@ -241,6 +250,17 @@ class ContinuousScheduler:
             "LUMEN_VLM_PREFILL_CHUNK", 256, minimum=32, maximum=4096
         )
         self.prefill_chunk = -(-chunk // self.page_size) * self.page_size
+        from ...utils.env import env_float
+
+        # Decode pacing floor: minimum wall time per decode STEP (a block
+        # sleeps out `block * floor - elapsed`). Off by default (0.0 = no
+        # branch taken on the hot path); the disagg bench phase arms it so
+        # decode throughput on a shared CPU box measures topology (slots x
+        # hosts) instead of this box's core count — sleeps scale across
+        # host processes the way real chips do, spins don't.
+        self._step_floor_s = env_float(
+            "LUMEN_GEN_STEP_FLOOR_MS", 0.0, minimum=0.0, maximum=1000.0
+        ) / 1e3
         # Decode sampling draws from one scheduler-level stream (sample()
         # takes a single key per batched step); entropy-seeded so sampled
         # continuations differ across processes. An admission group's
@@ -278,6 +298,19 @@ class ContinuousScheduler:
         self.spill_denied = 0     # ledger full/disabled -> no spill attempt
         self.preempt_redone = 0   # victim restarted from the prompt
         self.preempt_failed = 0   # victim shed with the typed retryable error
+        # -- disaggregated serving: the migration dispatcher hook. When a
+        # federation with role-tagged peers is live, the serving layer
+        # installs ``migrator(scheduler, req, rec, manifest, target)``
+        # here; requests tagged ``migrate_to`` are then exported right
+        # after prefill (the SAME record format as the spill tier) and
+        # their decode runs on the target peer. None (the default, and
+        # always when LUMEN_FED_ROLE is unset) never exports — the
+        # unconfigured loop is byte-identical to the pre-disagg engine.
+        self.migrator = None
+        self.migrated_out = 0        # rows handed to the dispatcher
+        self.migrate_out_failed = 0  # wire failed -> resumed/shed locally
+        self.migrated_in = 0         # peer rows admitted with zero re-prefill
+        self.migrate_in_rejected = 0 # bad commit (crc/manifest/pool) refused
         # -- copy-on-write prefix KV reuse: content-addressed cache of
         # page-aligned prompt prefixes. Off (None) unless
         # LUMEN_VLM_PREFIX_BYTES grants a budget — the unconfigured
@@ -357,6 +390,10 @@ class ContinuousScheduler:
                 "spill_denied": s.spill_denied,
                 "preempt_redone": s.preempt_redone,
                 "preempt_failed": s.preempt_failed,
+                "migrated_out": s.migrated_out,
+                "migrate_out_failed": s.migrate_out_failed,
+                "migrated_in": s.migrated_in,
+                "migrate_in_rejected": s.migrate_in_rejected,
             }
             if s._spill_arena is not None:
                 arena = s._spill_arena.stats()
@@ -597,6 +634,8 @@ class ContinuousScheduler:
                                 "slot pool invalidated by failed admission"
                             ) from e
                 self._advance_prefill_lane()
+                if self.migrator is not None:
+                    self._migrate_sweep()
                 if self._slots:
                     self._run_block()
         except BaseException as e:  # noqa: BLE001 - never strand callers
@@ -1046,30 +1085,19 @@ class ContinuousScheduler:
             )
         return self._spill_arena
 
-    def _spill_victim(self, idx: int) -> "_SpillRecord | None":
-        """Export slot ``idx``'s live pages + decode state into a spill
-        record. ``None`` = tier disabled or ledger full (counted, caller
-        degrades); raises on export/pack failure (incl. the ``kv_spill``
-        fault point). Runs BEFORE the caller releases the pages, and
-        ``_export_row`` does not donate, so failure leaves the pool
-        untouched."""
-        if self._spill_budget <= 0 or self._spill_max <= 0:
-            return None
-        if len(self._spill_ledger) >= self._spill_max:
-            self.spill_denied += 1
-            metrics.count("vlm_spill_denied")
-            return None
-        faults.check(KV_SPILL, f"{self.name}:{idx}")
+    def _export_state(self, idx: int, n_shared: int) -> tuple:
+        """ONE export codepath for both migration sinks: gather slot
+        ``idx``'s pages past the first ``n_shared`` block-table entries
+        (power-of-2 padded — dump-page garbage fills the tail, bounding
+        compiled export/resume shapes at log2(max_pages)) plus the row's
+        exact decode scalars and rng, in ONE fused device->host
+        transfer. Returns ``(record, shared_page_ids)`` with the payload
+        as host-array leaves; the sink decides where the bytes live —
+        the shm arena (spill), or the tensor wire (``fed_kv_put``).
+        ``_export_row`` does not donate, so failure anywhere leaves the
+        pool untouched."""
         owned = self.kv.owned_pages(idx)
-        # A row that attached a cached prefix does not need its shared
-        # pages exported — they stay resident under the cache's (and this
-        # record's) reference and re-attach on resume as a block-table
-        # copy. Only the PRIVATE suffix crosses to host memory.
-        n_shared = self.kv.shared_prefix_len(idx)
         shared, private = owned[:n_shared], owned[n_shared:]
-        # Power-of-2 padding (dump page 0 fills the tail) bounds compiled
-        # export/resume shapes at log2(max_pages), same as the decode
-        # block's table bucketing. Padded rows hold garbage nothing reads.
         n_pad = 1
         while n_pad < max(1, len(private)):
             n_pad *= 2
@@ -1077,32 +1105,52 @@ class ContinuousScheduler:
         ids[: len(private)] = private
         req = self._slots[idx].request
         exported = self.gen._export_row(self.pool, idx, jnp.asarray(ids))
-        # ONE fused device->host transfer per victim: pages, seen row,
-        # decode scalars, and the request's rng key all come back together.
         host, rng = jax.device_get((exported, req.rng))
         payload = {"pages": host["pages"], "seen": host["seen"]}
         leaves, treedef = jax.tree.flatten(payload)
-        nbytes = sum(int(a.nbytes) for a in leaves)
-        if self._spill_bytes_live + nbytes > self._spill_budget:
+        rec = _SpillRecord(
+            n_pages=len(private), n_pad=n_pad,
+            nbytes=sum(int(a.nbytes) for a in leaves),
+            treedef=treedef, crc=0, cur_tok=int(host["cur_tok"]),
+            cur_len=int(host["cur_len"]), n_gen=int(host["n_gen"]),
+            rng=rng, arrays=leaves,
+        )
+        return rec, shared
+
+    def _spill_victim(self, idx: int) -> "_SpillRecord | None":
+        """Export slot ``idx``'s live pages + decode state into a spill
+        record. ``None`` = tier disabled or ledger full (counted, caller
+        degrades); raises on export/pack failure (incl. the ``kv_spill``
+        fault point). Runs BEFORE the caller releases the pages, so
+        failure leaves the pool untouched."""
+        if self._spill_budget <= 0 or self._spill_max <= 0:
+            return None
+        if len(self._spill_ledger) >= self._spill_max:
             self.spill_denied += 1
             metrics.count("vlm_spill_denied")
             return None
-        shapes = [(a.shape, a.dtype) for a in leaves]
-        lease = arrays = None
-        crc = 0
-        got = self._get_arena().acquire(nbytes)
+        faults.check(KV_SPILL, f"{self.name}:{idx}")
+        # A row that attached a cached prefix does not need its shared
+        # pages exported — they stay resident under the cache's (and this
+        # record's) reference and re-attach on resume as a block-table
+        # copy. Only the PRIVATE suffix crosses to host memory.
+        rec, shared = self._export_state(idx, self.kv.shared_prefix_len(idx))
+        if self._spill_bytes_live + rec.nbytes > self._spill_budget:
+            self.spill_denied += 1
+            metrics.count("vlm_spill_denied")
+            return None
+        # Pack into the one migration lease blob (the same frame train
+        # fed_kv_put ships) and park it in the shm arena when the budget
+        # allows; else keep the plain host-array leaves — same bytes
+        # against the same ledger budget, just not recyclable segments.
+        blob, crc = migration.pack_payload(rec.arrays)
+        got = self._get_arena().acquire(len(blob))
         if got is not None:
-            off = 0
-            for a in leaves:
-                got.view(a.shape, a.dtype, offset=off)[:] = a
-                off += int(a.nbytes)
-            crc = zlib.crc32(got.buf[:nbytes])
-            lease = got
+            np.frombuffer(got.buf, np.uint8, count=len(blob))[:] = np.frombuffer(
+                blob, np.uint8
+            )
+            rec.lease, rec.crc, rec.nbytes, rec.arrays = got, crc, len(blob), None
         else:
-            # Arena denied (budget pressure / no /dev/shm): keep plain
-            # host arrays — same bytes against the same ledger budget,
-            # just not recyclable shm segments.
-            arrays = leaves
             self.spill_fallbacks += 1
             metrics.count("vlm_spill_fallbacks")
         # The record's hold on the shared prefix is taken LAST — every
@@ -1112,12 +1160,8 @@ class ContinuousScheduler:
         # own references without freeing the prefix out from under us.
         if shared:
             self.kv.incref(shared)
-        return _SpillRecord(
-            n_pages=len(private), n_pad=n_pad, nbytes=nbytes, shapes=shapes,
-            treedef=treedef, crc=crc, cur_tok=int(host["cur_tok"]),
-            cur_len=int(host["cur_len"]), n_gen=int(host["n_gen"]),
-            rng=rng, lease=lease, arrays=arrays, shared_pages=list(shared),
-        )
+            rec.shared_pages = list(shared)
+        return rec
 
     def _park_spill(self, req: _Request, record: "_SpillRecord") -> None:
         req.spill = record
@@ -1194,14 +1238,11 @@ class ContinuousScheduler:
             if rec.arrays is None:
                 raise RuntimeError("spill record has no payload (double resume?)")
             return list(rec.arrays)
-        if zlib.crc32(rec.lease.buf[: rec.nbytes]) != rec.crc:
-            raise RuntimeError("spill lease failed crc verification (torn write?)")
-        leaves, off = [], 0
-        for shape, dtype in rec.shapes:
-            view = rec.lease.view(shape, dtype, offset=off)
-            leaves.append(view.copy())
-            off += int(view.nbytes)
-        return leaves
+        try:
+            leaves = migration.unpack_payload(rec.lease.buf[: rec.nbytes], rec.crc)
+        except ValueError as e:
+            raise RuntimeError(f"spill lease rejected: {e}") from None
+        return [leaf.copy() for leaf in leaves]
 
     def _resume_row(self, req: _Request) -> None:
         """Scatter a parked spill record into a fresh page grant and
@@ -1215,6 +1256,8 @@ class ContinuousScheduler:
         slot = granted = None
         try:
             faults.check(KV_RESUME, f"{self.name}:resume")
+            if req.migrate_in is not None:
+                self._attach_migrate_shared(req, rec)
             leaves = self._unpack_spill(rec)
             payload = jax.tree.unflatten(rec.treedef, leaves)
             slot = self._free_slot()
@@ -1248,7 +1291,15 @@ class ContinuousScheduler:
                 self.kv.release(granted)
             logger.warning("KV resume failed (%s); degrading", e)
             self._drop_spill(req)
-            if not (req.do_sample and req.delivered > 0):
+            if req.migrate_in is not None:
+                # A migrated-in row has no local prompt to redo from —
+                # refuse it; the PREFILL host owns the fallback ladder
+                # and resumes the row from its own snapshot.
+                req.migrate_in = None
+                self.migrate_in_rejected += 1
+                metrics.count("vlm_migrate_in_rejected")
+                _fail(req, e)
+            elif not (req.do_sample and req.delivered > 0):
                 self.preempt_redone += 1
                 metrics.count("vlm_preempt_redone")
                 self._requeue_front([req])
@@ -1269,6 +1320,17 @@ class ContinuousScheduler:
         self.spill_resumes += 1
         metrics.count("vlm_spill_resumes")
         self._drop_spill(req)
+        if req.migrate_in is not None:
+            keys, _ = req.migrate_in
+            req.migrate_in = None
+            self.migrated_in += 1
+            metrics.count("vlm_migrated_in")
+            if self.prefix is not None and keys:
+                # The migrated prompt's pages are cacheable history HERE
+                # too: later same-prefix migrations (and local requests)
+                # resolve them by reference instead of riding the wire.
+                pages = self.kv.owned_pages(slot)[: len(keys)]
+                self.prefix.insert(keys[: len(pages)], pages)
         record_event(
             "vlm_resume", self.name,
             f"row resumed into slot {slot}: {rec.n_pages} pages "
@@ -1276,6 +1338,138 @@ class ContinuousScheduler:
             min_interval_s=1.0,
             pages=rec.n_pages, tokens=len(rec.tokens),
         )
+
+    # -- KV page migration (disaggregated prefill/decode) --------------------
+
+    def _wire_manifest(self, req: _Request, n: int) -> list:
+        """Content-hash chain keys over the prompt's page-aligned prefix
+        (capped one page short like the prefix cache's attach cap) — the
+        offer leg's reference list. Empty when the request carries no
+        content identity; the whole prompt then rides the wire."""
+        if req.prefix_content is None:
+            return []
+        content = np.asarray(req.prefix_content)[:n]
+        return chunk_keys(content, self.page_size)[: (n - 1) // self.page_size]
+
+    def _migrate_sweep(self) -> None:
+        """Hand freshly prefilled rows tagged for a decode-lane peer to
+        the migration dispatcher: export through the spill codepath
+        (shared prefix CONTENTS included — the peer may not hold them),
+        release the slot, and let the dispatcher run the wire legs
+        off-thread. Every failure re-enters via :meth:`resubmit_spilled`
+        — the preemption ladder with the peer as one more flaky sink, so
+        a dead decode host never loses or duplicates tokens."""
+        for idx in list(self._slots):
+            slot = self._slots.get(idx)
+            if slot is None:
+                continue
+            req = slot.request
+            if not req.migrate_to or slot.tokens or req.cancelled:
+                continue
+            target, req.migrate_to = req.migrate_to, None  # one attempt
+            try:
+                # All owned pages export by content (n_shared=0): the
+                # record is self-contained; reference-vs-contents is the
+                # DISPATCHER's call after the peer answers the offer.
+                rec, _ = self._export_state(idx, 0)
+            except Exception as e:  # noqa: BLE001 - decode locally instead
+                logger.warning(
+                    "KV migrate-out export of slot %d failed (%s); "
+                    "decoding locally", idx, e,
+                )
+                continue
+            rec.prompt_len = slot.prompt_len
+            manifest = self._wire_manifest(req, slot.prompt_len)
+            self.pool = dict(
+                self.pool,
+                done=self.pool["done"].at[jnp.asarray([idx], jnp.int32)].set(True),
+            )
+            with self._cond:
+                self._slots.pop(idx, None)
+            self.kv.release(idx)
+            self.migrated_out += 1
+            metrics.count("vlm_migrated_out")
+            try:
+                self.migrator(self, req, rec, manifest, target)
+            except Exception as e:  # noqa: BLE001 - ladder, not a loss
+                logger.warning(
+                    "KV migration dispatch to %s failed (%s); resuming "
+                    "locally", target, e,
+                )
+                self.resubmit_spilled(req, rec)
+
+    def resubmit_spilled(self, req: _Request, rec: _SpillRecord) -> None:
+        """Thread-safe re-entry for a migration that failed before or
+        mid-stream: park the record as a spill and resume locally with
+        zero re-prefill (greedy replays are token-identical and the
+        ``delivered`` counter suppresses any already-streamed prefix).
+        A sampled row whose peer already streamed past the snapshot
+        cannot resume without splicing draws — it sheds with the typed
+        retryable error, exactly the preemption ladder."""
+        self.migrate_out_failed += 1
+        metrics.count("vlm_migrate_fallbacks")
+        with self._cond:
+            closed = self._closed
+        if closed:
+            _fail(req, RuntimeError("continuous scheduler is closed"))
+            return
+        if req.do_sample and req.delivered > rec.n_gen:
+            self._fail_preempted(req, None)
+            return
+        req.spill = rec
+        self._spill_ledger[id(req)] = rec
+        self._spill_bytes_live += rec.nbytes
+        self._requeue_front([req])
+        with self._cond:
+            self._cond.notify()
+
+    def submit_migrated(
+        self, req: _Request, rec: _SpillRecord, manifest: list, n_shared: int
+    ) -> None:
+        """Decode-host entry for a ``fed_kv_put`` commit: park the wire
+        record as a parked spill and queue the request — the ordinary
+        resume path then re-installs the row with ZERO re-prefill device
+        work. ``manifest``/``n_shared`` defer shared-prefix resolution
+        to the loop thread (the prefix cache is loop-owned); a lost
+        race fails the request with :class:`migration.ChunksMissing`,
+        which the wire handler maps to a retryable refusal."""
+        need = rec.cur_len + max(int(req.max_new) - rec.n_gen, 0) + 1
+        if not self.kv.fits(need):
+            raise ValueError(
+                f"migrated row needs {need} KV tokens but this pool holds "
+                f"at most {min(self.kv.row_capacity(), (self.kv.pages_total - 1) * self.kv.page_size)} "
+                "per row"
+            )
+        req.spill = rec
+        req.migrate_in = (list(manifest), int(n_shared))
+        if req.trace is None:
+            req.trace = current_trace()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("continuous scheduler is closed")
+            self._spill_ledger[id(req)] = rec
+            self._spill_bytes_live += rec.nbytes
+            self._pending.append(req)
+            self._cond.notify()
+
+    def _attach_migrate_shared(self, req: _Request, rec: _SpillRecord) -> None:
+        """Resolve a migrated-in row's shared-prefix references against
+        the LOCAL prefix cache (loop thread — authoritative, unlike the
+        offer leg's advisory peek) and take the record's hold on them.
+        Idempotent across page-race requeues: once ``shared_pages`` is
+        set the references are held and re-resolution would double-count."""
+        keys, n_shared = req.migrate_in
+        if n_shared <= 0 or rec.shared_pages:
+            return
+        got = self.prefix.lookup(keys[:n_shared]) if self.prefix is not None else []
+        if len(got) < n_shared:
+            raise migration.ChunksMissing(
+                f"offer promised {n_shared} cached prefix pages but only "
+                f"{len(got)} survive (evicted since the offer)"
+            )
+        got = got[:n_shared]
+        self.kv.incref(got)
+        rec.shared_pages = list(got)
 
     def _row_need(self, slot: "_Slot", horizon: "int | None" = None) -> int:
         """KV tokens a row needs covered before the next block: the
@@ -1471,6 +1665,14 @@ class ContinuousScheduler:
         # Decode pace for the PreemptionShed drain hint (first block seeds
         # the EWMA; compile-heavy first blocks wash out within a few).
         dt = t1 - t0
+        if self._step_floor_s > 0.0:
+            # Pace BEFORE tokens stream out so first-token latency pays
+            # the floor too — a paced block models a slower chip, not a
+            # faster chip with delayed bookkeeping.
+            lag = self.block * self._step_floor_s - dt
+            if lag > 0.0:
+                time.sleep(lag)
+                dt = time.perf_counter() - t0
         self._block_s_ewma = (
             dt if self._block_s_ewma == 0.0 else 0.8 * self._block_s_ewma + 0.2 * dt
         )
@@ -1504,7 +1706,11 @@ class ContinuousScheduler:
                 if req.stream_q is not None:
                     for t in slot.tokens[req.delivered :]:
                         req.stream_q.put(t)
-                    req.delivered = len(slot.tokens)
+                    # max(): after a failed migration the remote relay
+                    # has already delivered PAST this replay's position —
+                    # moving the watermark backward would re-emit every
+                    # token from here to the crash point as duplicates.
+                    req.delivered = max(req.delivered, len(slot.tokens))
             if done[idx]:
                 with self._cond:
                     del self._slots[idx]

@@ -939,11 +939,19 @@ class VLMManager:
             repetition_penalty=float(repetition_penalty),
         )
         if self._continuous is not None:
+            from ...utils import disagg
             from .continuous import _Request
 
-            return _Request(
+            req = _Request(
                 rng=self._next_rng(), prefix_content=prefix_content, **common
             )
+            owner = disagg.current()
+            if owner:
+                # Disaggregated serving: the front tier pinned this
+                # request's decode to a decode-lane peer; the scheduler
+                # migrates the row there right after prefill.
+                req.migrate_to = owner
+            return req
         return _PendingGen(**common)
 
     def _next_rng(self) -> jax.Array:

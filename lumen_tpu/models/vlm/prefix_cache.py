@@ -136,6 +136,21 @@ class PrefixCache:
             pages.append(e.page)
         return pages
 
+    def peek(self, keys: list[bytes]) -> int:
+        """ADVISORY leading-run length for ``keys`` — no LRU touch, no
+        reference taken, no mutation. Serves the migration offer leg
+        from the gRPC thread while the loop thread owns the cache: a
+        concurrent resize can at worst mis-size the answer, and the
+        commit leg re-resolves authoritatively on the loop thread
+        (:class:`~.migration.ChunksMissing` on a lost race). Callers
+        off the loop thread must treat any exception as 0."""
+        n = 0
+        for k in keys:
+            if k not in self._entries:
+                break
+            n += 1
+        return n
+
     # -- mutation ----------------------------------------------------------
 
     def insert(self, keys: list[bytes], pages: list[int]) -> int:

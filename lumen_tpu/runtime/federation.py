@@ -84,6 +84,10 @@ DISCOVER_ENV = "LUMEN_FED_DISCOVER"
 from ..serving.router import (  # noqa: E402,F401
     FED_CACHE_MAX_WAIT_S,
     FED_CACHE_TASK,
+    FED_KV_PUT_TASK,
+    FED_ROLE_META,
+    ROLE_ENV,
+    advertised_fed_role,
 )
 
 #: per-peer virtual nodes on the ring — enough that 3 peers split the
@@ -94,6 +98,39 @@ VNODES = 64
 SERVING = "serving"
 EJECTED = "ejected"
 _STATE_CODES = {SERVING: 0, EJECTED: 2}
+
+#: disaggregation lanes + their codes for the numeric-only gauges
+#: registry (``federation:{peer}`` → ``fed_role``).
+ROLE_BOTH, ROLE_PREFILL, ROLE_DECODE = "both", "prefill", "decode"
+_ROLE_CODES = {ROLE_BOTH: 0, ROLE_PREFILL: 1, ROLE_DECODE: 2}
+
+#: tasks the disaggregation planner splits across lanes — generation is
+#: the only protocol task with a prefill/decode phase boundary to cut at.
+DISAGG_TASKS = ("vlm_generate", "vlm_generate_stream")
+
+#: wire chunk size for a migration commit's page payload (under the
+#: 64 MB gRPC message cap with protobuf headroom).
+_KV_CHUNK_BYTES = 48 * 1024 * 1024
+
+#: process-wide KV-migration counters — both wire halves call in via
+#: :func:`note_migration` (lock-free int += like ``Peer.stats``),
+#: surfaced in ``export_status()["kv_migration"]`` and the client
+#: ``peers`` subcommand's duty-split line.
+MIGRATION = {
+    "puts": 0,            # commit legs that retired on the decode peer
+    "put_bytes": 0,       # payload bytes shipped out on the wire
+    "put_failures": 0,    # outbound attempts that fell back to the local ladder
+    "ref_pages": 0,       # pages resolved by content-hash reference, not bytes
+    "lane_busy": 0,       # dispatches refused: all migration lanes in flight
+    "in_commits": 0,      # rows this host admitted from a prefill peer
+    "in_bytes": 0,        # payload bytes received on the wire
+    "in_rejected": 0,     # inbound commits this host refused (typed, in-band)
+}
+
+
+def note_migration(**deltas: int) -> None:
+    for key, delta in deltas.items():
+        MIGRATION[key] = MIGRATION.get(key, 0) + int(delta)
 
 
 
@@ -141,6 +178,30 @@ def fed_forward_timeout_s() -> float:
     """``LUMEN_FED_FORWARD_TIMEOUT_S``: front-tier forward deadline per
     hop when the client set none (default 300s, the client default)."""
     return env_float("LUMEN_FED_FORWARD_TIMEOUT_S", 300.0, minimum=1.0)
+
+
+def fed_role() -> str:
+    """``LUMEN_FED_ROLE``: this host's lane in a disaggregated fleet —
+    ``prefill`` (serve prompt prefill + vision encode, migrate the decode
+    out), ``decode`` (accept migrated rows), or ``both`` (the default AND
+    the byte-identical unconfigured state: nothing advertised, no routing
+    change anywhere)."""
+    return advertised_fed_role() or ROLE_BOTH
+
+
+def fed_kv_timeout_s() -> float:
+    """``LUMEN_FED_KV_TIMEOUT_S``: end-to-end deadline for one migration
+    commit (default 300s). It covers the decode host's ENTIRE remaining
+    decode, not just the page transfer — the token stream rides the same
+    RPC back."""
+    return env_float("LUMEN_FED_KV_TIMEOUT_S", 300.0, minimum=1.0)
+
+
+def fed_kv_lanes() -> int:
+    """``LUMEN_FED_KV_LANES``: concurrent migration dispatches in flight
+    per prefill host (default 4). Over budget, rows decode locally
+    instead of queueing — migration is an optimization, never a wait."""
+    return env_int("LUMEN_FED_KV_LANES", 4, minimum=1)
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +339,9 @@ class Peer:
         self.last_ok = 0.0
         self.last_error = ""
         self.slo: dict = {}
+        # Disaggregation lane, learned passively from the peer's Health
+        # trailing metadata; "both" until (unless) the peer advertises.
+        self.role = ROLE_BOTH
         # Incremented lock-free from handler threads: int += is fine for
         # telemetry (same convention as ResultCache.stats) — health
         # decisions never read these, only streak/state, which ARE
@@ -381,6 +445,13 @@ class FederationManager:
         self.lookup_timeout_s = fed_lookup_timeout_s()
         self.lookup_wait_ms = fed_lookup_wait_ms()
         self.forward_timeout_s = fed_forward_timeout_s()
+        self.kv_timeout_s = fed_kv_timeout_s()
+        self._kv_lanes = threading.BoundedSemaphore(fed_kv_lanes())
+        self._role_warned = False
+        if self.self_listed:
+            # Our own lane comes from the env, not from probing ourselves
+            # (the poll loop skips self on purpose).
+            self.peers[self.self_name].role = fed_role()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -398,6 +469,7 @@ class FederationManager:
                     "state": _STATE_CODES[p.state],
                     "streak": p.streak,
                     "ring_share": round(share, 4),
+                    "fed_role": _ROLE_CODES.get(p.role, 0),
                 }
 
             peer._gauge_fn = _gauges
@@ -424,6 +496,35 @@ class FederationManager:
     def owner_of(self, digest_hex: str) -> Peer | None:
         name = self.ring.owner(digest_hex, skip=self._ejected_names())
         return self.peers.get(name) if name else None
+
+    def disagg_plan(
+        self, task: str, plan: list[Peer]
+    ) -> tuple[list[Peer], str | None]:
+        """Role-aware rewrite of a forward plan. For generation tasks in
+        a fleet with configured lanes: prefill-capable peers lead (the
+        forward target runs vision encode + prefill) and the first
+        decode-capable peer in RING ORDER is named the row's decode OWNER
+        — the prefill host migrates the row's KV there after prefill.
+        Identity ``(plan, None)`` whenever roles are unconfigured, the
+        task has no phase boundary, only one peer is live, or a lane is
+        missing entirely (unservable — warned once, routing stays
+        role-blind rather than refusing). Owner is also None when the
+        chosen forward peer IS the owner: colocated, no migration."""
+        if task not in DISAGG_TASKS or len(plan) < 2:
+            return plan, None
+        roles = {p.name: p.role for p in plan}
+        if all(r == ROLE_BOTH for r in roles.values()):
+            return plan, None
+        prefill = [p for p in plan if roles[p.name] != ROLE_DECODE]
+        decode = [p for p in plan if roles[p.name] != ROLE_PREFILL]
+        if not prefill or not decode:
+            self._warn_unservable()
+            return plan, None
+        ordered = prefill + [p for p in plan if roles[p.name] == ROLE_DECODE]
+        owner = decode[0].name
+        if ordered[0].name == owner:
+            return ordered, None
+        return ordered, owner
 
     # -- health accounting (breaker semantics one level up) ----------------
 
@@ -505,6 +606,236 @@ class FederationManager:
             "fed_peer_readmit", peer.name, f"peer readmitted: {how}"
         )
 
+    # -- disaggregation role coverage --------------------------------------
+
+    def _check_role_coverage(self) -> None:
+        """An all-prefill or all-decode fleet can never FINISH a
+        generation (no decode lane to own rows / no prefill lane to admit
+        prompts). Roles are advisory — routing silently falls back to
+        role-blind order — but a misconfigured fleet must say so LOUDLY,
+        once, instead of quietly serving degraded forever."""
+        roles = [p.role for p in self.peers.values()]
+        if all(r == ROLE_BOTH for r in roles):
+            return
+        has_prefill = any(r in (ROLE_PREFILL, ROLE_BOTH) for r in roles)
+        has_decode = any(r in (ROLE_DECODE, ROLE_BOTH) for r in roles)
+        if not (has_prefill and has_decode):
+            self._warn_unservable()
+
+    def _warn_unservable(self) -> None:
+        if self._role_warned:
+            return
+        self._role_warned = True
+        roles = {n: p.role for n, p in sorted(self.peers.items())}
+        missing = ROLE_DECODE if ROLE_PREFILL in roles.values() else ROLE_PREFILL
+        logger.error(
+            "federation role set is UNSERVABLE: %s — no %s-capable peer; "
+            "role-aware routing is DISABLED (every peer treated as 'both') "
+            "until %s on at least one host provides the missing lane",
+            roles, missing, ROLE_ENV,
+        )
+        telemetry.record_event(
+            "fed_roles_unservable", "federation",
+            f"no {missing}-capable peer among {sorted(roles)}; "
+            "role routing disabled, serving role-blind",
+        )
+
+    # -- KV page migration (disaggregated prefill/decode) ------------------
+
+    def kv_migrate(self, scheduler, req, rec, manifest: list, target: str) -> None:
+        """Migration dispatcher — installed as ``ContinuousScheduler.
+        migrator`` on peer-aware backends. Validates the target and the
+        lane budget SYNCHRONOUSLY (raising hands the row straight back to
+        the scheduler's local degradation ladder, nothing half-done),
+        then runs the wire legs on a short-lived daemon thread so the
+        scheduler loop never blocks on the network."""
+        peer = self.peers.get(target)
+        if peer is None:
+            raise RuntimeError(f"migration target {target!r} is not a peer")
+        if self.self_listed and target == self.self_name:
+            raise RuntimeError("migration target is this host (colocated row)")
+        with self._lock:
+            state = peer.state
+        if state == EJECTED:
+            raise RuntimeError(f"migration target {target} is ejected")
+        if not self._kv_lanes.acquire(blocking=False):
+            note_migration(lane_busy=1)
+            metrics.count("fed_kv_lane_busy")
+            raise RuntimeError("all KV migration lanes are in flight")
+        threading.Thread(
+            target=self._kv_migrate_run,
+            args=(scheduler, req, rec, list(manifest), peer),
+            name="fed-kv-migrate",
+            daemon=True,
+        ).start()
+
+    def _kv_migrate_run(self, scheduler, req, rec, manifest, peer) -> None:
+        ok = False
+        try:
+            ok = self._kv_migrate_legs(scheduler, req, rec, manifest, peer)
+        except Exception as e:  # noqa: BLE001 - any crash -> the local ladder
+            logger.warning(
+                "KV migration to %s died (%s: %s); resuming locally",
+                peer.name, type(e).__name__, e,
+            )
+        finally:
+            self._kv_lanes.release()
+        if not ok:
+            note_migration(put_failures=1)
+            metrics.count("fed_kv_put_failures")
+            # rec.arrays still holds the full pre-slice snapshot
+            # (slice_pages copies the list), so the local resume replays
+            # the exact state the wire failed to deliver.
+            scheduler.resubmit_spilled(req, rec)
+
+    def _kv_migrate_legs(self, scheduler, req, rec, manifest, peer) -> bool:
+        tr = getattr(req, "trace", None)
+        span = (
+            tr.begin("fed.kv_migrate", {"peer": peer.name, "pages": str(rec.n_pages)})
+            if tr is not None
+            else None
+        )
+        h = self._kv_offer(peer, manifest, rec) if manifest else 0
+        status = self._kv_commit(scheduler, req, rec, manifest, peer, h)
+        if status == "chunks_missing" and h > 0 and not getattr(req, "delivered", 0):
+            # Offer/commit race: the promised prefix chunks were evicted
+            # between the legs. One retry shipping full page contents —
+            # safe only while no token has streamed to the client.
+            status = self._kv_commit(scheduler, req, rec, manifest, peer, 0)
+        if span is not None:
+            span.end(ok="1" if status == "done" else "0", ref_pages=str(h))
+        return status == "done"
+
+    def _kv_offer(self, peer: Peer, manifest: list, rec) -> int:
+        """Offer leg: ship the prompt's chain-key manifest, learn how
+        many LEADING pages the decode host's prefix cache already holds —
+        those migrate as references, only the missed suffix rides the
+        commit. Advisory and best-effort: any failure means "ship
+        everything" (0), never a migration failure."""
+        from ..models.vlm import migration
+        from ..serving.proto import ml_service_pb2 as pb
+
+        try:
+            resps = list(peer.stub.Infer(iter([pb.InferRequest(
+                correlation_id="fedkv-offer",
+                task=FED_KV_PUT_TASK,
+                meta={"op": "offer", "manifest": migration.manifest_csv(manifest)},
+            )]), timeout=self.lookup_timeout_s))
+        except Exception as e:  # noqa: BLE001 - a failed offer ships bytes
+            self.record_unreachable(peer, e, "kv offer")
+            return 0
+        last = resps[-1] if resps else None
+        if last is None or last.HasField("error") or last.meta.get("fed_kv") != "ok":
+            return 0
+        try:
+            hit = int(last.meta.get("hit", "0"))
+        except ValueError:
+            return 0
+        # At least one page must ride the wire (the row's live tail page
+        # is never content-addressable), and never claim more than the
+        # manifest covers.
+        return max(0, min(hit, rec.n_pages - 1, len(manifest)))
+
+    def _kv_commit(self, scheduler, req, rec, manifest, peer: Peer, h: int) -> str:
+        """Commit leg: slice off the ``h`` offered pages, pack the rest +
+        decode state into chunked bundle frames, stream the decode host's
+        tokens back into the request, and retire it on the done frame.
+        Returns ``"done"``, ``"chunks_missing"`` (retryable offer race),
+        or ``"failed"`` (caller falls back to the local ladder)."""
+        import numpy as np
+
+        # Lazy: the scheduler exists, so the engine module is loaded —
+        # this import never drags jax into a jax-free process.
+        from ..models.vlm import continuous, migration
+        from ..serving.proto import ml_service_pb2 as pb
+
+        n_page_leaves = len(rec.arrays) - 1  # [per-layer page stacks..., seen]
+        leaves = migration.slice_pages(
+            rec.arrays, n_page_leaves, h, stop=rec.n_pages
+        )
+        leaves.append(np.ascontiguousarray(np.asarray(rec.rng)))
+        leaves.append(np.ascontiguousarray(np.asarray(req.prompt_ids)))
+        blob, crc = migration.pack_payload(leaves)
+        meta = migration.commit_meta(
+            crc=crc,
+            n_page_leaves=n_page_leaves,
+            n_pages=rec.n_pages,
+            n_shared=h,
+            page_size=scheduler.page_size,
+            cur_tok=rec.cur_tok,
+            cur_len=rec.cur_len,
+            n_gen=rec.n_gen,
+            prompt_len=rec.prompt_len,
+            max_new=int(req.max_new),
+            temperature=req.temperature,
+            top_p=req.top_p,
+            do_sample=req.do_sample,
+            repetition_penalty=req.repetition_penalty,
+            manifest=manifest,
+        )
+        from ..utils.tensorwire import BUNDLE_MIME
+
+        n_chunks = max(1, -(-len(blob) // _KV_CHUNK_BYTES))
+        msgs = []
+        for i in range(n_chunks):
+            part = blob[i * _KV_CHUNK_BYTES : (i + 1) * _KV_CHUNK_BYTES]
+            msgs.append(pb.InferRequest(
+                correlation_id="fedkv-commit",
+                task=FED_KV_PUT_TASK,
+                payload=part,
+                payload_mime=BUNDLE_MIME if i == 0 else "",
+                meta=meta if i == 0 else None,
+                seq=i,
+                total=n_chunks,
+            ))
+        tokens: list[int] = []
+        done = None
+        try:
+            for resp in peer.stub.Infer(iter(msgs), timeout=self.kv_timeout_s):
+                if resp.HasField("error"):
+                    if resp.meta.get("fed_kv") == "chunks_missing":
+                        return "chunks_missing"
+                    if resp.error.code == pb.ERROR_CODE_UNAVAILABLE:
+                        self.record_shed(peer)  # alive but refusing: neutral
+                    logger.warning(
+                        "fed_kv_put to %s refused: %s",
+                        peer.name, resp.error.message,
+                    )
+                    return "failed"
+                kind = resp.meta.get("fed_kv", "")
+                if kind == "tok":
+                    for part in resp.meta.get("toks", "").split(","):
+                        if not part:
+                            continue
+                        tok = int(part)
+                        tokens.append(tok)
+                        if req.stream_q is not None:
+                            # Relay live so the CLIENT's stream keeps
+                            # flowing during remote decode; delivered
+                            # tracks it so a mid-stream peer death never
+                            # double-delivers on the local fallback.
+                            req.stream_q.put(tok)
+                            req.delivered += 1
+                elif kind == "done":
+                    done = resp
+                    break
+        except Exception as e:  # noqa: BLE001 - transport death mid-stream
+            self.record_unreachable(peer, e, "kv commit")
+            logger.warning(
+                "fed_kv_put commit to %s died mid-stream after %d token(s): %s",
+                peer.name, len(tokens), e,
+            )
+            return "failed"
+        if done is None:
+            return "failed"
+        eos = done.meta.get("eos") == "1"
+        note_migration(puts=1, put_bytes=len(blob), ref_pages=h)
+        metrics.count("fed_kv_puts")
+        metrics.count("fed_kv_put_bytes", len(blob))
+        self.record_success(peer)
+        continuous._retire(req, tokens, eos)
+        return "done"
+
     # -- background health poll --------------------------------------------
 
     def start(self) -> None:
@@ -542,6 +873,7 @@ class FederationManager:
                 if waiting:
                     continue  # still inside the eject window: no probe yet
                 self._probe(peer, ejected)
+            self._check_role_coverage()
 
     def _probe(self, peer: Peer, ejected: bool) -> None:
         try:
@@ -564,9 +896,17 @@ class FederationManager:
                 # stash them so /peers answers "how is that host doing"
                 # without another hop.
                 trailing = call[1].trailing_metadata() or ()
+                role_seen = None
                 for item in trailing:
                     if item.key == telemetry.SLO_META_KEY:
                         peer.slo = json.loads(item.value)
+                    elif item.key == FED_ROLE_META:
+                        role = str(item.value)
+                        if role in _ROLE_CODES:
+                            role_seen = role
+                # No trailer = the default lane: a peer restarted WITHOUT
+                # the knob must shed its stale role, not keep it forever.
+                peer.role = role_seen or ROLE_BOTH
             except Exception:  # noqa: BLE001 - telemetry, never a verdict
                 pass
         with self._lock:
@@ -677,6 +1017,7 @@ class FederationManager:
                 peers[name] = {
                     "state": p.state,
                     "streak": p.streak,
+                    "fed_role": p.role,
                     **p.stats,
                     "ring_share": round(shares.get(name, 0.0), 4),
                     "sidecar": p.spec.sidecar,
@@ -690,8 +1031,10 @@ class FederationManager:
             "enabled": True,
             "mode": "peer" if self.self_name else "front",
             "self": self.self_name,
+            "role": fed_role(),
             "hops": self.hops,
             "peers": peers,
+            "kv_migration": dict(MIGRATION),
             "cache_peer_hit_rate": round(hits / (hits + misses), 4)
             if hits + misses
             else 0.0,

@@ -53,6 +53,7 @@ import grpc
 from google.protobuf import empty_pb2
 
 from ..utils import deadline as request_deadline, request_notes
+from ..utils import disagg
 from ..utils import qos as request_qos
 from ..utils import tensorwire
 from ..utils import trace as request_trace
@@ -690,6 +691,17 @@ class BaseService(InferenceServicer):
         # batcher, so it is answered without touching deadline or
         # admission accounting (no shed, no deadline_drop, no batch slot).
         notes_token = request_notes.begin_notes()
+        # Decode-owner scope (disaggregated prefill/decode): the front
+        # tier's ``lumen-decode-owner`` metadata rides down to the VLM
+        # manager's request construction — same contextvar pattern as the
+        # deadline. Gated on disagg.enabled() (server boot with a
+        # federation attached) so unconfigured hosts never even scan
+        # request metadata for the key.
+        owner_token = (
+            disagg.activate(self._invocation_meta(context, disagg.DECODE_OWNER_META))
+            if disagg.enabled()
+            else None
+        )
         try:
             try:
                 out = task.handler(payload, asm.payload_mime, asm.meta)
@@ -740,6 +752,8 @@ class BaseService(InferenceServicer):
                 # Streaming handler: iterator of (bytes, mime, meta) chunks.
                 yield from self._stream_out(cid, asm.task, out, t0)
         finally:
+            if owner_token is not None:
+                disagg.deactivate(owner_token)
             request_notes.end_notes(notes_token)
             request_qos.deactivate(qos_token)
             request_deadline.reset(token)

@@ -40,6 +40,7 @@ import hashlib
 import itertools
 import json
 import logging
+import os
 import sys
 import threading
 from typing import Iterable, Iterator
@@ -77,6 +78,52 @@ _FED_CACHE_MAX_BLOB = 48 * 1024 * 1024
 FED_CACHE_MAX_WAIT_S = 30.0
 
 
+#: Reserved task name of the KV page-migration RPC (disaggregated
+#: prefill/decode): a prefill-lane host ships a freshly prefilled row's
+#: KV pages + exact decode state to its decode-lane owner, which decodes
+#: with ZERO re-prefill and streams the tokens back on the same RPC.
+#: Answered like :data:`FED_CACHE_TASK` (before the route table — the
+#: task is reserved, never registered) but BEHIND the drain gate:
+#: accepting a row to decode is real admission. Server half:
+#: :meth:`HubRouter._answer_kv_put` (sink = the VLM service's
+#: ``handle_kv_put``); client half:
+#: ``lumen_tpu.runtime.federation.FederationManager.kv_migrate``.
+FED_KV_PUT_TASK = "fed_kv_put"
+
+#: env knob selecting this host's lane in a disaggregated fleet.
+ROLE_ENV = "LUMEN_FED_ROLE"
+
+#: gRPC metadata key a host's lane rides on Health TRAILING metadata —
+#: peers learn each other's roles passively from the probe they already
+#: run, no new RPC. Absent = unconfigured = serves both lanes.
+FED_ROLE_META = "lumen-fed-role"
+
+FED_ROLES = ("prefill", "decode", "both")
+
+_ROLE_WARNED = False
+
+
+def advertised_fed_role() -> str | None:
+    """This host's ``LUMEN_FED_ROLE`` lane, or None when unset. None
+    advertises nothing — an unconfigured host's Health payload (and
+    every request path) stays byte-identical to pre-role builds. A
+    malformed value warns once and behaves as unset: serve both lanes,
+    degrade rather than crash."""
+    raw = (os.environ.get(ROLE_ENV) or "").strip().lower()
+    if not raw:
+        return None
+    if raw not in FED_ROLES:
+        global _ROLE_WARNED
+        if not _ROLE_WARNED:
+            _ROLE_WARNED = True
+            logger.warning(
+                "%s=%r is not one of %s; serving both lanes",
+                ROLE_ENV, raw, FED_ROLES,
+            )
+        return None
+    return raw
+
+
 def _fed_wait_slots() -> threading.Semaphore:
     """Process-wide cap on CONCURRENTLY-PARKED cache-lookup waits — the
     per-RPC deadline clamp bounds each wait, this bounds the aggregate:
@@ -104,6 +151,12 @@ class HubRouter(InferenceServicer):
     #: the only state when ``LUMEN_FED_PEERS`` is unset) keeps every
     #: request path byte-identical to single-host.
     federation = None
+
+    #: KV-migration sink (the VLM service's ``handle_kv_put``), attached
+    #: by the server on decode-capable boots; None answers the reserved
+    #: ``fed_kv_put`` task with a typed in-band refusal, and the prefill
+    #: host decodes the row locally — a refusal never loses work.
+    kv_migration = None
 
     def __init__(self, services: dict[str, BaseService]):
         self.services = dict(services)
@@ -309,6 +362,48 @@ class HubRouter(InferenceServicer):
             total=1,
         )
 
+    def _answer_kv_put(
+        self, first: pb.InferRequest, request_iterator, context
+    ) -> Iterator[pb.InferResponse]:
+        """Server half of the KV page-migration protocol: delegate to the
+        attached sink. Unlike the cache lookup this IS admission of real
+        decode work, so the drain gate applies; every refusal is a typed
+        in-band UNAVAILABLE — the prefill host treats ANY failure as
+        "resume locally", so nothing here can lose a row."""
+        if self._draining:
+            yield self._drain_response(first)
+            return
+        sink = self.kv_migration
+        if sink is None:
+            yield pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                meta={"fed_kv": "refused"},
+                error=pb.Error(
+                    code=pb.ERROR_CODE_UNAVAILABLE,
+                    message="this host accepts no KV migrations",
+                    detail=(
+                        "no continuous-batching VLM engine is attached "
+                        "(front tier, modelless host, or non-continuous "
+                        "scheduler); the prefill host decodes locally"
+                    ),
+                ),
+            )
+            return
+        try:
+            yield from sink.handle_kv_put(first, request_iterator, context)
+        except Exception as e:  # noqa: BLE001 - a broken sink must answer in-band
+            logger.exception("fed_kv_put sink failed")
+            yield pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                meta={"fed_kv": "refused"},
+                error=pb.Error(
+                    code=pb.ERROR_CODE_INTERNAL,
+                    message=f"fed_kv_put sink failed: {type(e).__name__}: {e}",
+                ),
+            )
+
     def Infer(self, request_iterator: Iterable[pb.InferRequest], context) -> Iterator[pb.InferResponse]:
         try:
             first = next(iter(request_iterator))
@@ -319,6 +414,11 @@ class HubRouter(InferenceServicer):
             # route table on purpose (read-only, O(1), and a draining or
             # modelless peer must still serve its cache).
             yield self._answer_cache_lookup(first, context)
+            return
+        if first.task == FED_KV_PUT_TASK:
+            # KV-migration protocol: reserved like the cache lookup, but
+            # the drain gate (inside) applies — this admits decode work.
+            yield from self._answer_kv_put(first, request_iterator, context)
             return
         if self._draining:
             yield self._drain_response(first)
@@ -527,6 +627,12 @@ class HubRouter(InferenceServicer):
                     # peer is a reported condition (its ring segment
                     # spilled to successors), not an outage of THIS host.
                     trailing.append(("lumen-fed-status", json.dumps(fed_state)))
+                role = advertised_fed_role()
+                if role:
+                    # Disaggregation lane: peers learn it from the Health
+                    # probe they already run. Unset advertises nothing —
+                    # the unconfigured payload stays byte-identical.
+                    trailing.append((FED_ROLE_META, role))
                 ap_state = self._autopilot_state()
                 if ap_state:
                     # Whether the capacity controller is live, which loops
@@ -562,6 +668,9 @@ class FederationRouter(HubRouter):
     Routing is consistent-hash by the request payload's sha256 — the same
     content address the result cache keys on — so identical payloads
     always land on the same peer and its cache concentrates the hits.
+    Empty-payload tasks (vlm generate: the prompt rides in request meta)
+    fold the first message's meta into the key instead, so a meta-borne
+    workload still spreads across the ring.
     Per-request resilience: the hop budget (``LUMEN_FED_HOPS``) walks the
     ring owner's live successors on a transport failure (peer dead —
     feeds the ejection streak) or an in-band UNAVAILABLE shed (peer alive
@@ -666,6 +775,13 @@ class FederationRouter(HubRouter):
             # tier owns no cache — answer miss honestly, right here.
             yield self._answer_cache_lookup(first, context)
             return
+        if first.task == FED_KV_PUT_TASK:
+            # A migration targets a SPECIFIC decode host, not a content
+            # address — consistent-hashing the page payload to a random
+            # peer would be wrong. A front tier never has a sink attached,
+            # so this answers the typed in-band refusal.
+            yield from self._answer_kv_put(first, request_iterator, context)
+            return
         if self._draining:
             yield self._drain_response(first)
             return
@@ -705,12 +821,38 @@ class FederationRouter(HubRouter):
             if not asm.complete and req.correlation_id == first.correlation_id:
                 asm.add(req)
         rspan = tr.begin("fed.route") if tr is not None else None
-        digest = hashlib.sha256(asm.payload()).hexdigest()
+        body = asm.payload()
+        h = hashlib.sha256(body)
+        if not body:
+            # Meta-borne tasks (vlm generate: the prompt rides in request
+            # meta over an empty payload) would otherwise all collapse to
+            # sha256(b"") — one ring owner for the whole workload and, in
+            # a role-tagged fleet, one decode owner for every migrated
+            # row. Fold the first message's meta in so content spreads;
+            # payload-bearing tasks keep their exact digests.
+            for k in sorted(first.meta):
+                h.update(k.encode())
+                h.update(b"\x00")
+                h.update(first.meta[k].encode())
+                h.update(b"\x00")
+        digest = h.hexdigest()
         plan = fed.plan(digest)
+        # Disaggregation rewrite: for generation tasks in a role-tagged
+        # fleet, prefill-capable peers lead the plan and the first
+        # decode-capable peer in ring order OWNS the decode — the chosen
+        # prefill host migrates the row's KV there. Identity (plan, None)
+        # whenever roles are unconfigured or the task has no phase split.
+        decode_owner = None
+        if plan:
+            plan, decode_owner = fed.disagg_plan(first.task, plan)
         if rspan is not None:
-            rspan.end(
-                owner=plan[0].name if plan else "none", candidates=str(len(plan))
-            )
+            rattrs = {
+                "owner": plan[0].name if plan else "none",
+                "candidates": str(len(plan)),
+            }
+            if decode_owner:
+                rattrs["decode_owner"] = decode_owner
+            rspan.end(**rattrs)
         if not plan:
             yield self._relay_exhausted(context, first.correlation_id, None, 0)
             return
@@ -743,10 +885,22 @@ class FederationRouter(HubRouter):
                     if tr is not None
                     else None
                 )
+                fkw = kwargs
+                if decode_owner is not None and peer.name != decode_owner:
+                    # Pin the row's decode to the ring-chosen owner; the
+                    # prefill host migrates the KV there after prefill.
+                    # Omitted when the forward target IS the owner (or on
+                    # the owner itself after failover) — decode locally.
+                    from ..utils.disagg import DECODE_OWNER_META
+
+                    fkw = dict(kwargs)
+                    fkw["metadata"] = (md or ()) + (
+                        (DECODE_OWNER_META, decode_owner),
+                    )
                 got_any = False
                 shed = None
                 try:
-                    for resp in peer.stub.Infer(iter(msgs), **kwargs):
+                    for resp in peer.stub.Infer(iter(msgs), **fkw):
                         if not got_any and self._reroutable_shed(resp):
                             shed = resp
                             break

@@ -386,6 +386,32 @@ def serve(
                     "LUMEN_FED_SELF",
                     "unset" if not federation.self_name else "not in peer list",
                 )
+            # Disaggregated prefill/decode: wire both halves of the KV
+            # migration protocol. This host can ANSWER fed_kv_put (decode
+            # sink) and DISPATCH migrations (prefill side); which lane it
+            # actually plays is the front tier's routing call, driven by
+            # each host's LUMEN_FED_ROLE advertisement.
+            from ..runtime.federation import fed_role
+            from ..utils import disagg
+
+            vlm = next(
+                (s for s in services.values() if hasattr(s, "handle_kv_put")),
+                None,
+            )
+            engines = (
+                list(getattr(getattr(vlm, "manager", None), "_engines", None) or [])
+                if vlm is not None
+                else []
+            )
+            if engines:
+                disagg.enable()
+                router.kv_migration = vlm
+                for eng in engines:
+                    eng.migrator = federation.kv_migrate
+                logger.info(
+                    "federation: KV migration wire enabled on %d engine(s) "
+                    "(role=%s)", len(engines), fed_role(),
+                )
     if federation is not None:
         federation.start()  # the one background health-poll thread
 

@@ -789,7 +789,7 @@ class TestKVSpillTier:
             repetition_penalty=1.0,
         )
         rec = _SpillRecord(
-            n_pages=1, n_pad=1, nbytes=1 << 10, shapes=[], treedef=None,
+            n_pages=1, n_pad=1, nbytes=1 << 10, treedef=None,
             crc=0, cur_tok=0, cur_len=0, n_gen=0, rng=None, lease=lease,
         )
         req.spill = rec
