@@ -235,9 +235,15 @@ def _print_peers(out: dict) -> None:
           + (f", self={out['self']}" if out.get("self") else "")
           + f", hop budget {out.get('hops', '?')}"
           + (f", role={out['role']}" if out.get("role") else ""))
+    if out.get("capacity_gossip"):
+        print("capacity gossip: on (ring weights follow reported headroom)")
     peers = out.get("peers") or {}
     for name, p in peers.items():
         state = p.get("state", "?")
+        if p.get("draining"):
+            # Drain leads the line: a planned handoff is the most
+            # operator-relevant fact about this peer right now.
+            state += " DRAINING"
         line = (
             f"  {name}: {state}"
             f" share={100 * p.get('ring_share', 0):.1f}%"
@@ -245,6 +251,15 @@ def _print_peers(out: dict) -> None:
             f" failovers={p.get('failovers', 0)}"
             f" sheds={p.get('sheds', 0)}"
         )
+        # Gossiped capacity columns: present only when LUMEN_FED_CAPACITY
+        # is armed on the server (the sidecar payload omits them
+        # otherwise, so unconfigured output is unchanged).
+        if p.get("weight") is not None:
+            line += f" weight={p['weight']:.2f}"
+        if p.get("duty") is not None:
+            line += f" duty={100 * p['duty']:.0f}%"
+        if p.get("burn_5m") is not None:
+            line += f" burn_5m={p['burn_5m']}"
         if p.get("fed_role"):
             line += f" role={p['fed_role']}"
         hits, misses = p.get("cache_hits", 0), p.get("cache_misses", 0)

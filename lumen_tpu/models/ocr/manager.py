@@ -21,6 +21,7 @@ from __future__ import annotations
 import copy
 import logging
 import os
+import time
 from dataclasses import dataclass
 
 import jax
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.model_info import dataclass_from_extra, load_model_info
+from ...utils import telemetry
 from ...ops.ctc import ctc_collapse_rows, ctc_greedy_device, load_ctc_vocab
 from ...ops.image import letterbox_numpy
 from ...runtime.batcher import bucket_for
@@ -56,6 +58,26 @@ DET_MEAN = (0.485, 0.456, 0.406)
 DET_STD = (0.229, 0.224, 0.225)
 REC_MEAN = (0.5, 0.5, 0.5)
 REC_STD = (0.5, 0.5, 0.5)
+
+
+class _DirectLane:
+    """Minimal dispatch unit standing in for a batcher/engine in the OCR
+    family's :class:`~lumen_tpu.runtime.fleet.EngineFleet`. OCR dispatches
+    ragged det/rec shapes directly (no queue to measure, nothing to
+    close), so the unit exists to give the family a chip claim in the
+    autopilot's ledger and a ``device:{name}`` duty meter the scale loop
+    can read. A 1-unit fleet can never be parked (the floor of 1), which
+    is the honest posture until the ragged-batching rework gives OCR real
+    replicas."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def load(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        return None
 
 
 @dataclass
@@ -326,6 +348,17 @@ class OcrManager:
                 ),
             )
             logger.info("ocr warmup in %.1fs", _time.perf_counter() - t0)
+        # Chip-ledger + duty coverage: a 1-unit engine fleet so the
+        # autopilot's scale loop sees OCR's device claim and duty like
+        # every other family (it can never park the last unit — the
+        # family is counted, not scalable, until OCR grows replicas).
+        from ...runtime.fleet import EngineFleet
+
+        self._lane = _DirectLane(self.info.name)
+        telemetry.set_capacity(f"device:{self._lane.name}", 1.0, union=True)
+        self._fleet = EngineFleet(
+            self.info.name, [self._lane], devices_per_replica=1
+        )
         self._initialized = True
         logger.info(
             "ocr manager ready: %s (det buckets %s, rec h=%d, vocab %d)",
@@ -333,6 +366,10 @@ class OcrManager:
         )
 
     def close(self) -> None:
+        fleet = getattr(self, "_fleet", None)
+        if fleet is not None:
+            fleet.close()
+            self._fleet = None
         self._initialized = False
 
     def topology(self) -> dict[str, str]:
@@ -364,7 +401,9 @@ class OcrManager:
         # is also one transfer, but device_get is the batched-fetch idiom
         # the clip/face fetch lane uses — and returns host numpy for the
         # cv2 postprocess either way).
+        t0 = time.monotonic()
         prob = jax.device_get(self._run_detector(self.det_vars, boxed[None]))[0]
+        telemetry.busy(f"device:{self.info.name}", t0, time.monotonic())
         return self.boxes_from_det_output(
             prob,
             image_hw=(h, w),
@@ -448,9 +487,11 @@ class OcrManager:
                 # conf) result tree — the old per-leaf np.asarray pair
                 # round-tripped the device once per leaf on the rec hot
                 # path (same fix PR 2 applied to the clip/face fetch lane).
+                t0 = time.monotonic()
                 ids, conf = jax.device_get(
                     self._run_recognizer(self.rec_vars, batch, widths)
                 )
+                telemetry.busy(f"device:{self.info.name}", t0, time.monotonic())
                 # Slice off batch-bucket padding rows before the host collapse.
                 ids = ids[: len(chunk)]
                 conf = conf[: len(chunk)]

@@ -62,6 +62,7 @@ import numpy as np
 
 from ...testing.faults import KV_RESUME, KV_SPILL, faults
 from ...utils.deadline import PreemptionShed
+from ...utils import telemetry
 from ...utils.metrics import metrics
 from ...utils.shm_arena import ShmArena
 from ...utils.telemetry import record_event
@@ -229,6 +230,10 @@ class ContinuousScheduler:
         # same-name replacement takes over the slot (last-writer-wins
         # register, ownership-guarded unregister).
         self.name = name
+        # Same ``device:{name}`` duty meter the MicroBatcher declares —
+        # the autopilot's scale loop (and the capacity gossip's duty
+        # report) read engine fleets through the identical sensor name.
+        telemetry.set_capacity(f"device:{self.name}", 1.0, union=True)
         self.n_slots = slots
         self.block = block
         self.page_size = page_size or env_int(
@@ -457,6 +462,10 @@ class ContinuousScheduler:
                 raise RuntimeError("continuous scheduler is closed")
             self._pending.append(req)
             self._cond.notify()
+        # Arrival counter under the batcher's ``batch_items:{name}`` key:
+        # the predictive autopilot fits its trend over these buckets, so
+        # engine families share the MicroBatcher sensor vocabulary.
+        telemetry.count(f"batch_items:{self.name}")
         return req.future
 
     def load(self) -> int:
@@ -1605,6 +1614,7 @@ class ContinuousScheduler:
                 width = 0
         active = len(self._slots)
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         # Ragged page bucketing: ship only a power-of-2 prefix of the
         # block tables covering the longest live row. The CPU reference
         # gathers every table entry it is given, so a pool of short
@@ -1676,6 +1686,9 @@ class ContinuousScheduler:
         self._block_s_ewma = (
             dt if self._block_s_ewma == 0.0 else 0.8 * self._block_s_ewma + 0.2 * dt
         )
+        # Duty credit covers the paced window too: a step floor models a
+        # slower chip, and the duty meter should describe that chip.
+        telemetry.busy(f"device:{self.name}", tm0, time.monotonic())
         span_meta = {
             "step": self.blocks_run,
             "rows": active,

@@ -742,6 +742,7 @@ class VLMManager:
         self._batcher = None
         self._continuous = None
         self._engines = []
+        self._engine_fleet = None
         if self.scheduler == "continuous":
             from ...runtime.fleet import batcher_name
             from ...utils.env import env_int
@@ -778,6 +779,32 @@ class VLMManager:
                 self._engines.append(build_engine(rid, plan.meshes[rid], placed))
             self._continuous = self._engines[0]
             if plan.replicas > 1:
+                from ...runtime.fleet import EngineFleet
+
+                def rebuild_engine(rid: int) -> ContinuousScheduler:
+                    """Unpark hook: re-place the (already device-resident)
+                    params on the replica's original mesh slice and build
+                    a fresh engine there. The migration dispatcher is
+                    wired at server boot only, so copy it over from a
+                    surviving sibling — a rebuilt engine in a role-tagged
+                    fleet must keep exporting rows."""
+                    placed = self._place_params(
+                        self.params, mesh=plan.meshes[rid]
+                    )
+                    eng = build_engine(rid, plan.meshes[rid], placed)
+                    fleet = self._engine_fleet
+                    if fleet is not None:
+                        for sib in fleet.serving_engines():
+                            if sib.migrator is not None:
+                                eng.migrator = sib.migrator
+                                break
+                    return eng
+
+                self._engine_fleet = EngineFleet(
+                    self.info.name, list(self._engines),
+                    build=rebuild_engine,
+                    devices_per_replica=plan.devices_per_replica,
+                )
                 logger.info(
                     "VLM continuous engine fleet: %d replicas x %d slots "
                     "(%d devices each)",
@@ -810,17 +837,30 @@ class VLMManager:
         if self._initialized:
             if self._batcher is not None:
                 self._batcher.close()
-            for engine in getattr(self, "_engines", []) or (
-                [self._continuous] if self._continuous is not None else []
-            ):
-                engine.close()
+            fleet = getattr(self, "_engine_fleet", None)
+            if fleet is not None:
+                # The fleet is authoritative after any unpark rebuilt an
+                # engine the boot-time _engines list has no reference to.
+                fleet.close()
+            else:
+                for engine in getattr(self, "_engines", []) or (
+                    [self._continuous] if self._continuous is not None else []
+                ):
+                    engine.close()
         if fn := getattr(self, "_route_gauge_fn", None):
             metrics.unregister_gauges(f"vlm-quant:{self.model_id}", fn)
         self._initialized = False
 
     def _pick_engine(self):
         """Least-loaded dispatch across the per-replica continuous
-        engines (queue depth + live rows + prefill lane)."""
+        engines (queue depth + live rows + prefill lane). With a fleet
+        attached, only SERVING engines are candidates — a parked engine
+        stops receiving work the moment the autopilot parks it."""
+        fleet = self._engine_fleet
+        if fleet is not None:
+            live = fleet.serving_engines()
+            if live:
+                return min(live, key=lambda e: e.load())
         if len(self._engines) == 1:
             return self._engines[0]
         return min(self._engines, key=lambda e: e.load())

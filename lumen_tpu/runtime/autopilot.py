@@ -92,6 +92,8 @@ SCALE_DOWN_ENV = "LUMEN_AUTOPILOT_SCALE_DOWN"
 BURN_DESCEND_ENV = "LUMEN_AUTOPILOT_BURN_DESCEND"
 BURN_ASCEND_ENV = "LUMEN_AUTOPILOT_BURN_ASCEND"
 WASTE_ENV = "LUMEN_AUTOPILOT_WASTE_PCT"
+PREDICT_ENV = "LUMEN_AUTOPILOT_PREDICT"
+HORIZON_ENV = "LUMEN_AUTOPILOT_HORIZON_S"
 
 #: per-loop manual-override knobs: ``0`` keeps that loop observing but
 #: never actuating (the operator holds that actuator by hand).
@@ -153,6 +155,24 @@ def autopilot_decisions() -> int:
     return env_int(DECISIONS_ENV, 64, minimum=1)
 
 
+def autopilot_predict() -> bool:
+    """``LUMEN_AUTOPILOT_PREDICT`` (default OFF): arms short-horizon
+    arrival-rate forecasting in the scale loop. The trend is fit over the
+    per-bucket ``batch_items`` rates already in the telemetry rings and
+    extrapolated ``LUMEN_AUTOPILOT_HORIZON_S`` ahead; the park/unpark
+    gates then act on the WORSE of current and projected duty, so a
+    rising family unparks before the reactive threshold trips. Off keeps
+    the reactive thresholds (and the sensor readings) byte-identical."""
+    return os.environ.get(PREDICT_ENV) == "1"
+
+
+def autopilot_horizon_s() -> float:
+    """``LUMEN_AUTOPILOT_HORIZON_S``: how far ahead the arrival-rate
+    trend is extrapolated (default 60s — about one replica unpark's
+    build+warmup cost, so the forecast leads by what acting costs)."""
+    return env_float(HORIZON_ENV, 60.0, minimum=1.0)
+
+
 def loop_enabled(loop: str) -> bool:
     """Per-loop manual override (:data:`LOOP_ENVS`): setting the loop's
     knob to ``0`` disables its actuations while the other loops keep
@@ -181,8 +201,14 @@ class Autopilot:
         fleets: Callable[[], list] | None = None,
         batchers: Callable[[], list] | None = None,
         queues: Callable[[], list] | None = None,
+        predict: bool | None = None,
+        horizon_s: float | None = None,
     ):
         self._clock = clock
+        self.predict = autopilot_predict() if predict is None else bool(predict)
+        self.horizon_s = (
+            autopilot_horizon_s() if horizon_s is None else max(1.0, horizon_s)
+        )
         self.tick_s = autopilot_tick_s() if tick_s is None else max(0.05, tick_s)
         self.cooldown_s = autopilot_cooldown_s() if cooldown_s is None else max(0.0, cooldown_s)
         self.sense_s = autopilot_sense_s() if sense_s is None else max(1.0, sense_s)
@@ -300,6 +326,8 @@ class Autopilot:
             duties: list[float] = []
             drain = 0.0
             queued = 0
+            rate = forecast = 0.0
+            saw_forecast = False
             for r in fs.replicas:
                 b = r.batcher
                 if r.state != _SERVING or b is None:
@@ -307,10 +335,25 @@ class Autopilot:
                 d = telemetry.duty_fraction(f"device:{b.name}", self.sense_s)
                 if d is not None:
                     duties.append(d)
-                est = b.drain_estimate_s()
+                # Engine fleets dispatch without a MicroBatcher queue, so
+                # there is no drain estimator to read — treat as no
+                # backlog rather than requiring the method.
+                est_fn = getattr(b, "drain_estimate_s", None)
+                est = est_fn() if est_fn is not None else None
                 if est is not None:
                     drain = max(drain, est)
                 queued += b.load()
+                if self.predict:
+                    cur = telemetry.window_total(
+                        f"batch_items:{b.name}", self.sense_s
+                    ) / self.sense_s
+                    f = telemetry.forecast_rate(
+                        f"batch_items:{b.name}", self.sense_s, self.horizon_s
+                    )
+                    rate += cur
+                    if f is not None:
+                        forecast += f
+                        saw_forecast = True
             active = sum(1 for r in fs.replicas if r.state == _SERVING)
             parked = sum(1 for r in fs.replicas if r.state == _PARKED)
             readings[fs.name] = {
@@ -327,6 +370,22 @@ class Autopilot:
                 "holding": len(fs.replicas) - parked,
                 "chips_per_replica": fs.devices_per_replica,
             }
+            if self.predict:
+                # Predictive keys exist ONLY with the knob on — the
+                # unconfigured sensor dict (and every event built from it)
+                # stays byte-identical. projected_duty scales the measured
+                # duty by the forecast/current arrival ratio, clamped so a
+                # noisy fit can neither zero the signal nor 100x it.
+                duty = readings[fs.name]["duty"]
+                proj = None
+                if saw_forecast and rate > 0 and duty is not None:
+                    ratio = max(0.25, min(4.0, forecast / rate))
+                    proj = round(min(1.0, duty * ratio), 4)
+                readings[fs.name]["rate_rps"] = round(rate, 3)
+                readings[fs.name]["forecast_rps"] = (
+                    round(forecast, 3) if saw_forecast else None
+                )
+                readings[fs.name]["projected_duty"] = proj
         return readings
 
     def _tick_scale(self, now: float, made: list[dict]) -> None:
@@ -361,7 +420,16 @@ class Autopilot:
             duty = r["duty"]
             if duty is None:  # no sensor -> no actuation
                 continue
-            if duty >= self.scale_down_duty or r["drain_s"] > self.tick_s:
+            # Predictive gate: act on the WORSE of measured and projected
+            # duty. A rising trend blocks the park (the chips are about to
+            # be needed) and trips the unpark early; a falling trend never
+            # parks ahead of the measurement — scale-down stays reactive,
+            # so a forecast can cost capacity margin only upward.
+            eff = duty
+            proj = r.get("projected_duty")
+            if proj is not None:
+                eff = max(duty, proj)
+            if eff >= self.scale_down_duty or r["drain_s"] > self.tick_s:
                 continue
             if r["active"] <= 1 or not self._may_act("scale", fs.name, now):
                 continue
@@ -383,8 +451,12 @@ class Autopilot:
         )
         for fs in hot:
             r = readings[fs.name]
+            eff = r["duty"]
+            proj = r.get("projected_duty")
+            if proj is not None:
+                eff = max(eff, proj)
             pressured = (
-                r["duty"] > self.scale_up_duty
+                eff > self.scale_up_duty
                 or r["drain_s"] > 2.0 * self.tick_s
             )
             if not pressured or r["parked"] <= 0:
@@ -566,6 +638,17 @@ class Autopilot:
             decisions = list(self.decisions)
             sensors = dict(self._last_sensors)
             ticks, acts = self.ticks, self.actuations
+        scale_loop: dict[str, Any] = {
+            "enabled": self.loops["scale"],
+            "up_duty": self.scale_up_duty,
+            "down_duty": self.scale_down_duty,
+            "families": sensors.get("scale", {}),
+        }
+        if self.predict:
+            # Predictive keys only when armed — the unconfigured body
+            # stays byte-identical.
+            scale_loop["predict"] = True
+            scale_loop["horizon_s"] = self.horizon_s
         return {
             "enabled": True,
             "running": self.running,
@@ -577,12 +660,7 @@ class Autopilot:
             "actuations": acts,
             "chips": sensors.get("chips", {"capacity": self.chip_capacity}),
             "loops": {
-                "scale": {
-                    "enabled": self.loops["scale"],
-                    "up_duty": self.scale_up_duty,
-                    "down_duty": self.scale_down_duty,
-                    "families": sensors.get("scale", {}),
-                },
+                "scale": scale_loop,
                 "brownout": {
                     "enabled": self.loops["brownout"],
                     "rung": self._rung,
@@ -688,9 +766,10 @@ def maybe_start_autopilot() -> Autopilot | None:
         _boot_logged = True
         logger.info(
             "autopilot ON (tick=%.1fs cooldown=%.0fs sense=%.0fs "
-            "rate<=%d/min; loops: %s)",
+            "rate<=%d/min; loops: %s%s)",
             ap.tick_s, ap.cooldown_s, ap.sense_s, ap.rate_per_min,
             ",".join(k for k, v in ap.loops.items() if v) or "none",
+            f"; predictive horizon={ap.horizon_s:.0f}s" if ap.predict else "",
         )
     return ap
 

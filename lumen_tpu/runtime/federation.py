@@ -84,10 +84,13 @@ DISCOVER_ENV = "LUMEN_FED_DISCOVER"
 from ..serving.router import (  # noqa: E402,F401
     FED_CACHE_MAX_WAIT_S,
     FED_CACHE_TASK,
+    FED_CAPACITY_ENV,
+    FED_CAPACITY_META,
     FED_KV_PUT_TASK,
     FED_ROLE_META,
     ROLE_ENV,
     advertised_fed_role,
+    capacity_gossip_enabled,
 )
 
 #: per-peer virtual nodes on the ring — enough that 3 peers split the
@@ -204,6 +207,40 @@ def fed_kv_lanes() -> int:
     return env_int("LUMEN_FED_KV_LANES", 4, minimum=1)
 
 
+def fed_capacity_hyst() -> float:
+    """``LUMEN_FED_CAPACITY_HYST``: minimum per-peer weight delta before
+    a capacity report may rebuild the ring (default 0.1) — sub-threshold
+    duty jitter must not move arcs at all."""
+    return env_float("LUMEN_FED_CAPACITY_HYST", 0.1, minimum=0.0, maximum=1.0)
+
+
+def fed_capacity_remap_s() -> float:
+    """``LUMEN_FED_CAPACITY_REMAP_S``: minimum seconds between two
+    capacity-driven ring rebuilds (default 10) — the remap-rate cap that
+    keeps a noisy fleet from thrashing arc ownership. A drain flip
+    bypasses it: handing off a planned drain is exactly the case where
+    waiting means discovering it by error."""
+    return env_float("LUMEN_FED_CAPACITY_REMAP_S", 10.0, minimum=0.0)
+
+
+def fed_capacity_stale_polls() -> int:
+    """``LUMEN_FED_CAPACITY_STALE_POLLS``: consecutive polls without a
+    capacity report before a peer's last report decays to neutral weight
+    (default 3) — a silent sidecar must not keep its last headroom claim
+    forever."""
+    return env_int("LUMEN_FED_CAPACITY_STALE_POLLS", 3, minimum=1)
+
+
+#: weight floor for a loaded-but-alive peer: ~3 vnodes of 64, so a fully
+#: busy host sheds most arcs yet stays reachable. Only a DRAINING peer
+#: goes to exactly 0 (no arcs at all).
+MIN_CAPACITY_WEIGHT = 0.05
+
+#: hot result-cache keys a draining peer advertises (and the front
+#: prefetches onto ring successors) per drain handoff.
+FED_HANDOFF_KEYS = 8
+
+
 # ---------------------------------------------------------------------------
 # Consistent-hash ring
 # ---------------------------------------------------------------------------
@@ -218,14 +255,33 @@ class HashRing:
     across processes and insertion orders by construction — the front
     tier and every peer build the SAME ring from the same peer list, so
     ownership agrees fleet-wide with zero coordination.
+
+    ``weights`` (capacity gossip) scale a peer's vnode COUNT: weight
+    ``w`` keeps ``round(vnodes * w)`` of its points, clamped to
+    ``[0, vnodes]``; an omitted name keeps all of them. Because a peer's
+    vnodes are the prefix ``name#0..#(k-1)``, changing one peer's weight
+    only adds/removes that peer's own points — the minimal-remap
+    property survives weighting (property-tested). Weight 0 removes the
+    peer from the ring entirely (a draining host owns no arcs).
     """
 
-    def __init__(self, names: list[str], vnodes: int = VNODES):
+    def __init__(
+        self,
+        names: list[str],
+        vnodes: int = VNODES,
+        weights: dict[str, float] | None = None,
+    ):
         self.names = sorted(set(names))
         self.vnodes = vnodes
+        self.weights = dict(weights) if weights else {}
         points: list[tuple[int, str]] = []
         for name in self.names:
-            for i in range(vnodes):
+            w = self.weights.get(name)
+            count = (
+                vnodes if w is None
+                else max(0, min(vnodes, round(vnodes * w)))
+            )
+            for i in range(count):
                 digest = hashlib.sha256(f"{name}#{i}".encode()).digest()
                 points.append((int.from_bytes(digest[:8], "big"), name))
         points.sort()
@@ -342,6 +398,14 @@ class Peer:
         # Disaggregation lane, learned passively from the peer's Health
         # trailing metadata; "both" until (unless) the peer advertises.
         self.role = ROLE_BOTH
+        # Capacity gossip (duty / burn_5m / draining / hot keys), learned
+        # the same way; {} until the peer reports, and decayed back to {}
+        # (= neutral weight) after LUMEN_FED_CAPACITY_STALE_POLLS silent
+        # polls.
+        self.capacity: dict = {}
+        self.weight = 1.0
+        self.missed_capacity = 0
+        self._stale_warned = False
         # Incremented lock-free from handler threads: int += is fine for
         # telemetry (same convention as ResultCache.stats) — health
         # decisions never read these, only streak/state, which ARE
@@ -455,22 +519,35 @@ class FederationManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        shares = self.ring.shares()
+        # Capacity-weighted ring state. Latched at build like the other
+        # knobs: with the gossip knob unset nothing below ever runs and
+        # the ring stays the equal-weight one built above.
+        self._capacity_on = capacity_gossip_enabled()
+        self.capacity_hyst = fed_capacity_hyst()
+        self.capacity_remap_s = fed_capacity_remap_s()
+        self.capacity_stale_polls = fed_capacity_stale_polls()
+        self._last_remap = -float("inf")
+        # Shares are cached per ring BUILD (weight changes rebuild), so
+        # the gauges below read live ownership, not the boot snapshot.
+        self._shares = self.ring.shares()
         ref = weakref.ref(self)
         for name, peer in self.peers.items():
-            share = shares.get(name, 0.0)
 
-            def _gauges(p=peer, share=share) -> dict:
+            def _gauges(p=peer, name=name) -> dict:
                 m = ref()
                 if m is None:
                     return {}
-                return {
+                out = {
                     **p.stats,
                     "state": _STATE_CODES[p.state],
                     "streak": p.streak,
-                    "ring_share": round(share, 4),
+                    "ring_share": round(m._shares.get(name, 0.0), 4),
                     "fed_role": _ROLE_CODES.get(p.role, 0),
                 }
+                if m._capacity_on:
+                    out["weight"] = round(p.weight, 4)
+                    out["draining"] = 1 if p.capacity.get("draining") else 0
+                return out
 
             peer._gauge_fn = _gauges
             metrics.register_gauges(f"federation:{name}", _gauges)
@@ -874,6 +951,7 @@ class FederationManager:
                     continue  # still inside the eject window: no probe yet
                 self._probe(peer, ejected)
             self._check_role_coverage()
+            self._maybe_reweight()
 
     def _probe(self, peer: Peer, ejected: bool) -> None:
         try:
@@ -886,10 +964,13 @@ class FederationManager:
                 call = None
             except Exception as e:  # noqa: BLE001 - probe failure is the signal
                 self.record_failure(peer, f"health probe: {type(e).__name__}: {e}")
+                self._note_capacity(peer, None)
                 return
         except Exception as e:  # noqa: BLE001 - probe failure is the signal
             self.record_failure(peer, f"health probe: {type(e).__name__}: {e}")
+            self._note_capacity(peer, None)
             return
+        cap_seen = None
         if call is not None:
             try:
                 # SLO burn + service status ride Health trailing metadata;
@@ -904,11 +985,14 @@ class FederationManager:
                         role = str(item.value)
                         if role in _ROLE_CODES:
                             role_seen = role
+                    elif item.key == FED_CAPACITY_META:
+                        cap_seen = json.loads(item.value)
                 # No trailer = the default lane: a peer restarted WITHOUT
                 # the knob must shed its stale role, not keep it forever.
                 peer.role = role_seen or ROLE_BOTH
             except Exception:  # noqa: BLE001 - telemetry, never a verdict
                 pass
+        self._note_capacity(peer, cap_seen)
         with self._lock:
             peer.streak = 0
             peer.last_ok = time.monotonic()
@@ -917,6 +1001,197 @@ class FederationManager:
                 peer.state = SERVING
         if readmitted:
             self._announce_readmit(peer, "health probe succeeded")
+
+    # -- capacity gossip -> weighted ring + drain handoff ------------------
+
+    def _note_capacity(self, peer: Peer, cap: dict | None) -> None:
+        """Fold one poll's capacity report (or its absence) into the
+        peer's state. Does nothing unless this host enables the gossip
+        (``LUMEN_FED_CAPACITY=1``) — the unconfigured path keeps the
+        boot-time equal-weight ring untouched."""
+        if not self._capacity_on:
+            return
+        if not isinstance(cap, dict):
+            peer.missed_capacity += 1
+            if (
+                peer.capacity
+                and peer.missed_capacity >= self.capacity_stale_polls
+            ):
+                peer.capacity = {}
+                metrics.count("fed_gossip_stale")
+                if not peer._stale_warned:
+                    peer._stale_warned = True
+                    logger.warning(
+                        "federation peer %s stopped reporting capacity "
+                        "(%d silent poll(s)); last report discarded, "
+                        "weight decays to neutral",
+                        peer.name, peer.missed_capacity,
+                    )
+                self._maybe_reweight()
+            return
+        peer.missed_capacity = 0
+        peer._stale_warned = False
+        was_draining = bool(peer.capacity.get("draining"))
+        peer.capacity = cap
+        if bool(cap.get("draining")) and not was_draining:
+            # A planned drain must never be discovered by failover: zero
+            # the weight NOW (bypassing the remap-rate cap) and prefetch
+            # the drained arcs' hottest cache entries onto successors.
+            self._maybe_reweight(force=True)
+            self._drain_handoff(peer)
+
+    def _desired_weight(self, peer: Peer) -> float:
+        """Gossip report -> ring weight: headroom (``1 - duty``), halved
+        while the peer's error budget burns faster than sustainable,
+        floored at :data:`MIN_CAPACITY_WEIGHT` so a busy-but-alive host
+        keeps a sliver of the ring. Draining = exactly 0 (no arcs);
+        no/stale report = neutral 1.0."""
+        cap = peer.capacity
+        if not cap:
+            return 1.0
+        if cap.get("draining"):
+            return 0.0
+        duty = cap.get("duty")
+        try:
+            w = 1.0 if duty is None else 1.0 - min(1.0, max(0.0, float(duty)))
+        except (TypeError, ValueError):
+            w = 1.0
+        try:
+            if float(cap.get("burn_5m") or 0.0) > 1.0:
+                w *= 0.5
+        except (TypeError, ValueError):
+            pass
+        return max(MIN_CAPACITY_WEIGHT, w)
+
+    def _maybe_reweight(self, force: bool = False) -> bool:
+        """Rebuild the ring from gossiped capacity — only when some
+        weight moved past the hysteresis band, and at most once per
+        ``LUMEN_FED_CAPACITY_REMAP_S`` (``force``, used by drain flips,
+        bypasses both). Returns True when the ring was rebuilt."""
+        if not self._capacity_on:
+            return False
+        desired = {n: self._desired_weight(p) for n, p in self.peers.items()}
+        now = time.monotonic()
+        with self._lock:
+            current = self.ring.weights
+            moved = any(
+                abs(w - current.get(n, 1.0)) > self.capacity_hyst
+                for n, w in desired.items()
+            )
+            if not moved and not force:
+                return False
+            if not force and now - self._last_remap < self.capacity_remap_s:
+                return False
+            weights = desired
+            if all(w <= 0.0 for w in desired.values()):
+                # Every peer drained at once: an empty ring refuses all
+                # traffic, which is strictly worse — keep the equal-weight
+                # ring and let per-request drain sheds steer instead.
+                weights = {}
+            self.ring = HashRing(list(self.peers), weights=weights)
+            self._shares = self.ring.shares()
+            self._last_remap = now
+            for n, p in self.peers.items():
+                p.weight = desired.get(n, 1.0)
+        metrics.count("fed_ring_remaps")
+        logger.info(
+            "federation ring re-weighted from capacity gossip: %s",
+            {n: round(w, 2) for n, w in sorted(desired.items())},
+        )
+        return True
+
+    def _drain_handoff(self, peer: Peer) -> None:
+        """Kick the hot-cache prefetch for a peer that just flipped its
+        gossiped ``draining`` flag: its advertised hottest result-cache
+        keys are fetched over the fed_cache_lookup peer-export path and
+        pushed onto their new ring owners, so the handed-off arcs arrive
+        warm. Runs on a short-lived daemon thread — the poll loop never
+        blocks on N cross-host copies."""
+        keys = [
+            k for k in (peer.capacity.get("hot") or [])
+            if isinstance(k, str)
+        ][:FED_HANDOFF_KEYS]
+        metrics.count("fed_drain_handoffs")
+        telemetry.record_event(
+            "fed_drain_handoff", peer.name,
+            f"draining peer re-weighted to zero; prefetching {len(keys)} "
+            "hot cache key(s) onto ring successors",
+            keys=len(keys),
+        )
+        if keys:
+            threading.Thread(
+                target=self._drain_handoff_run, args=(peer, keys),
+                name="fed-drain-handoff", daemon=True,
+            ).start()
+
+    def _drain_handoff_run(self, peer: Peer, keys: list[str]) -> None:
+        moved = 0
+        for key in keys:
+            digest = key.rpartition(":")[2]
+            target = None
+            for name in self.ring.owners(digest, 2, skip=self._ejected_names()):
+                if name not in (peer.name, self.self_name):
+                    target = self.peers.get(name)
+                    break
+            if target is None:
+                continue
+            blob = self._fetch_blob(peer, key)
+            if blob is not None and self._push_blob(target, key, blob):
+                moved += 1
+        if moved:
+            metrics.count("fed_drain_prefetch", moved)
+            logger.info(
+                "drain handoff from %s: %d/%d hot cache blob(s) "
+                "prefetched onto ring successors",
+                peer.name, moved, len(keys),
+            )
+
+    def _fetch_blob(self, owner: Peer, key: str) -> bytes | None:
+        """One raw (un-unpickled) cache export from ``owner`` — the
+        drain-handoff fetch leg; the blob is relayed verbatim."""
+        from ..serving.proto import ml_service_pb2 as pb
+
+        try:
+            req = pb.InferRequest(
+                correlation_id="fedcache-handoff",
+                task=FED_CACHE_TASK,
+                payload=key.encode("utf-8"),
+                meta={"wait_ms": "0"},
+            )
+            resps = list(owner.stub.Infer(iter([req]), timeout=self.lookup_timeout_s))
+        except Exception as e:  # noqa: BLE001 - a failed fetch skips the key
+            self.record_unreachable(owner, e, "drain handoff fetch")
+            return None
+        last = resps[-1] if resps else None
+        if (
+            last is None
+            or last.HasField("error")
+            or last.meta.get("fed_cache") != "hit"
+        ):
+            return None
+        return b"".join(r.result for r in resps)
+
+    def _push_blob(self, target: Peer, key: str, blob: bytes) -> bool:
+        """Drain-handoff store leg: push one exported blob to its new
+        ring owner (the ``op=put`` extension of the fed_cache task)."""
+        from ..serving.proto import ml_service_pb2 as pb
+
+        try:
+            resps = list(target.stub.Infer(iter([pb.InferRequest(
+                correlation_id="fedcache-put",
+                task=FED_CACHE_TASK,
+                payload=blob,
+                meta={"op": "put", "key": key},
+            )]), timeout=self.lookup_timeout_s))
+        except Exception as e:  # noqa: BLE001 - a failed push skips the key
+            self.record_unreachable(target, e, "drain handoff put")
+            return False
+        last = resps[-1] if resps else None
+        return bool(
+            last is not None
+            and not last.HasField("error")
+            and last.meta.get("fed_cache") == "stored"
+        )
 
     # -- peer cache lookup (the ResultCache pre-compute hook) --------------
 
@@ -1006,11 +1281,11 @@ class FederationManager:
     def export_status(self) -> dict:
         """Full per-peer view for ``GET /peers`` and the client ``peers``
         subcommand."""
-        shares = self.ring.shares()
         now = time.monotonic()
         peers: dict[str, dict] = {}
         hits = misses = 0
         with self._lock:
+            shares = dict(self._shares)
             for name, p in sorted(self.peers.items()):
                 hits += p.stats["cache_hits"]
                 misses += p.stats["cache_misses"]
@@ -1027,7 +1302,17 @@ class FederationManager:
                     "last_error": p.last_error or None,
                     "slo": p.slo or None,
                 }
-        return {
+                if self._capacity_on:
+                    # Gossiped capacity columns (the `client peers` view):
+                    # absent entirely when the gossip is off, so the
+                    # unconfigured payload is unchanged.
+                    peers[name].update({
+                        "weight": round(p.weight, 4),
+                        "duty": p.capacity.get("duty"),
+                        "burn_5m": p.capacity.get("burn_5m"),
+                        "draining": bool(p.capacity.get("draining")),
+                    })
+        out = {
             "enabled": True,
             "mode": "peer" if self.self_name else "front",
             "self": self.self_name,
@@ -1039,6 +1324,9 @@ class FederationManager:
             if hits + misses
             else 0.0,
         }
+        if self._capacity_on:
+            out["capacity_gossip"] = True
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -408,6 +408,17 @@ class ResultCache:
         if blob is not None:
             self._disk_write(key, blob, fence=fence)
 
+    def hot_keys(self, n: int = 8) -> list[str]:
+        """The ``n`` most-recently-used RAM-tier keys, hottest first — the
+        drain-handoff manifest a draining host gossips so the front can
+        prefetch exactly these onto ring successors. ``_entries`` is kept
+        in LRU order (MRU at the end), so the reversal is the recency
+        ranking; no touch, no promotion — reading the manifest must not
+        reorder the cache it describes."""
+        with self._lock:
+            keys = list(self._entries)
+        return keys[::-1][:n]
+
     def _approx_nbytes(self, value: Any, _depth: int = 0) -> int | None:
         """Structural RAM weight for common result shapes (arrays, bytes,
         records, dataclasses); odd types fall back to one pickle."""
@@ -897,6 +908,40 @@ def peer_export(key: str, wait_s: float = 0.0) -> bytes | None:
     if blob is not None:
         metrics.count("fed_cache_serves")
     return blob
+
+
+def hot_keys(n: int = 8) -> list[str]:
+    """Module-level hot-key manifest for the capacity gossip: the shared
+    cache's MRU keys WITHOUT instantiating a cache that was never used
+    (same posture as :func:`peer_export` — a process that never cached
+    has nothing hot)."""
+    with _shared_lock:
+        cache = _shared
+    if cache is None or not cache.enabled:
+        return []
+    return cache.hot_keys(n)
+
+
+def peer_import(key: str, blob: bytes) -> bool:
+    """Store a pickle blob pushed by the federation drain handoff (the
+    write half of the peer-cache protocol; :func:`peer_export` is the
+    read half). Unlike the export this DOES build the shared cache on
+    first use — the push targets a ring successor that is about to
+    inherit the drained host's arcs, and an empty cache is exactly the
+    state the handoff exists to fix. Returns True when stored."""
+    if not key or not blob:
+        return False
+    cache = get_result_cache()
+    if not cache.enabled:
+        return False
+    try:
+        value = pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 - a bad peer blob is a no-op, not a crash
+        logger.warning("federation cache import failed for %r: %s", key, e)
+        return False
+    cache.put(key, value)
+    metrics.count("fed_cache_imports")
+    return True
 
 
 def detach_peer_lookup(hook) -> None:

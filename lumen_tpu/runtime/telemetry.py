@@ -23,10 +23,12 @@ from ..utils.telemetry import (  # noqa: F401 - re-exported runtime surface
     capacity_stats,
     count,
     count_error,
+    device_duty,
     duty_fraction,
     enabled,
     export_events,
     export_incidents,
+    forecast_rate,
     get_hub,
     install_hub,
     observe,
@@ -46,10 +48,12 @@ __all__ = [
     "capacity_stats",
     "count",
     "count_error",
+    "device_duty",
     "duty_fraction",
     "enabled",
     "export_events",
     "export_incidents",
+    "forecast_rate",
     "get_hub",
     "install_hub",
     "observe",

@@ -43,6 +43,7 @@ import logging
 import os
 import sys
 import threading
+import time
 from typing import Iterable, Iterator
 
 import grpc
@@ -100,7 +101,27 @@ FED_ROLE_META = "lumen-fed-role"
 
 FED_ROLES = ("prefill", "decode", "both")
 
+#: env knob opting a fleet into capacity gossip: when "1", each host's
+#: Health trailing metadata carries a compact capacity report (duty
+#: fraction, worst SLO burn, drain flag) and the federation front scales
+#: ring weights from it. Unset keeps the Health payload — and the ring —
+#: byte-identical to pre-capacity builds.
+FED_CAPACITY_ENV = "LUMEN_FED_CAPACITY"
+
+#: gRPC metadata key the capacity report rides on Health TRAILING
+#: metadata — same passive channel as :data:`FED_ROLE_META`: peers learn
+#: each other's headroom from the probe they already run, no new RPC.
+FED_CAPACITY_META = "lumen-fed-capacity"
+
 _ROLE_WARNED = False
+
+
+def capacity_gossip_enabled() -> bool:
+    """Whether this process participates in capacity gossip (report on
+    the server side, weighted ring + drain handoff on the front). Read
+    fresh on each call — it gates per-probe work, not a latched
+    structure."""
+    return os.environ.get(FED_CAPACITY_ENV, "") == "1"
 
 
 def advertised_fed_role() -> str | None:
@@ -170,6 +191,13 @@ class HubRouter(InferenceServicer):
         self._draining = False
         self._drain_retry_ms = "1000"
         self._active_streams = 0
+        # Capacity-gossip observation timestamps (monotonic; 0.0 = never):
+        # when a Health probe last carried our capacity report, and when
+        # one carried it with the draining flag SET. The drain sequencer
+        # reads these to hold teardown until a watching front has actually
+        # seen the flag — without a watcher, shutdown is unchanged.
+        self._capacity_probe_t = 0.0
+        self._drain_announced_t = 0.0
         self._rebuild_routes()
 
     def begin_drain(self, retry_after_s: float = 1.0) -> None:
@@ -189,6 +217,20 @@ class HubRouter(InferenceServicer):
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def capacity_probe_age(self) -> float | None:
+        """Seconds since a Health probe last carried this host's capacity
+        report (None = never, i.e. gossip off or nobody watching)."""
+        if self._capacity_probe_t <= 0.0:
+            return None
+        return max(0.0, time.monotonic() - self._capacity_probe_t)
+
+    def drain_announced(self) -> bool:
+        """Whether a capacity report with the draining flag SET has been
+        served since :meth:`begin_drain` — i.e. a watching front has had
+        the chance to re-weight us to zero and start the hot-key handoff
+        instead of discovering the shutdown through failover."""
+        return self._drain_announced_t > 0.0
 
     def active_streams(self) -> int:
         """Forwarded Infer streams currently executing — the drain's
@@ -312,9 +354,31 @@ class HubRouter(InferenceServicer):
         single-flight) for the requested key. Reads the cache module via
         ``sys.modules`` — a process that never loaded the runtime package
         (jax-free echo deployments, the front tier itself) answers miss
-        without importing anything."""
-        blob = None
+        without importing anything.
+
+        A ``meta["op"] == "put"`` request is the drain-handoff WRITE half
+        (the front pushing a draining peer's hot entry onto a ring
+        successor): the payload is the pickle blob, ``meta["key"]`` the
+        cache key. Gated on the same capacity-gossip knob that produces
+        the pushes — a host outside the gossip ignores stray writes."""
         mod = sys.modules.get("lumen_tpu.runtime.result_cache")
+        if first.meta.get("op") == "put":
+            stored = False
+            if mod is not None and capacity_gossip_enabled():
+                try:
+                    stored = bool(
+                        mod.peer_import(
+                            first.meta.get("key", ""), bytes(first.payload)
+                        )
+                    )
+                except Exception:  # noqa: BLE001 - a bad blob must never 500 the peer
+                    logger.exception("federation cache import failed")
+            return pb.InferResponse(
+                correlation_id=first.correlation_id,
+                is_final=True,
+                meta={"fed_cache": "stored" if stored else "ignored"},
+            )
+        blob = None
         if mod is not None:
             try:
                 wait_ms = int(first.meta.get("wait_ms", "0") or "0")
@@ -563,6 +627,48 @@ class HubRouter(InferenceServicer):
         except Exception:  # noqa: BLE001 - health must never fail on telemetry
             return {}
 
+    def _capacity_status(self) -> dict:
+        """Compact capacity report for the ``lumen-fed-capacity``
+        trailing-metadata key: duty fraction (busiest device meter over
+        the last 30s), worst per-task 5m SLO burn, and the drain flag —
+        the three signals the front's weighted ring is built from. While
+        draining, the hottest result-cache keys ride along so successors
+        can prefetch them before failover would discover the drain.
+        ``{}`` (knob off, or nothing to report) omits the key entirely —
+        the unconfigured Health payload stays byte-identical."""
+        if not capacity_gossip_enabled():
+            return {}
+        from ..utils import telemetry
+
+        cap: dict = {"draining": 1 if self._draining else 0}
+        try:
+            duty = telemetry.device_duty(30.0)
+            if duty is not None:
+                cap["duty"] = round(duty, 4)
+            slo = telemetry.slo_status()
+            if slo:
+                burns = [
+                    s.get("burn_5m")
+                    for s in slo.values()
+                    if isinstance(s, dict) and s.get("burn_5m") is not None
+                ]
+                if burns:
+                    cap["burn_5m"] = round(max(burns), 3)
+        except Exception:  # noqa: BLE001 - health must never fail on telemetry
+            pass
+        if self._draining:
+            # Hot-key manifest for the drain handoff: the front fetches
+            # these via the ordinary peer-cache path and pushes them onto
+            # ring successors. sys.modules read — a jax-free front never
+            # imports the runtime package for this.
+            mod = sys.modules.get("lumen_tpu.runtime.result_cache")
+            if mod is not None:
+                try:
+                    cap["hot"] = mod.hot_keys(8)
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
+        return cap
+
     def _fed_status(self) -> dict:
         """Per-peer federation state for the ``lumen-fed-status``
         trailing-metadata key. ``{}`` (no fleet attached) omits the key —
@@ -640,7 +746,20 @@ class HubRouter(InferenceServicer):
                     # that replica / forced that rung" is answerable from
                     # a Health probe.
                     trailing.append(("lumen-autopilot-status", json.dumps(ap_state)))
+                cap = self._capacity_status()
+                if cap:
+                    # Capacity gossip: duty/burn/drain ride the probe the
+                    # federation poll thread already runs — the front
+                    # scales ring weights from this, no new RPC.
+                    trailing.append((FED_CAPACITY_META, json.dumps(cap)))
                 context.set_trailing_metadata(tuple(trailing))
+                if cap:
+                    # Stamp AFTER the metadata is attached: these feed the
+                    # drain sequencer's "has a watcher seen the flag yet"
+                    # hold, so they must mean served, not merely built.
+                    self._capacity_probe_t = time.monotonic()
+                    if cap.get("draining"):
+                        self._drain_announced_t = time.monotonic()
             except Exception:  # noqa: BLE001 - test stubs may lack metadata support
                 pass
         unhealthy = [n for n, s in statuses.items() if s == "unhealthy"]

@@ -58,6 +58,12 @@ GRPC_OPTIONS = [
 ]
 
 DRAIN_ENV = "LUMEN_DRAIN_S"
+DRAIN_ANNOUNCE_ENV = "LUMEN_DRAIN_ANNOUNCE_S"
+
+#: a capacity probe older than this means nobody is watching anymore (a
+#: front polls every LUMEN_FED_POLL_S, default 2s) — don't hold shutdown
+#: for a departed observer.
+_DRAIN_WATCHER_STALE_S = 15.0
 
 
 def grpc_workers() -> int:
@@ -79,6 +85,20 @@ def drain_budget_s() -> float:
     from ..utils.env import env_float
 
     return env_float(DRAIN_ENV, 10.0, minimum=0.0)
+
+
+def drain_announce_s() -> float:
+    """``LUMEN_DRAIN_ANNOUNCE_S``: max extra seconds an idle drain holds
+    the server open so capacity gossip can announce the draining flag to
+    a watching front (default 5; ``0`` disables the hold). Only applies
+    when a Health probe carried this host's capacity report recently —
+    a standalone or ungossiped server shuts down exactly as before. The
+    hold ends early the moment a probe is served with the flag set, plus
+    a short margin for the front's hot-key handoff fetches to arrive;
+    always capped by the remaining ``LUMEN_DRAIN_S`` budget."""
+    from ..utils.env import env_float
+
+    return env_float(DRAIN_ANNOUNCE_ENV, 5.0, minimum=0.0)
 
 
 def build_one_service(config: LumenConfig, name: str) -> BaseService:
@@ -231,6 +251,44 @@ class ServerHandle:
             f"drain started: refusing new RPCs, draining in-flight work "
             f"(budget {drain_s:.0f}s)",
         )
+        # Announce hold: a PLANNED shutdown must be gossiped, not
+        # discovered. If a front was recently reading our capacity report
+        # off Health probes, an idle drain would otherwise tear the
+        # listener down before the next poll — and the front would eject
+        # us via failover (fed_peer_down incident) instead of re-weighting
+        # to zero and prefetching hot keys. Hold (bounded) until a probe
+        # is served WITH the draining flag, then a short margin so the
+        # front's handoff fetches land while we still answer.
+        announce_s = drain_announce_s()
+        probe_age = (
+            getattr(self.router, "capacity_probe_age", lambda: None)()
+            if self.router is not None
+            else None
+        )
+        if (
+            announce_s > 0
+            and probe_age is not None
+            and probe_age <= _DRAIN_WATCHER_STALE_S
+        ):
+            announce_deadline = min(deadline, started + announce_s)
+            while (
+                not self.router.drain_announced()
+                and _time.monotonic() < announce_deadline
+            ):
+                _time.sleep(0.05)
+            if self.router.drain_announced():
+                logger.info(
+                    "drain: draining flag gossiped to a watching front "
+                    "(%.2fs after SIGTERM)", _time.monotonic() - started,
+                )
+                _time.sleep(
+                    min(1.0, max(deadline - _time.monotonic(), 0.0))
+                )
+            else:
+                logger.info(
+                    "drain: no probe observed the draining flag within "
+                    "%.1fs; proceeding", announce_s,
+                )
         # Hold the gRPC server OPEN while in-flight streams finish: once
         # server.stop() runs, new RPCs die at the transport with no
         # metadata — the in-band hint only exists during this window.

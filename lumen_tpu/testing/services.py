@@ -131,19 +131,38 @@ class FederationBenchService(BaseService):
 
     def _embed(self, payload: bytes, mime: str, meta: dict[str, str]):  # noqa: ARG002
         import hashlib
+        import os
         import time
 
         from ..runtime.result_cache import get_result_cache, make_namespace
+        from ..utils import telemetry as tele
         from ..utils.metrics import metrics
 
         device_ms = float(meta.get("device_ms", "20"))
+        # Per-HOST slowdown (a weak or co-tenanted box). Like device_ms it
+        # shapes the simulated compute only, so it stays out of the cache
+        # key — and being per-host it cannot ride request meta.
+        try:
+            device_ms *= float(os.environ.get("FEDBENCH_DEVICE_SCALE") or 1.0)
+        except ValueError:
+            pass
+        try:
+            pool = int(os.environ.get("LUMEN_GRPC_WORKERS") or 4)
+        except ValueError:
+            pool = 4
 
         def compute() -> dict:
             # The fleet-wide dedupe proof: this counter moving is the
             # ONLY evidence of "device" work, so summing it across hosts
             # counts exact computations per unique payload.
             metrics.count("fedbench_device_calls")
+            t0 = time.monotonic()
             time.sleep(device_ms / 1e3)
+            # Genuine busy-time accounting against the handler-pool
+            # capacity: the host's device_duty is what capacity gossip
+            # advertises, so a loaded bench host reports real duty.
+            tele.set_capacity("device:fedbench", max(1, pool))
+            tele.busy("device:fedbench", t0, time.monotonic())
             return {
                 "digest": hashlib.sha256(payload).hexdigest(),
                 "n_bytes": len(payload),

@@ -192,6 +192,16 @@ class RollingCounter:
                     out += self._vals[i]
         return out
 
+    def series(self, window_s: float, now: float) -> list[float]:
+        """Per-bucket totals over the last ``window_s`` seconds, oldest
+        first, COMPLETED buckets only — the current partial bucket would
+        bias a trend fit low. Buckets nothing landed in read 0.0."""
+        epoch = int(now / self.bucket_s)
+        n_back = min(self.slots - 1, max(2, int(window_s / self.bucket_s)))
+        with self._lock:
+            have = dict(zip(self._epochs, self._vals))
+        return [have.get(e, 0.0) for e in range(epoch - n_back, epoch)]
+
 
 class RollingHistogram:
     """Windowed latency quantiles: a ring of per-bucket count arrays
@@ -799,6 +809,49 @@ class TelemetryHub:
         ctr = self._counters.get(name)
         return 0.0 if ctr is None else ctr.total(window_s, self.clock())
 
+    def forecast_rate(
+        self, name: str, window_s: float, horizon_s: float
+    ) -> float | None:
+        """Short-horizon arrival-rate forecast for one rolling counter:
+        a least-squares line through the per-bucket rates of the last
+        ``window_s`` seconds, extrapolated ``horizon_s`` past the newest
+        complete bucket and floored at 0. ``None`` when the counter does
+        not exist (no sensor = no forecast — the autopilot falls back to
+        its reactive thresholds) or the window holds fewer than two
+        complete buckets."""
+        ctr = self._counters.get(name)
+        if ctr is None:
+            return None
+        series = ctr.series(window_s, self.clock())
+        if len(series) < 2:
+            return None
+        b = ctr.bucket_s
+        xs = [i * b for i in range(len(series))]
+        ys = [v / b for v in series]
+        n = len(xs)
+        mean_x = sum(xs) / n
+        mean_y = sum(ys) / n
+        var = sum((x - mean_x) ** 2 for x in xs)
+        if var <= 0:
+            return None
+        slope = sum(
+            (x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)
+        ) / var
+        return max(0.0, mean_y + slope * (xs[-1] + horizon_s - mean_x))
+
+    def device_duty(self, window_s: float) -> float | None:
+        """Worst ``device:*`` duty fraction over the window — the
+        host-level headroom signal the federation capacity gossip
+        advertises. ``None`` when no device meter exists yet."""
+        with self._lock:
+            meters = [
+                m for n, m in self._duties.items() if n.startswith("device:")
+            ]
+        if not meters:
+            return None
+        now = self.clock()
+        return max(m.window(window_s, now)["fraction"] for m in meters)
+
     # -- export ------------------------------------------------------------
 
     def window_stats(self, window_s: float) -> dict:
@@ -942,6 +995,29 @@ def window_total(name: str, window_s: float) -> float:
     if hub is None:
         return 0.0
     return hub.window_total(name, window_s)
+
+
+def forecast_rate(name: str, window_s: float, horizon_s: float) -> float | None:
+    """Trend-extrapolated arrival rate for one rolling counter
+    (``None`` = counter absent, too little history, or telemetry
+    disabled — the no-sensor/no-forecast rule)."""
+    if not telemetry_enabled():
+        return None
+    hub = _hub
+    if hub is None:
+        return None
+    return hub.forecast_rate(name, window_s, horizon_s)
+
+
+def device_duty(window_s: float) -> float | None:
+    """Worst device duty fraction across the host's ``device:*`` meters
+    (``None`` = no meter yet or telemetry disabled)."""
+    if not telemetry_enabled():
+        return None
+    hub = _hub
+    if hub is None:
+        return None
+    return hub.device_duty(window_s)
 
 
 def record_event(

@@ -1,11 +1,11 @@
 #!/usr/bin/env python
 """Run every doc-gate script in one command with a summary table.
 
-The four gates (`check_knobs`, `check_metrics`, `check_meta_keys`,
-`check_endpoints`) each police one operator-API surface against the docs;
-until this runner, each was only exercised by its own test and a local
-pre-push check meant four invocations. One command, one table, one exit
-code::
+The gates (`check_knobs`, `check_metrics`, `check_meta_keys`,
+`check_endpoints`, `check_events`) each police one operator-API surface
+against the docs; until this runner, each was only exercised by its own
+test and a local pre-push check meant one invocation per gate. One
+command, one table, one exit code::
 
     python scripts/check_all.py
 
@@ -26,7 +26,8 @@ SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 #: gate module names, run in this order (each must expose ``main() -> int``
 #: and print its own detail lines).
-GATES = ("check_knobs", "check_metrics", "check_meta_keys", "check_endpoints")
+GATES = ("check_knobs", "check_metrics", "check_meta_keys", "check_endpoints",
+         "check_events")
 
 
 def load_gate(name: str):

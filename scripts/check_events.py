@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Assert every flight-recorder event kind emitted by ``lumen_tpu/`` is
+documented in the event vocabulary table of ``docs/OBSERVABILITY.md``.
+
+Event kinds (``telemetry.record_event("kind", ...)``) are the operator's
+3am timeline — ``GET /events`` and incident bundles are read by humans
+under pressure, so a kind emitted in code but missing from the vocabulary
+table is a word the operator can't look up. Unlike ``check_metrics`` this
+gate scans one *section* of the doc, not the whole file: a kind that only
+shows up in the counter cookbook doesn't count as documented. Collected by
+pytest (``tests/test_check_events.py``) so tier-1 fails on the gap, and
+runs standalone::
+
+    python scripts/check_events.py
+
+Mechanics: regex scan for ``record_event("kind"`` literals (f-string kinds
+like ``autopilot_{loop}`` reduce to their prefix, matched against any
+documented kind that starts with it) plus the ``INCIDENT_KINDS`` tuple in
+``utils/telemetry.py`` — incident triggers must be documented even if a
+refactor ever routed their emission through a variable.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "OBSERVABILITY.md")
+
+#: event emissions — ``record_event(`` optionally prefixed by a module
+#: alias; ``\s*`` spans the newline of multi-line call sites.
+_EMIT_PATTERN = re.compile(r'record_event\(\s*f?"([^"]+)"')
+#: the incident-trigger allowlist in utils/telemetry.py.
+_INCIDENT_PATTERN = re.compile(r"INCIDENT_KINDS\s*=\s*\(([^)]*)\)")
+#: the doc section holding the vocabulary table.
+_SECTION_MARKER = "Event vocabulary"
+#: backticked kinds in a table row's first cell: ``| `a`, `b` | ... |``.
+_ROW_PATTERN = re.compile(r"^\|([^|]*)\|", re.MULTILINE)
+_KIND_PATTERN = re.compile(r"`([a-z_]+)`")
+
+
+def _prefix(name: str) -> str:
+    """Reduce an f-string kind to its literal prefix."""
+    return name.split("{", 1)[0]
+
+
+def emitted_kinds() -> set[str]:
+    found: set[str] = set()
+    for dirpath, _, filenames in os.walk(os.path.join(REPO_ROOT, "lumen_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8", errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for m in _EMIT_PATTERN.findall(text):
+                name = _prefix(m).strip()
+                if name:
+                    found.add(name)
+            for tup in _INCIDENT_PATTERN.findall(text):
+                found.update(_KIND_PATTERN.findall(tup.replace('"', "`")))
+    return found
+
+
+def documented_kinds() -> set[str]:
+    """Kinds named in the first cell of the event vocabulary table."""
+    if not os.path.exists(DOC_PATH):
+        return set()
+    with open(DOC_PATH, encoding="utf-8", errors="ignore") as f:
+        text = f.read()
+    idx = text.find(_SECTION_MARKER)
+    if idx < 0:
+        return set()
+    # The table ends at the first blank line after its rows begin.
+    section = text[idx:]
+    table_end = section.find("\n\n", section.find("\n|"))
+    if table_end > 0:
+        section = section[:table_end]
+    kinds: set[str] = set()
+    for cell in _ROW_PATTERN.findall(section):
+        kinds.update(_KIND_PATTERN.findall(cell))
+    return kinds
+
+
+def undocumented() -> list[str]:
+    doc = documented_kinds()
+    missing = []
+    for kind in emitted_kinds():
+        # Exact kinds must match exactly; f-string prefixes (trailing
+        # ``_``) match any documented kind sharing the prefix.
+        if kind in doc:
+            continue
+        if any(d.startswith(kind) for d in doc):
+            continue
+        missing.append(kind)
+    return sorted(missing)
+
+
+def main() -> int:
+    if not documented_kinds():
+        print("check_events: could not find the event vocabulary table in "
+              "docs/OBSERVABILITY.md")
+        return 1
+    missing = undocumented()
+    if missing:
+        print("event kinds emitted in code but missing from the "
+              "OBSERVABILITY.md event vocabulary table:")
+        for name in missing:
+            print(f"  {name}")
+        return 1
+    print(f"ok: {len(emitted_kinds())} emitted event kinds all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -583,3 +583,96 @@ class TestClientAutopilot:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+# -- predictive scaling (LUMEN_AUTOPILOT_PREDICT) -----------------------------
+
+
+def feed_arrivals(hub, clock, name: str, per_bucket: list[float]):
+    """One arrival burst per telemetry bucket, stepping the clock so every
+    fed bucket completes (the trend fit reads completed buckets only)."""
+    for n in per_bucket:
+        if n:
+            hub.count(name, n)
+        clock.advance(hub.bucket_s)
+
+
+class TestPredictiveScale:
+    def test_rising_forecast_blocks_park(self, hub, clock):
+        """Low measured duty would park reactively — but arrivals are
+        climbing, so the projected duty holds the chips."""
+        a = FakeFleet("fam-a", active=2)
+        ap = make_ap(clock, fleets=lambda: [a], predict=True, horizon_s=60.0)
+        feed_arrivals(hub, clock, "batch_items:fam-a-r0", [5, 10, 15, 20, 25, 30])
+        busy_for(hub, clock, "device:fam-a-r0", 0.1)
+        busy_for(hub, clock, "device:fam-a-r1", 0.1)
+        ap.tick()
+        assert a.parks == []
+        r = ap._last_sensors["scale"]["fam-a"]
+        assert r["projected_duty"] is not None
+        assert r["projected_duty"] > r["duty"]
+        assert r["forecast_rps"] > r["rate_rps"]
+
+    def test_reactive_twin_parks_on_the_same_sensors(self, hub, clock):
+        """The control: identical load, predict OFF — the park happens.
+        Together with the test above this isolates the forecast as the
+        only difference."""
+        a = FakeFleet("fam-a", active=2)
+        ap = make_ap(clock, fleets=lambda: [a])
+        feed_arrivals(hub, clock, "batch_items:fam-a-r0", [5, 10, 15, 20, 25, 30])
+        busy_for(hub, clock, "device:fam-a-r0", 0.1)
+        busy_for(hub, clock, "device:fam-a-r1", 0.1)
+        ap.tick()
+        assert a.parks == [1]
+        # And the unconfigured readings carry none of the predictive keys.
+        r = ap._last_sensors["scale"]["fam-a"]
+        assert "projected_duty" not in r
+        assert "rate_rps" not in r and "forecast_rps" not in r
+        assert "predict" not in ap.status()["loops"]["scale"]
+
+    def test_rising_forecast_trips_unpark_early(self, hub, clock):
+        """Moderate duty (under the 0.75 reactive gate) + a steep arrival
+        ramp: the projection crosses the gate and the family claims the
+        chip an idle sibling frees in the SAME tick."""
+        a = FakeFleet("fam-a", active=2)
+        b = FakeFleet("fam-b", active=1, parked=1)
+        ap = make_ap(clock, fleets=lambda: [a, b], predict=True, horizon_s=60.0)
+        feed_arrivals(hub, clock, "batch_items:fam-b-r0", [5, 15, 30, 50, 75, 105])
+        busy_for(hub, clock, "device:fam-a-r0", 0.0)
+        busy_for(hub, clock, "device:fam-a-r1", 0.0)
+        busy_for(hub, clock, "device:fam-b-r0", 0.3)
+        ap.tick()
+        assert a.parks, "idle family must release the chip"
+        assert b.unparks, "projected pressure must claim it"
+        r = ap._last_sensors["scale"]["fam-b"]
+        assert r["projected_duty"] > ap.scale_up_duty >= r["duty"]
+
+    def test_falling_forecast_never_releases_needed_capacity(self, hub, clock):
+        """Scale-down stays reactive: current duty above the park gate
+        keeps the chips no matter how hard the forecast falls — a wrong
+        forecast can cost margin only upward."""
+        a = FakeFleet("fam-a", active=2)
+        ap = make_ap(clock, cooldown_s=0.0, fleets=lambda: [a], predict=True,
+                     horizon_s=600.0)
+        feed_arrivals(hub, clock, "batch_items:fam-a-r0", [105, 75, 50, 30, 15, 5])
+        busy_for(hub, clock, "device:fam-a-r0", 0.5)
+        busy_for(hub, clock, "device:fam-a-r1", 0.5)
+        for _ in range(3):
+            ap.tick()
+            clock.advance(ap.tick_s)
+        assert a.parks == []
+
+    def test_no_arrival_sensor_falls_back_reactive(self, hub, clock):
+        """predict armed but no batch_items counter: no forecast, and the
+        loop behaves exactly like the reactive controller."""
+        a = FakeFleet("fam-a", active=2)
+        ap = make_ap(clock, fleets=lambda: [a], predict=True)
+        busy_for(hub, clock, "device:fam-a-r0", 0.05)
+        busy_for(hub, clock, "device:fam-a-r1", 0.05)
+        ap.tick()
+        assert a.parks == [1]
+        r = ap._last_sensors["scale"]["fam-a"]
+        assert r["forecast_rps"] is None and r["projected_duty"] is None
+        # status() advertises the armed horizon.
+        loop = ap.status()["loops"]["scale"]
+        assert loop["predict"] is True and loop["horizon_s"] == 60.0

@@ -1,5 +1,5 @@
 """Tier-1 gate: the aggregate doc-gate runner (scripts/check_all.py) runs
-all four surface checks and fails when ANY of them does — one command is
+all five surface checks and fails when ANY of them does — one command is
 the whole pre-push story."""
 
 import importlib.util
@@ -23,14 +23,15 @@ def test_every_gate_passes():
     )
 
 
-def test_covers_all_four_gates():
+def test_covers_all_known_gates():
     # The aggregate must not silently drop a gate: the registry names all
-    # four known scanners, and each produced SOME output when run.
+    # five known scanners, and each produced SOME output when run.
     assert set(check_all.GATES) == {
-        "check_knobs", "check_metrics", "check_meta_keys", "check_endpoints"
+        "check_knobs", "check_metrics", "check_meta_keys", "check_endpoints",
+        "check_events",
     }
     _, results = check_all.run_all()
-    assert len(results) == 4
+    assert len(results) == 5
     for name, _rc, out in results:
         assert out.strip(), f"gate {name} produced no output"
 

@@ -1,0 +1,47 @@
+"""Tier-1 gate: every flight-recorder event kind emitted in the package
+appears in the docs/OBSERVABILITY.md event vocabulary table, so the
+operator timeline vocabulary can't silently drift. See
+scripts/check_events.py."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_events",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_events.py"),
+)
+check_events = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_events)
+
+
+def test_every_emitted_event_kind_is_documented():
+    missing = check_events.undocumented()
+    assert not missing, (
+        f"event kinds emitted in code but missing from the OBSERVABILITY.md "
+        f"event vocabulary table: {missing} — add a row for each"
+    )
+
+
+def test_scan_finds_known_kinds():
+    # Sanity that the scan sees through each pattern family — a regex typo
+    # must not turn the gate into a silent pass.
+    kinds = check_events.emitted_kinds()
+    assert "shed" in kinds                  # single-line literal
+    assert "slo_breach" in kinds            # multi-line call site
+    assert "autopilot_" in kinds            # f-string kind reduced to prefix
+    assert "fed_peer_down" in kinds         # INCIDENT_KINDS tuple member
+    assert "fed_drain_handoff" in kinds     # capacity-gossip drain event
+
+
+def test_doc_table_is_parsed():
+    # The vocabulary table itself must be locatable — a doc refactor that
+    # renames the section heading should fail loudly, not pass vacuously.
+    doc = check_events.documented_kinds()
+    assert "watchdog" in doc
+    assert "autopilot_scale" in doc
+    assert "fed_drain_handoff" in doc
+
+
+def test_gate_main_is_green():
+    assert check_events.main() == 0

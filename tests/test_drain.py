@@ -219,3 +219,80 @@ class TestSigtermEndToEnd:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=10)
+
+
+@pytest.mark.integration
+class TestDrainAnnounceHold:
+    """A PLANNED shutdown must be gossiped, not discovered: when a front
+    has been reading this host's capacity report off Health probes, an
+    idle drain holds the listener open until one probe is served with
+    the draining flag set (``LUMEN_DRAIN_ANNOUNCE_S``) — otherwise the
+    front's next poll hits a closed socket and failover ejects the peer
+    as a ``fed_peer_down`` incident, the exact noise the drain handoff
+    exists to remove."""
+
+    def test_idle_drain_holds_for_watching_front(self, tmp_path, monkeypatch):
+        from google.protobuf import empty_pb2
+
+        from lumen_tpu.serving.router import FED_CAPACITY_META
+        from lumen_tpu.serving.server import serve
+
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        handle = serve(
+            validate_config_dict(drain_config_dict(tmp_path)), skip_download=True
+        )
+        chan = None
+        try:
+            chan = grpc.insecure_channel(f"127.0.0.1:{handle.port}")
+            grpc.channel_ready_future(chan).result(timeout=10)
+            stub = InferenceStub(chan)
+
+            def probe() -> dict:
+                _, call = stub.Health.with_call(empty_pb2.Empty())
+                md = {k: v for k, v in call.trailing_metadata()}
+                return json.loads(md[FED_CAPACITY_META])
+
+            # The "front": one capacity-carrying probe marks us watched.
+            assert probe()["draining"] == 0
+            assert handle.router.capacity_probe_age() is not None
+
+            done = threading.Event()
+            t0 = time.monotonic()
+            t = threading.Thread(
+                target=lambda: (handle.drain_and_stop(drain_s=8.0), done.set()),
+                daemon=True,
+            )
+            t.start()
+            # Idle server, yet the drain must HOLD: without the announce
+            # hold, teardown here is near-instant.
+            assert not done.wait(0.8), "idle drain tore down before gossip"
+            # The next poll observes the flag (and would start the hot-key
+            # handoff); the drain then finishes after its short margin,
+            # well before the 5s announce cap.
+            assert probe()["draining"] == 1
+            assert done.wait(4.0), "drain never completed after the probe"
+            elapsed = time.monotonic() - t0
+            assert elapsed < 5.0, f"announce hold burned the cap: {elapsed:.1f}s"
+            t.join(timeout=5)
+        finally:
+            if chan is not None:
+                chan.close()
+            handle.stop(grace=0.2)
+
+    def test_unwatched_drain_unchanged(self, tmp_path, monkeypatch):
+        """No capacity probe ever served (standalone server, or gossip
+        off): the idle drain tears down immediately — the hold must not
+        tax ordinary shutdowns."""
+        from lumen_tpu.serving.server import serve
+
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        handle = serve(
+            validate_config_dict(drain_config_dict(tmp_path)), skip_download=True
+        )
+        try:
+            assert handle.router.capacity_probe_age() is None
+            t0 = time.monotonic()
+            handle.drain_and_stop(drain_s=8.0)
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            handle.stop(grace=0.2)

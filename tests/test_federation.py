@@ -1022,6 +1022,78 @@ class TestClientPeers:
             httpd.shutdown()
             httpd.server_close()
 
+    def test_peers_cli_capacity_columns(self, capsys):
+        """With capacity gossip armed on the server the sidecar payload grows
+        weight/duty/burn_5m/draining per peer; the CLI renders them and flags
+        the draining host. Without the fields the line is byte-identical to
+        the pre-gossip format (covered by the test above)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from lumen_tpu import client
+
+        payload = {
+            "enabled": True,
+            "mode": "front",
+            "self": None,
+            "hops": 3,
+            "capacity_gossip": True,
+            "peers": {
+                "10.0.0.1:50051": {
+                    "state": "serving", "streak": 0, "dispatches": 50,
+                    "failovers": 0, "sheds": 0, "failures": 0,
+                    "cache_hits": 0, "cache_misses": 0,
+                    "ring_share": 0.8, "sidecar": None,
+                    "last_ok_s_ago": 0.2, "last_error": None, "slo": None,
+                    "weight": 0.72, "duty": 0.28, "burn_5m": 0.4,
+                    "draining": False,
+                },
+                "10.0.0.2:50051": {
+                    "state": "serving", "streak": 0, "dispatches": 40,
+                    "failovers": 0, "sheds": 0, "failures": 0,
+                    "cache_hits": 0, "cache_misses": 0,
+                    "ring_share": 0.2, "sidecar": None,
+                    "last_ok_s_ago": 0.2, "last_error": None, "slo": None,
+                    "weight": 0.0, "duty": 0.95, "burn_5m": 1.8,
+                    "draining": True,
+                },
+            },
+            "cache_peer_hit_rate": 0.0,
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: A002
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            rc = client.main(["peers", "--metrics-addr", f"127.0.0.1:{port}"])
+            assert rc == 0
+            printed = capsys.readouterr().out
+            assert "capacity gossip: on" in printed
+            assert "weight=0.72" in printed
+            assert "duty=28%" in printed
+            assert "burn_5m=0.4" in printed
+            assert "serving DRAINING" in printed
+            assert "weight=0.00" in printed
+            rc = client.main(["peers", "--metrics-addr", f"127.0.0.1:{port}",
+                              "--json"])
+            assert rc == 0
+            parsed = json.loads(capsys.readouterr().out)
+            assert parsed["capacity_gossip"] is True
+            assert parsed["peers"]["10.0.0.2:50051"]["draining"] is True
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
     def test_peers_cli_reports_unconfigured(self, capsys):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -1126,3 +1198,249 @@ class TestMdnsBrowser:
         packet = struct.pack("!HHHHHH", 0, 0x8400, 0, len(answers), 0, 0)
         packet += b"".join(answers)
         assert parse_mdns_response(packet) == []
+
+
+# ---------------------------------------------------------------------------
+# Capacity gossip: weight formula, hysteresis, staleness, drain handoff
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityWeights:
+    def test_desired_weight_formula(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            p = m.peers["a:1"]
+            assert m._desired_weight(p) == 1.0              # no report = neutral
+            p.capacity = {"draining": 1}
+            assert m._desired_weight(p) == 0.0              # draining = no arcs
+            p.capacity = {"duty": 0.3}
+            assert abs(m._desired_weight(p) - 0.7) < 1e-9   # headroom
+            p.capacity = {"duty": 0.6, "burn_5m": 2.0}
+            assert abs(m._desired_weight(p) - 0.2) < 1e-9   # burn halves it
+            p.capacity = {"duty": 1.0}
+            assert m._desired_weight(p) == fed_mod.MIN_CAPACITY_WEIGHT
+            p.capacity = {"duty": "junk"}
+            assert m._desired_weight(p) == 1.0              # garbage = neutral
+        finally:
+            m.close()
+
+    def test_knob_off_is_inert(self):
+        """Without LUMEN_FED_CAPACITY the gossip plumbing must be a
+        no-op: reports are dropped, the ring never re-weights, and the
+        /peers payload carries none of the new fields."""
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            p = m.peers["a:1"]
+            m._note_capacity(p, {"draining": 1, "duty": 0.9})
+            assert p.capacity == {}
+            assert not m._maybe_reweight()
+            assert m.ring.weights == {}
+            out = m.export_status()
+            assert "capacity_gossip" not in out
+            assert "weight" not in out["peers"]["a:1"]
+            assert "draining" not in out["peers"]["a:1"]
+        finally:
+            m.close()
+
+    def test_hysteresis_and_remap_rate_cap(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            p = m.peers["a:1"]
+            # 0.95 desired vs 1.0 current: inside the 0.1 band — no churn
+            # from sensor jitter.
+            m._note_capacity(p, {"duty": 0.05})
+            assert not m._maybe_reweight()
+            assert m.ring.weights == {}
+            # A real move rebuilds and lands on peer + ring + shares.
+            m._note_capacity(p, {"duty": 0.5})
+            assert m._maybe_reweight()
+            assert p.weight == 0.5
+            assert m.ring.weights["a:1"] == 0.5
+            assert m._shares["a:1"] < m._shares["b:1"]
+            # Another big move immediately after: the 10s rate cap holds
+            # it back... unless forced (the drain path).
+            m._note_capacity(p, {"duty": 0.9})
+            assert not m._maybe_reweight()
+            assert m._maybe_reweight(force=True)
+            assert abs(p.weight - 0.1) < 1e-9
+        finally:
+            m.close()
+
+    def test_stale_report_decays_to_neutral(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        monkeypatch.setenv("LUMEN_FED_CAPACITY_REMAP_S", "0")
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            p = m.peers["a:1"]
+            m._note_capacity(p, {"duty": 0.8})
+            assert m._maybe_reweight()
+            assert abs(p.weight - 0.2) < 1e-9
+            # Silent polls short of the threshold keep the last report.
+            for _ in range(m.capacity_stale_polls - 1):
+                m._note_capacity(p, None)
+            assert p.capacity
+            # The threshold poll discards it and the weight decays back —
+            # a wedged sidecar can't pin a stale weight forever.
+            m._note_capacity(p, None)
+            assert p.capacity == {}
+            assert p.weight == 1.0
+            # A fresh report resets the streak.
+            m._note_capacity(p, {"duty": 0.8})
+            assert p.missed_capacity == 0
+        finally:
+            m.close()
+
+    def test_all_drained_falls_back_to_equal_ring(self, monkeypatch):
+        """Every peer draining at once must NOT produce an empty ring —
+        the equal-weight ring keeps routing while per-request drain sheds
+        steer, which strictly beats refusing everything."""
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            for p in m.peers.values():
+                p.capacity = {"draining": 1}
+            assert m._maybe_reweight(force=True)
+            assert m.ring.weights == {}
+            assert m.ring.owner(_digest(b"x")) in m.peers
+        finally:
+            m.close()
+
+    def test_export_status_carries_capacity_columns(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        m = make_manager({"a:1": DeadStub(), "b:1": DeadStub()})
+        try:
+            m._note_capacity(
+                m.peers["a:1"], {"duty": 0.4, "burn_5m": 0.2, "draining": 0}
+            )
+            m._maybe_reweight(force=True)
+            out = m.export_status()
+            assert out["capacity_gossip"] is True
+            a = out["peers"]["a:1"]
+            assert a["weight"] == 0.6
+            assert a["duty"] == 0.4
+            assert a["burn_5m"] == 0.2
+            assert a["draining"] is False
+            b = out["peers"]["b:1"]
+            assert b["weight"] == 1.0 and b["duty"] is None
+        finally:
+            m.close()
+
+
+class TestDrainHandoff:
+    def test_drain_flip_zeroes_weight_and_prefetches(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        m = make_manager(
+            {"a:1": DeadStub(), "b:1": DeadStub(), "c:1": DeadStub()}
+        )
+        try:
+            key = f"echo:{_digest(b'hot-item')}"
+            pushed = []
+            monkeypatch.setattr(
+                m, "_fetch_blob", lambda owner, k: b"blob:" + k.encode()
+            )
+            monkeypatch.setattr(
+                m, "_push_blob",
+                lambda target, k, blob: pushed.append((target.name, k, blob))
+                or True,
+            )
+            # Exhaust the rate cap first: the drain flip must bypass it.
+            assert m._maybe_reweight(force=True) or True
+            m._note_capacity(m.peers["a:1"], {"draining": 1, "hot": [key]})
+            assert m.peers["a:1"].weight == 0.0
+            assert m.ring.shares()["a:1"] == 0.0
+            for t in threading.enumerate():
+                if t.name == "fed-drain-handoff":
+                    t.join(5.0)
+            assert len(pushed) == 1
+            target, k, blob = pushed[0]
+            assert k == key and blob == b"blob:" + key.encode()
+            assert target != "a:1", "handoff must land on a SUCCESSOR"
+            # The successor is the weighted ring's new owner of that arc.
+            assert target == m.ring.owner(_digest(b"hot-item"))
+            # A second identical report is NOT a new flip — no re-handoff.
+            m._note_capacity(m.peers["a:1"], {"draining": 1, "hot": [key]})
+            assert len(pushed) == 1
+        finally:
+            m.close()
+
+    def test_fetch_and_push_legs_over_stub(self, monkeypatch):
+        """The wire legs against a live router: fetch exports the raw
+        blob via fed_cache_lookup, push stores it via op=put (accepted
+        only when the receiver gossips too)."""
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(8 << 20))
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        reset_result_cache()
+        try:
+            backend = HubRouter({"echo": EchoService()})
+            stub = InProcStub(backend)
+            m = make_manager({"a:1": stub, "b:1": DeadStub()})
+            try:
+                cache = get_result_cache()
+                key = make_key("echo", None, b"payload")
+                cache.put(key, {"answer": 41})
+                blob = m._fetch_blob(m.peers["a:1"], key)
+                assert blob is not None
+                stored = m._push_blob(m.peers["a:1"], "echo:deadbeef", blob)
+                assert stored is True
+                assert cache.get("echo:deadbeef") == (True, {"answer": 41})
+            finally:
+                m.close()
+        finally:
+            reset_result_cache()
+
+    def test_put_ignored_when_receiver_not_gossiping(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(8 << 20))
+        monkeypatch.delenv("LUMEN_FED_CAPACITY", raising=False)
+        reset_result_cache()
+        try:
+            backend = HubRouter({"echo": EchoService()})
+            resp = next(backend.Infer(iter([_req(
+                FED_CACHE_TASK, payload=b"x",
+                meta={"op": "put", "key": "echo:feed"},
+            )]), None))
+            assert resp.meta["fed_cache"] == "ignored"
+            assert get_result_cache().get("echo:feed") == (False, None)
+        finally:
+            reset_result_cache()
+
+
+class TestCapacityHealthTrailer:
+    def _health_trailing(self, router) -> dict:
+        captured = {}
+
+        class Ctx:
+            def set_trailing_metadata(self, md):
+                captured.update(dict(md))
+
+            def abort(self, code, detail):
+                raise AssertionError(detail)
+
+        router.Health(None, Ctx())
+        return captured
+
+    def test_unconfigured_health_omits_capacity_key(self, monkeypatch):
+        monkeypatch.delenv("LUMEN_FED_CAPACITY", raising=False)
+        router = HubRouter({"echo": EchoService()})
+        assert "lumen-fed-capacity" not in self._health_trailing(router)
+
+    def test_armed_health_reports_drain_and_hot_keys(self, monkeypatch):
+        monkeypatch.setenv("LUMEN_FED_CAPACITY", "1")
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(8 << 20))
+        reset_result_cache()
+        try:
+            router = HubRouter({"echo": EchoService()})
+            cap = json.loads(self._health_trailing(router)["lumen-fed-capacity"])
+            assert cap["draining"] == 0
+            assert "hot" not in cap, "hot keys ride only while draining"
+            # Drain: flag flips and the hottest cache keys ship along.
+            get_result_cache().put("echo:aaaa", 1)
+            get_result_cache().put("echo:bbbb", 2)
+            router.begin_drain(retry_after_s=0.1)
+            cap = json.loads(self._health_trailing(router)["lumen-fed-capacity"])
+            assert cap["draining"] == 1
+            assert cap["hot"][0] == "echo:bbbb"  # MRU first
+            assert set(cap["hot"]) == {"echo:aaaa", "echo:bbbb"}
+        finally:
+            reset_result_cache()

@@ -136,3 +136,92 @@ def test_shares_partition_the_keyspace(n_peers):
     assert len(shares) == n_peers
     assert abs(sum(shares.values()) - 1.0) < 1e-9
     assert all(s > 0 for s in shares.values())
+
+
+# ---------------------------------------------------------------------------
+# Capacity-weighted ring (LUMEN_FED_CAPACITY)
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    w=st.floats(min_value=0.1, max_value=1.0),
+    seed=st.integers(min_value=0, max_value=2**32 - 1),
+)
+def test_weighted_shares_converge_to_weights(w, seed):
+    """A peer at weight w against two peers at 1.0 must own roughly
+    w/(w+2) of a random key population — the weight IS the expected
+    traffic fraction. Bounded loosely (vnode granularity + hash noise),
+    tight enough to catch an inverted or ignored weight."""
+    names = _peers(3)
+    ring = HashRing(names, weights={names[0]: w})
+    counts = dict.fromkeys(names, 0)
+    keys = _keys(seed, 400)
+    for key in keys:
+        counts[ring.owner(key)] += 1
+    expected = w / (w + 2.0)
+    got = counts[names[0]] / len(keys)
+    assert abs(got - expected) < 0.15, (w, expected, got)
+    # shares() must tell the same story exactly (arc math, no sampling).
+    share = ring.shares()[names[0]]
+    assert abs(share - expected) < 0.12, (w, expected, share)
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    n_peers=st.integers(min_value=2, max_value=6),
+    victim=st.integers(min_value=0, max_value=5),
+    w=st.floats(min_value=0.0, max_value=0.9),
+    seed=st.integers(min_value=0, max_value=2**32 - 1),
+)
+def test_weight_change_minimal_remap(n_peers, victim, w, seed):
+    """Lowering ONE peer's weight only sheds that peer's keys: every key
+    that moves was owned by the re-weighted peer, and every other peer
+    keeps everything it had — the prefix-vnode construction's minimal-
+    remap guarantee extended to weights."""
+    names = _peers(n_peers)
+    target = names[victim % n_peers]
+    before = HashRing(names)
+    after = HashRing(names, weights={target: w})
+    for key in _keys(seed, 100):
+        a, b = before.owner(key), after.owner(key)
+        if a != b:
+            assert a == target, "re-weighting one peer moved another's key"
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    n_peers=st.integers(min_value=2, max_value=6),
+    victim=st.integers(min_value=0, max_value=5),
+    seed=st.integers(min_value=0, max_value=2**32 - 1),
+)
+def test_zero_weight_peer_owns_nothing(n_peers, victim, seed):
+    """Weight 0.0 (a draining peer) = no arcs at all: it can never be a
+    first-choice owner, and its share is exactly zero — equivalent to
+    departure for placement while it stays probeable for readmission."""
+    names = _peers(n_peers)
+    drained = names[victim % n_peers]
+    ring = HashRing(names, weights={drained: 0.0})
+    assert ring.shares()[drained] == 0.0
+    without = HashRing([n for n in names if n != drained])
+    for key in _keys(seed, 60):
+        owner = ring.owner(key)
+        assert owner != drained
+        assert owner == without.owner(key), (
+            "a zero-weight ring must route exactly like the ring without "
+            "the drained peer"
+        )
+
+
+@settings(max_examples=20, deadline=None)
+@given(n_peers=st.integers(min_value=1, max_value=6))
+def test_neutral_weights_match_unweighted_ring(n_peers):
+    """weights={} and all-1.0 weights are byte-identical to the
+    unweighted ring — arming the knob with no capacity reports must not
+    move a single key."""
+    names = _peers(n_peers)
+    plain = HashRing(names)
+    for weights in ({}, dict.fromkeys(names, 1.0)):
+        weighted = HashRing(names, weights=weights)
+        assert weighted._points == plain._points
+        assert weighted.shares() == plain.shares()

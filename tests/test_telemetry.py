@@ -703,3 +703,74 @@ class TestClientStats:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+# -- arrival-rate trend extraction (predictive autopilot sensor) -------------
+
+
+class TestForecastRate:
+    """Fake-clock trend fits: the forecast the predictive scale loop acts
+    on must follow the arrival-rate slope, never go negative, and answer
+    None whenever there is no sensor — a blind controller stays reactive."""
+
+    def _feed(self, hub, name, per_bucket):
+        """One count burst per telemetry bucket, then step into a fresh
+        bucket so every fed bucket is COMPLETE (series() excludes the
+        current partial bucket by design)."""
+        for n in per_bucket:
+            if n:
+                hub.count(name, n)
+            hub.clock_handle.advance(hub.bucket_s)
+
+    def test_rising_trend_forecasts_above_current_rate(self, hub):
+        self._feed(hub, "batch_items:vlm", [5, 10, 15, 20, 25, 30])
+        f = hub.forecast_rate("batch_items:vlm", 30.0, 60.0)
+        assert f is not None
+        newest_rate = 30 / hub.bucket_s
+        assert f > newest_rate, (f, newest_rate)
+
+    def test_falling_trend_forecasts_below_and_floors_at_zero(self, hub):
+        self._feed(hub, "batch_items:vlm", [30, 25, 20, 15, 10, 5])
+        f = hub.forecast_rate("batch_items:vlm", 30.0, 30.0)
+        assert f is not None
+        assert f < 5 / hub.bucket_s
+        # A long horizon extrapolates past zero arrivals — floored, never
+        # a negative rate.
+        far = hub.forecast_rate("batch_items:vlm", 30.0, 600.0)
+        assert far == 0.0
+
+    def test_flat_trend_forecasts_the_current_rate(self, hub):
+        self._feed(hub, "batch_items:vlm", [10, 10, 10, 10, 10, 10])
+        f = hub.forecast_rate("batch_items:vlm", 30.0, 120.0)
+        assert f is not None
+        assert abs(f - 10 / hub.bucket_s) < 1e-9
+
+    def test_bursty_window_is_finite_and_nonnegative(self, hub):
+        self._feed(hub, "batch_items:vlm", [40, 0, 35, 0, 45, 0])
+        f = hub.forecast_rate("batch_items:vlm", 30.0, 60.0)
+        assert f is not None
+        assert f >= 0.0
+        assert f < 1000.0
+
+    def test_no_sensor_means_no_forecast(self, hub):
+        assert hub.forecast_rate("batch_items:nope", 30.0, 60.0) is None
+
+    def test_module_facade_gates_on_hub(self, monkeypatch):
+        # No hub installed: the module function must answer None without
+        # building one (the unconfigured path allocates nothing).
+        tele.reset_hub()
+        assert tele.forecast_rate("batch_items:x", 30.0, 60.0) is None
+        assert tele.device_duty(30.0) is None
+
+    def test_device_duty_none_without_meters_then_worst(self, hub):
+        assert hub.device_duty(30.0) is None
+        hub.set_capacity("device:a", 1.0)
+        hub.set_capacity("device:b", 1.0)
+        t = hub.clock_handle.t
+        hub.busy("device:a", t, t + 3.0)
+        hub.busy("device:b", t, t + 0.5)
+        hub.clock_handle.advance(5.0)
+        duty = hub.device_duty(30.0)
+        assert duty is not None
+        # max over meters: device:a's fraction dominates.
+        assert duty > 0.05
