@@ -21,6 +21,8 @@ Usage (server from `python -m lumen_tpu.serving.server --config ...`):
     python examples/client.py caption photo.jpg --prompt "Describe this photo."
     python examples/client.py caption photo.jpg --stream
     python examples/client.py bulk clip_image_embed *.jpg
+    python examples/client.py upsert batch.json --tenant alice
+    python examples/client.py search query_vec.json -k 10 --tenant alice
 
 Large payloads are chunked with the protocol's seq/total/offset framing —
 the same reassembly path reference clients use.
@@ -593,6 +595,17 @@ def _read(path: str) -> tuple[bytes, str]:
     return data, mime
 
 
+def _load_json_arg(path: str):
+    """Parse a JSON document from a file path or stdin (``-``)."""
+    try:
+        raw = sys.stdin.read() if path == "-" else open(path, encoding="utf-8").read()
+        return json.loads(raw)
+    except OSError as e:
+        raise SystemExit(f"cannot read {path}: {e}") from e
+    except ValueError as e:
+        raise SystemExit(f"{path} is not valid JSON: {e}") from e
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description=(__doc__ or "lumen-tpu example client").splitlines()[0]
@@ -668,6 +681,30 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--stream", action="store_true")
     p = sub.add_parser("bulk", help="many images down ONE stream (server bulk lane)")
     p.add_argument("task"); p.add_argument("images", nargs="+")
+    p = sub.add_parser(
+        "search",
+        help="ANN top-k over the tenant's index — the query vector rides "
+        "the tensor/raw wire path (zero server-side decode); a federated "
+        "front fans it to the tenant's shard owners and merges",
+    )
+    p.add_argument("vector", help="path to a JSON array of floats ('-' = stdin)")
+    p.add_argument("-k", "--top-k", type=int, default=10)
+    p.add_argument(
+        "--shard", default=None,
+        help="pin one named shard (default: the server fans over all of them)",
+    )
+    p.add_argument("--json", action="store_true", help="raw response JSON instead of the ranked list")
+    p = sub.add_parser(
+        "upsert",
+        help="index a vector batch — packed client-side as a tensor/bundle "
+        "([vectors f32, ids as JSON-in-uint8]), the same raw-tensor shape "
+        "the fleet-internal hop re-packs per shard",
+    )
+    p.add_argument(
+        "batch",
+        help="path to JSON {'ids': [...], 'vectors': [[...]]} ('-' = stdin)",
+    )
+    p.add_argument("--json", action="store_true", help="raw response JSON instead of the added/updated summary")
     args = ap.parse_args(argv)
 
     if args.cmd == "stats":
@@ -770,6 +807,51 @@ def main(argv: list[str] | None = None) -> int:
             hit = " (cache hit)" if meta.get("cache_hit") == "1" else ""
             print(f"{name}{hit}: {json.dumps(out, ensure_ascii=False)}")
         return 1 if failed else 0
+
+    if args.cmd == "search":
+        import numpy as np
+
+        vec = np.asarray(_load_json_arg(args.vector), np.float32)
+        if vec.ndim != 1:
+            raise SystemExit(f"query vector must be a flat array, got shape {vec.shape}")
+        meta = dict(qos_meta)
+        meta["k"] = str(args.top_k)
+        if args.shard is not None:
+            meta["shard"] = args.shard
+        out = infer(stub, "search_query", vec, meta=meta,
+                    timeout=args.timeout, tenant=args.tenant)
+        if args.json:
+            print(json.dumps(out, indent=2))
+            return 0
+        hits = list(zip(out.get("ids", []), out.get("scores", [])))
+        if not hits:
+            print(f"no hits (searched {out.get('shards', 0)} shards, "
+                  f"tenant {out.get('tenant', 'default')!r})")
+            return 0
+        for rank, (vid, score) in enumerate(hits, 1):
+            print(f"{rank:3d}. {score:8.4f}  {vid}")
+        return 0
+    if args.cmd == "upsert":
+        import numpy as np
+
+        body = _load_json_arg(args.batch)
+        try:
+            ids, vecs = body["ids"], np.asarray(body["vectors"], np.float32)
+        except (TypeError, KeyError) as e:
+            raise SystemExit(
+                "batch must be JSON {'ids': [...], 'vectors': [[...]]}"
+            ) from e
+        payload = tensorwire.pack_bundle([
+            vecs, np.frombuffer(json.dumps(ids).encode("utf-8"), np.uint8),
+        ])
+        out = run_infer("search_upsert", payload, tensorwire.BUNDLE_MIME, {})
+        if args.json:
+            print(json.dumps(out, indent=2))
+        else:
+            print(f"added={out.get('added', 0)} updated={out.get('updated', 0)}"
+                  + (f" total={out['total']}" if "total" in out else "")
+                  + f" tenant={out.get('tenant', 'default')}")
+        return 0
 
     if args.cmd == "embed-text":
         out = run_infer("clip_text_embed", args.text.encode(), "text/plain", {})

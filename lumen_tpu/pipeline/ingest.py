@@ -24,6 +24,7 @@ ONNX session call per payload (``SURVEY.md`` §3.2).
 from __future__ import annotations
 
 import copy
+import hashlib
 import logging
 import queue
 import threading
@@ -54,19 +55,101 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class Stage:
-    """One device-batched step of an ingest pipeline.
+    """One node of an ingest task graph.
+
+    Two kinds, distinguished by ``inputs``:
+
+    **Source node** (``inputs=()``, the classic device-batched stage) —
+    consumes the decoded item:
 
     - ``preprocess(decoded)`` -> fixed-shape numpy pytree for one item (host,
       runs in the decode worker pool);
     - ``device_fn(batched_tree)`` -> batched device result tree (should be
       ``jax.jit``-ed; inputs arrive sharded over the ``data`` mesh axis);
     - ``postprocess(decoded, row)`` -> the per-item record value (host).
+
+    **Derived node** (``inputs`` non-empty) — a host-side step fed by other
+    nodes' record values instead of a device batch. ``preprocess`` and
+    ``device_fn`` are unused (must stay ``None``); ``postprocess(decoded,
+    deps)`` receives a ``{input_name: value}`` dict of the declared inputs
+    and its return value lands under ``name`` in the record. Inputs name
+    other stages, or record meta keys starting with ``_`` (``"_sha256"``).
+    Derived nodes run in dependency (topological) order after the item's
+    source-stage values settle — including on CACHE-HIT records when
+    ``cache_output=False`` (see below), where ``decoded`` is ``None``
+    because the item was never decoded; a derived ``postprocess`` must
+    tolerate that.
+
+    ``cache_output=False`` marks a node whose value is a side effect (e.g.
+    pushing an embedding into a search index), excluded from the result
+    cache so it re-fires on every pass — cache hits included — instead of
+    replaying a stale verdict.
     """
 
     name: str
-    preprocess: Callable[[Any], Any]
-    device_fn: Callable[[Any], Any]
+    preprocess: Callable[[Any], Any] | None = None
+    device_fn: Callable[[Any], Any] | None = None
     postprocess: Callable[[Any, Any], Any] = field(default=lambda decoded, row: row)
+    inputs: tuple[str, ...] = ()
+    cache_output: bool = True
+
+
+def _build_graph(stages: Sequence[Stage]) -> tuple[list[Stage], list[Stage]]:
+    """Validate the declared task graph -> ``(device_stages, derived_topo)``.
+
+    Device stages keep their given order (it IS the dispatch and record-key
+    order — the parity contract with the pre-DAG pipeline). Derived nodes
+    come back topologically sorted; duplicate names, unknown inputs, a
+    ``device_fn`` on a derived node, a missing one on a source node, and
+    dependency cycles all raise at construction, not mid-run."""
+    names = [s.name for s in stages]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"duplicate stage names: {sorted(dupes)}")
+    known = set(names)
+    device: list[Stage] = []
+    derived: list[Stage] = []
+    for s in stages:
+        if s.inputs:
+            if s.device_fn is not None or s.preprocess is not None:
+                raise ValueError(
+                    f"derived stage {s.name!r} declares inputs; it runs "
+                    "host-side and must not set preprocess/device_fn"
+                )
+            for dep in s.inputs:
+                if not dep.startswith("_") and dep not in known:
+                    raise ValueError(
+                        f"stage {s.name!r} depends on unknown stage {dep!r}"
+                    )
+            derived.append(s)
+        else:
+            if s.preprocess is None or s.device_fn is None:
+                raise ValueError(
+                    f"source stage {s.name!r} needs both preprocess and "
+                    "device_fn (declare inputs to make it a derived node)"
+                )
+            device.append(s)
+    # Kahn's algorithm over the derived subgraph (device stages and meta
+    # keys are always-ready inputs).
+    derived_names = {s.name for s in derived}
+    pending = {
+        s.name: {d for d in s.inputs if d in derived_names} for s in derived
+    }
+    by_name = {s.name: s for s in derived}
+    order: list[Stage] = []
+    ready = [s.name for s in derived if not pending[s.name]]
+    while ready:
+        name = ready.pop(0)
+        order.append(by_name[name])
+        for other, deps in pending.items():
+            if name in deps:
+                deps.discard(name)
+                if not deps:
+                    ready.append(other)
+    if len(order) != len(derived):
+        stuck = sorted(set(derived_names) - {s.name for s in order})
+        raise ValueError(f"dependency cycle among derived stages: {stuck}")
+    return device, order
 
 
 @dataclass
@@ -76,6 +159,7 @@ class IngestStats:
     cache_hits: int = 0  # items answered from the result cache (no decode)
     errors: int = 0      # items that became per-item ``_error`` records
     quarantined: int = 0  # items rejected up front by the poison quarantine
+    duplicates: int = 0  # byte items whose content sha256 repeated in-run
     wall_s: float = 0.0
     decode_s: float = 0.0  # producer-lane time (decode + preprocess + transfer)
     device_s: float = 0.0  # consumer time blocked on device fetches
@@ -99,6 +183,7 @@ class IngestStats:
             "cache_hit_rate": round(self.cache_hit_rate, 4),
             "errors": self.errors,
             "quarantined": self.quarantined,
+            "duplicates": self.duplicates,
             "wall_s": round(self.wall_s, 4),
             "items_per_sec": round(self.items_per_sec, 2),
             "decode_s": round(self.decode_s, 4),
@@ -113,7 +198,7 @@ class IngestStats:
 
 class _Batch:
     __slots__ = (
-        "decoded", "inputs", "outputs", "n", "indices", "keys",
+        "decoded", "inputs", "outputs", "n", "indices", "keys", "shas",
         "trace", "qspan", "wspan", "leases",
     )
 
@@ -124,6 +209,7 @@ class _Batch:
         n: int,
         indices: list[int] | None = None,
         keys: list[str | None] | None = None,
+        shas: list[str | None] | None = None,
     ):
         self.decoded = decoded
         self.inputs = inputs  # stage name -> sharded device tree
@@ -134,6 +220,10 @@ class _Batch:
         # the item is uncacheable or caching is off).
         self.indices = indices if indices is not None else list(range(n))
         self.keys = keys if keys is not None else [None] * n
+        # Content sha256 per row (None for non-bytes items): surfaces on
+        # records as ``_sha256`` — the dedupe primitive — and is NOT part
+        # of the cached value (attached fresh each run).
+        self.shas = shas if shas is not None else [None] * n
         # Per-batch request trace (LUMEN_TRACE_SAMPLE > 0): the trace and
         # its open queue-wait / inflight-wait spans hop from the producer
         # thread to the consumer with the batch — contextvars don't cross.
@@ -183,6 +273,16 @@ class IngestPipeline:
             )
         self.mesh = mesh
         self.stages = list(stages)
+        # Task-graph validation: split the declared nodes into device
+        # (source) stages — kept in GIVEN order, which fixes the batch
+        # dispatch order and the record key order — and host-side derived
+        # nodes, topologically sorted by their declared inputs.
+        self._device_stages, self._derived_stages = _build_graph(self.stages)
+        # Record keys excluded from cache.put values: positional meta plus
+        # every ``cache_output=False`` node's value.
+        self._strip_keys = {"_index", "_sha256"} | {
+            s.name for s in self.stages if not s.cache_output
+        }
         self.decode = decode
         self.batch_size = batch_size
         self.prefetch = max(prefetch, 1)
@@ -247,18 +347,20 @@ class IngestPipeline:
 
     # -- producer lane ----------------------------------------------------
 
-    def _prepare(self, pool: DecodePool, chunk: list[tuple[int, Any, str | None]]) -> _Batch:
+    def _prepare(
+        self, pool: DecodePool, chunk: list[tuple[int, Any, str | None, str | None]]
+    ) -> _Batch:
         # One trace per BATCH (not per item — 64x cheaper and the stages
         # are batch-granular anyway): decode covers the producer lane
         # (pool fan-out + stack + transfer), queue is the hand-off wait to
         # the consumer, then dispatch/fetch/post land on the consumer.
         tr = begin_request("ingest")
         dspan = tr.begin("decode", {"items": len(chunk)}) if tr is not None else None
-        raw_items = [item for _, item, _ in chunk]
+        raw_items = [item for _, item, _, _ in chunk]
         decoded, leases = self._decode_chunk(pool, raw_items)
         try:
             inputs: dict[str, Any] = {}
-            for stage in self.stages:
+            for stage in self._device_stages:
                 trees = pool.map(stage.preprocess, decoded)
                 stacked = stack_and_pad(trees, self.batch_size)
                 inputs[stage.name] = jax.tree_util.tree_map(
@@ -271,13 +373,14 @@ class IngestPipeline:
         # Producer-side count (only the producer thread writes): the pool's
         # own `tasks` gauge is process-wide, so THIS run's decode work has
         # to be tallied where it is submitted.
-        self._run_pool_tasks += len(raw_items) * (1 + len(self.stages))
+        self._run_pool_tasks += len(raw_items) * (1 + len(self._device_stages))
         batch = _Batch(
             decoded,
             inputs,
             len(raw_items),
-            [idx for idx, _, _ in chunk],
-            [key for _, _, key in chunk],
+            [idx for idx, _, _, _ in chunk],
+            [key for _, _, key, _ in chunk],
+            [sha for _, _, _, sha in chunk],
         )
         batch.leases = leases
         if tr is not None:
@@ -358,9 +461,14 @@ class IngestPipeline:
                 pool = private = DecodePool(
                     self._pinned_workers, name=f"ingest-prep:{id(self) & 0xFFFF:04x}"
                 )
-            chunk: list[tuple[int, Any, str | None]] = []
+            chunk: list[tuple[int, Any, str | None, str | None]] = []
             hits: dict[int, dict] = {}
             index = 0
+            # Content-fingerprint dedupe tally: one sha256 of the RAW bytes
+            # per item (the cache key folds namespace+options in, so it
+            # cannot serve as a pure content hash). Surfaced per record as
+            # ``_sha256``; repeats within this run count as ``duplicates``.
+            seen_shas: set[str] = set()
 
             def emit_hits() -> bool:
                 nonlocal hits
@@ -385,6 +493,13 @@ class IngestPipeline:
                     return
                 key = None
                 record = None
+                sha = None
+                if isinstance(item, (bytes, bytearray)):
+                    sha = hashlib.sha256(item).hexdigest()
+                    if sha in seen_shas:
+                        self.stats.duplicates += 1
+                    else:
+                        seen_shas.add(sha)
                 if (
                     self.cache_namespace
                     and isinstance(item, (bytes, bytearray))
@@ -409,6 +524,7 @@ class IngestPipeline:
                             self.stats.cache_hits += 1
                             record = rec
                 if record is not None:
+                    record["_sha256"] = sha
                     hits[index] = record
                     index += 1
                     # Bound the consumer's reorder buffer: a long hit
@@ -421,7 +537,7 @@ class IngestPipeline:
                     if not chunk and not emit_hits():
                         return
                     continue
-                chunk.append((index, item, key))
+                chunk.append((index, item, key, sha))
                 index += 1
                 if len(chunk) == self.batch_size:
                     if not emit_hits() or not emit_chunk():
@@ -505,6 +621,22 @@ class IngestPipeline:
                     if isinstance(got, tuple) and got and got[0] == "hits":
                         for i, rec in got[1].items():
                             rec["_index"] = i
+                            # Side-effect nodes (cache_output=False) fire
+                            # on hits too — a cached embedding still gets
+                            # (re-)indexed. `decoded` is None: the item
+                            # was answered without a decode. Runs under
+                            # the bulk lane like every consumer-side hook.
+                            if self._derived_stages:
+                                try:
+                                    with qos_context(None, LANE_BULK):
+                                        self._apply_derived(
+                                            rec, None, skip_cached=True
+                                        )
+                                except QueueFull as e:
+                                    rec["_error"] = (
+                                        f"shed: {type(e).__name__}: {e}"
+                                    )
+                                    self.stats.errors += 1
                             finished[i] = rec
                         continue
                     if got.qspan is not None:
@@ -516,13 +648,17 @@ class IngestPipeline:
                         # as bulk, never displacing interactive traffic.
                         with qos_context(None, LANE_BULK):
                             if got.trace is not None:
+                                # Per-stage child spans: DAG attribution —
+                                # which node of the task graph ate the
+                                # dispatch budget — for free in any trace.
                                 with got.trace.span("device.dispatch"):
-                                    for stage in self.stages:
-                                        got.outputs[stage.name] = stage.device_fn(
-                                            got.inputs[stage.name]
-                                        )
+                                    for stage in self._device_stages:
+                                        with got.trace.span(f"stage.{stage.name}"):
+                                            got.outputs[stage.name] = stage.device_fn(
+                                                got.inputs[stage.name]
+                                            )
                             else:
-                                for stage in self.stages:
+                                for stage in self._device_stages:
                                     got.outputs[stage.name] = stage.device_fn(got.inputs[stage.name])
                     except Exception as e:  # noqa: BLE001 - contain, don't abort the run
                         self._salvage_batch(got, e, cache, fence, quarantine, finished)
@@ -555,7 +691,8 @@ class IngestPipeline:
                 fspan = batch.trace.begin("fetch") if batch.trace is not None else None
                 try:
                     rows_by_stage = {
-                        s.name: unstack(batch.outputs[s.name], batch.n) for s in self.stages
+                        s.name: unstack(batch.outputs[s.name], batch.n)
+                        for s in self._device_stages
                     }
                 except Exception as e:  # noqa: BLE001 - async dispatch: errors often land at fetch
                     if fspan is not None:
@@ -579,13 +716,16 @@ class IngestPipeline:
                 with qos_context(None, LANE_BULK):
                     for i in range(batch.n):
                         record: dict[str, Any] = {"_index": batch.indices[i]}
+                        if batch.shas[i] is not None:
+                            record["_sha256"] = batch.shas[i]
                         try:
-                            for s in self.stages:
+                            for s in self._device_stages:
                                 record[s.name] = s.postprocess(
                                     batch.decoded[i], rows_by_stage[s.name][i]
                                 )
                             if self.annotate is not None:
                                 record.update(self.annotate(batch.decoded[i]))
+                            self._apply_derived(record, batch.decoded[i])
                         except QueueFull as e:
                             # A bulk-lane shed from a shared admission queue
                             # (postprocess hooks submit into MicroBatchers,
@@ -605,7 +745,8 @@ class IngestPipeline:
                         if cache is not None and batch.keys[i] is not None and not record.get("_error"):
                             cache.put(
                                 batch.keys[i],
-                                {k: v for k, v in record.items() if k != "_index"},
+                                {k: v for k, v in record.items()
+                                 if k not in self._strip_keys},
                                 clone=copy.deepcopy,
                                 fence=fence,
                             )
@@ -647,6 +788,25 @@ class IngestPipeline:
                 # concurrent users by design (that contention is real).
                 g["tasks"] = self._run_pool_tasks
                 self.stats.pool = g
+
+    def _apply_derived(
+        self, record: dict, decoded, skip_cached: bool = False
+    ) -> None:
+        """Evaluate the derived nodes of the task graph (topological
+        order) against one record. A node whose declared inputs are not
+        all present (an ``_error`` record, a stale cached shape) is
+        skipped, not crashed. ``skip_cached=True`` — the cache-hit path —
+        leaves already-cached values alone and only (re-)fires nodes
+        missing from the record, i.e. every ``cache_output=False`` side
+        effect plus any node added since the record was cached."""
+        for s in self._derived_stages:
+            if skip_cached and s.name in record:
+                continue
+            if not all(d in record for d in s.inputs):
+                continue
+            record[s.name] = s.postprocess(
+                decoded, {d: record[d] for d in s.inputs}
+            )
 
     def _salvage_batch(
         self,
@@ -699,8 +859,10 @@ class IngestPipeline:
             for i in range(batch.n):
                 idx = batch.indices[i]
                 record: dict[str, Any] = {"_index": idx}
+                if batch.shas[i] is not None:
+                    record["_sha256"] = batch.shas[i]
                 try:
-                    for s in self.stages:
+                    for s in self._device_stages:
                         tree = s.preprocess(batch.decoded[i])
                         stacked = stack_and_pad([tree], self.batch_size)
                         placed = jax.tree_util.tree_map(
@@ -728,10 +890,19 @@ class IngestPipeline:
                     succeeded += 1
                     if self.annotate is not None:
                         record.update(self.annotate(batch.decoded[i]))
+                    try:
+                        self._apply_derived(record, batch.decoded[i])
+                    except QueueFull as e:
+                        record = {
+                            "_index": idx,
+                            "_error": f"shed: {type(e).__name__}: {e}",
+                        }
+                        self.stats.errors += 1
                     if cache is not None and batch.keys[i] is not None and not record.get("_error"):
                         cache.put(
                             batch.keys[i],
-                            {k: v for k, v in record.items() if k != "_index"},
+                            {k: v for k, v in record.items()
+                             if k not in self._strip_keys},
                             clone=copy.deepcopy,
                             fence=fence,
                         )

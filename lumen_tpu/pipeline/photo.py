@@ -38,6 +38,10 @@ class PhotoRecord:
     ocr: list = field(default_factory=list)  # models.ocr.OcrResult
     caption: str | None = None
     error: str | None = None  # decode failure (on_decode_error="record")
+    # Content fingerprint of the RAW input bytes (None for non-bytes
+    # items): stable across decode policy and model versions — the dedupe
+    # primitive, and the natural vector id for the search index.
+    sha256: str | None = None
 
 
 class PhotoIngestPipeline:
@@ -62,11 +66,13 @@ class PhotoIngestPipeline:
         caption: bool = False,
         caption_prompt: str = "Describe this photo in one sentence.",
         caption_max_tokens: int = 32,
+        caption_workers: int = 4,
         prefetch: int = 2,
         inflight: int = 2,
         workers: int | None = None,
         on_decode_error: str = "raise",
         decode_max_edge: int | None = None,
+        index: Any | None = None,
     ):
         if on_decode_error not in ("raise", "record"):
             raise ValueError("on_decode_error must be 'raise' or 'record'")
@@ -111,6 +117,7 @@ class PhotoIngestPipeline:
         self.caption = caption
         self.caption_prompt = caption_prompt
         self.caption_max_tokens = caption_max_tokens
+        self.caption_workers = max(1, caption_workers)
 
         # Scaled decode target: the producer decodes oversized JPEGs at
         # reduced scale, never below the LARGEST consumer's input edge, so
@@ -140,6 +147,32 @@ class PhotoIngestPipeline:
             stages.append(self._face_stage(mesh))
         if ocr is not None:
             stages.append(self._ocr_stage(mesh))
+        # embed -> index as a CONFIGURED task-graph edge: a derived node
+        # fed by the clip stage's record value plus the item's content
+        # fingerprint. ``cache_output=False`` keeps the sink's verdict out
+        # of the result cache AND re-fires it on cache hits, so a warm
+        # re-ingest of an already-embedded library still (re)indexes every
+        # photo without touching the decode pool or the device.
+        if index is not None:
+            if clip is None:
+                raise ValueError("index= requires a clip manager (the embedding source)")
+            if not callable(index):
+                raise ValueError("index= must be a callable(sha256, clip_out)")
+
+            def index_post(decoded, deps):
+                clip_out = deps["clip"]
+                if clip_out is None or clip_out.get("embedding") is None:
+                    return None  # undecodable item: nothing to index
+                return index(deps.get("_sha256"), clip_out)
+
+            stages.append(
+                Stage(
+                    "index",
+                    postprocess=index_post,
+                    inputs=("clip", "_sha256"),
+                    cache_output=False,
+                )
+            )
         # Content-addressed re-ingest cache: the namespace pins every model
         # id@revision (and its compute precision — records from one
         # numerics config must not answer for another, esp. across
@@ -350,7 +383,11 @@ class PhotoIngestPipeline:
 
     def run(self, items: Iterable[Any]) -> Iterator[PhotoRecord]:
         for raw in self.engine.run(items):
-            rec = PhotoRecord(index=raw["_index"], error=raw.get("_error"))
+            rec = PhotoRecord(
+                index=raw["_index"],
+                error=raw.get("_error"),
+                sha256=raw.get("_sha256"),
+            )
             if "clip" in raw and raw["clip"] is not None:
                 rec.clip_embedding = raw["clip"]["embedding"]
                 rec.labels = raw["clip"].get("labels", [])
@@ -371,24 +408,55 @@ class PhotoIngestPipeline:
     ) -> list[PhotoRecord]:
         """Caption already-swept records in place. Per-image fault
         tolerance matches the decode contract: one VLM failure records an
-        error on that row instead of aborting a multi-hour bulk run."""
+        error on that row instead of aborting a multi-hour bulk run.
+
+        Generation is autoregressive, but the continuous engine multiplexes
+        decode slots — serial submission would leave all but one slot idle.
+        Items fan out over ``caption_workers`` submitter threads (bounded:
+        the engine's own admission queue is the real backpressure, the
+        bound just keeps this caller from camping every slot), each tagged
+        onto the BULK QoS lane so a captioning sweep browns out before
+        interactive VLM traffic, never displacing it."""
         if not self.caption or self.vlm is None:
             return records
-        from lumen_tpu.models.vlm.chat import ChatMessage
+        from concurrent.futures import ThreadPoolExecutor
 
-        for rec, payload in zip(records, items):
-            if rec.error:  # undecodable image: nothing to caption
-                continue
-            try:
-                result = self.vlm.generate(
-                    [ChatMessage(role="user", content=self.caption_prompt)],
-                    image_bytes=payload,
-                    max_new_tokens=self.caption_max_tokens,
-                )
-                rec.caption = result.text
-            except Exception as e:  # noqa: BLE001 - record, don't abort
-                logger.warning("caption failed for item %d: %s", rec.index, e)
-                rec.error = f"caption failed: {e}"
+        from lumen_tpu.models.vlm.chat import ChatMessage
+        from lumen_tpu.runtime.qos import LANE_BULK, qos_context
+
+        def caption_one(rec: PhotoRecord, payload: bytes) -> None:
+            # contextvars don't cross thread starts: re-tag per task.
+            with qos_context(None, LANE_BULK):
+                try:
+                    result = self.vlm.generate(
+                        [ChatMessage(role="user", content=self.caption_prompt)],
+                        image_bytes=payload,
+                        max_new_tokens=self.caption_max_tokens,
+                    )
+                    rec.caption = result.text
+                except Exception as e:  # noqa: BLE001 - record, don't abort
+                    logger.warning("caption failed for item %d: %s", rec.index, e)
+                    rec.error = f"caption failed: {e}"
+
+        todo = [
+            (rec, payload)
+            for rec, payload in zip(records, items)
+            if not rec.error  # undecodable image: nothing to caption
+        ]
+        if not todo:
+            return records
+        if len(todo) == 1 or self.caption_workers == 1:
+            for rec, payload in todo:
+                caption_one(rec, payload)
+            return records
+        with ThreadPoolExecutor(
+            max_workers=min(self.caption_workers, len(todo)),
+            thread_name_prefix="caption",
+        ) as pool:
+            # Each record is touched by exactly ONE task (in-place, no
+            # shared state); list(…) propagates nothing — caption_one
+            # already contains every failure as a record error.
+            list(pool.map(lambda rp: caption_one(*rp), todo))
         return records
 
     @property

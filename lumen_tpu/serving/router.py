@@ -113,6 +113,24 @@ FED_CAPACITY_ENV = "LUMEN_FED_CAPACITY"
 #: each other's headroom from the probe they already run, no new RPC.
 FED_CAPACITY_META = "lumen-fed-capacity"
 
+#: Search tasks the federation FRONT fans out SHARD-WISE instead of
+#: routing to a single content-address owner: ANN shard placement keys
+#: the hash ring per ``ann/{tenant}/{shard}`` (data placement — a query
+#: must visit EVERY shard owner, an upsert batch is partitioned by the
+#: same placement function), so the ordinary payload-digest routing
+#: would send a query to one random peer holding one fraction of the
+#: index. String literals on purpose: the canonical definitions live in
+#: :mod:`.services.search_service`, whose import drags numpy and the
+#: batcher machinery this router deliberately stays free of — and the
+#: task names are wire protocol either way.
+FED_SEARCH_QUERY_TASK = "search_query"
+FED_SEARCH_UPSERT_TASK = "search_upsert"
+FED_SEARCH_TASKS = (FED_SEARCH_QUERY_TASK, FED_SEARCH_UPSERT_TASK)
+
+#: chunk size for front-built shard sub-requests (same 1 MiB the client
+#: uses — comfortably under any gRPC frame limit).
+_FED_SEARCH_CHUNK = 1 << 20
+
 _ROLE_WARNED = False
 
 
@@ -832,6 +850,24 @@ class FederationRouter(HubRouter):
             return None
         return tuple(out) or None
 
+    def _forward_timeout(self, context) -> float:
+        """Deadline for one peer forward: the caller's own remaining
+        budget when it set one, else the fleet default. Clamp: a
+        no-deadline client surfaces as a HUGE ``time_remaining()`` on
+        some gRPC stacks, and that number fed raw into the forward's
+        deadline overflows C time — the call dies instantly instead of
+        never (same trap the result cache's flight wait hit)."""
+        timeout = None
+        tr_fn = getattr(context, "time_remaining", None)
+        if callable(tr_fn):
+            try:
+                timeout = tr_fn()
+            except Exception:  # noqa: BLE001 - stub contexts
+                timeout = None
+        if timeout is None or timeout <= 0:
+            timeout = self.federation.forward_timeout_s
+        return min(timeout, 86400.0)
+
     @staticmethod
     def _reroutable_shed(resp: pb.InferResponse) -> bool:
         """An in-band UNAVAILABLE as the FIRST response: the peer refused
@@ -904,6 +940,11 @@ class FederationRouter(HubRouter):
         if self._draining:
             yield self._drain_response(first)
             return
+        forward = (
+            self._search_fanout
+            if first.task in FED_SEARCH_TASKS
+            else self._route_and_forward
+        )
         tr = None
         if request_trace.enabled():
             tr = request_trace.begin_request(
@@ -911,11 +952,11 @@ class FederationRouter(HubRouter):
                 trace_id=BaseService._trace_id_from(context),
             )
         if tr is None:
-            yield from self._route_and_forward(first, request_iterator, context, None)
+            yield from forward(first, request_iterator, context, None)
             return
         token = request_trace.activate(tr)
         try:
-            for resp in self._route_and_forward(first, request_iterator, context, tr):
+            for resp in forward(first, request_iterator, context, tr):
                 if resp.HasField("error"):
                     tr.set_error(resp.error.message or "error")
                 yield resp
@@ -975,20 +1016,7 @@ class FederationRouter(HubRouter):
         if not plan:
             yield self._relay_exhausted(context, first.correlation_id, None, 0)
             return
-        timeout = None
-        tr_fn = getattr(context, "time_remaining", None)
-        if callable(tr_fn):
-            try:
-                timeout = tr_fn()
-            except Exception:  # noqa: BLE001 - stub contexts
-                timeout = None
-        if timeout is None or timeout <= 0:
-            timeout = fed.forward_timeout_s
-        # Clamp: a no-deadline client surfaces as a HUGE time_remaining()
-        # on some gRPC stacks, and that number fed raw into the forward's
-        # deadline overflows C time — the call dies instantly instead of
-        # never (same trap the result cache's flight wait hit).
-        timeout = min(timeout, 86400.0)
+        timeout = self._forward_timeout(context)
         md = self._forward_metadata(context)
         kwargs = {"timeout": timeout} if md is None else {
             "timeout": timeout, "metadata": md,
@@ -1053,6 +1081,359 @@ class FederationRouter(HubRouter):
         finally:
             with self._lock:
                 self._active_streams -= 1
+
+    # -- sharded search fan-out --------------------------------------------
+
+    def _search_fanout(
+        self, first: pb.InferRequest, request_iterator, context, tr
+    ) -> Iterator[pb.InferResponse]:
+        """Front half of the sharded search path: buffer the request,
+        resolve the tenant, and fan out to the ring owners of every
+        ``ann/{tenant}/{shard}`` key — per-shard forwards run their own
+        failover walk and the results merge HERE, so one dead shard
+        owner degrades to its ring successor, never to a silently
+        partial answer. Responses are collected (not streamed), which
+        keeps replay safe for every shard hop: no byte reaches the
+        client until all shards have answered."""
+        fed = self.federation
+        msgs: list[pb.InferRequest] = [first]
+        asm = _Assembly()
+        asm.add(first)
+        for req in request_iterator:
+            msgs.append(req)
+            if not asm.complete and req.correlation_id == first.correlation_id:
+                asm.add(req)
+        # jax-free: runtime.ann defers its jax import past module level,
+        # and the front only uses its placement/merge helpers.
+        from ..runtime.ann import ann_shards
+        from ..utils.qos import DEFAULT_TENANT, TENANT_META_KEY
+
+        tenant = (
+            first.meta.get("tenant")
+            or BaseService._invocation_meta(context, TENANT_META_KEY)
+            or DEFAULT_TENANT
+        )
+        n_shards = ann_shards()
+        timeout = self._forward_timeout(context)
+        md = self._forward_metadata(context)
+        kwargs = {"timeout": timeout} if md is None else {
+            "timeout": timeout, "metadata": md,
+        }
+        with self._lock:
+            self._active_streams += 1
+        try:
+            if first.task == FED_SEARCH_UPSERT_TASK:
+                yield from self._search_upsert_fanout(
+                    first, asm, context, tr, tenant, n_shards, kwargs
+                )
+            else:
+                yield from self._search_query_fanout(
+                    first, msgs, context, tr, tenant, n_shards, kwargs
+                )
+        finally:
+            with self._lock:
+                self._active_streams -= 1
+
+    def _search_query_fanout(
+        self, first, msgs, context, tr, tenant, n_shards, kwargs
+    ) -> Iterator[pb.InferResponse]:
+        fed = self.federation
+        cid = first.correlation_id
+        metrics.count("fed_search_queries")
+
+        def one_shard(shard: int):
+            # Same payload (the query tensor forwards verbatim — a
+            # fleet-internal hop never re-encodes), shard-pinned meta:
+            # the owner answers ONLY from ann/{tenant}/{shard}.
+            head = pb.InferRequest()
+            head.CopyFrom(first)
+            head.meta["shard"] = str(shard)
+            head.meta["tenant"] = tenant
+            key = hashlib.sha256(f"ann/{tenant}/{shard}".encode()).hexdigest()
+            plan = fed.plan(key)
+            span = (
+                tr.begin("fed.search", {"shard": str(shard), "tenant": tenant})
+                if tr is not None
+                else None
+            )
+            got, peer, last_shed, tried = self._forward_collect(
+                [head, *msgs[1:]], plan, kwargs
+            )
+            if span is not None:
+                span.end(
+                    owner=peer.name if peer is not None else "none",
+                    hops=str(tried),
+                    ok="1" if got is not None else "0",
+                )
+            return got, last_shed, tried
+
+        parts: list[tuple[list, list]] = []
+        last_shed = None
+        total_tried = 0
+        for got, shed, tried in self._fanout_run(one_shard, list(range(n_shards))):
+            total_tried += tried
+            if shed is not None:
+                last_shed = shed
+            if got is None:
+                # One unreachable shard fails the WHOLE query: a quietly
+                # partial top-k is a wrong answer, not a degraded one.
+                yield self._relay_exhausted(context, cid, last_shed, total_tried)
+                return
+            final = got[-1]
+            if final.HasField("error"):
+                # The shard's own in-band error (bad k, bad vector...)
+                # relays verbatim — its message is the ground truth.
+                yield final
+                return
+            body = b"".join(bytes(r.result) for r in got)
+            try:
+                doc = json.loads(body.decode("utf-8"))
+                parts.append((doc["ids"], doc["scores"]))
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                yield pb.InferResponse(
+                    correlation_id=cid,
+                    is_final=True,
+                    error=pb.Error(
+                        code=pb.ERROR_CODE_INTERNAL,
+                        message=f"shard returned a malformed search body: {e}",
+                    ),
+                )
+                return
+        from ..runtime.ann import merge_topk
+
+        try:
+            k = max(1, int(first.meta.get("k", "10") or "10"))
+        except ValueError:
+            k = 10  # the shards validated k; unreachable in practice
+        ids, scores = merge_topk(parts, k)
+        out = {
+            "ids": ids,
+            "scores": scores,
+            "k": k,
+            "shards": n_shards,
+            "tenant": tenant,
+        }
+        yield pb.InferResponse(
+            correlation_id=cid,
+            is_final=True,
+            result=json.dumps(out).encode(),
+            result_mime="application/json",
+            total=1,
+        )
+
+    def _search_upsert_fanout(
+        self, first, asm, context, tr, tenant, n_shards, kwargs
+    ) -> Iterator[pb.InferResponse]:
+        import numpy as np
+
+        from ..runtime.ann import shard_of
+        from ..utils.tensorwire import BUNDLE_MIME, pack_bundle, unpack_bundle
+
+        fed = self.federation
+        cid = first.correlation_id
+        payload = asm.payload()
+        try:
+            if asm.payload_mime == BUNDLE_MIME:
+                tensors = unpack_bundle(payload)
+                if len(tensors) != 2:
+                    raise ValueError(
+                        f"upsert bundle must hold [vectors, ids_json], "
+                        f"got {len(tensors)} tensors"
+                    )
+                vecs = np.asarray(tensors[0], np.float32)
+                ids = json.loads(
+                    bytes(np.asarray(tensors[1], np.uint8)).decode("utf-8")
+                )
+            else:
+                doc = json.loads(payload.decode("utf-8"))
+                ids = doc["ids"]
+                vecs = np.asarray(doc["vectors"], np.float32)
+            if (
+                not isinstance(ids, list)
+                or not all(isinstance(i, str) for i in ids)
+                or vecs.ndim != 2
+                or len(ids) != vecs.shape[0]
+                or not ids
+            ):
+                raise ValueError(
+                    f"{len(ids) if isinstance(ids, list) else '?'} string ids "
+                    f"over vectors {vecs.shape}"
+                )
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            # The front must parse to PARTITION, so malformed batches
+            # answer here — same contract the shard host would apply.
+            yield pb.InferResponse(
+                correlation_id=cid,
+                is_final=True,
+                error=pb.Error(
+                    code=pb.ERROR_CODE_INVALID_ARGUMENT,
+                    message=f"upsert batch did not parse: {type(e).__name__}: {e}",
+                    detail=(
+                        "expected tensor/bundle [vectors, ids_json] or "
+                        "JSON {'ids': [...], 'vectors': [[...]]}"
+                    ),
+                ),
+            )
+            return
+        metrics.count("fed_search_upserts")
+        groups: dict[int, list[int]] = {}
+        for row, vid in enumerate(ids):
+            groups.setdefault(shard_of(vid, n_shards), []).append(row)
+
+        def one_shard(item):
+            shard, rows = item
+            sub_ids = [ids[r] for r in rows]
+            body = pack_bundle([
+                np.ascontiguousarray(vecs[rows]),
+                np.frombuffer(json.dumps(sub_ids).encode("utf-8"), np.uint8),
+            ])
+            meta = dict(first.meta)
+            meta["shard"] = str(shard)
+            meta["tenant"] = tenant
+            shard_msgs = list(
+                self._search_msgs(first.task, cid, bytes(body), BUNDLE_MIME, meta)
+            )
+            key = hashlib.sha256(f"ann/{tenant}/{shard}".encode()).hexdigest()
+            plan = fed.plan(key)
+            span = (
+                tr.begin(
+                    "fed.search",
+                    {"shard": str(shard), "tenant": tenant, "rows": str(len(rows))},
+                )
+                if tr is not None
+                else None
+            )
+            got, peer, last_shed, tried = self._forward_collect(
+                shard_msgs, plan, kwargs
+            )
+            if span is not None:
+                span.end(
+                    owner=peer.name if peer is not None else "none",
+                    hops=str(tried),
+                    ok="1" if got is not None else "0",
+                )
+            return got, last_shed, tried
+
+        added = updated = 0
+        last_shed = None
+        total_tried = 0
+        items = sorted(groups.items())
+        for got, shed, tried in self._fanout_run(one_shard, items):
+            total_tried += tried
+            if shed is not None:
+                last_shed = shed
+            if got is None:
+                # Partial-write honesty: some slices may have landed, but
+                # upserts are idempotent by id — the client retries the
+                # whole batch and converges.
+                yield self._relay_exhausted(context, cid, last_shed, total_tried)
+                return
+            final = got[-1]
+            if final.HasField("error"):
+                yield final
+                return
+            body = b"".join(bytes(r.result) for r in got)
+            try:
+                doc = json.loads(body.decode("utf-8"))
+                added += int(doc.get("added", 0))
+                updated += int(doc.get("updated", 0))
+            except (ValueError, TypeError) as e:
+                yield pb.InferResponse(
+                    correlation_id=cid,
+                    is_final=True,
+                    error=pb.Error(
+                        code=pb.ERROR_CODE_INTERNAL,
+                        message=f"shard returned a malformed upsert body: {e}",
+                    ),
+                )
+                return
+        out = {
+            "added": added,
+            "updated": updated,
+            "shards": len(items),
+            "tenant": tenant,
+        }
+        yield pb.InferResponse(
+            correlation_id=cid,
+            is_final=True,
+            result=json.dumps(out).encode(),
+            result_mime="application/json",
+            total=1,
+        )
+
+    def _forward_collect(self, msgs, plan, kwargs):
+        """One shard's forward: walk the ring owner's live successors
+        exactly like :meth:`_route_and_forward`, but COLLECT the response
+        messages instead of streaming them. Returns ``(responses | None,
+        serving_peer | None, last_shed, hops_tried)`` — ``None`` responses
+        mean the plan is exhausted (empty plan included)."""
+        fed = self.federation
+        last_shed = None
+        for attempt, peer in enumerate(plan):
+            fed.record_dispatch(peer, failover=attempt > 0)
+            got: list[pb.InferResponse] = []
+            shed = None
+            try:
+                for resp in peer.stub.Infer(iter(msgs), **kwargs):
+                    if not got and self._reroutable_shed(resp):
+                        shed = resp
+                        break
+                    got.append(resp)
+            except grpc.RpcError as e:
+                if not fed.record_unreachable(peer, e, "search"):
+                    # DEADLINE_EXCEEDED/CANCELLED describe the CLIENT's
+                    # budget or patience — burning more hops serves a
+                    # caller that is already gone. Replay stays safe
+                    # (nothing was forwarded), but pointless.
+                    raise
+                continue
+            if shed is not None:
+                fed.record_shed(peer)
+                last_shed = shed
+                continue
+            if not got:
+                # A peer that half-answered an empty stream is broken in
+                # a way record_unreachable never saw; try the successor.
+                continue
+            fed.record_success(peer)
+            return got, peer, last_shed, attempt + 1
+        return None, None, last_shed, len(plan)
+
+    def _fanout_run(self, fn, items: list) -> list:
+        """Run ``fn(item)`` for every item CONCURRENTLY (the per-shard
+        forwards are network-bound; serial fan-out would multiply query
+        latency by the shard count) and return results in item order.
+        A worker exception propagates — same surface as a failed single
+        forward."""
+        if len(items) <= 1:
+            return [fn(i) for i in items]
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=min(len(items), 8), thread_name_prefix="fed-search"
+        ) as pool:
+            return list(pool.map(fn, items))
+
+    @staticmethod
+    def _search_msgs(
+        task: str, cid: str, payload: bytes, mime: str, meta: dict[str, str]
+    ) -> Iterator[pb.InferRequest]:
+        """Chunked request messages for a front-built shard sub-request
+        (the same framing the client's ``_requests`` helper emits)."""
+        if len(payload) <= _FED_SEARCH_CHUNK:
+            yield pb.InferRequest(
+                correlation_id=cid, task=task, payload=payload,
+                payload_mime=mime, meta=meta,
+            )
+            return
+        total = (len(payload) + _FED_SEARCH_CHUNK - 1) // _FED_SEARCH_CHUNK
+        for i in range(total):
+            part = payload[i * _FED_SEARCH_CHUNK : (i + 1) * _FED_SEARCH_CHUNK]
+            yield pb.InferRequest(
+                correlation_id=cid, task=task, payload=part,
+                payload_mime=mime, meta=meta if i == 0 else {},
+                seq=i, total=total, offset=i * _FED_SEARCH_CHUNK,
+            )
 
     def GetCapabilities(self, request, context) -> pb.Capability:
         """Aggregate the LIVE peers' capabilities into one record (the

@@ -14,6 +14,7 @@ import json
 from ..core.config import ServiceConfig
 from ..serving.base_service import BaseService
 from ..serving.registry import TaskDefinition, TaskRegistry
+from ..serving.services.search_service import SearchService
 
 
 class SecondaryEchoService(BaseService):
@@ -92,6 +93,59 @@ class SlowEchoService(BaseService):
 
         time.sleep(float(meta.get("sleep_s", "0.3")))
         return payload, mime or "application/octet-stream", {"slow": "1"}
+
+
+class SearchBenchService(SearchService):
+    """The REAL :class:`~lumen_tpu.serving.services.search_service.
+    SearchService` with a simulated device cost inside each shard's
+    batcher dispatch: ``SEARCHBENCH_ROW_NS`` nanoseconds of sleep per
+    corpus row the dispatch sweeps. That is where a chip would spend its
+    time — per DISPATCH (coalesced queries share one sweep, like one
+    matmul), serialized per shard (one device), proportional to the
+    shard's committed rows (exact search is memory-bound on the corpus)
+    — for a corpus that is sub-millisecond on CPU. Like
+    :class:`FederationBenchService` it SLEEPS instead of spinning, so N
+    subprocess hosts on one box scale like N hosts and ``bench.py
+    --phase search`` can measure sharded fan-out honestly. Everything
+    else (upsert, top-k, merge) is the unmodified ANN path, so the
+    recall-vs-oracle segment exercises real code; handler threads only
+    park on batcher futures, so a bulk upsert flood contends with
+    queries exactly where the real system says it must: at the device,
+    where upsert's bounded chunk writes interleave between dispatches."""
+
+    def _batcher(self, tenant: str, shard: str):
+        import os
+        import time
+
+        import numpy as np
+
+        from ..runtime.ann import ann_k_cap
+        from ..runtime.batcher import MicroBatcher
+
+        key = (tenant, shard)
+        with self._batcher_lock:
+            got = self._batchers.get(key)
+            if got is None:
+                shard_obj = self.index.shard(tenant, shard)
+                try:
+                    row_ns = int(os.environ.get("SEARCHBENCH_ROW_NS") or 0)
+                except ValueError:
+                    row_ns = 0
+
+                def fn(batch: np.ndarray, n_valid: int, _s=shard_obj):  # noqa: ARG001
+                    if row_ns > 0:
+                        time.sleep(_s.count * row_ns / 1e9)
+                    scores, idx = _s.query_raw(np.asarray(batch), ann_k_cap())
+                    return scores, idx
+
+                got = MicroBatcher(
+                    fn,
+                    max_batch=self._batch_size,
+                    max_latency_ms=self._max_latency_ms,
+                    name=f"search:{tenant}:{shard}",
+                ).start()
+                self._batchers[key] = got
+            return got
 
 
 class FederationBenchService(BaseService):

@@ -2,7 +2,7 @@
 """Run every doc-gate script in one command with a summary table.
 
 The gates (`check_knobs`, `check_metrics`, `check_meta_keys`,
-`check_endpoints`, `check_events`) each police one operator-API surface
+`check_endpoints`, `check_events`, `check_tasks`) each police one operator-API surface
 against the docs; until this runner, each was only exercised by its own
 test and a local pre-push check meant one invocation per gate. One
 command, one table, one exit code::
@@ -27,7 +27,7 @@ SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 #: gate module names, run in this order (each must expose ``main() -> int``
 #: and print its own detail lines).
 GATES = ("check_knobs", "check_metrics", "check_meta_keys", "check_endpoints",
-         "check_events")
+         "check_events", "check_tasks")
 
 
 def load_gate(name: str):

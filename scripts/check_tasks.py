@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Assert every task name the serving layer registers or reserves is
+documented in the task vocabulary table of ``docs/ARCHITECTURE.md``.
+
+Task names are the routing surface of the whole stack: clients put them
+in ``InferRequest.task``, the hub dispatches on them, the federation
+front tier special-cases some of them (``fed_cache_lookup``,
+``fed_kv_put``, the search fan-out pair) — so a task that exists in code
+but not in the table is a route operators can't discover. Like
+``check_events`` this gate scans one *section* of the doc: a task name
+that only appears in prose elsewhere doesn't count as documented.
+Collected by pytest (``tests/test_check_tasks.py``) so tier-1 fails on
+the gap, and runs standalone::
+
+    python scripts/check_tasks.py
+
+Mechanics: regex scan of ``lumen_tpu/serving/`` for (a) ``name=`` inside
+``TaskDefinition(...)`` registrations — literals, f-strings (reduced to
+their literal suffix after the last ``}``, matched against any
+documented task sharing it: ``{prefix}_text_embed`` is documented as the
+concrete ``clip_text_embed``/``bioclip_text_embed``/... rows), and
+UPPER_CASE constants resolved from a ``CONST = "value"`` assignment in
+the same file; plus (b) reserved-task constants (``*_TASK = "value"``)
+— the fleet-internal names the router compares against even though no
+registry ever registers them. A ``name=`` bound to a plain variable
+(e.g. ``resilience.py`` re-registering placeholder routes for tasks a
+degraded service *would* have had) resolves to nothing and is skipped:
+those names are someone else's literals, scanned at their source.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "ARCHITECTURE.md")
+SCAN_ROOT = os.path.join(REPO_ROOT, "lumen_tpu", "serving")
+
+#: ``TaskDefinition(`` then (possibly over a newline) its ``name=`` —
+#: capture a string literal, an f-string, or a constant reference.
+_REGISTER_PATTERN = re.compile(
+    r'TaskDefinition\(\s*name=(?:f?"([^"]+)"|([A-Z][A-Z0-9_]*))'
+)
+#: reserved-task constants: ``FOO_TASK = "bar"`` at module scope.
+_RESERVED_PATTERN = re.compile(r'^[A-Z][A-Z0-9_]*_TASK\s*=\s*"([^"]+)"', re.M)
+#: constant assignments, for resolving ``name=SOME_CONST``.
+_CONST_PATTERN = re.compile(r'^([A-Z][A-Z0-9_]*)\s*=\s*"([^"]+)"', re.M)
+#: the doc section holding the task table.
+_SECTION_MARKER = "Task vocabulary"
+#: backticked names in a table row's first cell: ``| `a`, `b` | ... |``.
+_ROW_PATTERN = re.compile(r"^\|([^|]*)\|", re.MULTILINE)
+_NAME_PATTERN = re.compile(r"`([a-z_]+)`")
+
+
+def _suffix(name: str) -> str:
+    """Reduce an f-string task name to its literal suffix (the part
+    after the last ``}``); a fully-literal name passes through."""
+    return name.rsplit("}", 1)[-1]
+
+
+def emitted_tasks() -> tuple[set[str], set[str]]:
+    """Scan serving/ → ``(exact_names, fstring_suffixes)``."""
+    exact: set[str] = set()
+    suffixes: set[str] = set()
+    for dirpath, _, filenames in os.walk(SCAN_ROOT):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), encoding="utf-8", errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            consts = dict(_CONST_PATTERN.findall(text))
+            for literal, const in _REGISTER_PATTERN.findall(text):
+                if const:
+                    resolved = consts.get(const)
+                    if resolved:
+                        exact.add(resolved)
+                elif "{" in literal:
+                    sfx = _suffix(literal)
+                    if sfx:
+                        suffixes.add(sfx)
+                elif literal:
+                    exact.add(literal)
+            exact.update(_RESERVED_PATTERN.findall(text))
+    return exact, suffixes
+
+
+def documented_tasks() -> set[str]:
+    """Task names in the first cell of the vocabulary table rows."""
+    if not os.path.exists(DOC_PATH):
+        return set()
+    with open(DOC_PATH, encoding="utf-8", errors="ignore") as f:
+        text = f.read()
+    idx = text.find(_SECTION_MARKER)
+    if idx < 0:
+        return set()
+    # The table ends at the first blank line after its rows begin.
+    section = text[idx:]
+    table_end = section.find("\n\n", section.find("\n|"))
+    if table_end > 0:
+        section = section[:table_end]
+    names: set[str] = set()
+    for cell in _ROW_PATTERN.findall(section):
+        names.update(_NAME_PATTERN.findall(cell))
+    return names
+
+
+def undocumented() -> list[str]:
+    doc = documented_tasks()
+    exact, suffixes = emitted_tasks()
+    missing = [name for name in exact if name not in doc]
+    # An f-string registration is covered when at least one documented
+    # task ends with its literal suffix (its concrete spellings are the
+    # documented rows).
+    missing += [
+        f"*{sfx}" for sfx in suffixes if not any(d.endswith(sfx) for d in doc)
+    ]
+    return sorted(missing)
+
+
+def main() -> int:
+    if not documented_tasks():
+        print("check_tasks: could not find the task vocabulary table in "
+              "docs/ARCHITECTURE.md")
+        return 1
+    missing = undocumented()
+    if missing:
+        print("task names registered/reserved in serving/ but missing from "
+              "the ARCHITECTURE.md task vocabulary table:")
+        for name in missing:
+            print(f"  {name}")
+        return 1
+    exact, suffixes = emitted_tasks()
+    print(f"ok: {len(exact)} task names (+{len(suffixes)} registration "
+          "families) all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
 """Tier-1 gate: the aggregate doc-gate runner (scripts/check_all.py) runs
-all five surface checks and fails when ANY of them does — one command is
+all six surface checks and fails when ANY of them does — one command is
 the whole pre-push story."""
 
 import importlib.util
@@ -25,13 +25,13 @@ def test_every_gate_passes():
 
 def test_covers_all_known_gates():
     # The aggregate must not silently drop a gate: the registry names all
-    # five known scanners, and each produced SOME output when run.
+    # six known scanners, and each produced SOME output when run.
     assert set(check_all.GATES) == {
         "check_knobs", "check_metrics", "check_meta_keys", "check_endpoints",
-        "check_events",
+        "check_events", "check_tasks",
     }
     _, results = check_all.run_all()
-    assert len(results) == 5
+    assert len(results) == 6
     for name, _rc, out in results:
         assert out.strip(), f"gate {name} produced no output"
 

@@ -1,0 +1,48 @@
+"""Tier-1 gate: every task name the serving layer registers (via
+``TaskDefinition``) or reserves (``*_TASK`` constants — the router's
+fleet-internal names) appears in the docs/ARCHITECTURE.md task
+vocabulary table, so the routing surface can't silently drift. See
+scripts/check_tasks.py."""
+
+import importlib.util
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "check_tasks",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "check_tasks.py"),
+)
+check_tasks = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(check_tasks)
+
+
+def test_every_task_name_is_documented():
+    missing = check_tasks.undocumented()
+    assert not missing, (
+        f"task names registered/reserved in serving/ but missing from the "
+        f"ARCHITECTURE.md task vocabulary table: {missing} — add a row for each"
+    )
+
+
+def test_scan_finds_known_names():
+    # Sanity that the scan sees through each pattern family — a regex typo
+    # must not turn the gate into a silent pass.
+    exact, suffixes = check_tasks.emitted_tasks()
+    assert "ocr" in exact                   # single-line literal
+    assert "vlm_generate_stream" in exact   # multi-line TaskDefinition site
+    assert "search_query" in exact          # name= bound to a CONST
+    assert "fed_kv_put" in exact            # reserved *_TASK constant
+    assert "_text_embed" in suffixes        # f-string name reduced to suffix
+
+
+def test_doc_table_is_parsed():
+    # The vocabulary table itself must be locatable — a doc refactor that
+    # renames the section heading should fail loudly, not pass vacuously.
+    doc = check_tasks.documented_tasks()
+    assert "face_detect_and_embed" in doc
+    assert "clip_text_embed" in doc         # an f-string family's concrete row
+    assert "fed_cache_lookup" in doc
+
+
+def test_gate_main_is_green():
+    assert check_tasks.main() == 0

@@ -1,0 +1,296 @@
+"""Task-graph ingest tests: stages as DAG nodes with declared inputs.
+
+What the DAG refactor must guarantee:
+
+- **construction-time validation** — duplicate names, unknown inputs,
+  mis-shaped nodes, and dependency cycles raise when the pipeline is
+  built, never mid-run;
+- **topological evaluation** — derived (host-side) nodes see their
+  declared inputs' settled values regardless of declaration order;
+- **cache semantics** — a ``cache_output=False`` side-effect node
+  (the embed→index edge) re-fires on cache-hit records with
+  ``decoded=None`` and never pollutes the cached value;
+- **content fingerprinting** — byte items surface ``_sha256`` and
+  in-run repeats count as ``duplicates``;
+- **concurrent captions** — the bounded caption fan-out overlaps
+  submissions while preserving the record-don't-abort error contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from lumen_tpu.pipeline import IngestPipeline, PhotoIngestPipeline, Stage
+from lumen_tpu.pipeline.ingest import _build_graph
+from lumen_tpu.runtime.mesh import build_mesh
+from tests.clip_fixtures import make_clip_model_dir, png_bytes
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh({"data": -1})
+
+
+pytestmark = pytest.mark.multichip
+
+
+def _source(name: str, scale: float = 2.0) -> Stage:
+    import jax
+
+    return Stage(
+        name=name,
+        preprocess=lambda item: np.array([item], np.float32),
+        device_fn=jax.jit(lambda x, s=scale: x * s),
+        postprocess=lambda decoded, row: float(row[0]),
+    )
+
+
+class TestGraphValidation:
+    def test_duplicate_names_raise(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            _build_graph([_source("a"), _source("a")])
+
+    def test_unknown_input_raises(self):
+        bad = Stage("b", postprocess=lambda d, deps: 0, inputs=("ghost",))
+        with pytest.raises(ValueError, match="unknown stage 'ghost'"):
+            _build_graph([_source("a"), bad])
+
+    def test_meta_inputs_are_always_known(self):
+        node = Stage("b", postprocess=lambda d, deps: 0, inputs=("_sha256",))
+        device, derived = _build_graph([_source("a"), node])
+        assert [s.name for s in device] == ["a"]
+        assert [s.name for s in derived] == ["b"]
+
+    def test_derived_node_must_not_carry_device_work(self):
+        bad = Stage(
+            "b",
+            preprocess=lambda item: item,
+            postprocess=lambda d, deps: 0,
+            inputs=("a",),
+        )
+        with pytest.raises(ValueError, match="must not set"):
+            _build_graph([_source("a"), bad])
+
+    def test_source_node_needs_both_halves(self):
+        with pytest.raises(ValueError, match="needs both"):
+            _build_graph([Stage("a", preprocess=lambda item: item)])
+
+    def test_cycle_raises(self):
+        x = Stage("x", postprocess=lambda d, deps: 0, inputs=("y",))
+        y = Stage("y", postprocess=lambda d, deps: 0, inputs=("x",))
+        with pytest.raises(ValueError, match="cycle"):
+            _build_graph([x, y])
+
+    def test_derived_topo_ignores_declaration_order(self):
+        # c <- b <- a declared backwards: topo order must still be b, c
+        c = Stage("c", postprocess=lambda d, deps: 0, inputs=("b",))
+        b = Stage("b", postprocess=lambda d, deps: 0, inputs=("a",))
+        device, derived = _build_graph([c, b, _source("a")])
+        assert [s.name for s in derived] == ["b", "c"]
+
+
+class TestDerivedEvaluation:
+    def test_chain_sees_settled_inputs(self, mesh):
+        plus1 = Stage(
+            "plus1", postprocess=lambda d, deps: deps["double"] + 1,
+            inputs=("double",),
+        )
+        squared = Stage(
+            "squared", postprocess=lambda d, deps: deps["plus1"] ** 2,
+            inputs=("plus1",),
+        )
+        # Declared out of order on purpose: topo sort, not list order.
+        pipe = IngestPipeline(
+            mesh, [squared, _source("double"), plus1], batch_size=8
+        )
+        records = pipe.run_all(range(6))
+        for i, rec in enumerate(records):
+            assert rec["double"] == 2.0 * i
+            assert rec["plus1"] == 2.0 * i + 1
+            assert rec["squared"] == (2.0 * i + 1) ** 2
+
+    def test_derived_node_gets_decoded_item_on_miss_path(self, mesh):
+        seen = []
+        probe = Stage(
+            "probe",
+            postprocess=lambda decoded, deps: seen.append(decoded) or True,
+            inputs=("double",),
+        )
+        IngestPipeline(mesh, [_source("double"), probe], batch_size=8).run_all(
+            range(3)
+        )
+        assert seen == [0, 1, 2]  # identity decode: the items themselves
+
+    def test_sha256_surfaces_and_duplicates_counted(self, mesh):
+        pipe = IngestPipeline(
+            mesh,
+            [_source("double")],
+            decode=lambda b: int.from_bytes(b, "big"),
+            batch_size=8,
+        )
+        a, b = (1).to_bytes(2, "big"), (2).to_bytes(2, "big")
+        records = pipe.run_all([a, b, a, a])
+        import hashlib
+
+        assert [r["_sha256"] for r in records] == [
+            hashlib.sha256(x).hexdigest() for x in (a, b, a, a)
+        ]
+        assert pipe.stats.duplicates == 2  # the two repeats of `a`
+        # Non-bytes items carry no fingerprint and count nothing.
+        plain = IngestPipeline(mesh, [_source("double")], batch_size=8)
+        recs = plain.run_all(range(4))
+        assert all("_sha256" not in r for r in recs)
+        assert plain.stats.duplicates == 0
+
+
+class TestSideEffectNodes:
+    @pytest.fixture()
+    def cache_on(self, monkeypatch):
+        from lumen_tpu.runtime import result_cache as rc
+
+        monkeypatch.setenv("LUMEN_CACHE_BYTES", str(32 * 1024 * 1024))
+        rc.reset_result_cache()
+        yield rc.get_result_cache()
+        rc.reset_result_cache()
+
+    def _pipe(self, mesh, sink_calls):
+        def sink(decoded, deps):
+            sink_calls.append((decoded, deps["double"], deps.get("_sha256")))
+            return "indexed"
+
+        return IngestPipeline(
+            mesh,
+            [
+                _source("double"),
+                Stage(
+                    "index", postprocess=sink,
+                    inputs=("double", "_sha256"), cache_output=False,
+                ),
+            ],
+            decode=lambda b: int.from_bytes(b, "big"),
+            batch_size=8,
+            cache_namespace="ingest/dag-test/m@1",
+        )
+
+    def test_side_effect_refires_on_cache_hits(self, cache_on, mesh):
+        sink_calls: list = []
+        pipe = self._pipe(mesh, sink_calls)
+        items = [int(i).to_bytes(2, "big") for i in range(10)]
+        cold = pipe.run_all(items)
+        assert len(sink_calls) == 10
+        assert all(r["index"] == "indexed" for r in cold)
+        # Cold pass: the sink saw the DECODED item and the settled value.
+        assert sink_calls[3][0] == 3 and sink_calls[3][1] == 6.0
+        assert sink_calls[3][2] is not None
+
+        warm = pipe.run_all(items)
+        assert pipe.stats.cache_hits == 10
+        # The side-effect node re-fired on every HIT record — with
+        # decoded=None (no decode happened) but the cached inputs intact.
+        assert len(sink_calls) == 20
+        assert sink_calls[13][0] is None and sink_calls[13][1] == 6.0
+        assert sink_calls[13][2] is not None
+        assert all(r["index"] == "indexed" for r in warm)
+
+    def test_side_effect_value_never_cached(self, cache_on, mesh):
+        sink_calls: list = []
+        pipe = self._pipe(mesh, sink_calls)
+        item = (7).to_bytes(2, "big")
+        pipe.run_all([item])
+        from lumen_tpu.runtime.result_cache import make_key
+
+        key = make_key(pipe.cache_namespace, pipe.cache_options, item)
+        found, rec = cache_on.get(key)
+        assert found
+        assert "index" not in rec and "_sha256" not in rec and "_index" not in rec
+        assert rec["double"] == 14.0
+
+
+class TestConcurrentCaptions:
+    def _clip(self, tmp_path_factory):
+        from lumen_tpu.models.clip import CLIPManager
+
+        clip_dir = make_clip_model_dir(tmp_path_factory.mktemp("dagclip"))
+        mgr = CLIPManager(clip_dir, dataset="Tiny", dtype="float32", batch_size=4)
+        mgr.initialize()
+        return mgr
+
+    def test_captions_overlap_and_record_errors(self, mesh, tmp_path_factory):
+        clip_mgr = self._clip(tmp_path_factory)
+
+        class GateVlm:
+            """generate() blocks until BOTH workers are inside — proof the
+            fan-out overlaps — and fails for one specific payload."""
+
+            mesh = None
+
+            def __init__(self):
+                self.gate = threading.Barrier(2, timeout=10)
+                self.lock = threading.Lock()
+                self.peak = 0
+                self.live = 0
+
+            def _ensure_ready(self):
+                pass
+
+            def generate(self, messages, image_bytes=None, max_new_tokens=0):
+                with self.lock:
+                    self.live += 1
+                    self.peak = max(self.peak, self.live)
+                try:
+                    self.gate.wait()  # serial submission would deadlock here
+                    if image_bytes == _POISON:
+                        raise RuntimeError("caption boom")
+                    return type("R", (), {"text": "a photo"})()
+                finally:
+                    with self.lock:
+                        self.live -= 1
+
+        _POISON = png_bytes(seed=1)
+        vlm = GateVlm()
+        try:
+            pipe = PhotoIngestPipeline(
+                mesh, clip=clip_mgr, vlm=vlm, caption=True,
+                batch_size=8, caption_workers=2,
+            )
+            items = [png_bytes(seed=0), _POISON, png_bytes(seed=2), png_bytes(seed=3)]
+            records = pipe.run_with_captions(items)
+            assert vlm.peak >= 2  # submissions genuinely overlapped
+            assert records[0].caption == "a photo"
+            assert records[1].caption is None
+            assert records[1].error and "caption boom" in records[1].error
+            assert records[2].caption == "a photo"
+            assert records[3].caption == "a photo"
+        finally:
+            clip_mgr.close()
+
+    def test_single_worker_stays_serial(self, mesh, tmp_path_factory):
+        clip_mgr = self._clip(tmp_path_factory)
+
+        class SerialVlm:
+            mesh = None
+            live = 0
+            peak = 0
+
+            def _ensure_ready(self):
+                pass
+
+            def generate(self, messages, image_bytes=None, max_new_tokens=0):
+                SerialVlm.live += 1
+                SerialVlm.peak = max(SerialVlm.peak, SerialVlm.live)
+                SerialVlm.live -= 1
+                return type("R", (), {"text": "ok"})()
+
+        try:
+            pipe = PhotoIngestPipeline(
+                mesh, clip=clip_mgr, vlm=SerialVlm(), caption=True,
+                batch_size=8, caption_workers=1,
+            )
+            records = pipe.run_with_captions([png_bytes(seed=i) for i in range(3)])
+            assert all(r.caption == "ok" for r in records)
+            assert SerialVlm.peak == 1
+        finally:
+            clip_mgr.close()

@@ -272,8 +272,13 @@ class TestPhotoCaptioning:
                 return type("R", (), {"text": "a photo"})()
 
         try:
+            # caption_workers=1 pins the serial path: the stub's call
+            # COUNTER decides which row fails, which only maps to row 1
+            # when submissions are ordered (the concurrent path's error
+            # contract is covered in test_ingest_dag.py).
             pipe = PhotoIngestPipeline(
-                mesh, clip=clip_mgr, vlm=StubVlm(), caption=True, batch_size=8
+                mesh, clip=clip_mgr, vlm=StubVlm(), caption=True, batch_size=8,
+                caption_workers=1,
             )
             records = pipe.run_with_captions([png_bytes(seed=i) for i in range(3)])
             assert [r.caption for r in records] == ["a photo", None, "a photo"]
